@@ -1,0 +1,2 @@
+# Root conftest: puts the repo root on sys.path so `escalator_tpu` imports
+# without installation. Test-only environment setup lives in tests/conftest.py.

@@ -1,0 +1,16 @@
+"""escalator-tpu: TPU-native rebuild of the Atlassian Escalator batch autoscaler.
+
+Layer map (mirrors SURVEY.md §1 of the reference, re-architected TPU-first):
+
+- ``escalator_tpu.core``       — typed cluster state, dense arrays, golden semantics
+- ``escalator_tpu.ops``        — batched JAX/XLA decision kernels
+- ``escalator_tpu.parallel``   — mesh sharding of the nodegroup axis (shard_map/pjit)
+- ``escalator_tpu.controller`` — the imperative controller shell (tick loop, executors)
+- ``escalator_tpu.k8s``        — k8s object model, listers, taint mechanics, election
+- ``escalator_tpu.cloudprovider`` — provider SPI + implementations
+- ``escalator_tpu.metrics``    — Prometheus metrics (same `escalator_*` names)
+- ``escalator_tpu.plugin``     — gRPC compute-plugin service wrapping the solver
+- ``escalator_tpu.testsupport``— fake cluster builders, mock providers
+"""
+
+__version__ = "0.1.0"

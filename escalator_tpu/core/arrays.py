@@ -1,0 +1,249 @@
+"""Dense structure-of-arrays cluster state — the host<->device boundary.
+
+The reference walks Go object graphs per nodegroup, serially
+(/root/reference/pkg/controller/controller.go:416-445, pkg/k8s/util.go:27-51). The TPU
+build instead packs the whole cluster into flat, fixed-shape arrays once per tick and
+evaluates *all* nodegroups in one device program:
+
+- pods:  flat ``[P]`` arrays tagged with a group id (segment-sum replaces the per-pod Go
+  loop at pkg/k8s/util.go:27-38);
+- nodes: flat ``[N]`` arrays tagged with a group id plus taint/cordon/no-delete flags and
+  creation/taint timestamps (replaces filterNodes at pkg/controller/controller.go:120-154
+  and the sort-based selection at pkg/controller/sort.go);
+- groups: ``[G]`` config+state vectors.
+
+Shapes are padded to caller-chosen capacities so jit traces once (no recompilation storms
+as cluster size fluctuates — SURVEY.md §7 "raggedness"). Padding entries carry
+``valid=False`` and are masked inside the kernel.
+
+All quantities are int64 (cpu milli-cores, memory bytes, unix nanoseconds). The decision
+percent math is float64 for bit-parity with the reference's Go float64 math — on TPU
+these are tiny ``[G]``-shaped ops, so f64 emulation costs nothing next to the ``[P]``
+segment sums, which stay integer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from escalator_tpu.core import semantics
+from escalator_tpu.k8s import types as k8s
+
+#: Sentinel for "no taint timestamp" in node_taint_time_sec.
+NO_TAINT_TIME = np.int64(-(2**62))
+
+
+@dataclass
+class GroupArrays:
+    """Per-nodegroup config + cross-tick state, ``[G]``-shaped."""
+
+    min_nodes: np.ndarray          # int32
+    max_nodes: np.ndarray          # int32
+    taint_lower: np.ndarray        # int32
+    taint_upper: np.ndarray        # int32
+    scale_up_thr: np.ndarray       # int32
+    slow_rate: np.ndarray          # int32
+    fast_rate: np.ndarray          # int32
+    locked: np.ndarray             # bool
+    requested_nodes: np.ndarray    # int32
+    cached_cpu_milli: np.ndarray   # int64
+    cached_mem_bytes: np.ndarray   # int64
+    soft_grace_sec: np.ndarray     # int64
+    hard_grace_sec: np.ndarray     # int64
+    valid: np.ndarray              # bool
+
+
+@dataclass
+class PodArrays:
+    """Flat pod state, ``[P]``-shaped. Pods are pre-filtered per group the way the
+    reference's filtered listers are (pkg/controller/node_group.go:218-275), so
+    daemonset/static/other-group pods never enter these arrays for a group."""
+
+    group: np.ndarray        # int32
+    cpu_milli: np.ndarray    # int64 (computed pod resource request)
+    mem_bytes: np.ndarray    # int64
+    node: np.ndarray         # int32 global node index, -1 if unscheduled/unknown
+    valid: np.ndarray        # bool
+
+
+@dataclass
+class NodeArrays:
+    """Flat node state, ``[N]``-shaped."""
+
+    group: np.ndarray           # int32
+    cpu_milli: np.ndarray       # int64 allocatable
+    mem_bytes: np.ndarray       # int64 allocatable
+    creation_ns: np.ndarray     # int64
+    tainted: np.ndarray         # bool (dry-mode packing maps the taint tracker here)
+    cordoned: np.ndarray        # bool
+    no_delete: np.ndarray       # bool (atlassian.com/no-delete annotation non-empty)
+    taint_time_sec: np.ndarray  # int64, NO_TAINT_TIME if absent/unparseable
+    valid: np.ndarray           # bool
+
+
+@dataclass
+class ClusterArrays:
+    groups: GroupArrays
+    pods: PodArrays
+    nodes: NodeArrays
+
+    @property
+    def num_groups(self) -> int:
+        return int(self.groups.valid.shape[0])
+
+    def tree_flatten(self):
+        leaves = (
+            [getattr(self.groups, f.name) for f in fields(GroupArrays)]
+            + [getattr(self.pods, f.name) for f in fields(PodArrays)]
+            + [getattr(self.nodes, f.name) for f in fields(NodeArrays)]
+        )
+        return leaves, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        ng = len(fields(GroupArrays))
+        npd = len(fields(PodArrays))
+        g = GroupArrays(*leaves[:ng])
+        p = PodArrays(*leaves[ng : ng + npd])
+        n = NodeArrays(*leaves[ng + npd :])
+        return cls(g, p, n)
+
+
+def _pad_to(n: int, pad: Optional[int]) -> int:
+    if pad is None:
+        return max(n, 1)
+    if pad < n:
+        raise ValueError(f"padded capacity {pad} < actual size {n}")
+    return max(pad, 1)
+
+
+def pack_cluster(
+    group_inputs: Sequence[
+        Tuple[
+            Sequence[k8s.Pod],
+            Sequence[k8s.Node],
+            semantics.GroupConfig,
+            semantics.GroupState,
+        ]
+    ],
+    dry_mode_flags: Optional[Sequence[bool]] = None,
+    taint_trackers: Optional[Sequence[Sequence[str]]] = None,
+    pad_pods: Optional[int] = None,
+    pad_nodes: Optional[int] = None,
+    pad_groups: Optional[int] = None,
+) -> ClusterArrays:
+    """Pack per-group object state into dense arrays.
+
+    Also refreshes each group's cached node capacity from its first listed node, the
+    way scaleNodeGroup does before computing (reference: controller.go:208-211) — that
+    cross-tick cache stays host-side state, mutated here.
+
+    In dry mode for a group, taint/cordon flags take the reference's dry-mode view:
+    membership of the in-memory taint tracker defines "tainted" and nothing is treated
+    as cordoned (reference: controller.go:126-138).
+    """
+    G = len(group_inputs)
+    GP = _pad_to(G, pad_groups)
+    total_pods = sum(len(p) for p, *_ in group_inputs)
+    total_nodes = sum(len(n) for _, n, *_ in group_inputs)
+    P = _pad_to(total_pods, pad_pods)
+    N = _pad_to(total_nodes, pad_nodes)
+
+    g = GroupArrays(
+        min_nodes=np.zeros(GP, np.int32),
+        max_nodes=np.zeros(GP, np.int32),
+        taint_lower=np.zeros(GP, np.int32),
+        taint_upper=np.zeros(GP, np.int32),
+        scale_up_thr=np.ones(GP, np.int32),  # avoid /0 on padding lanes
+        slow_rate=np.zeros(GP, np.int32),
+        fast_rate=np.zeros(GP, np.int32),
+        locked=np.zeros(GP, bool),
+        requested_nodes=np.zeros(GP, np.int32),
+        cached_cpu_milli=np.zeros(GP, np.int64),
+        cached_mem_bytes=np.zeros(GP, np.int64),
+        soft_grace_sec=np.zeros(GP, np.int64),
+        hard_grace_sec=np.zeros(GP, np.int64),
+        valid=np.zeros(GP, bool),
+    )
+    p = PodArrays(
+        group=np.zeros(P, np.int32),
+        cpu_milli=np.zeros(P, np.int64),
+        mem_bytes=np.zeros(P, np.int64),
+        node=np.full(P, -1, np.int32),
+        valid=np.zeros(P, bool),
+    )
+    n = NodeArrays(
+        group=np.zeros(N, np.int32),
+        cpu_milli=np.zeros(N, np.int64),
+        mem_bytes=np.zeros(N, np.int64),
+        creation_ns=np.zeros(N, np.int64),
+        tainted=np.zeros(N, bool),
+        cordoned=np.zeros(N, bool),
+        no_delete=np.zeros(N, bool),
+        taint_time_sec=np.full(N, NO_TAINT_TIME, np.int64),
+        valid=np.zeros(N, bool),
+    )
+
+    pi = 0
+    ni = 0
+    for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+        dry = bool(dry_mode_flags[gi]) if dry_mode_flags is not None else False
+        tracker = set(taint_trackers[gi]) if taint_trackers is not None else set()
+
+        if nodes:
+            state.cached_cpu_milli = nodes[0].cpu_allocatable_milli
+            state.cached_mem_bytes = nodes[0].mem_allocatable_bytes
+
+        g.min_nodes[gi] = config.min_nodes
+        g.max_nodes[gi] = config.max_nodes
+        g.taint_lower[gi] = config.taint_lower_percent
+        g.taint_upper[gi] = config.taint_upper_percent
+        g.scale_up_thr[gi] = config.scale_up_percent
+        g.slow_rate[gi] = config.slow_removal_rate
+        g.fast_rate[gi] = config.fast_removal_rate
+        g.locked[gi] = state.locked
+        g.requested_nodes[gi] = state.requested_nodes
+        g.cached_cpu_milli[gi] = state.cached_cpu_milli
+        g.cached_mem_bytes[gi] = state.cached_mem_bytes
+        g.soft_grace_sec[gi] = config.soft_delete_grace_sec
+        g.hard_grace_sec[gi] = config.hard_delete_grace_sec
+        g.valid[gi] = True
+
+        node_index = {}
+        for node in nodes:
+            n.group[ni] = gi
+            n.cpu_milli[ni] = node.cpu_allocatable_milli
+            n.mem_bytes[ni] = node.mem_allocatable_bytes
+            n.creation_ns[ni] = node.creation_time_ns
+            taint = k8s.get_to_be_removed_taint(node)
+            if dry:
+                n.tainted[ni] = node.name in tracker
+                n.cordoned[ni] = False
+            else:
+                n.tainted[ni] = taint is not None
+                n.cordoned[ni] = node.unschedulable
+            n.no_delete[ni] = bool(
+                node.annotations.get(k8s.NODE_ESCALATOR_IGNORE_ANNOTATION)
+            )
+            if taint is not None:
+                try:
+                    n.taint_time_sec[ni] = int(taint.value)
+                except ValueError:
+                    pass
+            n.valid[ni] = True
+            node_index[node.name] = ni
+            ni += 1
+
+        for pod in pods:
+            req = k8s.compute_pod_resource_request(pod)
+            p.group[pi] = gi
+            p.cpu_milli[pi] = req.cpu_milli
+            p.mem_bytes[pi] = req.mem_bytes
+            p.node[pi] = node_index.get(pod.node_name, -1)
+            p.valid[pi] = True
+            pi += 1
+
+    return ClusterArrays(groups=g, pods=p, nodes=n)

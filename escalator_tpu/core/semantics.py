@@ -1,0 +1,374 @@
+"""Golden (pure-Python) reference semantics of the scale decision.
+
+This module reproduces, bit-for-bit in IEEE float64, the per-nodegroup decision math of
+the reference controller:
+
+- percent usage (reference: /root/reference/pkg/controller/util.go:58-81), including the
+  all-zero fast path and the math.MaxFloat64 scale-up-from-zero sentinel;
+- scale-up delta (reference: pkg/controller/util.go:13-46), both the normal
+  ``ceil(nodeCount*(percent-threshold)/threshold)`` case and the scale-from-zero case
+  using cached per-node capacity;
+- the full decision switch of ``scaleNodeGroup``
+  (reference: pkg/controller/controller.go:192-397): bounds checks, forced min scale-up,
+  scale lock, threshold dispatch;
+- scale-down victim selection / untaint ordering (reference: pkg/controller/sort.go,
+  scale_up.go:118-163, scale_down.go:171-205) and the reaper eligibility rule
+  (reference: pkg/controller/scale_down.go:51-99).
+
+It is the parity contract for the batched JAX kernel (`escalator_tpu.ops.kernel`): the
+kernel's outputs are tested element-wise against this module on randomized and golden
+inputs. Keep this module dependency-free (stdlib only) so it can run anywhere as the CPU
+fallback of last resort.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from escalator_tpu.k8s import types as k8s
+
+# Go's math.MaxFloat64 — used as the scale-up-from-zero sentinel
+# (reference: pkg/controller/util.go:71-73).
+MAX_FLOAT64 = 1.7976931348623157e308
+
+# Scale-up deltas are clamped to int32 range (the executor re-clamps to max_nodes
+# anyway; only inputs describing >2^31 nodes could ever notice). Keeps the golden
+# model and the int32 device kernel in exact agreement.
+MAX_DELTA = 2**31 - 1
+
+
+class DecisionStatus(enum.IntEnum):
+    """Terminal state of one nodegroup evaluation. Mirrors the control-flow exits of
+    scaleNodeGroup (reference: pkg/controller/controller.go:192-397)."""
+
+    OK = 0                    # normal path: nodes_delta holds the decision
+    NOOP_EMPTY = 1            # 0 nodes and 0 pods -> do nothing (controller.go:233-236)
+    ERR_BELOW_MIN = 2         # node count < min (controller.go:238-246)
+    ERR_ABOVE_MAX = 3         # node count > max (controller.go:247-255)
+    FORCED_MIN_SCALE_UP = 4   # untainted < min -> immediate scale up (controller.go:281-294)
+    LOCKED = 5                # scale lock held -> return requested nodes (controller.go:317-323)
+    ERR_DIV_ZERO = 6          # zero capacity with >0 untainted nodes (util.go:75)
+    ERR_NEG_DELTA = 7         # negative scale-up delta (util.go:42-44)
+
+
+@dataclass
+class GroupConfig:
+    """Per-nodegroup decision inputs that come from configuration.
+    Mirrors the fields of NodeGroupOptions the decision math reads
+    (reference: pkg/controller/node_group.go:20-52)."""
+
+    min_nodes: int = 0
+    max_nodes: int = 0
+    taint_lower_percent: int = 0
+    taint_upper_percent: int = 0
+    scale_up_percent: int = 0
+    slow_removal_rate: int = 0
+    fast_removal_rate: int = 0
+    soft_delete_grace_sec: int = 0
+    hard_delete_grace_sec: int = 0
+
+
+@dataclass
+class GroupState:
+    """Cross-tick mutable state the decision reads.
+    Mirrors NodeGroupState (reference: pkg/controller/controller.go:28-44)."""
+
+    locked: bool = False
+    requested_nodes: int = 0
+    cached_cpu_milli: int = 0     # cached per-node cpu allocatable (controller.go:208-211)
+    cached_mem_bytes: int = 0
+
+
+@dataclass
+class Decision:
+    status: DecisionStatus
+    nodes_delta: int = 0          # the value scaleNodeGroup would compute (pre-execution)
+    cpu_percent: float = 0.0
+    mem_percent: float = 0.0
+    # Aggregates, for metrics parity (controller.go:275-278)
+    cpu_request_milli: int = 0
+    mem_request_bytes: int = 0
+    cpu_capacity_milli: int = 0
+    mem_capacity_bytes: int = 0
+    num_untainted: int = 0
+    num_tainted: int = 0
+    num_cordoned: int = 0
+
+
+def calc_percent_usage(
+    cpu_request_milli: int,
+    mem_request_milli: int,
+    cpu_capacity_milli: int,
+    mem_capacity_milli: int,
+    num_untainted_nodes: int,
+) -> Tuple[float, float]:
+    """Percent usage for cpu+mem (reference: pkg/controller/util.go:58-81).
+
+    Raises ZeroDivisionError where the reference returns the divide-by-zero error.
+    NOTE: arguments are *milli* values (memory milli = bytes*1000) so the float64
+    rounding matches the reference exactly.
+    """
+    if (
+        cpu_request_milli == 0
+        and mem_request_milli == 0
+        and cpu_capacity_milli == 0
+        and mem_capacity_milli == 0
+        and num_untainted_nodes == 0
+    ):
+        return 0.0, 0.0
+
+    if cpu_capacity_milli == 0 or mem_capacity_milli == 0:
+        if num_untainted_nodes == 0:
+            return MAX_FLOAT64, MAX_FLOAT64
+        raise ZeroDivisionError("cannot divide by zero in percent calculation")
+
+    cpu_percent = float(cpu_request_milli) / float(cpu_capacity_milli) * 100
+    mem_percent = float(mem_request_milli) / float(mem_capacity_milli) * 100
+    return cpu_percent, mem_percent
+
+
+def calc_scale_up_delta(
+    num_untainted_nodes: int,
+    cpu_percent: float,
+    mem_percent: float,
+    cpu_request_milli: int,
+    mem_request_milli: int,
+    cached_cpu_milli: int,
+    cached_mem_milli: int,
+    scale_up_threshold_percent: int,
+) -> int:
+    """Nodes to add so util drops below the threshold
+    (reference: pkg/controller/util.go:13-46).
+
+    Raises ValueError for a negative delta (the reference's error path) and for a
+    non-positive threshold (the reference can never reach this code with one —
+    ValidateNodeGroup rejects it at startup, pkg/controller/node_group.go:96 — and
+    its float math would otherwise produce machine-dependent garbage; we fail
+    deterministically instead). Memory arguments are milli values (bytes*1000) for
+    float64 parity. The result is clamped to MAX_DELTA (int32) to match the device
+    kernel; the executor clamps to max_nodes regardless.
+    """
+    if scale_up_threshold_percent <= 0:
+        raise ValueError("non-positive scale up threshold")
+    threshold = float(scale_up_threshold_percent)
+
+    if cpu_percent == MAX_FLOAT64 or mem_percent == MAX_FLOAT64:
+        # Scale up from zero. Without cached capacity, add one node to learn it.
+        if cached_cpu_milli == 0 or cached_mem_milli == 0:
+            return 1
+        nodes_needed_cpu = math.ceil(
+            float(cpu_request_milli) / float(cached_cpu_milli) / threshold * 100
+        )
+        nodes_needed_mem = math.ceil(
+            float(mem_request_milli) / float(cached_mem_milli) / threshold * 100
+        )
+    else:
+        pct_needed_cpu = (cpu_percent - threshold) / threshold
+        pct_needed_mem = (mem_percent - threshold) / threshold
+        nodes_needed_cpu = math.ceil(float(num_untainted_nodes) * pct_needed_cpu)
+        nodes_needed_mem = math.ceil(float(num_untainted_nodes) * pct_needed_mem)
+
+    delta = int(max(nodes_needed_cpu, nodes_needed_mem))
+    if delta < 0:
+        raise ValueError("negative scale up delta")
+    return min(delta, MAX_DELTA)
+
+
+# ---------------------------------------------------------------------------
+# Node filtering (reference: pkg/controller/controller.go:120-154)
+# ---------------------------------------------------------------------------
+
+
+def filter_nodes(
+    nodes: Sequence[k8s.Node],
+    dry_mode: bool = False,
+    taint_tracker: Optional[Sequence[str]] = None,
+) -> Tuple[List[k8s.Node], List[k8s.Node], List[k8s.Node]]:
+    """Split nodes into (untainted, tainted, cordoned).
+
+    In dry mode the in-memory taint tracker substitutes for real taints and cordoned
+    nodes are NOT separated (reference: controller.go:126-138 — the dry-mode branch
+    never checks Unschedulable).
+    """
+    untainted: List[k8s.Node] = []
+    tainted: List[k8s.Node] = []
+    cordoned: List[k8s.Node] = []
+    tracker = set(taint_tracker or ())
+    for node in nodes:
+        if dry_mode:
+            if node.name in tracker:
+                tainted.append(node)
+            else:
+                untainted.append(node)
+        else:
+            if node.unschedulable:
+                cordoned.append(node)
+                continue
+            if k8s.get_to_be_removed_taint(node) is None:
+                untainted.append(node)
+            else:
+                tainted.append(node)
+    return untainted, tainted, cordoned
+
+
+# ---------------------------------------------------------------------------
+# Full per-group decision (reference: pkg/controller/controller.go:192-397)
+# ---------------------------------------------------------------------------
+
+
+def evaluate_node_group(
+    pods: Sequence[k8s.Pod],
+    nodes: Sequence[k8s.Node],
+    config: GroupConfig,
+    state: GroupState,
+    dry_mode: bool = False,
+    taint_tracker: Optional[Sequence[str]] = None,
+) -> Decision:
+    """Pure decision part of scaleNodeGroup: everything between the lister reads and
+    the ScaleUp/ScaleDown dispatch. Mutates ``state.cached_*`` the way the reference
+    caches node capacity (controller.go:208-211)."""
+    pods = list(pods)
+    nodes = list(nodes)
+
+    if nodes:
+        state.cached_cpu_milli = nodes[0].cpu_allocatable_milli
+        state.cached_mem_bytes = nodes[0].mem_allocatable_bytes
+
+    untainted, tainted, cordoned = filter_nodes(nodes, dry_mode, taint_tracker)
+
+    base = dict(
+        num_untainted=len(untainted),
+        num_tainted=len(tainted),
+        num_cordoned=len(cordoned),
+    )
+
+    if len(nodes) == 0 and len(pods) == 0:
+        return Decision(DecisionStatus.NOOP_EMPTY, **base)
+    if len(nodes) < config.min_nodes:
+        return Decision(DecisionStatus.ERR_BELOW_MIN, **base)
+    if len(nodes) > config.max_nodes:
+        return Decision(DecisionStatus.ERR_ABOVE_MAX, **base)
+
+    mem_request, cpu_request = k8s.calculate_pods_requests_total(pods)
+    mem_capacity, cpu_capacity = k8s.calculate_nodes_capacity_total(untainted)
+    base.update(
+        cpu_request_milli=cpu_request,
+        mem_request_bytes=mem_request,
+        cpu_capacity_milli=cpu_capacity,
+        mem_capacity_bytes=mem_capacity,
+    )
+
+    if len(untainted) < config.min_nodes:
+        return Decision(
+            DecisionStatus.FORCED_MIN_SCALE_UP,
+            nodes_delta=config.min_nodes - len(untainted),
+            **base,
+        )
+
+    try:
+        cpu_percent, mem_percent = calc_percent_usage(
+            cpu_request, mem_request * 1000, cpu_capacity, mem_capacity * 1000,
+            len(untainted),
+        )
+    except ZeroDivisionError:
+        return Decision(DecisionStatus.ERR_DIV_ZERO, **base)
+    base.update(cpu_percent=cpu_percent, mem_percent=mem_percent)
+
+    if state.locked:
+        return Decision(DecisionStatus.LOCKED, nodes_delta=state.requested_nodes, **base)
+
+    max_percent = max(cpu_percent, mem_percent)
+    nodes_delta = 0
+    if max_percent < float(config.taint_lower_percent):
+        nodes_delta = -config.fast_removal_rate
+    elif max_percent < float(config.taint_upper_percent):
+        nodes_delta = -config.slow_removal_rate
+    elif max_percent > float(config.scale_up_percent):
+        try:
+            nodes_delta = calc_scale_up_delta(
+                len(untainted),
+                cpu_percent,
+                mem_percent,
+                cpu_request,
+                mem_request * 1000,
+                state.cached_cpu_milli,
+                state.cached_mem_bytes * 1000,
+                config.scale_up_percent,
+            )
+        except ValueError:
+            return Decision(DecisionStatus.ERR_NEG_DELTA, **base)
+
+    return Decision(DecisionStatus.OK, nodes_delta=nodes_delta, **base)
+
+
+# ---------------------------------------------------------------------------
+# Ordering / selection (reference: pkg/controller/sort.go, scale_up.go, scale_down.go)
+# ---------------------------------------------------------------------------
+
+
+def nodes_oldest_first(nodes: Sequence[k8s.Node]) -> List[int]:
+    """Indices of nodes ordered oldest creation time first — scale-down victim order
+    (reference: pkg/controller/sort.go:12-24). Ties break by input index, making the
+    order deterministic (the reference uses an unstable sort; order under exact-tie
+    timestamps is unspecified there)."""
+    return sorted(range(len(nodes)), key=lambda i: (nodes[i].creation_time_ns, i))
+
+
+def nodes_newest_first(nodes: Sequence[k8s.Node]) -> List[int]:
+    """Indices of nodes ordered newest creation time first — untaint order
+    (reference: pkg/controller/sort.go:27-39)."""
+    return sorted(range(len(nodes)), key=lambda i: (-nodes[i].creation_time_ns, i))
+
+
+def reap_eligible(
+    tainted_nodes: Sequence[k8s.Node],
+    node_info_map: Dict[str, Tuple[Optional[k8s.Node], List[k8s.Pod]]],
+    soft_grace_sec: int,
+    hard_grace_sec: int,
+    now_unix_sec: int,
+) -> List[int]:
+    """Indices of tainted nodes eligible for deletion this tick
+    (reference: pkg/controller/scale_down.go:51-99):
+    not annotated no-delete, taint timestamp readable, past the soft grace period AND
+    (empty of non-daemonset pods OR past the hard grace period). Comparisons are
+    strict ``>`` as in the reference."""
+    out: List[int] = []
+    for i, node in enumerate(tainted_nodes):
+        if node.annotations.get(k8s.NODE_ESCALATOR_IGNORE_ANNOTATION):
+            continue
+        try:
+            tainted_time = k8s.get_to_be_removed_time(node)
+        except ValueError:
+            continue
+        if tainted_time is None:
+            continue
+        age = now_unix_sec - tainted_time
+        if age > soft_grace_sec and (
+            k8s.node_empty(node, node_info_map) or age > hard_grace_sec
+        ):
+            out.append(i)
+    return out
+
+
+def clamp_scale_down(num_untainted: int, nodes_to_remove: int, min_nodes: int) -> int:
+    """Clamp a scale-down so untainted-after >= min
+    (reference: pkg/controller/scale_down.go:143-158). Returns the clamped count;
+    raises ValueError when untainted is already below min (the reference's abort)."""
+    if num_untainted - nodes_to_remove < min_nodes:
+        nodes_to_remove = num_untainted - min_nodes
+        if nodes_to_remove < 0:
+            raise ValueError(
+                "the number of nodes is less than specified minimum; taking no action"
+            )
+    return nodes_to_remove
+
+
+def calculate_nodes_to_add(nodes_to_add: int, target_size: int, max_nodes: int) -> int:
+    """Clamp a provider scale-up to the group max
+    (reference: pkg/controller/scale_up.go:48-55)."""
+    if target_size + nodes_to_add > max_nodes:
+        nodes_to_add = max_nodes - target_size
+    return nodes_to_add

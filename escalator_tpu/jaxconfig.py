@@ -1,0 +1,21 @@
+"""JAX global configuration for exact-parity arithmetic.
+
+The decision math must match the reference's Go float64/int64 semantics bit-for-bit
+(SURVEY.md §7 "bit-exact parity"). JAX defaults to 32-bit; we enable x64 once, before
+any kernel is traced. The f64 work is tiny ([num_groups]-shaped scalars) — the heavy
+[num_pods] segment sums stay integer — so TPU f64 emulation cost is negligible here.
+"""
+
+from __future__ import annotations
+
+_configured = False
+
+
+def ensure_x64() -> None:
+    global _configured
+    if _configured:
+        return
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    _configured = True

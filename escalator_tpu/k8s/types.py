@@ -1,0 +1,242 @@
+"""Kubernetes object model (framework-local, no client-go / kubernetes package needed).
+
+This is the data model the controller shell and the decision kernels share. It is a
+deliberately small, typed mirror of the slices of the k8s API the reference consumes:
+
+- pod resource-request semantics (reference: /root/reference/pkg/k8s/scheduler/types.go:72-89):
+  sum of container requests, elementwise max against each init container, plus overhead.
+- pod classification (reference: /root/reference/pkg/k8s/util.go:11-24): daemonset by
+  owner-reference kind, static by `kubernetes.io/config.source=file` annotation.
+- node taint scheme (reference: /root/reference/pkg/k8s/taint.go:15-32): key
+  `atlassian.com/escalator`, value = tainting unix timestamp, effect NoSchedule default.
+
+CPU is carried in milli-cores (int), memory in bytes (int) — the same canonical units the
+reference's `resource.Quantity` usage boils down to (pkg/k8s/resource/quantity.go:7-17:
+memory = BinarySI bytes, cpu = DecimalSI milli). `MilliValue()` of a memory quantity is
+bytes*1000; where the reference's float64 math uses milli values we multiply by 1000 at
+that call-site so rounding matches bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# Taint key the autoscaler uses to mark nodes for removal
+# (reference: pkg/k8s/taint.go:29-32).
+TO_BE_REMOVED_BY_AUTOSCALER_KEY = "atlassian.com/escalator"
+
+# Annotation marking a node as never-delete (reference: pkg/controller/scale_down.go:15-20).
+NODE_ESCALATOR_IGNORE_ANNOTATION = "atlassian.com/no-delete"
+
+# Annotation marking a static (file-sourced) pod (reference: pkg/k8s/util.go:21-24).
+STATIC_POD_ANNOTATION = "kubernetes.io/config.source"
+
+
+class TaintEffect(str, enum.Enum):
+    NO_SCHEDULE = "NoSchedule"
+    NO_EXECUTE = "NoExecute"
+    PREFER_NO_SCHEDULE = "PreferNoSchedule"
+
+
+#: Valid taint effects (reference: pkg/k8s/taint.go:23-27).
+TAINT_EFFECT_TYPES = {e.value for e in TaintEffect}
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = TaintEffect.NO_SCHEDULE.value
+
+
+@dataclass
+class ResourceRequests:
+    """Per-container resource requests. cpu in milli-cores, memory in bytes."""
+
+    cpu_milli: int = 0
+    mem_bytes: int = 0
+
+
+class NodeSelectorOperator(str, enum.Enum):
+    IN = "In"
+    NOT_IN = "NotIn"
+    EXISTS = "Exists"
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str
+    operator: str = NodeSelectorOperator.IN.value
+    values: Tuple[str, ...] = ()
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: Tuple[NodeSelectorRequirement, ...] = ()
+
+
+@dataclass
+class Affinity:
+    """Only the slices of affinity the reference inspects
+    (pkg/controller/node_group.go:206-275)."""
+
+    node_affinity_required_terms: Optional[Tuple[NodeSelectorTerm, ...]] = None
+    has_node_affinity: bool = False
+    has_pod_affinity: bool = False
+    has_pod_anti_affinity: bool = False
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    node_name: str = ""  # "" = pending / unscheduled
+    containers: List[ResourceRequests] = field(default_factory=list)
+    init_containers: List[ResourceRequests] = field(default_factory=list)
+    overhead: Optional[ResourceRequests] = None
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    owner_kind: str = ""  # e.g. "DaemonSet", "ReplicaSet"
+    annotations: Dict[str, str] = field(default_factory=dict)
+    # k8s phase; informer cache excludes Succeeded/Failed (pkg/k8s/cache.go:17)
+    phase: str = "Running"
+
+
+@dataclass
+class Node:
+    name: str
+    creation_time_ns: int = 0  # unix nanoseconds
+    cpu_allocatable_milli: int = 0
+    mem_allocatable_bytes: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False  # cordoned
+    provider_id: str = ""
+
+    def copy(self) -> "Node":
+        n = dataclasses.replace(self)
+        n.labels = dict(self.labels)
+        n.annotations = dict(self.annotations)
+        n.taints = [dataclasses.replace(t) for t in self.taints]
+        return n
+
+
+# ---------------------------------------------------------------------------
+# Pod classification (reference: pkg/k8s/util.go:11-24)
+# ---------------------------------------------------------------------------
+
+
+def pod_is_daemonset(pod: Pod) -> bool:
+    return pod.owner_kind == "DaemonSet"
+
+
+def pod_is_static(pod: Pod) -> bool:
+    return pod.annotations.get(STATIC_POD_ANNOTATION) == "file"
+
+
+# ---------------------------------------------------------------------------
+# Pod resource-request semantics (reference: pkg/k8s/scheduler/types.go:72-89)
+# ---------------------------------------------------------------------------
+
+
+def compute_pod_resource_request(pod: Pod) -> ResourceRequests:
+    """Sum container requests, take elementwise max vs each init container, add overhead."""
+    cpu = 0
+    mem = 0
+    for c in pod.containers:
+        cpu += c.cpu_milli
+        mem += c.mem_bytes
+    for ic in pod.init_containers:
+        cpu = max(cpu, ic.cpu_milli)
+        mem = max(mem, ic.mem_bytes)
+    if pod.overhead is not None:
+        cpu += pod.overhead.cpu_milli
+        mem += pod.overhead.mem_bytes
+    return ResourceRequests(cpu_milli=cpu, mem_bytes=mem)
+
+
+def calculate_pods_requests_total(pods: List[Pod]) -> Tuple[int, int]:
+    """Total (mem_bytes, cpu_milli) requested across pods
+    (reference: pkg/k8s/util.go:27-38)."""
+    mem = 0
+    cpu = 0
+    for pod in pods:
+        req = compute_pod_resource_request(pod)
+        mem += req.mem_bytes
+        cpu += req.cpu_milli
+    return mem, cpu
+
+
+def calculate_nodes_capacity_total(nodes: List[Node]) -> Tuple[int, int]:
+    """Total allocatable (mem_bytes, cpu_milli) across nodes
+    (reference: pkg/k8s/util.go:41-51)."""
+    mem = 0
+    cpu = 0
+    for node in nodes:
+        mem += node.mem_allocatable_bytes
+        cpu += node.cpu_allocatable_milli
+    return mem, cpu
+
+
+# ---------------------------------------------------------------------------
+# Taint inspection — pure parts (reference: pkg/k8s/taint.go:78-101)
+# ---------------------------------------------------------------------------
+
+
+def get_to_be_removed_taint(node: Node) -> Optional[Taint]:
+    for taint in node.taints:
+        if taint.key == TO_BE_REMOVED_BY_AUTOSCALER_KEY:
+            return taint
+    return None
+
+
+def get_to_be_removed_time(node: Node) -> Optional[int]:
+    """Unix seconds the node was tainted, or None. Raises ValueError on a
+    malformed timestamp value (reference returns an error there,
+    pkg/k8s/taint.go:91-101)."""
+    taint = get_to_be_removed_taint(node)
+    if taint is None:
+        return None
+    return int(taint.value)
+
+
+# ---------------------------------------------------------------------------
+# Node→pods map (reference: pkg/k8s/node_state.go:10-65)
+# ---------------------------------------------------------------------------
+
+
+def create_node_name_to_info_map(
+    pods: List[Pod], nodes: List[Node]
+) -> Dict[str, Tuple[Optional[Node], List[Pod]]]:
+    """Buckets pods by spec.nodeName, attaches nodes, drops entries with no node."""
+    info: Dict[str, Tuple[Optional[Node], List[Pod]]] = {}
+    for pod in pods:
+        entry = info.setdefault(pod.node_name, (None, []))
+        entry[1].append(pod)
+    for node in nodes:
+        existing = info.get(node.name)
+        if existing is None:
+            info[node.name] = (node, [])
+        else:
+            info[node.name] = (node, existing[1])
+    return {k: v for k, v in info.items() if v[0] is not None}
+
+
+def node_pods_remaining(
+    node: Node, info_map: Dict[str, Tuple[Optional[Node], List[Pod]]]
+) -> Tuple[int, bool]:
+    """Count of non-daemonset pods on the node; ok=False when the node is not
+    in the map (reference: pkg/k8s/node_state.go:48-65)."""
+    entry = info_map.get(node.name)
+    if entry is None:
+        return 0, False
+    return sum(1 for p in entry[1] if not pod_is_daemonset(p)), True
+
+
+def node_empty(node: Node, info_map: Dict[str, Tuple[Optional[Node], List[Pod]]]) -> bool:
+    remaining, ok = node_pods_remaining(node, info_map)
+    return ok and remaining == 0

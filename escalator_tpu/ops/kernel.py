@@ -1,0 +1,314 @@
+"""Batched scale-decision kernel: all nodegroups in one device program.
+
+This replaces the reference's serial per-nodegroup loop
+(/root/reference/pkg/controller/controller.go:416-445) and the O(pods) Go aggregation
+loops (pkg/k8s/util.go:27-51) with:
+
+- one integer segment-sum sweep over the flat pod array (requests per group),
+- one masked segment-sum sweep over the flat node array (capacity + counts per group),
+- vectorized float64 percent/delta math over the ``[G]`` group axis, bit-matching
+  calcPercentUsage (pkg/controller/util.go:58-81) and calcScaleUpDelta
+  (pkg/controller/util.go:13-46) including the math.MaxFloat64 scale-from-zero sentinel,
+- two stable device argsorts producing the scale-down (oldest-first,
+  pkg/controller/sort.go:12-24) and untaint (newest-first, sort.go:27-39) orders for
+  every group at once, segment-partitioned by offsets,
+- the reaper eligibility mask (pkg/controller/scale_down.go:51-99) via a per-node
+  pod-count segment sum.
+
+Everything is fixed-shape and branch-free (jnp.where/select), so XLA compiles a single
+fused program; jit caches on the padded shapes chosen by the packer
+(`escalator_tpu.core.arrays.pack_cluster`).
+
+Status codes mirror `escalator_tpu.core.semantics.DecisionStatus`, the golden model
+this kernel is parity-tested against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+from escalator_tpu.core.arrays import NO_TAINT_TIME, ClusterArrays, GroupArrays, NodeArrays, PodArrays
+from escalator_tpu.core.semantics import MAX_FLOAT64, DecisionStatus
+
+tree_util.register_pytree_node(
+    ClusterArrays, ClusterArrays.tree_flatten, ClusterArrays.tree_unflatten
+)
+
+
+@dataclass
+class DecisionArrays:
+    """Kernel outputs. ``[G]`` per-group decisions + ``[N]`` per-node selections."""
+
+    status: jnp.ndarray            # int32 [G] DecisionStatus codes
+    nodes_delta: jnp.ndarray       # int32 [G] the scaleNodeGroup decision value
+    cpu_percent: jnp.ndarray       # float64 [G]
+    mem_percent: jnp.ndarray       # float64 [G]
+    cpu_request_milli: jnp.ndarray   # int64 [G]
+    mem_request_bytes: jnp.ndarray   # int64 [G]
+    cpu_capacity_milli: jnp.ndarray  # int64 [G]
+    mem_capacity_bytes: jnp.ndarray  # int64 [G]
+    num_pods: jnp.ndarray          # int32 [G]
+    num_nodes: jnp.ndarray         # int32 [G]
+    num_untainted: jnp.ndarray     # int32 [G]
+    num_tainted: jnp.ndarray       # int32 [G]
+    num_cordoned: jnp.ndarray      # int32 [G]
+    # Node selections (global node indices):
+    # scale-down victims: untainted nodes ordered (group asc, creation asc); group g's
+    # victims occupy slots [untainted_offsets[g], untainted_offsets[g+1]).
+    scale_down_order: jnp.ndarray   # int32 [N]
+    untainted_offsets: jnp.ndarray  # int32 [G+1]
+    # untaint candidates: tainted nodes ordered (group asc, creation desc)
+    untaint_order: jnp.ndarray      # int32 [N]
+    tainted_offsets: jnp.ndarray    # int32 [G+1]
+    reap_mask: jnp.ndarray          # bool [N] eligible for deletion this tick
+    node_pods_remaining: jnp.ndarray  # int32 [N] non-daemonset pods per node
+
+    def tree_flatten(self):
+        return [getattr(self, f.name) for f in fields(self)], None
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+tree_util.register_pytree_node(
+    DecisionArrays, DecisionArrays.tree_flatten, DecisionArrays.tree_unflatten
+)
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+_F64 = jnp.float64
+
+
+def _segsum(values, segment_ids, num_segments):
+    return jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
+
+
+def _grouped_order(keys, selected, group, num_groups):
+    """Stable order of selected entries by (group asc, key asc); non-selected pushed
+    to the tail. Two stable argsorts compose to a lexicographic sort."""
+    perm1 = jnp.argsort(keys, stable=True)
+    major = jnp.where(selected, group.astype(_I64), jnp.int64(num_groups))
+    perm2 = jnp.argsort(major[perm1], stable=True)
+    return perm1[perm2].astype(_I32)
+
+
+def decide(cluster: ClusterArrays, now_sec: jnp.ndarray) -> DecisionArrays:
+    """Evaluate every nodegroup's scale decision. Pure; shapes static; jit-safe."""
+    g: GroupArrays = cluster.groups
+    p: PodArrays = cluster.pods
+    n: NodeArrays = cluster.nodes
+    G = g.valid.shape[0]
+
+    # ---- aggregation (replaces pkg/k8s/util.go:27-51 per-group loops) ----
+    pvalid = p.valid
+    pgroup = jnp.where(pvalid, p.group, 0)
+    pw = pvalid.astype(_I64)
+    cpu_req = _segsum(p.cpu_milli * pw, pgroup, G)
+    mem_req = _segsum(p.mem_bytes * pw, pgroup, G)
+    num_pods = _segsum(pw, pgroup, G).astype(_I32)
+
+    nvalid = n.valid
+    ngroup = jnp.where(nvalid, n.group, 0)
+    untainted_sel = nvalid & ~n.tainted & ~n.cordoned
+    tainted_sel = nvalid & n.tainted & ~n.cordoned
+    cordoned_sel = nvalid & n.cordoned
+
+    uw = untainted_sel.astype(_I64)
+    cpu_cap = _segsum(n.cpu_milli * uw, ngroup, G)
+    mem_cap = _segsum(n.mem_bytes * uw, ngroup, G)
+    num_nodes = _segsum(nvalid.astype(_I64), ngroup, G).astype(_I32)
+    num_untainted = _segsum(uw, ngroup, G).astype(_I32)
+    num_tainted = _segsum(tainted_sel.astype(_I64), ngroup, G).astype(_I32)
+    num_cordoned = _segsum(cordoned_sel.astype(_I64), ngroup, G).astype(_I32)
+
+    # ---- percent usage (pkg/controller/util.go:58-81) ----
+    # Memory percent uses MilliValue (= bytes*1000) in the reference; replicate the
+    # exact int64->float64 conversion order for bit-parity.
+    mem_req_milli = mem_req * 1000
+    mem_cap_milli = mem_cap * 1000
+    all_zero = (
+        (cpu_req == 0) & (mem_req_milli == 0) & (cpu_cap == 0) & (mem_cap_milli == 0)
+        & (num_untainted == 0)
+    )
+    zero_cap = (cpu_cap == 0) | (mem_cap_milli == 0)
+    from_zero = zero_cap & (num_untainted == 0) & ~all_zero
+    div_zero = zero_cap & (num_untainted > 0) & ~all_zero
+
+    safe_cpu_cap = jnp.where(cpu_cap == 0, 1, cpu_cap).astype(_F64)
+    safe_mem_cap = jnp.where(mem_cap_milli == 0, 1, mem_cap_milli).astype(_F64)
+    cpu_pct = jnp.where(
+        all_zero | div_zero,
+        0.0,
+        jnp.where(from_zero, MAX_FLOAT64, cpu_req.astype(_F64) / safe_cpu_cap * 100.0),
+    )
+    mem_pct = jnp.where(
+        all_zero | div_zero,
+        0.0,
+        jnp.where(
+            from_zero, MAX_FLOAT64, mem_req_milli.astype(_F64) / safe_mem_cap * 100.0
+        ),
+    )
+
+    # ---- scale-up delta (pkg/controller/util.go:13-46) ----
+    # A non-positive threshold can't occur on validated config (the reference's
+    # ValidateNodeGroup rejects it, node_group.go:96); guard anyway so NaN/Inf from
+    # /0 can never masquerade as a valid delta — it becomes ERR_NEG_DELTA, matching
+    # the golden model's deterministic ValueError.
+    bad_thr = g.scale_up_thr <= 0
+    thr = jnp.where(bad_thr, 1, g.scale_up_thr).astype(_F64)
+    cached_cpu = g.cached_cpu_milli
+    cached_mem_milli = g.cached_mem_bytes * 1000
+    no_cache = (cached_cpu == 0) | (cached_mem_milli == 0)
+    safe_cached_cpu = jnp.where(cached_cpu == 0, 1, cached_cpu).astype(_F64)
+    safe_cached_mem = jnp.where(cached_mem_milli == 0, 1, cached_mem_milli).astype(_F64)
+
+    fz_cpu = jnp.ceil(cpu_req.astype(_F64) / safe_cached_cpu / thr * 100.0)
+    fz_mem = jnp.ceil(mem_req_milli.astype(_F64) / safe_cached_mem / thr * 100.0)
+    nrm_cpu = jnp.ceil(num_untainted.astype(_F64) * (cpu_pct - thr) / thr)
+    nrm_mem = jnp.ceil(num_untainted.astype(_F64) * (mem_pct - thr) / thr)
+
+    needed = jnp.where(
+        from_zero,
+        jnp.where(no_cache, 1.0, jnp.maximum(fz_cpu, fz_mem)),
+        jnp.maximum(nrm_cpu, nrm_mem),
+    )
+    # Go: delta := int(math.Max(...)) — truncation toward zero of an integral float.
+    # Clamped to int32 like the golden model's MAX_DELTA (semantics.py).
+    up_delta = jnp.trunc(needed)
+    neg_delta = (up_delta < 0) | bad_thr
+
+    # ---- threshold switch (pkg/controller/controller.go:332-351) ----
+    max_pct = jnp.maximum(cpu_pct, mem_pct)
+    down_fast = max_pct < g.taint_lower.astype(_F64)
+    down_slow = ~down_fast & (max_pct < g.taint_upper.astype(_F64))
+    scale_up = ~down_fast & ~down_slow & (max_pct > g.scale_up_thr.astype(_F64))
+
+    switch_delta = jnp.where(
+        down_fast,
+        -g.fast_rate.astype(_I64),
+        jnp.where(
+            down_slow,
+            -g.slow_rate.astype(_I64),
+            jnp.where(
+                scale_up,
+                jnp.clip(up_delta, -(2.0**31), 2.0**31 - 1).astype(_I64),
+                0,
+            ),
+        ),
+    )
+
+    # ---- status priority cascade (exit order of controller.go:192-397) ----
+    empty = (num_nodes == 0) & (num_pods == 0)
+    below_min = num_nodes < g.min_nodes
+    above_max = num_nodes > g.max_nodes
+    forced_min = num_untainted < g.min_nodes
+    invalid = ~g.valid
+
+    conds = [
+        invalid | empty,
+        below_min,
+        above_max,
+        forced_min,
+        div_zero,
+        g.locked,
+        scale_up & neg_delta,
+    ]
+    status_choices = [
+        jnp.int32(DecisionStatus.NOOP_EMPTY),
+        jnp.int32(DecisionStatus.ERR_BELOW_MIN),
+        jnp.int32(DecisionStatus.ERR_ABOVE_MAX),
+        jnp.int32(DecisionStatus.FORCED_MIN_SCALE_UP),
+        jnp.int32(DecisionStatus.ERR_DIV_ZERO),
+        jnp.int32(DecisionStatus.LOCKED),
+        jnp.int32(DecisionStatus.ERR_NEG_DELTA),
+    ]
+    status = jnp.select(conds, status_choices, jnp.int32(DecisionStatus.OK))
+
+    zero32 = jnp.zeros((), _I32)
+    delta_choices = [
+        jnp.broadcast_to(zero32, status.shape),
+        jnp.broadcast_to(zero32, status.shape),
+        jnp.broadcast_to(zero32, status.shape),
+        (g.min_nodes - num_untainted).astype(_I32),
+        jnp.broadcast_to(zero32, status.shape),
+        g.requested_nodes,
+        jnp.broadcast_to(zero32, status.shape),
+    ]
+    nodes_delta = jnp.select(conds, delta_choices, switch_delta.astype(_I32))
+
+    # Percent outputs: statuses that exit before the percent calc report 0 (matches the
+    # metrics the reference would have emitted — none — represented as 0 here).
+    pct_computed = ~(invalid | empty | below_min | above_max | forced_min | div_zero)
+    cpu_pct_out = jnp.where(pct_computed, cpu_pct, 0.0)
+    mem_pct_out = jnp.where(pct_computed, mem_pct, 0.0)
+
+    # ---- selections (pkg/controller/sort.go; scale_up.go:118; scale_down.go:171) ----
+    scale_down_order = _grouped_order(n.creation_ns, untainted_sel, ngroup, G)
+    untaint_order = _grouped_order(-n.creation_ns, tainted_sel, ngroup, G)
+
+    def offsets(sel):
+        counts = _segsum(sel.astype(_I64), ngroup, G)
+        return jnp.concatenate(
+            [jnp.zeros(1, _I64), jnp.cumsum(counts)]
+        ).astype(_I32)
+
+    untainted_offsets = offsets(untainted_sel)
+    tainted_offsets = offsets(tainted_sel)
+
+    # ---- reaper eligibility (pkg/controller/scale_down.go:51-99) ----
+    N = n.valid.shape[0]
+    pod_node = jnp.where(pvalid & (p.node >= 0), p.node, 0)
+    pod_on_node_w = (
+        pvalid
+        & (p.node >= 0)
+        # a pod only counts for its own group's node-info map (the reference builds
+        # the map from group-filtered pod+node lists, pkg/controller/controller.go:259)
+        & (p.group == n.group[jnp.clip(p.node, 0, N - 1)])
+    ).astype(_I64)
+    node_pods_remaining = _segsum(pod_on_node_w, pod_node, N).astype(_I32)
+
+    has_tt = n.taint_time_sec != NO_TAINT_TIME
+    age = now_sec.astype(_I64) - n.taint_time_sec
+    reap_mask = (
+        tainted_sel
+        & ~n.no_delete
+        & has_tt
+        & (age > g.soft_grace_sec[ngroup])
+        & ((node_pods_remaining == 0) | (age > g.hard_grace_sec[ngroup]))
+    )
+
+    return DecisionArrays(
+        status=status,
+        nodes_delta=nodes_delta,
+        cpu_percent=cpu_pct_out,
+        mem_percent=mem_pct_out,
+        cpu_request_milli=cpu_req,
+        mem_request_bytes=mem_req,
+        cpu_capacity_milli=cpu_cap,
+        mem_capacity_bytes=mem_cap,
+        num_pods=num_pods,
+        num_nodes=num_nodes,
+        num_untainted=num_untainted,
+        num_tainted=num_tainted,
+        num_cordoned=num_cordoned,
+        scale_down_order=scale_down_order,
+        untainted_offsets=untainted_offsets,
+        untaint_order=untaint_order,
+        tainted_offsets=tainted_offsets,
+        reap_mask=reap_mask,
+        node_pods_remaining=node_pods_remaining,
+    )
+
+
+#: jitted entry point; backend chosen by JAX (TPU when present, else CPU) — the CPU
+#: fallback is the same traced program, keeping parity guarantees cheap (SURVEY.md §7).
+decide_jit = jax.jit(decide)

@@ -1,0 +1,138 @@
+"""Test object builders — the framework's equivalent of the reference's fake-cluster
+generator (/root/reference/pkg/test/builder.go:104-296). Used by the test suite and the
+benchmark harness to synthesize clusters of arbitrary size."""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from escalator_tpu.k8s import types as k8s
+
+_counter = itertools.count()
+
+
+@dataclass
+class NodeOpts:
+    name: str = ""
+    cpu: int = 0              # allocatable cpu milli
+    mem: int = 0              # allocatable memory bytes
+    label_key: str = "customer"
+    label_value: str = "buildeng"
+    creation_time_ns: int = 0
+    tainted: bool = False
+    taint_time_sec: Optional[int] = None
+    cordoned: bool = False
+    no_delete: bool = False
+
+
+def build_test_node(opts: NodeOpts) -> k8s.Node:
+    name = opts.name or f"n{next(_counter)}"
+    taints: List[k8s.Taint] = []
+    if opts.tainted:
+        ts = opts.taint_time_sec if opts.taint_time_sec is not None else int(time.time())
+        taints.append(
+            k8s.Taint(key=k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY, value=str(ts))
+        )
+    annotations = {}
+    if opts.no_delete:
+        annotations[k8s.NODE_ESCALATOR_IGNORE_ANNOTATION] = "test"
+    return k8s.Node(
+        name=name,
+        creation_time_ns=opts.creation_time_ns,
+        cpu_allocatable_milli=opts.cpu,
+        mem_allocatable_bytes=opts.mem,
+        labels={opts.label_key: opts.label_value},
+        annotations=annotations,
+        taints=taints,
+        unschedulable=opts.cordoned,
+        provider_id=name,
+    )
+
+
+def build_test_nodes(amount: int, opts: NodeOpts) -> List[k8s.Node]:
+    out = []
+    for _ in range(amount):
+        o = NodeOpts(**{**opts.__dict__, "name": ""})
+        out.append(build_test_node(o))
+    return out
+
+
+@dataclass
+class PodOpts:
+    name: str = ""
+    namespace: str = "default"
+    cpu: Sequence[int] = field(default_factory=list)   # per-container cpu milli
+    mem: Sequence[int] = field(default_factory=list)   # per-container mem bytes
+    node_selector_key: str = ""
+    node_selector_value: str = ""
+    owner: str = ""
+    node_affinity_key: str = ""
+    node_affinity_value: str = ""
+    node_affinity_op: str = k8s.NodeSelectorOperator.IN.value
+    node_name: str = ""
+    cpu_overhead: int = 0
+    mem_overhead: int = 0
+    init_containers_cpu: Sequence[int] = field(default_factory=list)
+    init_containers_mem: Sequence[int] = field(default_factory=list)
+    static: bool = False
+
+
+def build_test_pod(opts: PodOpts) -> k8s.Pod:
+    containers = [
+        k8s.ResourceRequests(cpu_milli=c, mem_bytes=m)
+        for c, m in zip(opts.cpu, opts.mem)
+    ]
+    init_containers = [
+        k8s.ResourceRequests(cpu_milli=c, mem_bytes=m)
+        for c, m in zip(opts.init_containers_cpu, opts.init_containers_mem)
+    ]
+    overhead = None
+    if opts.cpu_overhead > 0 or opts.mem_overhead > 0:
+        overhead = k8s.ResourceRequests(
+            cpu_milli=max(opts.cpu_overhead, 0), mem_bytes=max(opts.mem_overhead, 0)
+        )
+    node_selector = {}
+    if opts.node_selector_key or opts.node_selector_value:
+        node_selector[opts.node_selector_key] = opts.node_selector_value
+    affinity = None
+    if opts.node_affinity_key or opts.node_affinity_value:
+        affinity = k8s.Affinity(
+            has_node_affinity=True,
+            node_affinity_required_terms=(
+                k8s.NodeSelectorTerm(
+                    match_expressions=(
+                        k8s.NodeSelectorRequirement(
+                            key=opts.node_affinity_key,
+                            operator=opts.node_affinity_op,
+                            values=(opts.node_affinity_value,),
+                        ),
+                    )
+                ),
+            ),
+        )
+    annotations = {}
+    if opts.static:
+        annotations[k8s.STATIC_POD_ANNOTATION] = "file"
+    return k8s.Pod(
+        name=opts.name or f"p{next(_counter)}",
+        namespace=opts.namespace,
+        node_name=opts.node_name,
+        containers=containers,
+        init_containers=init_containers,
+        overhead=overhead,
+        node_selector=node_selector,
+        affinity=affinity,
+        owner_kind=opts.owner,
+        annotations=annotations,
+    )
+
+
+def build_test_pods(amount: int, opts: PodOpts) -> List[k8s.Pod]:
+    out = []
+    for i in range(amount):
+        o = PodOpts(**{**opts.__dict__, "name": f"p{i}-{next(_counter)}"})
+        out.append(build_test_pod(o))
+    return out

@@ -1,0 +1,17 @@
+# Test environment: force the CPU backend with 8 virtual devices so the multi-chip
+# sharding path is exercised without TPU hardware, and so float64 parity tests are
+# bit-exact (TPU f64 emulation is not). A sitecustomize on this machine pins
+# jax_platforms to the TPU tunnel, so the env var alone is not enough — we override
+# the config after import, before any computation runs.
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,225 @@
+"""Parity: the batched JAX kernel must reproduce the golden semantics element-wise on
+randomized clusters — the contract demanded by SURVEY.md §4/§7 (kernel vs reference Go
+math, here kernel vs the ported golden model)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.core.arrays import pack_cluster
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.ops import kernel
+from escalator_tpu.testsupport.builders import NodeOpts, PodOpts, build_test_node, build_test_pod
+
+NOW = 1_700_000_000
+
+
+def random_group(rng: random.Random, gi: int):
+    """A randomized nodegroup snapshot covering all decision branches."""
+    scenario = rng.choice(
+        ["normal", "empty", "all_tainted", "zero_cap", "below_min", "above_max", "locked"]
+    )
+    config = sem.GroupConfig(
+        min_nodes=rng.randint(0, 3),
+        max_nodes=rng.randint(5, 40),
+        taint_lower_percent=30,
+        taint_upper_percent=45,
+        scale_up_percent=70,
+        slow_removal_rate=rng.randint(1, 2),
+        fast_removal_rate=rng.randint(2, 5),
+        soft_delete_grace_sec=300,
+        hard_delete_grace_sec=900,
+    )
+    state = sem.GroupState(
+        locked=(scenario == "locked"),
+        requested_nodes=rng.randint(0, 7),
+        cached_cpu_milli=rng.choice([0, 1000, 4000]),
+        cached_mem_bytes=rng.choice([0, 10**9]),
+    )
+
+    nodes = []
+    pods = []
+    if scenario != "empty":
+        n_nodes = {
+            "below_min": max(0, config.min_nodes - 1),
+            "above_max": config.max_nodes + rng.randint(1, 3),
+        }.get(scenario, rng.randint(max(1, config.min_nodes), config.max_nodes))
+        for i in range(n_nodes):
+            tainted = scenario == "all_tainted" or rng.random() < 0.2
+            cordoned = (not tainted) and rng.random() < 0.1
+            cap_cpu = 0 if scenario == "zero_cap" else rng.choice([1000, 2000, 4000])
+            cap_mem = 0 if scenario == "zero_cap" else rng.choice([10**9, 4 * 10**9])
+            nodes.append(
+                build_test_node(
+                    NodeOpts(
+                        name=f"g{gi}-n{i}",
+                        cpu=cap_cpu,
+                        mem=cap_mem,
+                        creation_time_ns=rng.randint(1, 10**9) * 1000,
+                        tainted=tainted,
+                        taint_time_sec=NOW - rng.randint(0, 2000) if tainted else None,
+                        cordoned=cordoned,
+                        no_delete=rng.random() < 0.1,
+                    )
+                )
+            )
+        n_pods = rng.randint(0, 30)
+        for i in range(n_pods):
+            target = rng.choice(nodes).name if nodes and rng.random() < 0.7 else ""
+            pods.append(
+                build_test_pod(
+                    PodOpts(
+                        name=f"g{gi}-p{i}",
+                        cpu=[rng.choice([100, 250, 500, 1000])],
+                        mem=[rng.choice([10**8, 5 * 10**8, 10**9])],
+                        node_name=target,
+                    )
+                )
+            )
+    return pods, nodes, config, state
+
+
+def eval_group_golden(pods, nodes, config, state):
+    """Golden decision + selections + reap for one group."""
+    decision = sem.evaluate_node_group(pods, nodes, config, dataclass_copy(state))
+    untainted, tainted, _ = sem.filter_nodes(nodes)
+    down_order = [untainted[i].name for i in sem.nodes_oldest_first(untainted)]
+    up_order = [tainted[i].name for i in sem.nodes_newest_first(tainted)]
+    info = k8s.create_node_name_to_info_map(pods, nodes)
+    reap = {
+        tainted[i].name
+        for i in sem.reap_eligible(
+            tainted, info, config.soft_delete_grace_sec, config.hard_delete_grace_sec, NOW
+        )
+    }
+    return decision, down_order, up_order, reap
+
+
+def dataclass_copy(state):
+    return sem.GroupState(**state.__dict__)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_kernel_matches_golden(seed):
+    rng = random.Random(seed)
+    G = 24
+    groups = [random_group(rng, gi) for gi in range(G)]
+
+    cluster = pack_cluster(groups, pad_pods=1024, pad_nodes=512, pad_groups=32)
+    out = kernel.decide_jit(cluster, np.int64(NOW))
+    status = np.asarray(out.status)
+    delta = np.asarray(out.nodes_delta)
+    cpu_pct = np.asarray(out.cpu_percent)
+    mem_pct = np.asarray(out.mem_percent)
+    down_order = np.asarray(out.scale_down_order)
+    up_order = np.asarray(out.untaint_order)
+    u_off = np.asarray(out.untainted_offsets)
+    t_off = np.asarray(out.tainted_offsets)
+    reap_mask = np.asarray(out.reap_mask)
+
+    # node index -> name for selection comparison
+    node_names = []
+    for gi, (_, nodes, _, _) in enumerate(groups):
+        node_names.extend(n.name for n in nodes)
+
+    for gi, (pods, nodes, config, state) in enumerate(groups):
+        want, want_down, want_up, want_reap = eval_group_golden(
+            pods, nodes, config, state
+        )
+        assert status[gi] == int(want.status), (
+            f"group {gi}: status {status[gi]} != {want.status}"
+        )
+        assert delta[gi] == want.nodes_delta, (
+            f"group {gi} ({want.status.name}): delta {delta[gi]} != {want.nodes_delta}"
+        )
+        if want.status not in (
+            sem.DecisionStatus.NOOP_EMPTY,
+            sem.DecisionStatus.ERR_BELOW_MIN,
+            sem.DecisionStatus.ERR_ABOVE_MAX,
+            sem.DecisionStatus.FORCED_MIN_SCALE_UP,
+            sem.DecisionStatus.ERR_DIV_ZERO,
+        ):
+            assert cpu_pct[gi] == want.cpu_percent
+            assert mem_pct[gi] == want.mem_percent
+
+        got_down = [node_names[i] for i in down_order[u_off[gi] : u_off[gi + 1]]]
+        got_up = [node_names[i] for i in up_order[t_off[gi] : t_off[gi + 1]]]
+        assert got_down == want_down, f"group {gi} scale-down order"
+        assert got_up == want_up, f"group {gi} untaint order"
+
+        got_reap = {
+            node_names[i]
+            for i in np.nonzero(reap_mask)[0]
+            if i < len(node_names) and node_names[i].startswith(f"g{gi}-")
+        }
+        assert got_reap == want_reap, f"group {gi} reap set"
+
+
+def test_aggregates_match():
+    rng = random.Random(42)
+    groups = [random_group(rng, gi) for gi in range(8)]
+    cluster = pack_cluster(groups)
+    out = kernel.decide_jit(cluster, np.int64(NOW))
+    for gi, (pods, nodes, config, state) in enumerate(groups):
+        mem_req, cpu_req = k8s.calculate_pods_requests_total(pods)
+        untainted, tainted, cordoned = sem.filter_nodes(nodes)
+        mem_cap, cpu_cap = k8s.calculate_nodes_capacity_total(untainted)
+        assert int(out.cpu_request_milli[gi]) == cpu_req
+        assert int(out.mem_request_bytes[gi]) == mem_req
+        assert int(out.cpu_capacity_milli[gi]) == cpu_cap
+        assert int(out.mem_capacity_bytes[gi]) == mem_cap
+        assert int(out.num_pods[gi]) == len(pods)
+        assert int(out.num_nodes[gi]) == len(nodes)
+        assert int(out.num_untainted[gi]) == len(untainted)
+        assert int(out.num_tainted[gi]) == len(tainted)
+        assert int(out.num_cordoned[gi]) == len(cordoned)
+
+
+def test_padding_lanes_inert():
+    rng = random.Random(7)
+    groups = [random_group(rng, gi) for gi in range(3)]
+    cluster = pack_cluster(groups, pad_pods=256, pad_nodes=128, pad_groups=16)
+    out = kernel.decide_jit(cluster, np.int64(NOW))
+    for gi in range(3, 16):
+        assert int(out.status[gi]) == int(sem.DecisionStatus.NOOP_EMPTY)
+        assert int(out.nodes_delta[gi]) == 0
+
+
+def test_zero_threshold_is_deterministic_error():
+    """scale_up_percent <= 0 is invalid config (reference rejects it at startup,
+    node_group.go:96); both golden and kernel must agree on ERR_NEG_DELTA, never
+    NaN-derived garbage."""
+    from escalator_tpu.testsupport.builders import build_test_nodes, build_test_pods
+
+    cfg = sem.GroupConfig(min_nodes=0, max_nodes=10, taint_lower_percent=0,
+                          taint_upper_percent=0, scale_up_percent=0,
+                          slow_removal_rate=1, fast_removal_rate=2)
+    pods = build_test_pods(1, PodOpts(cpu=[100], mem=[100]))
+    nodes = build_test_nodes(1, NodeOpts(cpu=1000, mem=1000))
+    want = sem.evaluate_node_group(pods, nodes, cfg, sem.GroupState())
+    assert want.status == sem.DecisionStatus.ERR_NEG_DELTA
+    cluster = pack_cluster([(pods, nodes, cfg, sem.GroupState())])
+    out = kernel.decide_jit(cluster, np.int64(NOW))
+    assert int(out.status[0]) == int(want.status)
+    assert int(out.nodes_delta[0]) == want.nodes_delta == 0
+
+
+def test_huge_delta_clamped_identically():
+    """Deltas are clamped to int32 in both models (semantics.MAX_DELTA)."""
+    from escalator_tpu.testsupport.builders import build_test_nodes, build_test_pods
+
+    cfg = sem.GroupConfig(min_nodes=0, max_nodes=10, taint_lower_percent=30,
+                          taint_upper_percent=45, scale_up_percent=1,
+                          slow_removal_rate=1, fast_removal_rate=2)
+    # scale-from-zero with tiny cached capacity and a colossal request
+    nodes = build_test_nodes(1, NodeOpts(cpu=1, mem=1, tainted=True, taint_time_sec=1))
+    pods = build_test_pods(1, PodOpts(cpu=[10**15], mem=[10**15]))
+    st1, st2 = sem.GroupState(), sem.GroupState()
+    want = sem.evaluate_node_group(pods, nodes, cfg, st1)
+    cluster = pack_cluster([(pods, nodes, cfg, st2)])
+    out = kernel.decide_jit(cluster, np.int64(NOW))
+    assert want.nodes_delta == sem.MAX_DELTA
+    assert int(out.nodes_delta[0]) == want.nodes_delta
+    assert int(out.status[0]) == int(want.status)

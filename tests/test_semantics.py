@@ -1,0 +1,313 @@
+"""Golden tests of the pure decision semantics, ported from the reference's tables
+(/root/reference/pkg/controller/util_test.go, pkg/k8s/util_test.go)."""
+
+import math
+
+import pytest
+
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_nodes,
+    build_test_pods,
+)
+
+
+def calc_percentage_usage(pods, nodes):
+    """Helper mirroring util_test.go:195-202."""
+    mem_req, cpu_req = k8s.calculate_pods_requests_total(pods)
+    mem_cap, cpu_cap = k8s.calculate_nodes_capacity_total(nodes)
+    return sem.calc_percent_usage(
+        cpu_req, mem_req * 1000, cpu_cap, mem_cap * 1000, len(nodes)
+    )
+
+
+class TestCalcPercentUsage:
+    """Table from util_test.go:204-302. Quantities are (cpu milli, mem milli)."""
+
+    def test_basic(self):
+        assert sem.calc_percent_usage(50, 50, 100, 100, 1) == (50.0, 50.0)
+
+    def test_divide_by_zero(self):
+        with pytest.raises(ZeroDivisionError):
+            sem.calc_percent_usage(50, 50, 0, 0, 10)
+
+    def test_no_request_nonzero_nodes(self):
+        with pytest.raises(ZeroDivisionError):
+            sem.calc_percent_usage(0, 0, 0, 0, 1)
+
+    def test_zero_numerator(self):
+        assert sem.calc_percent_usage(0, 0, 66, 66, 1) == (0.0, 0.0)
+
+    def test_zero_all(self):
+        assert sem.calc_percent_usage(0, 0, 0, 0, 0) == (0.0, 0.0)
+
+    def test_scale_from_zero_sentinel(self):
+        cpu, mem = sem.calc_percent_usage(50, 50, 0, 0, 0)
+        assert cpu == sem.MAX_FLOAT64
+        assert mem == sem.MAX_FLOAT64
+
+
+class TestCalcScaleUpDelta:
+    """Closed-loop property from util_test.go:15-192: after adding the computed delta
+    of nodes, utilisation must drop to <= threshold."""
+
+    CASES = [
+        # (num_pods, pod_cpu, pod_mem, num_nodes, node_cpu, node_mem, threshold)
+        (10, 500, 100, 2, 1000, 4000, 70),
+        (10, 500, 2000, 2, 3000, 1000, 70),
+        (10, 500, 2000, 2, 3000, 1000, 40),
+        (10, 500, 2000, 2, 3000, 1000, 23),
+        (10, 500, 2000, 2, 3000, 1000, 3),
+        (80, 1000, 1000, 100, 1000, 1000, 70),
+        (150, 1000, 1000, 100, 1000, 1000, 110),
+    ]
+
+    @pytest.mark.parametrize("case", CASES)
+    def test_closed_loop(self, case):
+        num_pods, pc, pm, num_nodes, nc, nm, thr = case
+        pods = build_test_pods(num_pods, PodOpts(cpu=[pc], mem=[pm]))
+        nodes = build_test_nodes(num_nodes, NodeOpts(cpu=nc, mem=nm))
+
+        cpu_pct, mem_pct = calc_percentage_usage(pods, nodes)
+        mem_req, cpu_req = k8s.calculate_pods_requests_total(pods)
+        try:
+            want = sem.calc_scale_up_delta(
+                len(nodes), cpu_pct, mem_pct, cpu_req, mem_req * 1000, 0, 0, thr
+            )
+        except ValueError:
+            return
+        if want <= 0:
+            return
+
+        new_nodes = nodes + build_test_nodes(want, NodeOpts(cpu=nc, mem=nm))
+        new_cpu, new_mem = calc_percentage_usage(pods, new_nodes)
+        assert new_cpu <= thr
+        assert new_mem <= thr
+
+    def test_scale_from_zero_no_cache(self):
+        # no cached capacity -> scale up by exactly 1 (util.go:20-24)
+        delta = sem.calc_scale_up_delta(
+            0, sem.MAX_FLOAT64, sem.MAX_FLOAT64, 5000, 5000 * 1000, 0, 0, 70
+        )
+        assert delta == 1
+
+    def test_scale_from_zero_with_cache(self):
+        # cached 1000m cpu / 1000 bytes mem; 5000m cpu requested; threshold 70
+        # -> ceil(5000/1000/70*100) = ceil(7.1428..) = 8
+        delta = sem.calc_scale_up_delta(
+            0, sem.MAX_FLOAT64, sem.MAX_FLOAT64, 5000, 100 * 1000, 1000, 1000 * 1000, 70
+        )
+        assert delta == 8
+
+    def test_negative_delta_error(self):
+        with pytest.raises(ValueError):
+            sem.calc_scale_up_delta(2, 10.0, 10.0, 100, 100, 0, 0, 70)
+
+
+class TestPodRequestSemantics:
+    """Resource request parity with the vendored scheduler logic
+    (reference: pkg/k8s/scheduler/types.go:72-89)."""
+
+    def test_init_container_max(self):
+        pod = build_test_pods(
+            1,
+            PodOpts(
+                cpu=[2000, 1000],
+                mem=[1 * 10**9, 1 * 10**9],
+                init_containers_cpu=[2000, 2000],
+                init_containers_mem=[1 * 10**9, 3 * 10**9],
+            ),
+        )[0]
+        req = k8s.compute_pod_resource_request(pod)
+        assert req.cpu_milli == 3000
+        assert req.mem_bytes == 3 * 10**9
+
+    def test_overhead_added(self):
+        pod = build_test_pods(
+            1, PodOpts(cpu=[1000], mem=[100], cpu_overhead=500, mem_overhead=50)
+        )[0]
+        req = k8s.compute_pod_resource_request(pod)
+        assert req.cpu_milli == 1500
+        assert req.mem_bytes == 150
+
+    def test_daemonset_and_static(self):
+        ds = build_test_pods(1, PodOpts(cpu=[1], mem=[1], owner="DaemonSet"))[0]
+        st = build_test_pods(1, PodOpts(cpu=[1], mem=[1], static=True))[0]
+        assert k8s.pod_is_daemonset(ds)
+        assert not k8s.pod_is_daemonset(st)
+        assert k8s.pod_is_static(st)
+        assert not k8s.pod_is_static(ds)
+
+
+class TestEvaluateNodeGroup:
+    def _config(self, **kw):
+        base = dict(
+            min_nodes=1,
+            max_nodes=100,
+            taint_lower_percent=30,
+            taint_upper_percent=45,
+            scale_up_percent=70,
+            slow_removal_rate=1,
+            fast_removal_rate=2,
+        )
+        base.update(kw)
+        return sem.GroupConfig(**base)
+
+    def test_empty_group_noop(self):
+        d = sem.evaluate_node_group([], [], self._config(min_nodes=0), sem.GroupState())
+        assert d.status == sem.DecisionStatus.NOOP_EMPTY
+
+    def test_below_min_error(self):
+        pods = build_test_pods(1, PodOpts(cpu=[100], mem=[100]))
+        d = sem.evaluate_node_group(
+            pods, [], self._config(min_nodes=2), sem.GroupState()
+        )
+        assert d.status == sem.DecisionStatus.ERR_BELOW_MIN
+
+    def test_above_max_error(self):
+        nodes = build_test_nodes(5, NodeOpts(cpu=1000, mem=1000))
+        d = sem.evaluate_node_group(
+            [], nodes, self._config(max_nodes=3), sem.GroupState()
+        )
+        assert d.status == sem.DecisionStatus.ERR_ABOVE_MAX
+
+    def test_scale_up(self):
+        pods = build_test_pods(10, PodOpts(cpu=[500], mem=[100]))
+        nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4000))
+        d = sem.evaluate_node_group(pods, nodes, self._config(), sem.GroupState())
+        assert d.status == sem.DecisionStatus.OK
+        # cpu: 5000/2000 = 250%; delta = ceil(2*(250-70)/70) = ceil(5.142..) = 6
+        assert d.nodes_delta == 6
+
+    def test_scale_down_fast(self):
+        pods = build_test_pods(1, PodOpts(cpu=[100], mem=[100]))
+        nodes = build_test_nodes(10, NodeOpts(cpu=1000, mem=1000))
+        d = sem.evaluate_node_group(pods, nodes, self._config(), sem.GroupState())
+        # 100/10000 = 1% < 30 -> -fast (=2)
+        assert d.status == sem.DecisionStatus.OK
+        assert d.nodes_delta == -2
+
+    def test_scale_down_slow(self):
+        pods = build_test_pods(4, PodOpts(cpu=[1000], mem=[1000]))
+        nodes = build_test_nodes(10, NodeOpts(cpu=1000, mem=1000))
+        d = sem.evaluate_node_group(pods, nodes, self._config(), sem.GroupState())
+        # 40% in [30,45) -> -slow (=1)
+        assert d.nodes_delta == -1
+
+    def test_no_action_band(self):
+        pods = build_test_pods(5, PodOpts(cpu=[1000], mem=[1000]))
+        nodes = build_test_nodes(10, NodeOpts(cpu=1000, mem=1000))
+        d = sem.evaluate_node_group(pods, nodes, self._config(), sem.GroupState())
+        # 50% in [45,70] -> 0
+        assert d.status == sem.DecisionStatus.OK
+        assert d.nodes_delta == 0
+
+    def test_locked_returns_requested(self):
+        pods = build_test_pods(10, PodOpts(cpu=[1000], mem=[1000]))
+        nodes = build_test_nodes(10, NodeOpts(cpu=1000, mem=1000))
+        st = sem.GroupState(locked=True, requested_nodes=4)
+        d = sem.evaluate_node_group(pods, nodes, self._config(), st)
+        assert d.status == sem.DecisionStatus.LOCKED
+        assert d.nodes_delta == 4
+
+    def test_forced_min_scale_up(self):
+        nodes = build_test_nodes(
+            4, NodeOpts(cpu=1000, mem=1000, tainted=True, taint_time_sec=1)
+        ) + build_test_nodes(1, NodeOpts(cpu=1000, mem=1000))
+        d = sem.evaluate_node_group(
+            [], nodes, self._config(min_nodes=3), sem.GroupState()
+        )
+        assert d.status == sem.DecisionStatus.FORCED_MIN_SCALE_UP
+        assert d.nodes_delta == 2  # 3 - 1 untainted
+
+    def test_scale_up_from_zero_untainted(self):
+        # all nodes tainted, pods pending -> MaxFloat64 sentinel -> from-zero delta
+        nodes = build_test_nodes(
+            2, NodeOpts(cpu=1000, mem=1000, tainted=True, taint_time_sec=1)
+        )
+        pods = build_test_pods(5, PodOpts(cpu=[1000], mem=[1000]))
+        st = sem.GroupState()
+        d = sem.evaluate_node_group(
+            pods, nodes, self._config(min_nodes=0), st
+        )
+        # cached capacity learned from nodes[0] -> ceil(5000/1000/70*100) = 8
+        assert d.status == sem.DecisionStatus.OK
+        assert d.nodes_delta == 8
+
+    def test_cached_capacity_updated(self):
+        nodes = build_test_nodes(2, NodeOpts(cpu=1234, mem=5678))
+        st = sem.GroupState()
+        sem.evaluate_node_group([], nodes, self._config(), st)
+        assert st.cached_cpu_milli == 1234
+        assert st.cached_mem_bytes == 5678
+
+    def test_div_zero_error(self):
+        nodes = build_test_nodes(2, NodeOpts(cpu=0, mem=0))
+        pods = build_test_pods(1, PodOpts(cpu=[100], mem=[100]))
+        d = sem.evaluate_node_group(pods, nodes, self._config(), sem.GroupState())
+        assert d.status == sem.DecisionStatus.ERR_DIV_ZERO
+
+
+class TestFilterNodes:
+    def test_tri_partition(self):
+        u = build_test_nodes(3, NodeOpts(cpu=1, mem=1))
+        t = build_test_nodes(2, NodeOpts(cpu=1, mem=1, tainted=True, taint_time_sec=5))
+        c = build_test_nodes(1, NodeOpts(cpu=1, mem=1, cordoned=True))
+        untainted, tainted, cordoned = sem.filter_nodes(u + t + c)
+        assert [n.name for n in untainted] == [n.name for n in u]
+        assert [n.name for n in tainted] == [n.name for n in t]
+        assert [n.name for n in cordoned] == [n.name for n in c]
+
+    def test_dry_mode_uses_tracker_and_ignores_cordon(self):
+        nodes = build_test_nodes(3, NodeOpts(cpu=1, mem=1, cordoned=True))
+        tracker = [nodes[1].name]
+        untainted, tainted, cordoned = sem.filter_nodes(
+            nodes, dry_mode=True, taint_tracker=tracker
+        )
+        assert [n.name for n in tainted] == [nodes[1].name]
+        assert len(untainted) == 2
+        assert cordoned == []
+
+
+class TestSelectionAndReap:
+    def test_oldest_and_newest_first(self):
+        nodes = [
+            build_test_nodes(1, NodeOpts(cpu=1, mem=1, creation_time_ns=t))[0]
+            for t in (50, 10, 30)
+        ]
+        assert sem.nodes_oldest_first(nodes) == [1, 2, 0]
+        assert sem.nodes_newest_first(nodes) == [0, 2, 1]
+
+    def test_reap_rules(self):
+        now = 10_000
+        mk = lambda **kw: build_test_nodes(
+            1, NodeOpts(cpu=1, mem=1, tainted=True, **kw)
+        )[0]
+        past_soft_empty = mk(taint_time_sec=now - 400)
+        before_soft = mk(taint_time_sec=now - 100)
+        past_hard = mk(taint_time_sec=now - 1000)
+        no_delete = mk(taint_time_sec=now - 1000, no_delete=True)
+        tainted = [past_soft_empty, before_soft, past_hard, no_delete]
+
+        # a pod keeps past_hard non-empty, but hard grace overrides
+        pod = build_test_pods(1, PodOpts(cpu=[1], mem=[1]))[0]
+        pod.node_name = past_hard.name
+        busy_pod = build_test_pods(1, PodOpts(cpu=[1], mem=[1]))[0]
+        busy_pod.node_name = before_soft.name
+        info = k8s.create_node_name_to_info_map([pod, busy_pod], tainted)
+
+        out = sem.reap_eligible(
+            tainted, info, soft_grace_sec=300, hard_grace_sec=900, now_unix_sec=now
+        )
+        assert out == [0, 2]
+
+    def test_clamps(self):
+        assert sem.clamp_scale_down(10, 5, 3) == 5
+        assert sem.clamp_scale_down(10, 9, 3) == 7
+        with pytest.raises(ValueError):
+            sem.clamp_scale_down(2, 1, 3)
+        assert sem.calculate_nodes_to_add(5, 8, 10) == 2
+        assert sem.calculate_nodes_to_add(5, 2, 10) == 5
