@@ -1,0 +1,166 @@
+"""Benchmark harness: the five BASELINE.md configs on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...detail}.
+Headline = config 4 (2048 nodegroups / 100k pods) scale-decision latency in ms,
+vs the 50 ms target from BASELINE.json (vs_baseline > 1 means faster than target).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _rng_cluster_arrays(
+    rng: np.random.Generator,
+    num_groups: int,
+    num_pods: int,
+    num_nodes: int,
+    mixed: bool = False,
+    heterogeneous: bool = False,
+    tainted_frac: float = 0.0,
+    cordoned_frac: float = 0.0,
+    now: int = 1_700_000_000,
+):
+    """Directly synthesize packed ClusterArrays (numpy fast path; building 100k
+    Python Pod objects would only measure the object builder)."""
+    from escalator_tpu.core.arrays import NO_TAINT_TIME, ClusterArrays, GroupArrays, NodeArrays, PodArrays
+
+    G, P, N = num_groups, num_pods, num_nodes
+    groups = GroupArrays(
+        min_nodes=np.zeros(G, np.int32),
+        max_nodes=np.full(G, 10**6, np.int32),
+        taint_lower=np.full(G, 30, np.int32),
+        taint_upper=np.full(G, 45, np.int32),
+        scale_up_thr=np.full(G, 70, np.int32),
+        slow_rate=np.ones(G, np.int32),
+        fast_rate=np.full(G, 2, np.int32),
+        locked=np.zeros(G, bool),
+        requested_nodes=np.zeros(G, np.int32),
+        cached_cpu_milli=np.full(G, 4000, np.int64),
+        cached_mem_bytes=np.full(G, 16 * 10**9, np.int64),
+        soft_grace_sec=np.full(G, 300, np.int64),
+        hard_grace_sec=np.full(G, 900, np.int64),
+        valid=np.ones(G, bool),
+    )
+    if mixed:
+        pod_cpu = rng.choice([100, 250, 500, 1000, 2000], P).astype(np.int64)
+        pod_mem = rng.choice([10**8, 5 * 10**8, 10**9, 4 * 10**9], P).astype(np.int64)
+    else:
+        pod_cpu = np.full(P, 500, np.int64)
+        pod_mem = np.full(P, 10**9, np.int64)
+    pod_group = rng.integers(0, G, P).astype(np.int32)
+    node_group = rng.integers(0, G, N).astype(np.int32)
+    if heterogeneous:
+        node_cpu = rng.choice([2000, 4000, 8000, 16000], N).astype(np.int64)
+        node_mem = rng.choice([8, 16, 32, 64], N).astype(np.int64) * 10**9
+    else:
+        node_cpu = np.full(N, 4000, np.int64)
+        node_mem = np.full(N, 16 * 10**9, np.int64)
+    tainted = rng.random(N) < tainted_frac
+    cordoned = (~tainted) & (rng.random(N) < cordoned_frac)
+    taint_time = np.where(
+        tainted, now - rng.integers(0, 2000, N), NO_TAINT_TIME
+    ).astype(np.int64)
+
+    pods = PodArrays(
+        group=pod_group,
+        cpu_milli=pod_cpu,
+        mem_bytes=pod_mem,
+        node=rng.integers(-1, N, P).astype(np.int32),
+        valid=np.ones(P, bool),
+    )
+    nodes = NodeArrays(
+        group=node_group,
+        cpu_milli=node_cpu,
+        mem_bytes=node_mem,
+        creation_ns=rng.integers(1, 10**15, N).astype(np.int64),
+        tainted=tainted,
+        cordoned=cordoned,
+        no_delete=rng.random(N) < 0.02,
+        taint_time_sec=taint_time,
+        valid=np.ones(N, bool),
+    )
+    return ClusterArrays(groups=groups, pods=pods, nodes=nodes)
+
+
+def _time_decide(cluster, now, iters=20):
+    import jax
+
+    from escalator_tpu.ops.kernel import decide_jit
+
+    out = decide_jit(cluster, now)  # compile + warm
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = decide_jit(cluster, now)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+def main() -> None:
+    import jax
+
+    from escalator_tpu.ops import kernel as _kernel  # noqa: F401 registers pytrees
+
+    now = np.int64(1_700_000_000)
+    rng = np.random.default_rng(0)
+    device = jax.devices()[0]
+    put = lambda c: jax.device_put(c, device)
+
+    detail = {}
+    # 1. single nodegroup, 500 pods, uniform
+    detail["cfg1_1ng_500pods_ms"] = _time_decide(
+        put(_rng_cluster_arrays(rng, 1, 500, 100)), now
+    )
+    # 2. single nodegroup, 50k pods, mixed requests
+    detail["cfg2_1ng_50kpods_ms"] = _time_decide(
+        put(_rng_cluster_arrays(rng, 1, 50_000, 2_000, mixed=True)), now
+    )
+    # 3. 64 nodegroups, heterogeneous instance types
+    detail["cfg3_64ng_hetero_ms"] = _time_decide(
+        put(
+            _rng_cluster_arrays(rng, 64, 20_000, 5_000, mixed=True, heterogeneous=True)
+        ),
+        now,
+    )
+    # 4. HEADLINE: 2048 nodegroups, 100k pods
+    headline_cluster = put(
+        _rng_cluster_arrays(
+            rng, 2048, 100_000, 50_000, mixed=True, heterogeneous=True,
+            tainted_frac=0.1, cordoned_frac=0.02,
+        )
+    )
+    headline = _time_decide(headline_cluster, now)
+    detail["cfg4_2048ng_100kpods_ms"] = headline
+    # 5. scale-down ordering: 10k pods, heavy taint/cordon masking
+    detail["cfg5_scaledown_10kpods_ms"] = _time_decide(
+        put(
+            _rng_cluster_arrays(
+                rng, 64, 10_000, 10_000, tainted_frac=0.4, cordoned_frac=0.1
+            )
+        ),
+        now,
+    )
+
+    target_ms = 50.0
+    print(
+        json.dumps(
+            {
+                "metric": "scale_decision_latency_2048ng_100kpods",
+                "value": round(headline, 3),
+                "unit": "ms",
+                "vs_baseline": round(target_ms / headline, 2),
+                "device": str(device),
+                "detail": {k: round(v, 3) for k, v in detail.items()},
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""Mesh sharding of the nodegroup axis — the framework's distributed backend.
+
+The reference processes nodegroups serially in one Go process
+(/root/reference/pkg/controller/controller.go:416-445) and has no collective layer at
+all (SURVEY.md §2.7). Here the nodegroup axis is the parallel axis: decisions are
+embarrassingly parallel across groups, so we shard groups across a
+``jax.sharding.Mesh`` with ``shard_map`` and run the batched kernel on each shard's
+local block. Pods/nodes are routed to their group's shard at pack time, so the device
+program needs **no cross-device communication** for decisions; only the optional
+fleet-wide aggregates use ``psum``-style reductions (computed here from the per-shard
+outputs). ICI/DCN scaling therefore comes for free: more devices, more nodegroup
+shards.
+
+This module is the TPU-native stand-in for what SURVEY.md §2.7 calls the "distributed
+communication backend" slot, and the "sequence parallelism" analog (sharding the
+100k-pod axis by way of its grouping).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from escalator_tpu.core import semantics
+from escalator_tpu.core.arrays import ClusterArrays, pack_cluster
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.ops.kernel import DecisionArrays, decide
+
+GROUP_AXIS = "groups"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over the nodegroup axis. Multi-host: pass the global device list."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (GROUP_AXIS,))
+
+
+def pack_cluster_sharded(
+    group_inputs: Sequence[
+        Tuple[
+            Sequence[k8s.Pod],
+            Sequence[k8s.Node],
+            semantics.GroupConfig,
+            semantics.GroupState,
+        ]
+    ],
+    num_shards: int,
+    pad_pods_per_shard: Optional[int] = None,
+    pad_nodes_per_shard: Optional[int] = None,
+    pad_groups_per_shard: Optional[int] = None,
+    dry_mode_flags: Optional[Sequence[bool]] = None,
+    taint_trackers: Optional[Sequence[Sequence[str]]] = None,
+) -> Tuple[ClusterArrays, List[List[int]]]:
+    """Distribute nodegroups onto ``num_shards`` shards (greedy least-loaded / LPT
+    placement by pod count) and pack each shard with identical padded shapes,
+    stacking to leaves with a leading shard axis.
+
+    LPT keeps shard loads balanced when group sizes are skewed (the classic
+    raggedness hazard, SURVEY.md §7). Returns the stacked arrays plus, per shard, the
+    list of original group indices (shard-local group id -> caller's group index).
+    """
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    assignment: List[List[int]] = [[] for _ in range(num_shards)]
+    # Largest-first onto the currently lightest shard.
+    order = sorted(
+        range(len(group_inputs)), key=lambda i: -len(group_inputs[i][0])
+    )
+    loads = [0] * num_shards
+    for gi in order:
+        s = loads.index(min(loads))
+        assignment[s].append(gi)
+        loads[s] += len(group_inputs[gi][0]) + 1
+    for s in range(num_shards):
+        assignment[s].sort()
+
+    max_pods = max(
+        (sum(len(group_inputs[gi][0]) for gi in shard) for shard in assignment),
+        default=0,
+    )
+    max_nodes = max(
+        (sum(len(group_inputs[gi][1]) for gi in shard) for shard in assignment),
+        default=0,
+    )
+    max_groups = max((len(shard) for shard in assignment), default=0)
+    pad_pods = pad_pods_per_shard or max(max_pods, 1)
+    pad_nodes = pad_nodes_per_shard or max(max_nodes, 1)
+    pad_groups = pad_groups_per_shard or max(max_groups, 1)
+
+    shards = [
+        pack_cluster(
+            [group_inputs[gi] for gi in shard],
+            pad_pods=pad_pods,
+            pad_nodes=pad_nodes,
+            pad_groups=pad_groups,
+            dry_mode_flags=(
+                [dry_mode_flags[gi] for gi in shard] if dry_mode_flags else None
+            ),
+            taint_trackers=(
+                [taint_trackers[gi] for gi in shard] if taint_trackers else None
+            ),
+        )
+        for shard in assignment
+    ]
+    leaves = [c.tree_flatten()[0] for c in shards]
+    stacked = [np.stack(parts) for parts in zip(*leaves)]
+    return ClusterArrays.tree_unflatten(None, stacked), assignment
+
+
+def make_sharded_decider(mesh: Mesh):
+    """jitted ``(sharded_cluster, now_sec) -> DecisionArrays`` with the leading shard
+    axis partitioned over the mesh. Local blocks may hold several shards (vmap'ed);
+    no collectives are emitted — per-group decisions are shard-local by construction."""
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(GROUP_AXIS), P()),
+        out_specs=P(GROUP_AXIS),
+    )
+    def sharded_decide(cluster: ClusterArrays, now_sec) -> DecisionArrays:
+        return jax.vmap(decide, in_axes=(0, None))(cluster, now_sec)
+
+    return sharded_decide
+
+
+def shard_cluster_arrays(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
+    """Place stacked cluster arrays so the shard axis lives on the mesh devices."""
+    sharding = NamedSharding(mesh, P(GROUP_AXIS))
+    leaves, aux = cluster.tree_flatten()
+    placed = [jax.device_put(leaf, sharding) for leaf in leaves]
+    return ClusterArrays.tree_unflatten(aux, placed)
+
+
+def fleet_totals(out: DecisionArrays) -> dict:
+    """Fleet-wide aggregates over all shards/groups (the reference's global metrics
+    analog). Computed as reductions over the sharded outputs — XLA turns these into
+    psum-style collectives over ICI when the outputs are device-resident."""
+    return {
+        "pods": int(jnp.sum(out.num_pods)),
+        "nodes": int(jnp.sum(out.num_nodes)),
+        "untainted": int(jnp.sum(out.num_untainted)),
+        "tainted": int(jnp.sum(out.num_tainted)),
+        "cordoned": int(jnp.sum(out.num_cordoned)),
+        "cpu_request_milli": int(jnp.sum(out.cpu_request_milli)),
+        "mem_request_bytes": int(jnp.sum(out.mem_request_bytes)),
+        "scale_up_groups": int(jnp.sum(out.nodes_delta > 0)),
+        "scale_down_groups": int(jnp.sum(out.nodes_delta < 0)),
+    }

@@ -1,0 +1,94 @@
+"""Sharded decision parity: shard_map over an 8-device CPU mesh must reproduce the
+unsharded kernel (and hence the golden model) exactly."""
+
+import random
+
+import numpy as np
+import jax
+import pytest
+
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.core.arrays import pack_cluster
+from escalator_tpu.ops import kernel
+from escalator_tpu.parallel import mesh as meshlib
+
+from tests.test_kernel_parity import NOW, random_group
+
+
+@pytest.fixture(scope="module")
+def cpu_mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual CPU devices"
+    return meshlib.make_mesh()
+
+
+def test_sharded_matches_unsharded(cpu_mesh):
+    rng = random.Random(11)
+    groups = [random_group(rng, gi) for gi in range(64)]
+
+    # Unsharded golden-parity path (separate GroupStates: pack mutates cached_*)
+    def fresh(groups):
+        return [
+            (p, n, c, sem.GroupState(**s.__dict__)) for (p, n, c, s) in groups
+        ]
+
+    flat = pack_cluster(fresh(groups))
+    ref = kernel.decide_jit(flat, np.int64(NOW))
+
+    sharded, assignment = meshlib.pack_cluster_sharded(fresh(groups), num_shards=8)
+    sharded = meshlib.shard_cluster_arrays(sharded, cpu_mesh)
+    decider = meshlib.make_sharded_decider(cpu_mesh)
+    out = decider(sharded, np.int64(NOW))
+
+    status = np.asarray(out.status)
+    delta = np.asarray(out.nodes_delta)
+    cpu_pct = np.asarray(out.cpu_percent)
+    for s, shard_groups in enumerate(assignment):
+        for local, gi in enumerate(shard_groups):
+            assert status[s, local] == int(ref.status[gi]), f"group {gi}"
+            assert delta[s, local] == int(ref.nodes_delta[gi]), f"group {gi}"
+            assert cpu_pct[s, local] == float(ref.cpu_percent[gi]), f"group {gi}"
+
+
+def test_sharded_selection_orders(cpu_mesh):
+    """Scale-down ordering must survive sharding: check one shard's local order maps
+    to the golden per-group order."""
+    rng = random.Random(5)
+    groups = [random_group(rng, gi) for gi in range(16)]
+    sharded, assignment = meshlib.pack_cluster_sharded(
+        [(p, n, c, sem.GroupState(**s.__dict__)) for (p, n, c, s) in groups],
+        num_shards=8,
+    )
+    sharded_placed = meshlib.shard_cluster_arrays(sharded, cpu_mesh)
+    out = meshlib.make_sharded_decider(cpu_mesh)(sharded_placed, np.int64(NOW))
+
+    down = np.asarray(out.scale_down_order)
+    offs = np.asarray(out.untainted_offsets)
+
+    for s, shard_groups in enumerate(assignment):
+        # shard-local node names in pack order
+        local_names = []
+        for gi in shard_groups:
+            local_names.extend(n.name for n in groups[gi][1])
+        for local, gi in enumerate(shard_groups):
+            untainted, _, _ = sem.filter_nodes(groups[gi][1])
+            want = [untainted[i].name for i in sem.nodes_oldest_first(untainted)]
+            got = [
+                local_names[i]
+                for i in down[s, offs[s, local] : offs[s, local + 1]]
+            ]
+            assert got == want, f"shard {s} group {gi}"
+
+
+def test_fleet_totals(cpu_mesh):
+    rng = random.Random(3)
+    groups = [random_group(rng, gi) for gi in range(16)]
+    sharded, _ = meshlib.pack_cluster_sharded(
+        [(p, n, c, sem.GroupState(**s.__dict__)) for (p, n, c, s) in groups],
+        num_shards=8,
+    )
+    out = meshlib.make_sharded_decider(cpu_mesh)(
+        meshlib.shard_cluster_arrays(sharded, cpu_mesh), np.int64(NOW)
+    )
+    totals = meshlib.fleet_totals(out)
+    assert totals["pods"] == sum(len(p) for p, *_ in groups)
+    assert totals["nodes"] == sum(len(n) for _, n, *_ in groups)
