@@ -1,0 +1,4 @@
+from escalator_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,294 @@
+"""CLI / process entry — mirror of /root/reference/cmd/main.go.
+
+Same flag surface (loglevel, logfmt, address, scaninterval, nodegroups, drymode,
+cloud-provider, leader-elect family), plus TPU-build additions: ``--backend`` selects
+the compute backend (auto/jax/sharded-jax/golden) and ``--sim-state`` runs the
+controller against an in-memory cluster loaded from YAML — the drivable surface when
+no apiserver is present (and the framework's shadow-testing facility alongside
+``--drymode``).
+
+Sim-state YAML schema::
+
+    nodes:
+      - name: n1
+        labels: {customer: buildeng}
+        cpu_milli: 4000
+        mem_bytes: 16000000000
+        creation_time_ns: 0
+        tainted_at: 1700000000   # optional -> escalator taint with this timestamp
+        cordoned: false
+    pods:
+      - name: p1
+        node_name: n1            # optional
+        cpu_milli: 500
+        mem_bytes: 1000000000
+        node_selector: {customer: buildeng}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+import yaml
+
+from escalator_tpu import __version__
+from escalator_tpu.controller import controller as ctl
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.controller.backend import make_backend
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.client import InMemoryKubernetesClient, load_incluster
+from escalator_tpu.k8s.election import (
+    FileResourceLock,
+    LeaderElectionConfig,
+    LeaderElector,
+)
+from escalator_tpu.metrics import metrics
+from escalator_tpu.testsupport.cloud_provider import MockBuilder, MockCloudProvider, MockNodeGroup
+
+log = logging.getLogger("escalator_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu",
+        description="TPU-native batch-optimized cluster autoscaler",
+    )
+    p.add_argument("--loglevel", default="info",
+                   choices=["debug", "info", "warn", "error"],
+                   help="log level (reference: cmd/main.go:30)")
+    p.add_argument("--logfmt", default="ascii", choices=["ascii", "json"],
+                   help="log format")
+    p.add_argument("--address", default=":8080",
+                   help="address:port for the /metrics endpoint")
+    p.add_argument("--scaninterval", default="60s",
+                   help="how often the cluster is reevaluated")
+    p.add_argument("--nodegroups", required=True,
+                   help="path to the nodegroups YAML config")
+    p.add_argument("--drymode", action="store_true",
+                   help="skip all mutations, track taints in memory")
+    p.add_argument("--cloud-provider", default="sim", choices=["sim", "aws"],
+                   help="cloud provider backend")
+    p.add_argument("--kubeconfig", default="",
+                   help="kubeconfig path (out-of-cluster mode)")
+    p.add_argument("--sim-state", default="",
+                   help="YAML cluster state for in-memory simulation mode")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "jax", "sharded-jax", "golden"],
+                   help="compute backend for the scale decision")
+    p.add_argument("--once", action="store_true",
+                   help="run a single tick and exit (prints per-group deltas)")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--leader-elect-lock-file", default="/tmp/escalator-tpu.lease")
+    p.add_argument("--leader-elect-lease-duration", default="15s")
+    p.add_argument("--leader-elect-renew-deadline", default="10s")
+    p.add_argument("--leader-elect-retry-period", default="2s")
+    p.add_argument("--version", action="version", version=__version__)
+    return p
+
+
+def setup_logging(level: str, fmt: str) -> None:
+    lvl = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warn": logging.WARNING, "error": logging.ERROR}[level]
+    if fmt == "json":
+        handler = logging.StreamHandler()
+
+        class JsonFormatter(logging.Formatter):
+            def format(self, record):
+                return json.dumps({
+                    "level": record.levelname.lower(),
+                    "msg": record.getMessage(),
+                    "logger": record.name,
+                    "time": self.formatTime(record),
+                })
+
+        handler.setFormatter(JsonFormatter())
+        logging.basicConfig(level=lvl, handlers=[handler])
+    else:
+        logging.basicConfig(
+            level=lvl,
+            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        )
+
+
+def setup_node_groups(path: str) -> List[ngmod.NodeGroupOptions]:
+    """Load + validate, fail-fast on problems (reference: cmd/main.go:94-121)."""
+    with open(path) as f:
+        node_groups = ngmod.unmarshal_node_group_options(f)
+    for ng in node_groups:
+        problems = ngmod.validate_node_group(ng)
+        if problems:
+            for problem in problems:
+                log.error("nodegroup %r: %s", ng.name, problem)
+            raise SystemExit(
+                f"nodegroup {ng.name!r} failed validation with "
+                f"{len(problems)} problem(s)"
+            )
+        log.info("valid nodegroup: %s", ng.name)
+    if not node_groups:
+        raise SystemExit("no nodegroups defined in config")
+    return node_groups
+
+
+def load_sim_state(path: str) -> InMemoryKubernetesClient:
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    nodes = []
+    for spec in doc.get("nodes", []) or []:
+        taints = []
+        if spec.get("tainted_at") is not None:
+            taints.append(k8s.Taint(
+                key=k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY,
+                value=str(int(spec["tainted_at"])),
+            ))
+        nodes.append(k8s.Node(
+            name=spec["name"],
+            labels=dict(spec.get("labels", {})),
+            annotations=dict(spec.get("annotations", {})),
+            cpu_allocatable_milli=int(spec.get("cpu_milli", 0)),
+            mem_allocatable_bytes=int(spec.get("mem_bytes", 0)),
+            creation_time_ns=int(spec.get("creation_time_ns", 0)),
+            unschedulable=bool(spec.get("cordoned", False)),
+            taints=taints,
+            provider_id=spec.get("provider_id", spec["name"]),
+        ))
+    pods = []
+    for spec in doc.get("pods", []) or []:
+        pods.append(k8s.Pod(
+            name=spec["name"],
+            namespace=spec.get("namespace", "default"),
+            node_name=spec.get("node_name", ""),
+            containers=[k8s.ResourceRequests(
+                cpu_milli=int(spec.get("cpu_milli", 0)),
+                mem_bytes=int(spec.get("mem_bytes", 0)),
+            )],
+            node_selector=dict(spec.get("node_selector", {})),
+            owner_kind=spec.get("owner_kind", ""),
+        ))
+    return InMemoryKubernetesClient(nodes=nodes, pods=pods)
+
+
+def setup_cloud_provider(args, node_groups, client) -> MockBuilder:
+    """Reference: cmd/main.go:68-91. The sim provider mirrors current cluster
+    state; AWS requires its SDK (gated)."""
+    if args.cloud_provider == "aws":
+        from escalator_tpu.cloudprovider.aws.builder import AWSBuilder
+
+        return AWSBuilder(node_groups)
+    provider = MockCloudProvider()
+    for ng in node_groups:
+        group_nodes = [
+            n for n in client.list_nodes()
+            if n.labels.get(ng.label_key) == ng.label_value
+        ]
+        provider.register_node_group(MockNodeGroup(
+            ng.cloud_provider_group_name, ng.name,
+            min_size=ng.min_nodes, max_size=max(ng.max_nodes, len(group_nodes)),
+            target_size=len(group_nodes),
+        ))
+    return MockBuilder(provider)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.loglevel, args.logfmt)
+
+    node_groups = setup_node_groups(args.nodegroups)
+
+    if args.sim_state:
+        client = load_sim_state(args.sim_state)
+    elif args.kubeconfig or args.cloud_provider == "aws":
+        client = load_incluster()  # raises with a clear message (no k8s package)
+    else:
+        raise SystemExit(
+            "no cluster source: pass --sim-state for simulation mode or"
+            " --kubeconfig for a real cluster"
+        )
+
+    builder = setup_cloud_provider(args, node_groups, client)
+
+    server = None
+    if not args.once:
+        host, _, port = args.address.rpartition(":")
+        server = metrics.start(f"{host or '0.0.0.0'}:{port}")
+        log.info("metrics listening on %s", args.address)
+
+    stop_event = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal received, stopping")
+        stop_event.set()
+
+    try:
+        signal.signal(signal.SIGINT, on_signal)
+        signal.signal(signal.SIGTERM, on_signal)
+    except ValueError:
+        pass  # not the main thread (tests)
+
+    if args.leader_elect:
+        deposed = threading.Event()
+        elector = LeaderElector(
+            FileResourceLock(args.leader_elect_lock_file),
+            LeaderElectionConfig(
+                lease_duration_sec=ngmod.parse_duration(
+                    args.leader_elect_lease_duration),
+                renew_deadline_sec=ngmod.parse_duration(
+                    args.leader_elect_renew_deadline),
+                retry_period_sec=ngmod.parse_duration(
+                    args.leader_elect_retry_period),
+            ),
+            on_deposed=deposed.set,
+        )
+        log.info("awaiting leadership (%s)", elector.identity)
+        if not elector.run():
+            return 1
+        log.info("became leader")
+
+        def watch_deposed():
+            deposed.wait()
+            # crash-to-restart HA (reference: cmd/main.go:147-154)
+            log.critical("lost leadership lease; exiting")
+            stop_event.set()
+
+        threading.Thread(target=watch_deposed, daemon=True).start()
+
+    controller = ctl.Controller(
+        ctl.Opts(
+            client=client,
+            node_groups=node_groups,
+            cloud_provider_builder=builder,
+            scan_interval_sec=ngmod.parse_duration(args.scaninterval) or 60.0,
+            dry_mode=args.drymode,
+            backend=make_backend(args.backend),
+        ),
+        stop_event=stop_event,
+    )
+
+    if args.once:
+        controller.run_once()
+        deltas = {
+            name: state.scale_delta
+            for name, state in controller.node_groups.items()
+        }
+        provider = controller.cloud_provider
+        targets = {
+            ng.name(): ng.target_size() for ng in provider.node_groups()
+        }
+        print(json.dumps({"deltas": deltas, "provider_targets": targets}))
+        return 0
+
+    try:
+        controller.run_forever(run_immediately=True)
+    finally:
+        if server is not None:
+            server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""AWS provider builder (reference: /root/reference/pkg/cloudprovider/aws/builder.go).
+Requires an AWS SDK (boto3), which is not part of this image — the full ASG/fleet
+implementation lives in aws.py and activates when an SDK (or injected fake) is
+available."""
+
+from __future__ import annotations
+
+from typing import List
+
+from escalator_tpu.cloudprovider import interface as cp
+
+
+class AWSBuilder(cp.Builder):
+    def __init__(self, node_groups, region: str = "", assume_role_arn: str = ""):
+        self.node_groups = node_groups
+        self.region = region
+        self.assume_role_arn = assume_role_arn
+
+    def build(self) -> cp.CloudProvider:
+        from escalator_tpu.cloudprovider.aws.aws import AWSCloudProvider, make_clients
+
+        autoscaling, ec2 = make_clients(self.region, self.assume_role_arn)
+        provider = AWSCloudProvider(autoscaling, ec2)
+        provider.register_node_groups(
+            *[
+                cp.NodeGroupConfig(
+                    name=ng.name,
+                    group_id=ng.cloud_provider_group_name,
+                    aws=cp.AWSNodeGroupConfig(
+                        launch_template_id=ng.aws.launch_template_id,
+                        launch_template_version=ng.aws.launch_template_version,
+                        fleet_instance_ready_timeout_sec=(
+                            ng.aws.fleet_instance_ready_timeout_duration()
+                        ),
+                        lifecycle=ng.aws.lifecycle,
+                        instance_type_overrides=tuple(
+                            ng.aws.instance_type_overrides
+                        ),
+                        resource_tagging=ng.aws.resource_tagging,
+                    ),
+                )
+                for ng in self.node_groups
+            ]
+        )
+        return provider

@@ -1,0 +1,16 @@
+"""Typed provider errors (reference: /root/reference/pkg/cloudprovider/types.go:7-15).
+NodeNotInNodeGroup is FATAL to the controller run — it aborts the tick loop
+(reference: pkg/controller/controller.go:386-393,435-443)."""
+
+from __future__ import annotations
+
+
+class NodeNotInNodeGroupError(Exception):
+    def __init__(self, node_name: str, provider_id: str, node_group: str):
+        self.node_name = node_name
+        self.provider_id = provider_id
+        self.node_group = node_group
+        super().__init__(
+            f"node {node_name} ({provider_id}) does not belong in node group"
+            f" {node_group}"
+        )

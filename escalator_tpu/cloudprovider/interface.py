@@ -1,0 +1,137 @@
+"""Cloud provider SPI — mirror of the reference's provider abstraction
+(/root/reference/pkg/cloudprovider/interface.go:12-121), re-typed for this framework's
+object model. Implementations: in-memory mock (testsupport), AWS (gated on SDK
+availability), and any future provider."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from escalator_tpu.k8s import types as k8s
+
+
+class Instance(abc.ABC):
+    """Cloud instance info (reference: interface.go:34-41)."""
+
+    @abc.abstractmethod
+    def instantiation_time(self) -> float:
+        """Unix seconds the resource was instantiated."""
+
+    @abc.abstractmethod
+    def id(self) -> str:
+        ...
+
+
+class NodeGroup(abc.ABC):
+    """A controllable set of homogeneous nodes (reference: interface.go:43-92)."""
+
+    @abc.abstractmethod
+    def id(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def min_size(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def max_size(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def target_size(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def size(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def increase_size(self, delta: int) -> None:
+        ...
+
+    @abc.abstractmethod
+    def belongs(self, node: k8s.Node) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def delete_nodes(self, *nodes: k8s.Node) -> None:
+        ...
+
+    @abc.abstractmethod
+    def decrease_target_size(self, delta: int) -> None:
+        ...
+
+    @abc.abstractmethod
+    def nodes(self) -> List[str]:
+        """Provider IDs of member nodes."""
+
+
+class CloudProvider(abc.ABC):
+    """Reference: interface.go:12-32."""
+
+    @abc.abstractmethod
+    def name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def node_groups(self) -> List[NodeGroup]:
+        ...
+
+    @abc.abstractmethod
+    def get_node_group(self, group_id: str) -> Optional[NodeGroup]:
+        ...
+
+    @abc.abstractmethod
+    def register_node_groups(self, *configs: "NodeGroupConfig") -> None:
+        ...
+
+    @abc.abstractmethod
+    def refresh(self) -> None:
+        """Called before every main loop tick."""
+
+    @abc.abstractmethod
+    def get_instance(self, node: k8s.Node) -> Instance:
+        ...
+
+
+class Builder(abc.ABC):
+    """Reference: interface.go:94-97."""
+
+    @abc.abstractmethod
+    def build(self) -> CloudProvider:
+        ...
+
+
+@dataclass
+class AWSNodeGroupConfig:
+    """Reference: interface.go:112-121."""
+
+    launch_template_id: str = ""
+    launch_template_version: str = ""
+    fleet_instance_ready_timeout_sec: float = 60.0
+    lifecycle: str = ""
+    instance_type_overrides: Tuple[str, ...] = ()
+    resource_tagging: bool = False
+
+
+@dataclass
+class NodeGroupConfig:
+    """Reference: interface.go:105-110."""
+
+    name: str
+    group_id: str
+    aws: AWSNodeGroupConfig = field(default_factory=AWSNodeGroupConfig)
+
+
+@dataclass
+class BuildOpts:
+    """Reference: interface.go:99-103."""
+
+    provider_id: str = ""
+    node_group_configs: List[NodeGroupConfig] = field(default_factory=list)

@@ -1,0 +1,293 @@
+"""Compute backends: where the scale decision actually runs.
+
+The reference computes decisions inline in Go (pkg/controller/controller.go:192-397).
+Here the controller depends on the ``ComputeBackend`` interface — the SPI slot
+SURVEY.md §2.7 calls the "compute plugin", shaped like a sibling of
+``cloudprovider.Builder`` (reference: pkg/cloudprovider/interface.go:95-97):
+
+- ``GoldenBackend``  — pure-Python semantics, dependency-free fallback of last resort
+- ``JaxBackend``     — batched device kernel, single program for all groups (TPU when
+  present, XLA-CPU otherwise: same traced code, so fallback keeps parity for free)
+- ``ShardedJaxBackend`` — nodegroup axis sharded over a device mesh via shard_map
+
+All return the same ``GroupDecision`` objects (decision + object-level selections), so
+the controller shell is backend-agnostic. ``make_backend("auto")`` picks the best
+available. A gRPC remote backend (``escalator_tpu.plugin``) wraps any of these behind
+a service boundary for non-Python controllers.
+"""
+
+from __future__ import annotations
+
+import abc
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from escalator_tpu.core import semantics
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.metrics import metrics
+
+#: One group's inputs: (pods, nodes, config, cross-tick state)
+GroupInput = Tuple[
+    Sequence[k8s.Pod],
+    Sequence[k8s.Node],
+    semantics.GroupConfig,
+    semantics.GroupState,
+]
+
+
+@dataclass
+class GroupDecision:
+    """Backend output for one nodegroup, at object level."""
+
+    decision: semantics.Decision
+    scale_down_order: List[k8s.Node] = field(default_factory=list)  # oldest-first
+    untaint_order: List[k8s.Node] = field(default_factory=list)     # newest-first
+    reap_nodes: List[k8s.Node] = field(default_factory=list)
+    node_pods_remaining: Dict[str, int] = field(default_factory=dict)
+
+
+class ComputeBackend(abc.ABC):
+    name = "abstract"
+
+    @abc.abstractmethod
+    def decide(
+        self,
+        group_inputs: Sequence[GroupInput],
+        now_sec: int,
+        dry_mode_flags: Optional[Sequence[bool]] = None,
+        taint_trackers: Optional[Sequence[Sequence[str]]] = None,
+    ) -> List[GroupDecision]:
+        ...
+
+
+class GoldenBackend(ComputeBackend):
+    """Pure-Python reference semantics (escalator_tpu.core.semantics)."""
+
+    name = "golden"
+
+    def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        out: List[GroupDecision] = []
+        for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+            dry = bool(dry_mode_flags[gi]) if dry_mode_flags else False
+            tracker = taint_trackers[gi] if taint_trackers else None
+            decision = semantics.evaluate_node_group(
+                pods, nodes, config, state, dry, tracker
+            )
+            untainted, tainted, _ = semantics.filter_nodes(nodes, dry, tracker)
+            info = k8s.create_node_name_to_info_map(list(pods), list(nodes))
+            reap_idx = semantics.reap_eligible(
+                tainted, info, config.soft_delete_grace_sec,
+                config.hard_delete_grace_sec, now_sec,
+            )
+            out.append(
+                GroupDecision(
+                    decision=decision,
+                    scale_down_order=[
+                        untainted[i] for i in semantics.nodes_oldest_first(untainted)
+                    ],
+                    untaint_order=[
+                        tainted[i] for i in semantics.nodes_newest_first(tainted)
+                    ],
+                    reap_nodes=[tainted[i] for i in reap_idx],
+                    node_pods_remaining={
+                        name: sum(
+                            1 for p in entry[1] if not k8s.pod_is_daemonset(p)
+                        )
+                        for name, entry in info.items()
+                    },
+                )
+            )
+        return out
+
+
+def _round_up(n: int, minimum: int = 64) -> int:
+    """Next power of two >= n (>= minimum): keeps jit shapes stable as the cluster
+    grows/shrinks (no recompilation storms, SURVEY.md §7 raggedness)."""
+    size = max(n, minimum)
+    return 1 << (size - 1).bit_length()
+
+
+def _unpack(out, group_inputs) -> List[GroupDecision]:
+    """Shared kernel-output -> GroupDecision conversion for array backends."""
+    status = np.asarray(out.status)
+    delta = np.asarray(out.nodes_delta)
+    cpu_pct = np.asarray(out.cpu_percent)
+    mem_pct = np.asarray(out.mem_percent)
+    cpu_req = np.asarray(out.cpu_request_milli)
+    mem_req = np.asarray(out.mem_request_bytes)
+    cpu_cap = np.asarray(out.cpu_capacity_milli)
+    mem_cap = np.asarray(out.mem_capacity_bytes)
+    n_unt = np.asarray(out.num_untainted)
+    n_tnt = np.asarray(out.num_tainted)
+    n_crd = np.asarray(out.num_cordoned)
+    down = np.asarray(out.scale_down_order)
+    up = np.asarray(out.untaint_order)
+    u_off = np.asarray(out.untainted_offsets)
+    t_off = np.asarray(out.tainted_offsets)
+    reap = np.asarray(out.reap_mask)
+    remaining = np.asarray(out.node_pods_remaining)
+
+    # flat node index -> object, in pack order
+    flat_nodes: List[k8s.Node] = []
+    for _, nodes, _, _ in group_inputs:
+        flat_nodes.extend(nodes)
+
+    results: List[GroupDecision] = []
+    for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+        decision = semantics.Decision(
+            status=semantics.DecisionStatus(int(status[gi])),
+            nodes_delta=int(delta[gi]),
+            cpu_percent=float(cpu_pct[gi]),
+            mem_percent=float(mem_pct[gi]),
+            cpu_request_milli=int(cpu_req[gi]),
+            mem_request_bytes=int(mem_req[gi]),
+            cpu_capacity_milli=int(cpu_cap[gi]),
+            mem_capacity_bytes=int(mem_cap[gi]),
+            num_untainted=int(n_unt[gi]),
+            num_tainted=int(n_tnt[gi]),
+            num_cordoned=int(n_crd[gi]),
+        )
+        down_nodes = [flat_nodes[i] for i in down[u_off[gi] : u_off[gi + 1]]]
+        up_nodes = [flat_nodes[i] for i in up[t_off[gi] : t_off[gi + 1]]]
+        results.append(
+            GroupDecision(
+                decision=decision,
+                scale_down_order=down_nodes,
+                untaint_order=up_nodes,
+            )
+        )
+    # reap + pods-remaining are flat-indexed; slice out each group's node range
+    base = 0
+    for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+        idxs = range(base, base + len(nodes))
+        results[gi].reap_nodes = [flat_nodes[i] for i in idxs if reap[i]]
+        results[gi].node_pods_remaining = {
+            flat_nodes[i].name: int(remaining[i]) for i in idxs
+        }
+        base += len(nodes)
+    return results
+
+
+class JaxBackend(ComputeBackend):
+    """Single-device (or data-parallel-free) batched kernel. The jit cache is keyed
+    on padded shapes; capacities grow by powers of two."""
+
+    name = "jax"
+
+    def __init__(self):
+        from escalator_tpu.ops import kernel  # defers jax import
+
+        self._kernel = kernel
+        self._pad_pods = 0
+        self._pad_nodes = 0
+        self._pad_groups = 0
+
+    def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        from escalator_tpu.core.arrays import pack_cluster
+
+        total_pods = sum(len(p) for p, *_ in group_inputs)
+        total_nodes = sum(len(n) for _, n, *_ in group_inputs)
+        self._pad_pods = max(self._pad_pods, _round_up(total_pods))
+        self._pad_nodes = max(self._pad_nodes, _round_up(total_nodes))
+        self._pad_groups = max(self._pad_groups, _round_up(len(group_inputs), 8))
+
+        t0 = time.perf_counter()
+        cluster = pack_cluster(
+            group_inputs,
+            dry_mode_flags=dry_mode_flags,
+            taint_trackers=taint_trackers,
+            pad_pods=self._pad_pods,
+            pad_nodes=self._pad_nodes,
+            pad_groups=self._pad_groups,
+        )
+        t1 = time.perf_counter()
+        out = self._kernel.decide_jit(cluster, np.int64(now_sec))
+        import jax
+
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
+        metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+        return _unpack(out, group_inputs)
+
+
+class ShardedJaxBackend(ComputeBackend):
+    """Nodegroup axis sharded over a device mesh (escalator_tpu.parallel.mesh)."""
+
+    name = "sharded-jax"
+
+    def __init__(self, mesh=None):
+        from escalator_tpu.parallel import mesh as meshlib
+
+        self._meshlib = meshlib
+        self._mesh = mesh if mesh is not None else meshlib.make_mesh()
+        self._decider = meshlib.make_sharded_decider(self._mesh)
+        self._num_shards = self._mesh.devices.size
+        # high-water-mark per-shard pads: same recompile-avoidance as JaxBackend
+        self._pad_pods = 0
+        self._pad_nodes = 0
+        self._pad_groups = 0
+
+    def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        import jax
+
+        t0 = time.perf_counter()
+        assignment = self._meshlib.assign_shards(group_inputs, self._num_shards)
+        max_pods, max_nodes, max_groups = self._meshlib.shard_capacity(
+            group_inputs, assignment
+        )
+        self._pad_pods = max(self._pad_pods, _round_up(max_pods))
+        self._pad_nodes = max(self._pad_nodes, _round_up(max_nodes))
+        self._pad_groups = max(self._pad_groups, _round_up(max_groups, 8))
+        sharded, assignment = self._meshlib.pack_cluster_sharded(
+            group_inputs,
+            num_shards=self._num_shards,
+            pad_pods_per_shard=self._pad_pods,
+            pad_nodes_per_shard=self._pad_nodes,
+            pad_groups_per_shard=self._pad_groups,
+            dry_mode_flags=dry_mode_flags,
+            taint_trackers=taint_trackers,
+        )
+        placed = self._meshlib.shard_cluster_arrays(sharded, self._mesh)
+        t1 = time.perf_counter()
+        out = self._decider(placed, np.int64(now_sec))
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
+        metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+
+        # Reassemble per-shard outputs back to the caller's group order.
+        results: List[Optional[GroupDecision]] = [None] * len(group_inputs)
+        leaves, aux = out.tree_flatten()
+        for s, shard_groups in enumerate(assignment):
+            shard_out = type(out).tree_unflatten(
+                aux, [np.asarray(leaf[s]) for leaf in leaves]
+            )
+            shard_inputs = [group_inputs[gi] for gi in shard_groups]
+            shard_results = _unpack(shard_out, shard_inputs)
+            for local, gi in enumerate(shard_groups):
+                results[gi] = shard_results[local]
+        return [r for r in results if r is not None]
+
+
+def make_backend(kind: str = "auto") -> ComputeBackend:
+    """auto: sharded-jax when >1 device, jax when jax imports, else golden."""
+    if kind == "golden":
+        return GoldenBackend()
+    if kind == "jax":
+        return JaxBackend()
+    if kind == "sharded-jax":
+        return ShardedJaxBackend()
+    if kind != "auto":
+        raise ValueError(f"unknown backend {kind!r}")
+    try:
+        import jax
+
+        if len(jax.devices()) > 1:
+            return ShardedJaxBackend()
+        return JaxBackend()
+    except Exception:
+        return GoldenBackend()

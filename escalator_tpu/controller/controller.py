@@ -1,0 +1,520 @@
+"""The controller: tick loop + scale executors around the batched decision backend.
+
+Mirror of /root/reference/pkg/controller/controller.go, scale_up.go, scale_down.go —
+with one architectural change: instead of computing each nodegroup's decision inline
+and serially (controller.go:416-445), ``run_once`` reads every group's listers, hands
+the whole batch to a ``ComputeBackend`` (one device program for all groups), then
+executes side effects per group. Nodegroups are disjoint by label selector, so
+batching the pure decision phase is semantically equivalent to the reference's serial
+loop; all cross-tick state (scale locks, cached capacity, dry-mode taint trackers —
+controller.go:28-44) stays host-side in ``NodeGroupState``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from escalator_tpu.cloudprovider import interface as cp
+from escalator_tpu.cloudprovider.errors import NodeNotInNodeGroupError
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.controller.backend import ComputeBackend, GroupDecision, make_backend
+from escalator_tpu.controller.scale_lock import ScaleLock
+from escalator_tpu.core import semantics
+from escalator_tpu.k8s import taint as taintlib
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.client import KubernetesClient
+from escalator_tpu.k8s.listers import NodeLister, PodLister
+from escalator_tpu.metrics import metrics
+from escalator_tpu.utils.clock import Clock
+
+log = logging.getLogger("escalator_tpu.controller")
+
+
+@dataclass
+class NodeGroupState:
+    """Everything about one nodegroup (reference: controller.go:28-44)."""
+
+    opts: ngmod.NodeGroupOptions
+    pod_lister: PodLister
+    node_lister: NodeLister
+    scale_lock: ScaleLock
+    # dry-mode in-memory taint tracking (controller.go:34-35)
+    taint_tracker: List[str] = field(default_factory=list)
+    scale_delta: int = 0
+    last_scale_out: float = 0.0
+    # cached instance capacity + lock view for the kernel
+    kernel_state: semantics.GroupState = field(default_factory=semantics.GroupState)
+
+
+@dataclass
+class Opts:
+    """Reference: controller.go:46-53."""
+
+    client: KubernetesClient
+    node_groups: List[ngmod.NodeGroupOptions]
+    cloud_provider_builder: cp.Builder
+    scan_interval_sec: float = 60.0
+    dry_mode: bool = False
+    backend: Optional[ComputeBackend] = None
+    clock: Clock = field(default_factory=Clock)
+
+
+@dataclass
+class _ScaleOpts:
+    """Reference: controller.go:55-63."""
+
+    nodes: List[k8s.Node]
+    tainted_nodes: List[k8s.Node]
+    untainted_nodes: List[k8s.Node]
+    node_group: NodeGroupState
+    nodes_delta: int = 0
+    group_decision: Optional[GroupDecision] = None
+
+
+def _build_listers(
+    client: KubernetesClient, opts: ngmod.NodeGroupOptions
+) -> Tuple[PodLister, NodeLister]:
+    """Reference: node_group.go:290-303 — the `default` group uses the
+    selector-less pod filter."""
+    if opts.name == ngmod.DEFAULT_NODE_GROUP:
+        pod_filter = ngmod.new_pod_default_filter_func()
+    else:
+        pod_filter = ngmod.new_pod_affinity_filter_func(opts.label_key, opts.label_value)
+    node_filter = ngmod.new_node_label_filter_func(opts.label_key, opts.label_value)
+    return PodLister(client, pod_filter), NodeLister(client, node_filter)
+
+
+class Controller:
+    """Reference: controller.go:19-117."""
+
+    def __init__(self, opts: Opts, stop_event: Optional[threading.Event] = None):
+        self.opts = opts
+        self.client = opts.client
+        self.clock = opts.clock
+        self.stop_event = stop_event or threading.Event()
+        self.backend = opts.backend or make_backend("auto")
+        self.cloud_provider = opts.cloud_provider_builder.build()
+
+        self.node_groups: Dict[str, NodeGroupState] = {}
+        for ng_opts in opts.node_groups:
+            cloud_ng = self.cloud_provider.get_node_group(
+                ng_opts.cloud_provider_group_name
+            )
+            if cloud_ng is None:
+                raise RuntimeError(
+                    f'could not find node group "{ng_opts.cloud_provider_group_name}"'
+                    " on cloud provider"
+                )
+            if ng_opts.auto_discover_min_max_node_options():
+                ng_opts.min_nodes = cloud_ng.min_size()
+                ng_opts.max_nodes = cloud_ng.max_size()
+            pods, nodes = _build_listers(self.client, ng_opts)
+            self.node_groups[ng_opts.name] = NodeGroupState(
+                opts=ng_opts,
+                pod_lister=pods,
+                node_lister=nodes,
+                scale_lock=ScaleLock(
+                    self.clock,
+                    ng_opts.scale_up_cool_down_period_duration(),
+                    ng_opts.name,
+                ),
+            )
+
+    # ------------------------------------------------------------------ dry mode
+    def _dry_mode(self, state: NodeGroupState) -> bool:
+        """Reference: controller.go:114-117."""
+        return self.opts.dry_mode or state.opts.dry_mode
+
+    # ------------------------------------------------------------------ tick
+    def run_once(self) -> None:
+        """One tick over all nodegroups (reference: controller.go:400-451)."""
+        start = self.clock.now()
+
+        # Provider refresh with stale-credential retries (controller.go:403-414).
+        try:
+            self.cloud_provider.refresh()
+        except Exception as first_err:
+            err: Optional[Exception] = first_err
+            for i in range(2):
+                log.warning(
+                    "cloud provider failed to refresh; re-fetching credentials"
+                    " (try %d): %s", i + 1, err,
+                )
+                self.clock.sleep(5)
+                self.cloud_provider = self.opts.cloud_provider_builder.build()
+                try:
+                    self.cloud_provider.refresh()
+                    err = None
+                    break
+                except Exception as e:  # noqa: PERF203
+                    err = e
+            if err is not None:
+                raise err
+
+        # Phase 1: per-group provider checks + lister reads (object level).
+        batch: List[Tuple[str, NodeGroupState, List[k8s.Pod], List[k8s.Node]]] = []
+        for ng_opts in self.opts.node_groups:
+            state = self.node_groups[ng_opts.name]
+            cloud_ng = self.cloud_provider.get_node_group(
+                ng_opts.cloud_provider_group_name
+            )
+            if cloud_ng is None:
+                raise RuntimeError("could not find node group")
+            if ng_opts.auto_discover_min_max_node_options():
+                state.opts.min_nodes = cloud_ng.min_size()
+                state.opts.max_nodes = cloud_ng.max_size()
+            metrics.cloud_provider_min_size.labels(
+                self.cloud_provider.name(), cloud_ng.id(), ng_opts.name
+            ).set(cloud_ng.min_size())
+            metrics.cloud_provider_max_size.labels(
+                self.cloud_provider.name(), cloud_ng.id(), ng_opts.name
+            ).set(cloud_ng.max_size())
+            metrics.cloud_provider_target_size.labels(
+                self.cloud_provider.name(), cloud_ng.id(), ng_opts.name
+            ).set(cloud_ng.target_size())
+            metrics.cloud_provider_size.labels(
+                self.cloud_provider.name(), cloud_ng.id(), ng_opts.name
+            ).set(cloud_ng.size())
+
+            try:
+                pods = state.pod_lister.list()
+                nodes = state.node_lister.list()
+            except Exception as e:
+                log.error("failed to list pods/nodes for %s: %s", ng_opts.name, e)
+                metrics.node_group_scale_delta.labels(ng_opts.name).set(0)
+                state.scale_delta = 0
+                continue
+            # sync the kernel's view of the scale lock
+            state.kernel_state.locked = state.scale_lock.locked()
+            state.kernel_state.requested_nodes = state.scale_lock.requested_nodes
+            batch.append((ng_opts.name, state, pods, nodes))
+
+        # Phase 2: one batched decision for all groups.
+        now_sec = int(self.clock.now())
+        group_inputs = [
+            (pods, nodes, st.opts.to_group_config(), st.kernel_state)
+            for (_, st, pods, nodes) in batch
+        ]
+        decisions = self.backend.decide(
+            group_inputs,
+            now_sec,
+            dry_mode_flags=[self._dry_mode(st) for (_, st, _, _) in batch],
+            taint_trackers=[st.taint_tracker for (_, st, _, _) in batch],
+        )
+
+        # Phase 3: per-group side effects.
+        for (name, state, pods, nodes), gd in zip(batch, decisions):
+            delta = self._act_on_decision(name, state, pods, nodes, gd)
+            metrics.node_group_scale_delta.labels(name).set(delta)
+            state.scale_delta = delta
+
+        metrics.run_count.inc()
+        log.debug("scaling took a total of %.3fs", self.clock.now() - start)
+
+    def run_forever(self, run_immediately: bool = False) -> None:
+        """Reference: controller.go:455-480."""
+        if run_immediately:
+            self.run_once()
+        while not self.stop_event.wait(self.opts.scan_interval_sec):
+            self.run_once()
+
+    # ------------------------------------------------------------------ decision
+    def _act_on_decision(
+        self,
+        nodegroup: str,
+        state: NodeGroupState,
+        pods: List[k8s.Pod],
+        nodes: List[k8s.Node],
+        gd: GroupDecision,
+    ) -> int:
+        """Everything scaleNodeGroup does after the math
+        (reference: controller.go:213-396). Returns the per-group delta the
+        reference would return."""
+        d = gd.decision
+        untainted, tainted, cordoned = semantics.filter_nodes(
+            nodes, self._dry_mode(state), state.taint_tracker
+        )
+
+        metrics.node_group_nodes.labels(nodegroup).set(len(nodes))
+        metrics.node_group_nodes_cordoned.labels(nodegroup).set(d.num_cordoned)
+        metrics.node_group_nodes_untainted.labels(nodegroup).set(d.num_untainted)
+        metrics.node_group_nodes_tainted.labels(nodegroup).set(d.num_tainted)
+        metrics.node_group_pods.labels(nodegroup).set(len(pods))
+
+        if d.status == semantics.DecisionStatus.NOOP_EMPTY:
+            return 0
+        if d.status == semantics.DecisionStatus.ERR_BELOW_MIN:
+            log.warning(
+                "[%s] node count %d less than minimum %d",
+                nodegroup, len(nodes), state.opts.min_nodes,
+            )
+            return 0
+        if d.status == semantics.DecisionStatus.ERR_ABOVE_MAX:
+            log.warning(
+                "[%s] node count %d larger than maximum %d",
+                nodegroup, len(nodes), state.opts.max_nodes,
+            )
+            return 0
+
+        metrics.node_group_cpu_request.labels(nodegroup).set(d.cpu_request_milli)
+        metrics.node_group_cpu_capacity.labels(nodegroup).set(d.cpu_capacity_milli)
+        metrics.node_group_mem_request.labels(nodegroup).set(d.mem_request_bytes)
+        metrics.node_group_mem_capacity.labels(nodegroup).set(d.mem_capacity_bytes)
+
+        scale_opts = _ScaleOpts(
+            nodes=nodes,
+            tainted_nodes=tainted,
+            untainted_nodes=untainted,
+            node_group=state,
+            group_decision=gd,
+        )
+
+        if d.status == semantics.DecisionStatus.FORCED_MIN_SCALE_UP:
+            log.warning("[%s] less untainted nodes than the minimum", nodegroup)
+            scale_opts.nodes_delta = d.nodes_delta
+            try:
+                return self.scale_up(scale_opts)
+            except NodeNotInNodeGroupError:
+                raise
+            except Exception as e:
+                log.error("[%s] %s", nodegroup, e)
+                return 0
+
+        if d.status == semantics.DecisionStatus.ERR_DIV_ZERO:
+            log.error("[%s] cannot divide by zero in percent calculation", nodegroup)
+            return 0
+
+        # percent metrics; scale-from-zero sentinel reported as 0
+        # (controller.go:308-315)
+        if d.cpu_percent == semantics.MAX_FLOAT64 or \
+                d.mem_percent == semantics.MAX_FLOAT64:
+            metrics.node_group_cpu_percent.labels(nodegroup).set(0)
+            metrics.node_group_mem_percent.labels(nodegroup).set(0)
+        else:
+            metrics.node_group_cpu_percent.labels(nodegroup).set(d.cpu_percent)
+            metrics.node_group_mem_percent.labels(nodegroup).set(d.mem_percent)
+
+        if d.status == semantics.DecisionStatus.LOCKED:
+            log.info("[%s] waiting for scale to finish", nodegroup)
+            return state.scale_lock.requested_nodes
+
+        self._calculate_new_node_metrics(nodegroup, state, nodes)
+
+        if d.status == semantics.DecisionStatus.ERR_NEG_DELTA:
+            log.error("[%s] negative scale up delta", nodegroup)
+            return 0
+
+        nodes_delta = d.nodes_delta
+
+        try:
+            if nodes_delta < 0:
+                scale_opts.nodes_delta = -nodes_delta
+                self.scale_down(scale_opts)
+            elif nodes_delta > 0:
+                scale_opts.nodes_delta = nodes_delta
+                self.scale_up(scale_opts)
+                state.last_scale_out = self.clock.now()
+            else:
+                removed = self.try_remove_tainted_nodes(scale_opts)
+                log.info("[%s] reaper: deleted %d empty nodes", nodegroup, -removed)
+        except NodeNotInNodeGroupError:
+            raise
+        except Exception as e:
+            log.error("[%s] %s", nodegroup, e)
+
+        return nodes_delta
+
+    def _calculate_new_node_metrics(
+        self, nodegroup: str, state: NodeGroupState, nodes: List[k8s.Node]
+    ) -> None:
+        """Node registration lag histogram (reference: controller.go:157-189)."""
+        if state.scale_delta <= 0:
+            return
+        count_new = 0
+        for node in nodes:
+            reg_time = node.creation_time_ns / 1e9
+            if reg_time > state.last_scale_out:
+                try:
+                    instance = self.cloud_provider.get_instance(node)
+                except Exception:
+                    log.error(
+                        "unable to get instance %s for registration lag",
+                        node.provider_id,
+                    )
+                    continue
+                lag = reg_time - instance.instantiation_time()
+                metrics.node_group_node_registration_lag.labels(nodegroup).observe(
+                    lag
+                )
+                count_new += 1
+        if count_new != state.scale_delta:
+            log.warning(
+                "[%s] expected new nodes: %d actual: %d",
+                nodegroup, state.scale_delta, count_new,
+            )
+
+    # ------------------------------------------------------------------ scale up
+    def scale_up(self, opts: _ScaleOpts) -> int:
+        """Untaint first, then grow the provider group
+        (reference: scale_up.go:14-45)."""
+        untainted = self._scale_up_untaint(opts)
+        remaining = opts.nodes_delta - untainted
+        if remaining > 0:
+            added = self._scale_up_cloud_provider(opts, remaining)
+            opts.node_group.scale_lock.lock(added)
+            return untainted + added
+        return untainted
+
+    def _scale_up_cloud_provider(self, opts: _ScaleOpts, delta: int) -> int:
+        """Reference: scale_up.go:48-95."""
+        state = opts.node_group
+        cloud_ng = self.cloud_provider.get_node_group(
+            state.opts.cloud_provider_group_name
+        )
+        if cloud_ng is None:
+            raise RuntimeError(
+                "cloud provider node group does not exist:"
+                f" {state.opts.cloud_provider_group_name}"
+            )
+        nodes_to_add = semantics.calculate_nodes_to_add(
+            delta, cloud_ng.target_size(), cloud_ng.max_size()
+        )
+        if nodes_to_add <= 0:
+            raise RuntimeError(
+                "refusing to scale up beyond the maximum size of the autoscaling"
+                f" group (TargetSize: {cloud_ng.target_size()};"
+                f" MaxNodes: {state.opts.max_nodes}). Taking no action"
+            )
+        dry = self._dry_mode(state)
+        log.info(
+            "[%s] increasing cloud provider node group by %d (drymode=%s)",
+            state.opts.name, nodes_to_add, dry,
+        )
+        if not dry:
+            cloud_ng.increase_size(nodes_to_add)
+        return nodes_to_add
+
+    def _scale_up_untaint(self, opts: _ScaleOpts) -> int:
+        """Untaint the newest N tainted nodes (reference: scale_up.go:98-163).
+        Uses the backend's precomputed newest-first order."""
+        state = opts.node_group
+        if not opts.tainted_nodes:
+            log.warning("[%s] there are no tainted nodes to untaint", state.opts.name)
+            return 0
+        metrics.node_group_untaint_event.labels(state.opts.name).inc(
+            opts.nodes_delta
+        )
+        order = (
+            opts.group_decision.untaint_order
+            if opts.group_decision is not None
+            else [
+                opts.tainted_nodes[i]
+                for i in semantics.nodes_newest_first(opts.tainted_nodes)
+            ]
+        )
+        untainted = 0
+        for node in order:
+            if untainted >= opts.nodes_delta:
+                break
+            if not self._dry_mode(state):
+                if k8s.get_to_be_removed_taint(node) is None:
+                    continue
+                try:
+                    taintlib.delete_to_be_removed_taint(node, self.client)
+                except Exception as e:
+                    log.error("failed to untaint %s: %s", node.name, e)
+                    continue
+                untainted += 1
+            else:
+                if node.name in state.taint_tracker:
+                    state.taint_tracker.remove(node.name)
+                    untainted += 1
+        log.info("untainted a total of %d nodes", untainted)
+        return untainted
+
+    # ------------------------------------------------------------------ scale down
+    def scale_down(self, opts: _ScaleOpts) -> int:
+        """Reap then taint (reference: scale_down.go:23-37)."""
+        try:
+            removed = self.try_remove_tainted_nodes(opts)
+            log.info("reaper: deleted %d empty nodes this round", -removed)
+        except NodeNotInNodeGroupError:
+            raise
+        except Exception as e:
+            log.warning("reaping nodes failed: %s", e)
+        return self._scale_down_taint(opts)
+
+    def try_remove_tainted_nodes(self, opts: _ScaleOpts) -> int:
+        """Delete reap-eligible tainted nodes (reference: scale_down.go:51-136).
+        Eligibility was computed in the decision batch (reap_nodes)."""
+        state = opts.node_group
+        if self._dry_mode(state):
+            return 0
+        gd = opts.group_decision
+        to_delete = list(gd.reap_nodes) if gd is not None else []
+        if not to_delete:
+            return 0
+
+        pods_remaining = sum(
+            gd.node_pods_remaining.get(n.name, 0) for n in to_delete
+        )
+        cloud_ng = self.cloud_provider.get_node_group(
+            state.opts.cloud_provider_group_name
+        )
+        if cloud_ng is None:
+            raise RuntimeError(
+                "cloud provider node group does not exist:"
+                f" {state.opts.cloud_provider_group_name}"
+            )
+        cloud_ng.delete_nodes(*to_delete)
+        taintlib.delete_nodes(to_delete, self.client)
+        log.info("[%s] sent delete request to %d nodes", state.opts.name,
+                 len(to_delete))
+        metrics.node_group_pods_evicted.labels(state.opts.name).inc(pods_remaining)
+        return -len(to_delete)
+
+    def _scale_down_taint(self, opts: _ScaleOpts) -> int:
+        """Taint the oldest N untainted nodes with the min-clamp
+        (reference: scale_down.go:138-205)."""
+        state = opts.node_group
+        try:
+            nodes_to_remove = semantics.clamp_scale_down(
+                len(opts.untainted_nodes), opts.nodes_delta, state.opts.min_nodes
+            )
+        except ValueError:
+            raise RuntimeError(
+                f"the number of nodes ({len(opts.untainted_nodes)}) is less than"
+                f" specified minimum of {state.opts.min_nodes}. Taking no action"
+            )
+        log.info("[%s] scaling down: tainting %d nodes", state.opts.name,
+                 nodes_to_remove)
+        metrics.node_group_taint_event.labels(state.opts.name).inc(nodes_to_remove)
+        order = (
+            opts.group_decision.scale_down_order
+            if opts.group_decision is not None
+            else [
+                opts.untainted_nodes[i]
+                for i in semantics.nodes_oldest_first(opts.untainted_nodes)
+            ]
+        )
+        tainted = 0
+        for node in order:
+            if tainted >= nodes_to_remove:
+                break
+            if not self._dry_mode(state):
+                try:
+                    taintlib.add_to_be_removed_taint(
+                        node, self.client, state.opts.taint_effect, self.clock
+                    )
+                except Exception as e:
+                    log.error("while tainting %s: %s", node.name, e)
+                    continue
+                tainted += 1
+            else:
+                state.taint_tracker.append(node.name)
+                tainted += 1
+        log.info("[%s] tainted a total of %d nodes", state.opts.name, tainted)
+        return tainted

@@ -1,0 +1,324 @@
+"""Nodegroup configuration, validation, and pod/node filters — mirror of
+/root/reference/pkg/controller/node_group.go.
+
+Two deliberate fixes over the reference (CHANGELOG-worthy divergences, see SURVEY.md
+§5 "known drift"):
+
+1. The reference's ``HardDeleteGracePeriod`` yaml tag is mistakenly
+   ``soft_delete_grace_period`` (node_group.go:40), silently dropping
+   ``hard_delete_grace_period`` in YAML configs. Here the tag is correct.
+2. The documented-but-phantom ``scale_up_cool_down_timeout`` option
+   (docs/configuration/nodegroup.md:143-157 vs no code) is not replicated; only the
+   real ``scale_up_cool_down_period`` exists.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass, field
+from typing import Dict, IO, List, Optional, Sequence, Tuple, Union
+
+import yaml
+
+from escalator_tpu.core import semantics
+from escalator_tpu.k8s import types as k8s
+
+# Nodegroup name handling pods with no selector (reference: node_group.go:15-16).
+DEFAULT_NODE_GROUP = "default"
+
+
+def parse_duration(s: str) -> float:
+    """Parse a Go-style duration string ("300ms", "1.5h", "2h45m", "10s") to seconds.
+    Returns 0.0 on parse failure, like the reference's lazy parsers
+    (node_group.go:139-175 return 0 on error)."""
+    if not s:
+        return 0.0
+    units = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0,
+             "h": 3600.0}
+    total = 0.0
+    num = ""
+    unit = ""
+    any_part = False
+
+    def flush() -> bool:
+        nonlocal total, num, unit, any_part
+        if not num or unit not in units:
+            return False
+        total += float(num) * units[unit]
+        num, unit = "", ""
+        any_part = True
+        return True
+
+    i = 0
+    negative = False
+    if s and s[0] in "+-":
+        negative = s[0] == "-"
+        i = 1
+    while i < len(s):
+        c = s[i]
+        if c.isdigit() or c == ".":
+            if unit:
+                if not flush():
+                    return 0.0
+            num += c
+        else:
+            unit += c
+        i += 1
+    if not flush():
+        return 0.0
+    return -total if negative else total
+
+
+@dataclass
+class AWSNodeGroupOptions:
+    """Reference: node_group.go:54-66."""
+
+    launch_template_id: str = ""
+    launch_template_version: str = ""
+    fleet_instance_ready_timeout: str = ""
+    lifecycle: str = ""
+    instance_type_overrides: List[str] = field(default_factory=list)
+    resource_tagging: bool = False
+
+    def fleet_instance_ready_timeout_duration(self) -> float:
+        """Defaults to 1 minute (reference: node_group.go:183-195)."""
+        if not self.fleet_instance_ready_timeout:
+            return 60.0
+        return parse_duration(self.fleet_instance_ready_timeout)
+
+
+@dataclass
+class NodeGroupOptions:
+    """Reference: node_group.go:20-52. Field names match the reference's yaml tags."""
+
+    name: str = ""
+    label_key: str = ""
+    label_value: str = ""
+    cloud_provider_group_name: str = ""
+    min_nodes: int = 0
+    max_nodes: int = 0
+    dry_mode: bool = False
+    taint_upper_capacity_threshold_percent: int = 0
+    taint_lower_capacity_threshold_percent: int = 0
+    scale_up_threshold_percent: int = 0
+    slow_node_removal_rate: int = 0
+    fast_node_removal_rate: int = 0
+    soft_delete_grace_period: str = ""
+    hard_delete_grace_period: str = ""
+    scale_up_cool_down_period: str = ""
+    taint_effect: str = ""
+    aws: AWSNodeGroupOptions = field(default_factory=AWSNodeGroupOptions)
+
+    def soft_delete_grace_period_duration(self) -> float:
+        return parse_duration(self.soft_delete_grace_period)
+
+    def hard_delete_grace_period_duration(self) -> float:
+        return parse_duration(self.hard_delete_grace_period)
+
+    def scale_up_cool_down_period_duration(self) -> float:
+        return parse_duration(self.scale_up_cool_down_period)
+
+    def auto_discover_min_max_node_options(self) -> bool:
+        """min=max=0 => discover from the cloud provider
+        (reference: node_group.go:177-180)."""
+        return self.min_nodes == 0 and self.max_nodes == 0
+
+    def to_group_config(self) -> semantics.GroupConfig:
+        """Dense-kernel view of this config."""
+        return semantics.GroupConfig(
+            min_nodes=self.min_nodes,
+            max_nodes=self.max_nodes,
+            taint_lower_percent=self.taint_lower_capacity_threshold_percent,
+            taint_upper_percent=self.taint_upper_capacity_threshold_percent,
+            scale_up_percent=self.scale_up_threshold_percent,
+            slow_removal_rate=self.slow_node_removal_rate,
+            fast_removal_rate=self.fast_node_removal_rate,
+            soft_delete_grace_sec=int(self.soft_delete_grace_period_duration()),
+            hard_delete_grace_sec=int(self.hard_delete_grace_period_duration()),
+        )
+
+
+def unmarshal_node_group_options(
+    stream: Union[str, bytes, IO]
+) -> List[NodeGroupOptions]:
+    """Decode the ``node_groups:`` YAML/JSON document
+    (reference: node_group.go:68-77; YAML is a JSON superset, so one parser)."""
+    if isinstance(stream, (str, bytes)):
+        stream = io.StringIO(
+            stream.decode() if isinstance(stream, bytes) else stream
+        )
+    doc = yaml.safe_load(stream) or {}
+    out: List[NodeGroupOptions] = []
+    for entry in doc.get("node_groups", []) or []:
+        aws_raw = entry.pop("aws", None) or {}
+        known = {f for f in NodeGroupOptions.__dataclass_fields__ if f != "aws"}
+        opts = NodeGroupOptions(
+            **{key: value for key, value in entry.items() if key in known}
+        )
+        aws_known = set(AWSNodeGroupOptions.__dataclass_fields__)
+        opts.aws = AWSNodeGroupOptions(
+            **{key: value for key, value in aws_raw.items() if key in aws_known}
+        )
+        out.append(opts)
+    return out
+
+
+#: AWS lifecycle constants (reference: pkg/cloudprovider/aws/aws.go:24-26).
+LIFECYCLE_ON_DEMAND = "on-demand"
+LIFECYCLE_SPOT = "spot"
+
+
+def _valid_aws_lifecycle(lifecycle: str) -> bool:
+    return lifecycle in ("", LIFECYCLE_ON_DEMAND, LIFECYCLE_SPOT)
+
+
+def _valid_taint_effect(effect: str) -> bool:
+    return effect == "" or effect in k8s.TAINT_EFFECT_TYPES
+
+
+def validate_node_group(ng: NodeGroupOptions) -> List[str]:
+    """All the reference's validation checks (node_group.go:80-126). Returns a list
+    of problems; empty means valid."""
+    problems: List[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            problems.append(msg)
+
+    check(len(ng.name) > 0, "name cannot be empty")
+    check(len(ng.label_key) > 0, "label_key cannot be empty")
+    check(len(ng.label_value) > 0, "label_value cannot be empty")
+    check(
+        len(ng.cloud_provider_group_name) > 0,
+        "cloud_provider_group_name cannot be empty",
+    )
+
+    check(
+        ng.taint_upper_capacity_threshold_percent > 0,
+        "taint_upper_capacity_threshold_percent must be larger than 0",
+    )
+    check(
+        ng.taint_lower_capacity_threshold_percent > 0,
+        "taint_lower_capacity_threshold_percent must be larger than 0",
+    )
+    check(
+        ng.scale_up_threshold_percent > 0,
+        "scale_up_threshold_percent must be larger than 0",
+    )
+    check(
+        ng.taint_lower_capacity_threshold_percent
+        < ng.taint_upper_capacity_threshold_percent,
+        "taint_lower_capacity_threshold_percent must be less than "
+        "taint_upper_capacity_threshold_percent",
+    )
+    check(
+        ng.taint_upper_capacity_threshold_percent < ng.scale_up_threshold_percent,
+        "taint_upper_capacity_threshold_percent must be less than "
+        "scale_up_threshold_percent",
+    )
+
+    if not ng.auto_discover_min_max_node_options():
+        check(ng.min_nodes < ng.max_nodes, "min_nodes must be less than max_nodes")
+        check(ng.max_nodes > 0, "max_nodes must be larger than 0")
+        check(ng.min_nodes >= 0, "min_nodes must be not less than 0")
+
+    check(
+        ng.slow_node_removal_rate <= ng.fast_node_removal_rate,
+        "slow_node_removal_rate must be less than fast_node_removal_rate",
+    )
+
+    check(len(ng.soft_delete_grace_period) > 0,
+          "soft_delete_grace_period must not be empty")
+    check(len(ng.hard_delete_grace_period) > 0,
+          "hard_delete_grace_period must not be empty")
+    check(
+        ng.soft_delete_grace_period_duration() > 0,
+        "soft_delete_grace_period failed to parse into a duration",
+    )
+    check(
+        ng.hard_delete_grace_period_duration() > 0,
+        "hard_delete_grace_period failed to parse into a duration",
+    )
+    check(
+        ng.soft_delete_grace_period_duration()
+        < ng.hard_delete_grace_period_duration(),
+        "soft_delete_grace_period must be less than hard_delete_grace_period",
+    )
+
+    check(len(ng.scale_up_cool_down_period) > 0,
+          "scale_up_cool_down_period must not be empty")
+    check(
+        ng.scale_up_cool_down_period_duration() > 0,
+        "scale_up_cool_down_period failed to parse into a duration",
+    )
+
+    check(_valid_taint_effect(ng.taint_effect),
+          "taint_effect must be valid kubernetes taint")
+    check(
+        _valid_aws_lifecycle(ng.aws.lifecycle),
+        f"aws.lifecycle must be '{LIFECYCLE_ON_DEMAND}' or '{LIFECYCLE_SPOT}' "
+        "if provided",
+    )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Pod / node filters (reference: node_group.go:206-287)
+# ---------------------------------------------------------------------------
+
+
+def _node_selector_terms(pod: k8s.Pod) -> Sequence[k8s.NodeSelectorTerm]:
+    if pod.affinity is not None and pod.affinity.node_affinity_required_terms:
+        return pod.affinity.node_affinity_required_terms
+    return ()
+
+
+def new_pod_affinity_filter_func(label_key: str, label_value: str):
+    """Non-daemonset pods that select this nodegroup via nodeSelector or a
+    required node-affinity `In` expression (reference: node_group.go:218-253)."""
+
+    def f(pod: k8s.Pod) -> bool:
+        if k8s.pod_is_daemonset(pod):
+            return False
+        if pod.node_selector.get(label_key) == label_value:
+            return True
+        for term in _node_selector_terms(pod):
+            for expr in term.match_expressions:
+                if expr.key != label_key:
+                    continue
+                if expr.operator == k8s.NodeSelectorOperator.IN.value:
+                    if label_value in expr.values:
+                        return True
+        return False
+
+    return f
+
+
+def new_pod_default_filter_func():
+    """Pods for the `default` nodegroup: non-daemonset, non-static, no selector and
+    no affinity of any kind (reference: node_group.go:256-275)."""
+
+    def f(pod: k8s.Pod) -> bool:
+        if k8s.pod_is_daemonset(pod):
+            return False
+        if k8s.pod_is_static(pod):
+            return False
+        if pod.node_selector:
+            return False
+        a = pod.affinity
+        return a is None or (
+            not a.has_node_affinity
+            and not a.has_pod_affinity
+            and not a.has_pod_anti_affinity
+        )
+
+    return f
+
+
+def new_node_label_filter_func(label_key: str, label_value: str):
+    """Reference: node_group.go:278-287."""
+
+    def f(node: k8s.Node) -> bool:
+        return node.labels.get(label_key) == label_value
+
+    return f

@@ -1,0 +1,58 @@
+"""Scale lock: cooldown/hysteresis after asking the provider for nodes — mirror of
+/root/reference/pkg/controller/scale_lock.go. Time-based: locked while
+now - lock_time < minimum_lock_duration (= scale_up_cool_down_period), then
+auto-unlocks on the next locked() check."""
+
+from __future__ import annotations
+
+from escalator_tpu.metrics import metrics
+from escalator_tpu.utils.clock import Clock
+
+
+class ScaleLock:
+    def __init__(self, clock: Clock, minimum_lock_duration_sec: float,
+                 nodegroup: str = ""):
+        self._clock = clock
+        self.minimum_lock_duration_sec = minimum_lock_duration_sec
+        self.nodegroup = nodegroup
+        self.is_locked = False
+        self.requested_nodes = 0
+        self.lock_time = -float("inf")
+
+    def locked(self) -> bool:
+        """Reference: scale_lock.go:22-29."""
+        if self._clock.now() - self.lock_time < self.minimum_lock_duration_sec:
+            metrics.node_group_scale_lock_check_was_locked.labels(
+                self.nodegroup
+            ).inc()
+            return True
+        self.unlock()
+        return self.is_locked
+
+    def lock(self, nodes: int) -> None:
+        """Reference: scale_lock.go:32-42."""
+        metrics.node_group_scale_lock.labels(self.nodegroup).inc()
+        self.is_locked = True
+        self.requested_nodes = nodes
+        self.lock_time = self._clock.now()
+
+    def unlock(self) -> None:
+        """Reference: scale_lock.go:45-56. No-op when not locked."""
+        if self.is_locked:
+            duration = self._clock.now() - self.lock_time
+            self.is_locked = False
+            self.requested_nodes = 0
+            metrics.node_group_scale_lock_duration.labels(self.nodegroup).observe(
+                duration
+            )
+            metrics.node_group_scale_lock.labels(self.nodegroup).set(0.0)
+
+    def time_until_minimum_unlock(self) -> float:
+        """Reference: scale_lock.go:59-61."""
+        return (self.lock_time + self.minimum_lock_duration_sec) - self._clock.now()
+
+    def __str__(self) -> str:
+        return (
+            f"lock({self.locked()}): there are {self.requested_nodes} upcoming nodes"
+            f" requested, {self.time_until_minimum_unlock():.0f}s before min cooldown."
+        )

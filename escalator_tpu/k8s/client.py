@@ -1,0 +1,123 @@
+"""Kubernetes API client abstraction.
+
+The reference talks to the API server through client-go
+(/root/reference/pkg/k8s/client.go:12-40). Here the controller depends only on the
+small ``KubernetesClient`` protocol below; implementations:
+
+- ``InMemoryKubernetesClient`` — thread-safe in-process cluster state. The framework's
+  equivalent of the reference's fake clientset with reactors
+  (pkg/test/builder.go:29-101), and the backing store for dry-run simulation.
+- a real apiserver-backed client can be plugged in by implementing the same protocol
+  (the ``kubernetes`` Python package is not vendored here; see ``load_incluster`` for
+  the gated import).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Protocol
+
+from escalator_tpu.k8s import types as k8s
+
+
+class KubernetesClient(Protocol):
+    def list_pods(self) -> List[k8s.Pod]:
+        ...
+
+    def list_nodes(self) -> List[k8s.Node]:
+        ...
+
+    def get_node(self, name: str) -> Optional[k8s.Node]:
+        ...
+
+    def update_node(self, node: k8s.Node) -> k8s.Node:
+        ...
+
+    def delete_node(self, name: str) -> None:
+        ...
+
+
+class InMemoryKubernetesClient:
+    """In-process cluster store. Update/delete observers let tests assert on write
+    traffic the way the reference's reactor channels do (pkg/test/builder.go:44-76)."""
+
+    def __init__(self, nodes: Optional[List[k8s.Node]] = None,
+                 pods: Optional[List[k8s.Pod]] = None):
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, k8s.Node] = {}
+        self._pods: Dict[str, k8s.Pod] = {}
+        self.on_node_update: List[Callable[[k8s.Node], None]] = []
+        self.on_node_delete: List[Callable[[str], None]] = []
+        for n in nodes or []:
+            self._nodes[n.name] = n
+        for p in pods or []:
+            self._pods[self._pod_key(p)] = p
+
+    @staticmethod
+    def _pod_key(pod: k8s.Pod) -> str:
+        return f"{pod.namespace}/{pod.name}"
+
+    # -- reads ---------------------------------------------------------------
+    def list_pods(self) -> List[k8s.Pod]:
+        with self._lock:
+            # informer semantics: Succeeded/Failed pods are excluded from the cache
+            # (reference: pkg/k8s/cache.go:17)
+            return [
+                p for p in self._pods.values() if p.phase not in ("Succeeded", "Failed")
+            ]
+
+    def list_nodes(self) -> List[k8s.Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def get_node(self, name: str) -> Optional[k8s.Node]:
+        with self._lock:
+            node = self._nodes.get(name)
+            return node.copy() if node is not None else None
+
+    # -- writes --------------------------------------------------------------
+    def update_node(self, node: k8s.Node) -> k8s.Node:
+        with self._lock:
+            if node.name not in self._nodes:
+                raise KeyError(f"node {node.name} not found")
+            self._nodes[node.name] = node
+        for cb in self.on_node_update:
+            cb(node)
+        return node
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            if name not in self._nodes:
+                raise KeyError(f"node {name} not found")
+            del self._nodes[name]
+        for cb in self.on_node_delete:
+            cb(name)
+
+    # -- simulation helpers ---------------------------------------------------
+    def add_node(self, node: k8s.Node) -> None:
+        with self._lock:
+            self._nodes[node.name] = node
+
+    def add_pod(self, pod: k8s.Pod) -> None:
+        with self._lock:
+            self._pods[self._pod_key(pod)] = pod
+
+    def remove_pod(self, pod: k8s.Pod) -> None:
+        with self._lock:
+            self._pods.pop(self._pod_key(pod), None)
+
+
+def load_incluster() -> KubernetesClient:
+    """Build a client against a real apiserver. Requires the ``kubernetes`` package,
+    which is not part of this image — gated import with a clear error (reference
+    equivalent: pkg/k8s/client.go:12-26)."""
+    try:
+        import kubernetes  # noqa: F401
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "real-cluster mode needs the `kubernetes` package; this environment "
+            "provides only in-memory/simulation clients"
+        ) from e
+    raise NotImplementedError(
+        "apiserver-backed client adapter not yet implemented"
+    )  # pragma: no cover

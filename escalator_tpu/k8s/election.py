@@ -1,0 +1,204 @@
+"""Leader election — active/passive HA, mirror of
+/root/reference/pkg/k8s/election.go + cmd/main.go:157-185.
+
+The reference elects over a k8s Lease object; deposition cancels a context and the
+process crashes to restart (crash-to-restart HA). Here election runs over the
+pluggable ``ResourceLock`` below; implementations:
+
+- ``InMemoryResourceLock`` — single-process/testing
+- ``FileResourceLock`` — lease in a file with atomic renew (multi-process on one host)
+- a k8s Lease adapter plugs in when a real apiserver client is available.
+
+``LeaderElector.run`` blocks until leadership, spawns a renew loop, and invokes
+``on_deposed`` when the lease is lost — callers should treat that as fatal, like the
+reference's ``awaitLeaderDeposed`` -> log.Fatal (cmd/main.go:147-154).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Optional, Protocol
+
+from escalator_tpu.utils.clock import Clock
+
+
+@dataclass
+class LeaderRecord:
+    holder: str
+    acquire_time: float
+    renew_time: float
+
+
+class ResourceLock(Protocol):
+    def get(self) -> Optional[LeaderRecord]:
+        ...
+
+    def create_or_update(self, record: LeaderRecord, expected_holder: Optional[str]) -> bool:
+        """Compare-and-swap: write only when the current holder is exactly
+        ``expected_holder`` (None = only when no record exists). Returns success."""
+        ...
+
+
+class InMemoryResourceLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._record: Optional[LeaderRecord] = None
+
+    def get(self) -> Optional[LeaderRecord]:
+        with self._lock:
+            return self._record
+
+    def create_or_update(self, record, expected_holder) -> bool:
+        with self._lock:
+            current = self._record.holder if self._record else None
+            if current != expected_holder:
+                return False
+            self._record = record
+            return True
+
+
+class FileResourceLock:
+    """Lease in a JSON file. The read-check-write is serialized ACROSS PROCESSES with
+    an fcntl advisory lock on a sidecar file (an in-process threading.Lock cannot
+    prevent two processes from both winning), making this safe for single-host HA
+    pairs. NOT a distributed lock across hosts without a shared filesystem that
+    honors fcntl."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._guard_path = f"{path}.lock"
+
+    def _read(self) -> Optional[LeaderRecord]:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            return LeaderRecord(**data)
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def get(self) -> Optional[LeaderRecord]:
+        return self._read()
+
+    def create_or_update(self, record, expected_holder) -> bool:
+        import fcntl
+
+        with open(self._guard_path, "a+") as guard:
+            fcntl.flock(guard, fcntl.LOCK_EX)
+            try:
+                current = self._read()
+                holder = current.holder if current else None
+                if holder != expected_holder:
+                    return False
+                tmp = f"{self.path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(record.__dict__, f)
+                os.replace(tmp, self.path)
+                return True
+            finally:
+                fcntl.flock(guard, fcntl.LOCK_UN)
+
+
+@dataclass
+class LeaderElectionConfig:
+    """Mirrors the reference's flags (cmd/main.go:39-45): lease duration, renew
+    deadline, retry period."""
+
+    lease_duration_sec: float = 15.0
+    renew_deadline_sec: float = 10.0
+    retry_period_sec: float = 2.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        lock: ResourceLock,
+        config: LeaderElectionConfig,
+        identity: Optional[str] = None,
+        clock: Optional[Clock] = None,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_deposed: Optional[Callable[[], None]] = None,
+    ):
+        self.lock = lock
+        self.config = config
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.clock = clock or Clock()
+        self.on_started_leading = on_started_leading
+        self.on_deposed = on_deposed
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
+
+    # -- acquisition ----------------------------------------------------------
+    def _try_acquire(self) -> bool:
+        now = self.clock.now()
+        current = self.lock.get()
+        if current is not None and current.holder != self.identity:
+            expired = now - current.renew_time > self.config.lease_duration_sec
+            if not expired:
+                return False
+            # takeover of an expired lease: CAS on the stale holder
+            return self.lock.create_or_update(
+                LeaderRecord(self.identity, now, now), current.holder
+            )
+        expected = self.identity if current is not None else None
+        return self.lock.create_or_update(
+            LeaderRecord(self.identity, now, now), expected
+        )
+
+    def _renew_loop(self) -> None:
+        """Renew every retry period; transient CAS failures are retried until the
+        renew deadline expires (client-go semantics). Deposition is immediate only
+        when another holder demonstrably owns the lease."""
+        last_renew = self.clock.now()
+        while not self._stop.wait(self.config.retry_period_sec):
+            now = self.clock.now()
+            try:
+                ok = self.lock.create_or_update(
+                    LeaderRecord(self.identity, now, now), self.identity
+                )
+            except Exception:
+                ok = False
+            if ok:
+                last_renew = now
+                continue
+            current = None
+            try:
+                current = self.lock.get()
+            except Exception:
+                pass
+            usurped = current is not None and current.holder != self.identity
+            if usurped or now - last_renew > self.config.renew_deadline_sec:
+                self.is_leader = False
+                if self.on_deposed is not None:
+                    self.on_deposed()
+                return
+
+    def run(self, blocking_acquire_timeout: Optional[float] = None) -> bool:
+        """Block until leadership (or timeout). On success starts the background
+        renew loop and returns True."""
+        deadline = (
+            self.clock.now() + blocking_acquire_timeout
+            if blocking_acquire_timeout is not None
+            else None
+        )
+        while not self._stop.is_set():
+            if self._try_acquire():
+                self.is_leader = True
+                if self.on_started_leading is not None:
+                    self.on_started_leading()
+                self._renew_thread = threading.Thread(
+                    target=self._renew_loop, daemon=True
+                )
+                self._renew_thread.start()
+                return True
+            if deadline is not None and self.clock.now() >= deadline:
+                return False
+            self.clock.sleep(self.config.retry_period_sec)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,44 @@
+"""Filtered listers over the cluster cache — mirror of
+/root/reference/pkg/k8s/pod_listers.go and node_listers.go. A lister = a list source
+plus a filter predicate; the controller builds one pair per nodegroup."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.client import KubernetesClient
+
+PodFilterFunc = Callable[[k8s.Pod], bool]
+NodeFilterFunc = Callable[[k8s.Node], bool]
+
+
+class PodLister:
+    def __init__(self, client: KubernetesClient, filter_func: PodFilterFunc):
+        self._client = client
+        self._filter = filter_func
+
+    def list(self) -> List[k8s.Pod]:
+        return [p for p in self._client.list_pods() if self._filter(p)]
+
+
+class NodeLister:
+    def __init__(self, client: KubernetesClient, filter_func: NodeFilterFunc):
+        self._client = client
+        self._filter = filter_func
+
+    def list(self) -> List[k8s.Node]:
+        return [n for n in self._client.list_nodes() if self._filter(n)]
+
+
+class FakeLister:
+    """Error-injectable lister for tests (reference: pkg/test/node_lister.go:12-44)."""
+
+    def __init__(self, items: Optional[list] = None, error: Optional[Exception] = None):
+        self.items = items or []
+        self.error = error
+
+    def list(self) -> list:
+        if self.error is not None:
+            raise self.error
+        return list(self.items)

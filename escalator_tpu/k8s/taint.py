@@ -1,0 +1,65 @@
+"""Taint mechanics — the durable state channel of the autoscaler.
+
+Mirror of /root/reference/pkg/k8s/taint.go: the taint *value* is the unix timestamp of
+tainting, which is how grace-period progress survives controller restarts (the only
+persistent state besides the leader lease — SURVEY.md §5 checkpoint/resume). Add and
+delete re-GET the node before updating to avoid conflicts, like the reference."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.client import KubernetesClient
+from escalator_tpu.utils.clock import Clock
+
+_default_clock = Clock()
+
+
+def add_to_be_removed_taint(
+    node: k8s.Node,
+    client: KubernetesClient,
+    taint_effect: str = "",
+    clock: Clock = _default_clock,
+) -> k8s.Node:
+    """Add the autoscaler taint with value=now-unix (reference: taint.go:36-76)."""
+    updated = client.get_node(node.name)
+    if updated is None:
+        raise RuntimeError(f"failed to get node {node.name}")
+
+    for taint in updated.taints:
+        if taint.key == k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY:
+            return updated  # already tainted; don't re-add
+
+    effect = taint_effect if taint_effect else k8s.TaintEffect.NO_SCHEDULE.value
+    updated.taints.append(
+        k8s.Taint(
+            key=k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY,
+            value=str(int(clock.now())),
+            effect=effect,
+        )
+    )
+    return client.update_node(updated)
+
+
+def delete_to_be_removed_taint(
+    node: k8s.Node, client: KubernetesClient
+) -> k8s.Node:
+    """Remove the autoscaler taint if present (reference: taint.go:105-130).
+    Swap-remove like the reference (order not preserved)."""
+    updated = client.get_node(node.name)
+    if updated is None:
+        raise RuntimeError(f"failed to get node {node.name}")
+
+    for i, taint in enumerate(updated.taints):
+        if taint.key == k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY:
+            updated.taints[i] = updated.taints[-1]
+            updated.taints.pop()
+            return client.update_node(updated)
+    return updated
+
+
+def delete_nodes(nodes, client: KubernetesClient) -> None:
+    """Reference: pkg/k8s/node.go:12-26."""
+    for node in nodes:
+        client.delete_node(node.name)

@@ -1,0 +1,164 @@
+"""Prometheus metrics — the same `escalator_*` metric names as the reference
+(/root/reference/pkg/metrics/metrics.go:12-230) so existing dashboards (e.g. the
+shipped Grafana board, docs/grafana-dashboard.json) keep working, plus
+`escalator_tpu_*` additions for the device solver."""
+
+from __future__ import annotations
+
+import threading
+from wsgiref.simple_server import WSGIServer, make_server
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    make_wsgi_app,
+)
+
+NAMESPACE = "escalator"
+
+#: Dedicated registry: keeps tests hermetic and avoids surprise default-registry
+#: collisions in embedding processes.
+registry = CollectorRegistry()
+
+_BUCKETS = tuple(float(60 * i) for i in range(1, 30))  # 60..1740s, 60s buckets
+_NG = ["node_group"]
+
+run_count = Counter(
+    "run_count", "Number of times the controller has checked for cluster state",
+    namespace=NAMESPACE, registry=registry,
+)
+node_group_nodes_untainted = Gauge(
+    "node_group_untainted_nodes",
+    "nodes considered by specific node groups that are untainted",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_nodes_tainted = Gauge(
+    "node_group_tainted_nodes",
+    "nodes considered by specific node groups that are tainted",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_nodes_cordoned = Gauge(
+    "node_group_cordoned_nodes",
+    "nodes considered by specific node groups that are cordoned",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_nodes = Gauge(
+    "node_group_nodes", "nodes considered by specific node groups",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_pods = Gauge(
+    "node_group_pods", "pods considered by specific node groups",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_pods_evicted = Counter(
+    "node_group_pods_evicted", "pods evicted during a scale down",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_mem_percent = Gauge(
+    "node_group_mem_percent", "percentage of util of memory",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_cpu_percent = Gauge(
+    "node_group_cpu_percent", "percentage of util of cpu",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_mem_request = Gauge(
+    "node_group_mem_request", "byte value of node request mem",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_cpu_request = Gauge(
+    "node_group_cpu_request", "milli value of node request cpu",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_mem_capacity = Gauge(
+    "node_group_mem_capacity", "byte value of node capacity mem",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_cpu_capacity = Gauge(
+    "node_group_cpu_capacity", "milli value of node capacity cpu",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_taint_event = Gauge(
+    "node_group_taint_event", "indicates a scale down event",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_untaint_event = Gauge(
+    "node_group_untaint_event", "indicates a scale up event",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_scale_lock = Gauge(
+    "node_group_scale_lock", "indicates if the nodegroup is locked from scaling",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_scale_lock_duration = Histogram(
+    "node_group_scale_lock_duration",
+    "indicates how long the nodegroup is locked from scaling",
+    _NG, namespace=NAMESPACE, registry=registry, buckets=_BUCKETS,
+)
+node_group_scale_lock_check_was_locked = Counter(
+    "node_group_scale_lock_check_was_locked",
+    "indicates how many checks of the nodegroup scale lock were done whilst the lock"
+    " was held",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_scale_delta = Gauge(
+    "node_group_scale_delta", "indicates current scale delta",
+    _NG, namespace=NAMESPACE, registry=registry,
+)
+node_group_node_registration_lag = Histogram(
+    "node_group_node_registration_lag",
+    "indicates how long nodes take to register in kube from instantiation in the"
+    " nodegroup",
+    _NG, namespace=NAMESPACE, registry=registry, buckets=_BUCKETS,
+)
+_CP = ["cloud_provider", "id", "node_group"]
+cloud_provider_min_size = Gauge(
+    "cloud_provider_min_size", "current cloud provider minimum size",
+    _CP, namespace=NAMESPACE, registry=registry,
+)
+cloud_provider_max_size = Gauge(
+    "cloud_provider_max_size", "current cloud provider maximum size",
+    _CP, namespace=NAMESPACE, registry=registry,
+)
+cloud_provider_target_size = Gauge(
+    "cloud_provider_target_size", "current cloud provider target size",
+    _CP, namespace=NAMESPACE, registry=registry,
+)
+cloud_provider_size = Gauge(
+    "cloud_provider_size", "current cloud provider size",
+    _CP, namespace=NAMESPACE, registry=registry,
+)
+
+# --- TPU-native additions (no reference equivalent) -------------------------
+solver_decide_latency = Histogram(
+    "solver_decide_latency_seconds",
+    "device latency of the batched scale-decision kernel",
+    ["backend"], namespace="escalator_tpu", registry=registry,
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
+solver_pack_latency = Histogram(
+    "solver_pack_latency_seconds",
+    "host latency of packing cluster state into device arrays",
+    ["backend"], namespace="escalator_tpu", registry=registry,
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
+
+
+def start(address: str = "0.0.0.0:8080") -> WSGIServer:
+    """Serve /metrics on a background thread (reference: metrics.go:260-268).
+    Returns the server (call .shutdown() to stop)."""
+    host, _, port = address.rpartition(":")
+    app = make_wsgi_app(registry)
+
+    def metrics_only(environ, start_response):
+        if environ.get("PATH_INFO") != "/metrics":
+            start_response("404 Not Found", [("Content-Type", "text/plain")])
+            return [b"not found"]
+        return app(environ, start_response)
+
+    server = make_server(host or "0.0.0.0", int(port), metrics_only)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

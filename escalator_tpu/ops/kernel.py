@@ -173,8 +173,10 @@ def decide(cluster: ClusterArrays, now_sec: jnp.ndarray) -> DecisionArrays:
 
     fz_cpu = jnp.ceil(cpu_req.astype(_F64) / safe_cached_cpu / thr * 100.0)
     fz_mem = jnp.ceil(mem_req_milli.astype(_F64) / safe_cached_mem / thr * 100.0)
-    nrm_cpu = jnp.ceil(num_untainted.astype(_F64) * (cpu_pct - thr) / thr)
-    nrm_mem = jnp.ceil(num_untainted.astype(_F64) * (mem_pct - thr) / thr)
+    # Operation order matters for bit-parity: Go computes percentageNeeded first
+    # (util.go:33-37), i.e. n * ((pct - thr) / thr), NOT (n * (pct - thr)) / thr.
+    nrm_cpu = jnp.ceil(num_untainted.astype(_F64) * ((cpu_pct - thr) / thr))
+    nrm_mem = jnp.ceil(num_untainted.astype(_F64) * ((mem_pct - thr) / thr))
 
     needed = jnp.where(
         from_zero,

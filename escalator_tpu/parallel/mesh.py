@@ -45,6 +45,39 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devs), (GROUP_AXIS,))
 
 
+def assign_shards(group_inputs, num_shards: int) -> List[List[int]]:
+    """Greedy least-loaded (LPT) placement of groups onto shards by pod count.
+    Returns, per shard, the sorted list of original group indices."""
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    assignment: List[List[int]] = [[] for _ in range(num_shards)]
+    order = sorted(
+        range(len(group_inputs)), key=lambda i: -len(group_inputs[i][0])
+    )
+    loads = [0] * num_shards
+    for gi in order:
+        s = loads.index(min(loads))
+        assignment[s].append(gi)
+        loads[s] += len(group_inputs[gi][0]) + 1
+    for s in range(num_shards):
+        assignment[s].sort()
+    return assignment
+
+
+def shard_capacity(group_inputs, assignment) -> Tuple[int, int, int]:
+    """(max pods, max nodes, max groups) over shards for the given assignment."""
+    max_pods = max(
+        (sum(len(group_inputs[gi][0]) for gi in shard) for shard in assignment),
+        default=0,
+    )
+    max_nodes = max(
+        (sum(len(group_inputs[gi][1]) for gi in shard) for shard in assignment),
+        default=0,
+    )
+    max_groups = max((len(shard) for shard in assignment), default=0)
+    return max_pods, max_nodes, max_groups
+
+
 def pack_cluster_sharded(
     group_inputs: Sequence[
         Tuple[
@@ -69,20 +102,7 @@ def pack_cluster_sharded(
     raggedness hazard, SURVEY.md §7). Returns the stacked arrays plus, per shard, the
     list of original group indices (shard-local group id -> caller's group index).
     """
-    if num_shards < 1:
-        raise ValueError("num_shards must be >= 1")
-    assignment: List[List[int]] = [[] for _ in range(num_shards)]
-    # Largest-first onto the currently lightest shard.
-    order = sorted(
-        range(len(group_inputs)), key=lambda i: -len(group_inputs[i][0])
-    )
-    loads = [0] * num_shards
-    for gi in order:
-        s = loads.index(min(loads))
-        assignment[s].append(gi)
-        loads[s] += len(group_inputs[gi][0]) + 1
-    for s in range(num_shards):
-        assignment[s].sort()
+    assignment = assign_shards(group_inputs, num_shards)
 
     max_pods = max(
         (sum(len(group_inputs[gi][0]) for gi in shard) for shard in assignment),

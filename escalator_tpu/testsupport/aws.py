@@ -1,0 +1,149 @@
+"""Fake AWS SDK clients at the boto3 dict-API level — mirror of the reference's
+SDK-interface mocks (/root/reference/pkg/test/aws.go:12-96). Canned outputs/errors
+per call, plus call recording for assertions."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class FakeAutoScaling:
+    """Mock of the autoscaling client surface the provider touches."""
+
+    groups: Dict[str, Dict] = field(default_factory=dict)
+    describe_error: Optional[Exception] = None
+    set_desired_error: Optional[Exception] = None
+    attach_error: Optional[Exception] = None
+    calls: List = field(default_factory=list)
+
+    def describe_auto_scaling_groups(self, AutoScalingGroupNames=None, **kw):
+        self.calls.append(("describe_auto_scaling_groups", AutoScalingGroupNames))
+        if self.describe_error is not None:
+            raise self.describe_error
+        names = AutoScalingGroupNames or list(self.groups)
+        return {
+            "AutoScalingGroups": [
+                self.groups[n] for n in names if n in self.groups
+            ]
+        }
+
+    def set_desired_capacity(self, AutoScalingGroupName, DesiredCapacity, **kw):
+        self.calls.append(
+            ("set_desired_capacity", AutoScalingGroupName, DesiredCapacity)
+        )
+        if self.set_desired_error is not None:
+            raise self.set_desired_error
+        self.groups[AutoScalingGroupName]["DesiredCapacity"] = DesiredCapacity
+        return {}
+
+    def terminate_instance_in_auto_scaling_group(
+        self, InstanceId, ShouldDecrementDesiredCapacity, **kw
+    ):
+        self.calls.append(
+            ("terminate_instance_in_auto_scaling_group", InstanceId,
+             ShouldDecrementDesiredCapacity)
+        )
+        for g in self.groups.values():
+            instances = g.get("Instances", [])
+            for i, inst in enumerate(instances):
+                if inst["InstanceId"] == InstanceId:
+                    instances.pop(i)
+                    if ShouldDecrementDesiredCapacity:
+                        g["DesiredCapacity"] -= 1
+                    return {"Activity": {"Description": f"terminated {InstanceId}"}}
+        return {"Activity": {"Description": f"{InstanceId} not found"}}
+
+    def attach_instances(self, AutoScalingGroupName, InstanceIds, **kw):
+        self.calls.append(("attach_instances", AutoScalingGroupName, list(InstanceIds)))
+        if self.attach_error is not None:
+            raise self.attach_error
+        g = self.groups[AutoScalingGroupName]
+        g.setdefault("Instances", []).extend(
+            {"InstanceId": i, "AvailabilityZone": "us-east-1a"} for i in InstanceIds
+        )
+        g["DesiredCapacity"] = g.get("DesiredCapacity", 0) + len(InstanceIds)
+        return {}
+
+    def create_or_update_tags(self, Tags, **kw):
+        self.calls.append(("create_or_update_tags", Tags))
+        for tag in Tags:
+            g = self.groups.get(tag["ResourceId"])
+            if g is not None:
+                g.setdefault("Tags", []).append(
+                    {"Key": tag["Key"], "Value": tag["Value"]}
+                )
+        return {}
+
+
+@dataclass
+class FakeEC2:
+    """Mock of the ec2 client surface the provider touches."""
+
+    instances: Dict[str, Dict] = field(default_factory=dict)
+    fleet_instance_ids: List[str] = field(default_factory=list)
+    fleet_errors: List[Dict] = field(default_factory=list)
+    all_instances_ready: bool = True
+    create_fleet_error: Optional[Exception] = None
+    calls: List = field(default_factory=list)
+    _fleet_counter: int = 0
+
+    def create_fleet(self, **fleet_input):
+        self.calls.append(("create_fleet", fleet_input))
+        if self.create_fleet_error is not None:
+            raise self.create_fleet_error
+        ids = list(self.fleet_instance_ids)
+        if not ids and not self.fleet_errors:
+            count = fleet_input["TargetCapacitySpecification"]["TotalTargetCapacity"]
+            ids = []
+            for _ in range(count):
+                self._fleet_counter += 1
+                ids.append(f"i-fleet{self._fleet_counter:04d}")
+        for i in ids:
+            self.instances.setdefault(
+                i,
+                {"InstanceId": i, "LaunchTime": 0.0,
+                 "State": {"Name": "running"}},
+            )
+        out = {"Instances": [{"InstanceIds": ids}] if ids else [],
+               "Errors": list(self.fleet_errors)}
+        return out
+
+    def describe_instance_status(self, InstanceIds, IncludeAllInstances=False, **kw):
+        self.calls.append(("describe_instance_status", list(InstanceIds)))
+        state = "running" if self.all_instances_ready else "pending"
+        return {
+            "InstanceStatuses": [
+                {"InstanceId": i, "InstanceState": {"Name": state}}
+                for i in InstanceIds
+            ]
+        }
+
+    def describe_instances(self, InstanceIds, **kw):
+        self.calls.append(("describe_instances", list(InstanceIds)))
+        found = [self.instances[i] for i in InstanceIds if i in self.instances]
+        return {"Reservations": [{"Instances": found}]} if found else {
+            "Reservations": []
+        }
+
+    def terminate_instances(self, InstanceIds, **kw):
+        self.calls.append(("terminate_instances", list(InstanceIds)))
+        for i in InstanceIds:
+            self.instances.pop(i, None)
+        return {}
+
+
+def make_asg(name: str, min_size=0, max_size=10, desired=0, instance_ids=(),
+             vpc_zone_identifier="subnet-1,subnet-2", az="us-east-1a"):
+    return {
+        "AutoScalingGroupName": name,
+        "MinSize": min_size,
+        "MaxSize": max_size,
+        "DesiredCapacity": desired,
+        "VPCZoneIdentifier": vpc_zone_identifier,
+        "Instances": [
+            {"InstanceId": i, "AvailabilityZone": az} for i in instance_ids
+        ],
+        "Tags": [],
+    }

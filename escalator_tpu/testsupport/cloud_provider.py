@@ -1,0 +1,122 @@
+"""In-memory mock cloud provider — mirror of the reference's test provider
+(/root/reference/pkg/test/cloud_provider.go:14-176). Also used by the simulation /
+dry-run tooling as a pure in-process provider."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from escalator_tpu.cloudprovider import interface as cp
+from escalator_tpu.k8s import types as k8s
+
+PROVIDER_NAME = "test"
+
+
+class MockInstance(cp.Instance):
+    def __init__(self, instance_id: str = "", instantiation_time: float = 0.0):
+        self._id = instance_id
+        self._time = instantiation_time
+
+    def instantiation_time(self) -> float:
+        return self._time
+
+    def id(self) -> str:
+        return self._id
+
+
+class MockNodeGroup(cp.NodeGroup):
+    """Tracks target/actual size through increase/delete/decrease
+    (reference: cloud_provider.go:81-176)."""
+
+    def __init__(self, group_id: str, name: str, min_size: int, max_size: int,
+                 target_size: int):
+        self._id = group_id
+        self._name = name
+        self._min = min_size
+        self._max = max_size
+        self._target = target_size
+        self._actual = target_size
+        # test hooks
+        self.increase_calls: List[int] = []
+        self.deleted_nodes: List[str] = []
+
+    def id(self) -> str:
+        return self._id
+
+    def name(self) -> str:
+        return self._name
+
+    def min_size(self) -> int:
+        return self._min
+
+    def max_size(self) -> int:
+        return self._max
+
+    def target_size(self) -> int:
+        return self._target
+
+    def size(self) -> int:
+        return self._actual
+
+    def _set_desired_size(self, new_size: int) -> None:
+        self._target = new_size
+        self._actual = new_size
+
+    def increase_size(self, delta: int) -> None:
+        self.increase_calls.append(delta)
+        self._set_desired_size(self._target + delta)
+
+    def delete_nodes(self, *nodes: k8s.Node) -> None:
+        for node in nodes:
+            self.deleted_nodes.append(node.name)
+            self._set_desired_size(self._target - 1)
+
+    def belongs(self, node: k8s.Node) -> bool:
+        return False
+
+    def decrease_target_size(self, delta: int) -> None:
+        self._set_desired_size(self._target + delta)
+
+    def nodes(self) -> List[str]:
+        return []
+
+
+class MockCloudProvider(cp.CloudProvider):
+    def __init__(self):
+        self._node_groups: Dict[str, MockNodeGroup] = {}
+        self.refresh_count = 0
+        self.fail_refreshes = 0  # fault injection: fail the next N refresh() calls
+
+    def name(self) -> str:
+        return PROVIDER_NAME
+
+    def node_groups(self) -> List[cp.NodeGroup]:
+        return list(self._node_groups.values())
+
+    def get_node_group(self, group_id: str) -> Optional[MockNodeGroup]:
+        return self._node_groups.get(group_id)
+
+    def register_node_groups(self, *configs: cp.NodeGroupConfig) -> None:
+        pass
+
+    def register_node_group(self, node_group: MockNodeGroup) -> None:
+        self._node_groups[node_group.id()] = node_group
+
+    def refresh(self) -> None:
+        self.refresh_count += 1
+        if self.fail_refreshes > 0:
+            self.fail_refreshes -= 1
+            raise RuntimeError("injected refresh failure")
+
+    def get_instance(self, node: k8s.Node) -> cp.Instance:
+        return MockInstance(node.provider_id, 0.0)
+
+
+class MockBuilder(cp.Builder):
+    def __init__(self, provider: Optional[MockCloudProvider] = None):
+        self.provider = provider or MockCloudProvider()
+        self.build_count = 0
+
+    def build(self) -> MockCloudProvider:
+        self.build_count += 1
+        return self.provider

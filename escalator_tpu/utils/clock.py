@@ -1,0 +1,34 @@
+"""Injectable clock — the framework's equivalent of the reference's mockable clock
+(github.com/stephanos/clock, used at /root/reference/pkg/controller/scale_down.go:11)
+so multi-tick and grace-period tests never sleep."""
+
+from __future__ import annotations
+
+import time as _time
+
+
+class Clock:
+    """Real time. Subclass/replace for tests."""
+
+    def now(self) -> float:
+        """Unix seconds (float)."""
+        return _time.time()
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)
+
+
+class MockClock(Clock):
+    """Deterministic, manually-advanced clock."""
+
+    def __init__(self, start: float = 1_700_000_000.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self._now += seconds
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds

@@ -1,0 +1,212 @@
+"""AWS provider tests against the fake SDK — mirror of the reference's
+aws_test.go/node_group_test.go coverage (fleet input construction incl. spot/on-demand
+and overrides matrix, attach batching, orphan termination, provider-ID codec,
+min/max guards)."""
+
+import pytest
+
+from escalator_tpu.cloudprovider import interface as cp
+from escalator_tpu.cloudprovider.aws import aws
+from escalator_tpu.cloudprovider.errors import NodeNotInNodeGroupError
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.testsupport.aws import FakeAutoScaling, FakeEC2, make_asg
+from escalator_tpu.utils.clock import MockClock
+
+
+def make_provider(asg_name="asg-1", aws_cfg=None, **asg_kw):
+    autoscaling = FakeAutoScaling(groups={asg_name: make_asg(asg_name, **asg_kw)})
+    ec2 = FakeEC2()
+    provider = aws.AWSCloudProvider(autoscaling, ec2, clock=MockClock())
+    provider.register_node_groups(
+        cp.NodeGroupConfig(
+            name="ng", group_id=asg_name, aws=aws_cfg or cp.AWSNodeGroupConfig()
+        )
+    )
+    return provider, autoscaling, ec2
+
+
+def test_provider_id_codec():
+    inst = {"AvailabilityZone": "us-east-1a", "InstanceId": "i-abc123"}
+    pid = aws.instance_to_provider_id(inst)
+    assert pid == "aws:///us-east-1a/i-abc123"
+    assert aws.provider_id_to_instance_id(pid) == "i-abc123"
+
+
+def test_register_and_refresh():
+    provider, autoscaling, _ = make_provider(desired=3)
+    ng = provider.get_node_group("asg-1")
+    assert ng.target_size() == 3
+    autoscaling.groups["asg-1"]["DesiredCapacity"] = 7
+    provider.refresh()
+    assert ng.target_size() == 7
+
+
+def test_register_missing_asg_fails():
+    autoscaling = FakeAutoScaling(groups={})
+    provider = aws.AWSCloudProvider(autoscaling, FakeEC2())
+    with pytest.raises(RuntimeError, match="not found on AWS"):
+        provider.register_node_groups(cp.NodeGroupConfig(name="x", group_id="nope"))
+
+
+def test_increase_size_set_desired_capacity():
+    provider, autoscaling, _ = make_provider(desired=2, max_size=10)
+    ng = provider.get_node_group("asg-1")
+    ng.increase_size(3)
+    assert ("set_desired_capacity", "asg-1", 5) in autoscaling.calls
+
+
+def test_increase_size_guards():
+    provider, _, _ = make_provider(desired=8, max_size=10)
+    ng = provider.get_node_group("asg-1")
+    with pytest.raises(ValueError):
+        ng.increase_size(0)
+    with pytest.raises(RuntimeError, match="breach maximum"):
+        ng.increase_size(5)
+
+
+def test_one_shot_fleet_scale_up_attaches_in_batches():
+    cfg = cp.AWSNodeGroupConfig(
+        launch_template_id="lt-1", launch_template_version="2",
+        fleet_instance_ready_timeout_sec=60,
+    )
+    provider, autoscaling, ec2 = make_provider(
+        desired=0, max_size=100, aws_cfg=cfg
+    )
+    ng = provider.get_node_group("asg-1")
+    ng.increase_size(45)
+    fleet_calls = [c for c in ec2.calls if c[0] == "create_fleet"]
+    assert len(fleet_calls) == 1
+    fi = fleet_calls[1 - 1][1]
+    assert fi["Type"] == "instant"
+    assert fi["TargetCapacitySpecification"]["TotalTargetCapacity"] == 45
+    assert fi["OnDemandOptions"]["MinTargetCapacity"] == 45  # all-or-nothing
+    # overrides matrix: 2 subnets, no type overrides
+    overrides = fi["LaunchTemplateConfigs"][0]["Overrides"]
+    assert [o["SubnetId"] for o in overrides] == ["subnet-1", "subnet-2"]
+    # attach in batches of 20: 20+20+5
+    batches = [c[2] for c in autoscaling.calls if c[0] == "attach_instances"]
+    assert [len(b) for b in batches] == [20, 20, 5]
+    assert ng.target_size() == 45
+
+
+def test_fleet_input_spot_and_type_overrides():
+    cfg = cp.AWSNodeGroupConfig(
+        launch_template_id="lt-1", lifecycle=aws.LIFECYCLE_SPOT,
+        instance_type_overrides=("m5.large", "m5.xlarge"),
+        resource_tagging=True,
+    )
+    provider, _, ec2 = make_provider(desired=0, max_size=100, aws_cfg=cfg)
+    ng = provider.get_node_group("asg-1")
+    fi = aws.create_fleet_input(ng, 5)
+    assert "SpotOptions" in fi and "OnDemandOptions" not in fi
+    overrides = fi["LaunchTemplateConfigs"][0]["Overrides"]
+    # subnet x type matrix: 2 x 2
+    assert len(overrides) == 4
+    assert {(o["SubnetId"], o["InstanceType"]) for o in overrides} == {
+        ("subnet-1", "m5.large"), ("subnet-1", "m5.xlarge"),
+        ("subnet-2", "m5.large"), ("subnet-2", "m5.xlarge"),
+    }
+    assert fi["TagSpecifications"][0]["Tags"][0]["Key"] == aws.TAG_KEY
+
+
+def test_fleet_not_ready_terminates_orphans():
+    cfg = cp.AWSNodeGroupConfig(
+        launch_template_id="lt-1", fleet_instance_ready_timeout_sec=3,
+    )
+    provider, _, ec2 = make_provider(desired=0, max_size=100, aws_cfg=cfg)
+    ec2.all_instances_ready = False
+    ng = provider.get_node_group("asg-1")
+    with pytest.raises(RuntimeError, match="Not all instances could be started"):
+        ng.increase_size(5)
+    term_calls = [c for c in ec2.calls if c[0] == "terminate_instances"]
+    assert len(term_calls) == 1
+    assert len(term_calls[0][1]) == 5
+    assert ng.terminate_instances_tries == 1
+
+
+def test_fleet_three_strikes_circuit_breaker():
+    cfg = cp.AWSNodeGroupConfig(
+        launch_template_id="lt-1", fleet_instance_ready_timeout_sec=1,
+    )
+    provider, _, ec2 = make_provider(desired=0, max_size=100, aws_cfg=cfg)
+    ec2.all_instances_ready = False
+    ng = provider.get_node_group("asg-1")
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            ng.increase_size(2)
+    with pytest.raises(aws.FleetProvisioningFailure):
+        ng.increase_size(2)
+
+
+def test_fleet_errors_with_no_instances():
+    cfg = cp.AWSNodeGroupConfig(launch_template_id="lt-1")
+    provider, _, ec2 = make_provider(desired=0, max_size=100, aws_cfg=cfg)
+    ec2.fleet_errors = [{"ErrorMessage": "InsufficientInstanceCapacity"}]
+    ng = provider.get_node_group("asg-1")
+    with pytest.raises(RuntimeError, match="InsufficientInstanceCapacity"):
+        ng.increase_size(2)
+
+
+def test_delete_nodes_decrements_capacity():
+    provider, autoscaling, _ = make_provider(
+        desired=3, min_size=1, instance_ids=("i-1", "i-2", "i-3")
+    )
+    ng = provider.get_node_group("asg-1")
+    node = k8s.Node(name="n1", provider_id="aws:///us-east-1a/i-2")
+    ng.delete_nodes(node)
+    assert ("terminate_instance_in_auto_scaling_group", "i-2", True) in \
+        autoscaling.calls
+    assert autoscaling.groups["asg-1"]["DesiredCapacity"] == 2
+
+
+def test_delete_nodes_wrong_group_raises_typed_error():
+    provider, _, _ = make_provider(desired=3, min_size=0,
+                                   instance_ids=("i-1", "i-2", "i-3"))
+    ng = provider.get_node_group("asg-1")
+    stranger = k8s.Node(name="nX", provider_id="aws:///us-east-1a/i-999")
+    with pytest.raises(NodeNotInNodeGroupError):
+        ng.delete_nodes(stranger)
+
+
+def test_delete_nodes_min_size_guards():
+    provider, _, _ = make_provider(desired=1, min_size=1, instance_ids=("i-1",))
+    ng = provider.get_node_group("asg-1")
+    node = k8s.Node(name="n1", provider_id="aws:///us-east-1a/i-1")
+    with pytest.raises(RuntimeError, match="min sized reached"):
+        ng.delete_nodes(node)
+
+
+def test_get_instance_launch_time():
+    provider, _, ec2 = make_provider(instance_ids=("i-1",))
+    ec2.instances["i-1"] = {"InstanceId": "i-1", "LaunchTime": 1234.5}
+    node = k8s.Node(name="n1", provider_id="aws:///us-east-1a/i-1")
+    inst = provider.get_instance(node)
+    assert inst.instantiation_time() == 1234.5
+    assert inst.id() == "i-1"
+
+
+def test_asg_tagging():
+    autoscaling = FakeAutoScaling(groups={"asg-1": make_asg("asg-1")})
+    provider = aws.AWSCloudProvider(autoscaling, FakeEC2())
+    provider.register_node_groups(cp.NodeGroupConfig(
+        name="ng", group_id="asg-1",
+        aws=cp.AWSNodeGroupConfig(resource_tagging=True),
+    ))
+    assert any(c[0] == "create_or_update_tags" for c in autoscaling.calls)
+    # second registration: tag present, not re-added
+    n_tag_calls = sum(1 for c in autoscaling.calls if c[0] == "create_or_update_tags")
+    provider.refresh()
+    assert sum(
+        1 for c in autoscaling.calls if c[0] == "create_or_update_tags"
+    ) == n_tag_calls
+
+
+def test_decrease_target_size():
+    provider, autoscaling, _ = make_provider(desired=5, min_size=1)
+    ng = provider.get_node_group("asg-1")
+    with pytest.raises(ValueError):
+        ng.decrease_target_size(1)
+    with pytest.raises(RuntimeError, match="breach minimum"):
+        ng.decrease_target_size(-5)
+    ng.decrease_target_size(-2)
+    assert ("set_desired_capacity", "asg-1", 3) in autoscaling.calls

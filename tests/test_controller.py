@@ -1,0 +1,380 @@
+"""Controller integration tests — the distinctive layer of the reference's test
+strategy (/root/reference/pkg/controller/controller_scale_node_group_test.go): full
+ticks against the fake client + mock provider + mock clock, including multi-run
+convergence. Parametrized over backends so the object shell and the device kernel are
+exercised through the same scenarios."""
+
+import logging
+import threading
+
+import pytest
+
+from escalator_tpu.controller import controller as ctl
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.controller.backend import GoldenBackend, JaxBackend
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.client import InMemoryKubernetesClient
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_nodes,
+    build_test_pods,
+)
+from escalator_tpu.testsupport.cloud_provider import (
+    MockBuilder,
+    MockCloudProvider,
+    MockNodeGroup,
+)
+from escalator_tpu.utils.clock import MockClock
+
+LABEL_KEY = "customer"
+LABEL_VALUE = "buildeng"
+
+
+def make_opts(**kw):
+    base = dict(
+        name="buildeng",
+        label_key=LABEL_KEY,
+        label_value=LABEL_VALUE,
+        cloud_provider_group_name="buildeng-asg",
+        min_nodes=1,
+        max_nodes=100,
+        taint_upper_capacity_threshold_percent=45,
+        taint_lower_capacity_threshold_percent=30,
+        scale_up_threshold_percent=70,
+        slow_node_removal_rate=1,
+        fast_node_removal_rate=2,
+        soft_delete_grace_period="5m",
+        hard_delete_grace_period="15m",
+        scale_up_cool_down_period="10m",
+    )
+    base.update(kw)
+    return ngmod.NodeGroupOptions(**base)
+
+
+class World:
+    """One controller + fake cluster + mock provider, wired together."""
+
+    def __init__(self, ng_opts, nodes=None, pods=None, backend=None,
+                 target_size=None, max_size=None, dry_mode=False):
+        self.clock = MockClock()
+        for n in nodes or []:
+            n.labels = {LABEL_KEY: LABEL_VALUE}
+        self.client = InMemoryKubernetesClient(nodes=nodes or [], pods=pods or [])
+        self.provider = MockCloudProvider()
+        self.group = MockNodeGroup(
+            "buildeng-asg", "buildeng",
+            min_size=ng_opts.min_nodes,
+            max_size=max_size if max_size is not None else ng_opts.max_nodes,
+            target_size=target_size if target_size is not None else len(nodes or []),
+        )
+        self.provider.register_node_group(self.group)
+        self.controller = ctl.Controller(
+            ctl.Opts(
+                client=self.client,
+                node_groups=[ng_opts],
+                cloud_provider_builder=MockBuilder(self.provider),
+                dry_mode=dry_mode,
+                backend=backend,
+                clock=self.clock,
+            )
+        )
+        self.state = self.controller.node_groups[ng_opts.name]
+
+    def tick(self):
+        self.controller.run_once()
+
+    def tainted_nodes(self):
+        return [
+            n for n in self.client.list_nodes()
+            if k8s.get_to_be_removed_taint(n) is not None
+        ]
+
+    def simulate_cloud_fills_nodes(self, cpu, mem):
+        """Bring provider target to life as registered kube nodes."""
+        missing = self.group.target_size() - len(self.client.list_nodes())
+        for n in build_test_nodes(max(0, missing), NodeOpts(
+                cpu=cpu, mem=mem, label_key=LABEL_KEY, label_value=LABEL_VALUE,
+                creation_time_ns=int(self.clock.now() * 1e9))):
+            self.client.add_node(n)
+
+
+BACKENDS = [GoldenBackend, JaxBackend]
+
+
+@pytest.fixture(params=BACKENDS, ids=["golden", "jax"])
+def backend(request):
+    return request.param()
+
+
+def test_scale_up_increases_provider(backend):
+    pods = build_test_pods(10, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    w = World(make_opts(), nodes=nodes, pods=pods, backend=backend)
+    w.tick()
+    # cpu 5000/2000 = 250% -> delta ceil(2*(250-70)/70) = 6
+    assert w.state.scale_delta == 6
+    assert w.group.increase_calls == [6]
+    assert w.group.target_size() == 8
+    # provider scale-out locks the scale lock
+    assert w.state.scale_lock.locked()
+
+
+def test_locked_group_returns_requested(backend):
+    pods = build_test_pods(10, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    w = World(make_opts(), nodes=nodes, pods=pods, backend=backend)
+    w.tick()
+    assert w.group.increase_calls == [6]
+    # Locked: second tick must not scale again, returns requested nodes
+    w.tick()
+    assert w.group.increase_calls == [6]
+    assert w.state.scale_delta == 6  # requestedNodes
+    # after the cooldown the lock opens
+    w.clock.advance(601)
+    w.simulate_cloud_fills_nodes(1000, 4 * 10**9)
+    w.tick()
+    assert not w.state.scale_lock.is_locked
+
+
+def test_convergence_after_cloud_fulfills(backend):
+    """Two-phase convergence (reference test at
+    controller_scale_node_group_test.go:531-546): scale up, let the cloud bring the
+    nodes, re-run -> delta 0."""
+    pods = build_test_pods(40, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    nodes = build_test_nodes(10, NodeOpts(cpu=2000, mem=8 * 10**9))
+    w = World(make_opts(), nodes=nodes, pods=pods, backend=backend)
+    w.tick()
+    assert w.state.scale_delta > 0
+    w.clock.advance(601)  # past cooldown
+    w.simulate_cloud_fills_nodes(2000, 8 * 10**9)
+    w.tick()
+    assert w.state.scale_delta == 0
+    # converged: util at/below threshold, nothing else to do
+    cpu_pct = 40 * 500 / (w.group.target_size() * 2000) * 100
+    assert cpu_pct <= 70
+
+
+def test_scale_up_untaints_first(backend):
+    """Tainted nodes are untainted (newest first) before provider scale
+    (reference: scale_up.go:14-45, untaintNewestN)."""
+    young = build_test_nodes(2, NodeOpts(
+        cpu=1000, mem=4 * 10**9, tainted=True, taint_time_sec=100,
+        creation_time_ns=2_000_000_000))
+    old = build_test_nodes(2, NodeOpts(
+        cpu=1000, mem=4 * 10**9, tainted=True, taint_time_sec=100,
+        creation_time_ns=1_000_000_000))
+    active = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(4, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(), nodes=young + old + active, pods=pods, backend=backend)
+    # cpu: 2000/2000 = 100% > 70 -> delta = ceil(2*30/70) = 1 -> untaint 1 newest
+    w.tick()
+    assert w.state.scale_delta == 1
+    assert w.group.increase_calls == []  # satisfied by untainting alone
+    assert len(w.tainted_nodes()) == 3
+    untainted_names = {
+        n.name for n in w.client.list_nodes()
+        if k8s.get_to_be_removed_taint(n) is None
+    }
+    assert young[0].name in untainted_names or young[1].name in untainted_names
+
+
+def test_scale_down_taints_oldest(backend):
+    nodes_old = build_test_nodes(1, NodeOpts(
+        cpu=1000, mem=4 * 10**9, creation_time_ns=1_000))
+    nodes_new = build_test_nodes(9, NodeOpts(
+        cpu=1000, mem=4 * 10**9, creation_time_ns=2_000_000))
+    pods = build_test_pods(1, PodOpts(
+        cpu=[100], mem=[10**8],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(), nodes=nodes_old + nodes_new, pods=pods, backend=backend)
+    w.tick()
+    # ~1% -> fast removal rate 2
+    assert w.state.scale_delta == -2
+    tainted = w.tainted_nodes()
+    assert len(tainted) == 2
+    assert nodes_old[0].name in {n.name for n in tainted}
+
+
+def test_scale_down_respects_min(backend):
+    nodes = build_test_nodes(3, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(1, PodOpts(
+        cpu=[10], mem=[10**7],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=2, fast_node_removal_rate=5),
+              nodes=nodes, pods=pods, backend=backend)
+    w.tick()
+    # clamp: untainted(3) - min(2) = 1 tainted despite rate 5
+    assert len(w.tainted_nodes()) == 1
+
+
+def test_reaper_deletes_after_grace(backend):
+    now = int(MockClock().now())
+    tainted = build_test_nodes(2, NodeOpts(
+        cpu=1000, mem=4 * 10**9, tainted=True, taint_time_sec=now - 1000))
+    active = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(2, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(), nodes=tainted + active, pods=pods, backend=backend,
+              target_size=4)
+    # 1000/2000 = 50% -> no-action band -> reap path; both tainted empty + past soft
+    w.tick()
+    assert w.state.scale_delta == 0
+    remaining = {n.name for n in w.client.list_nodes()}
+    assert tainted[0].name not in remaining
+    assert tainted[1].name not in remaining
+    assert set(w.group.deleted_nodes) == {tainted[0].name, tainted[1].name}
+    assert w.group.target_size() == 2
+
+
+def test_reaper_respects_no_delete_annotation(backend):
+    now = int(MockClock().now())
+    protected = build_test_nodes(1, NodeOpts(
+        cpu=1000, mem=4 * 10**9, tainted=True, taint_time_sec=now - 10_000,
+        no_delete=True))
+    active = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(2, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(), nodes=protected + active, pods=pods, backend=backend)
+    w.tick()
+    assert protected[0].name in {n.name for n in w.client.list_nodes()}
+
+
+def test_dry_mode_mutates_nothing(backend):
+    nodes = build_test_nodes(10, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(1, PodOpts(
+        cpu=[100], mem=[10**8],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(), nodes=nodes, pods=pods, backend=backend, dry_mode=True)
+    w.tick()
+    # tracker populated, but no real taints and no provider calls
+    assert len(w.state.taint_tracker) == 2
+    assert w.tainted_nodes() == []
+    assert w.group.increase_calls == []
+    # next tick sees tracker-tainted nodes as tainted
+    w.tick()
+    assert len(w.state.taint_tracker) == 4
+
+
+def test_forced_min_scale_up_untaints(backend):
+    """untainted < min while allNodes >= min -> immediate ScaleUp of the difference,
+    satisfied by untainting (controller.go:281-294)."""
+    tainted = build_test_nodes(2, NodeOpts(
+        cpu=1000, mem=4 * 10**9, tainted=True, taint_time_sec=100))
+    active = build_test_nodes(1, NodeOpts(cpu=1000, mem=4 * 10**9))
+    w = World(make_opts(min_nodes=2), nodes=tainted + active, backend=backend,
+              target_size=3)
+    w.tick()
+    assert w.group.increase_calls == []  # untaint satisfied it
+    assert len(w.tainted_nodes()) == 1
+    assert w.state.scale_delta == 1  # ScaleUp result (1 untainted)
+
+
+def test_forced_min_scale_up_via_provider(backend):
+    """untainted < min with only cordoned spares -> provider increase
+    (no tainted nodes to untaint)."""
+    cordoned = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9, cordoned=True))
+    active = build_test_nodes(1, NodeOpts(cpu=1000, mem=4 * 10**9))
+    w = World(make_opts(min_nodes=2), nodes=cordoned + active, backend=backend,
+              target_size=3)
+    w.tick()
+    assert w.group.increase_calls == [1]
+    assert w.state.scale_delta == 1  # ScaleUp result (1 added)
+
+
+def test_scale_up_from_zero_without_cache(backend):
+    pods = build_test_pods(5, PodOpts(
+        cpu=[1000], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=0), nodes=[], pods=pods, backend=backend,
+              target_size=0)
+    w.tick()
+    # no nodes ever seen -> no cached capacity -> +1 (util.go:20-24)
+    assert w.state.scale_delta == 1
+    assert w.group.increase_calls == [1]
+
+
+def test_scale_up_from_zero_with_cache(backend):
+    """Cached capacity survives the nodes disappearing and informs the from-zero
+    delta (util.go:26-31)."""
+    pods = build_test_pods(5, PodOpts(
+        cpu=[1000], mem=[10**8],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    nodes = build_test_nodes(1, NodeOpts(cpu=1000, mem=10**9))
+    w = World(make_opts(min_nodes=0), nodes=nodes, pods=pods, backend=backend)
+    w.tick()  # learns cached capacity (1000m); scales up and locks
+    w.clock.advance(601)
+    # the cloud never delivered; node disappears entirely
+    w.client.delete_node(nodes[0].name)
+    w.tick()
+    # ceil(5000/1000/70*100) = 8
+    assert w.state.scale_delta == 8
+
+
+def test_lister_error_skips_group(backend):
+    class FailingClient(InMemoryKubernetesClient):
+        fail = False
+
+        def list_pods(self):
+            if self.fail:
+                raise RuntimeError("boom")
+            return super().list_pods()
+
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    for n in nodes:
+        n.labels = {LABEL_KEY: LABEL_VALUE}
+    client = FailingClient(nodes=nodes)
+    provider = MockCloudProvider()
+    provider.register_node_group(
+        MockNodeGroup("buildeng-asg", "buildeng", 1, 100, 2)
+    )
+    c = ctl.Controller(ctl.Opts(
+        client=client, node_groups=[make_opts()],
+        cloud_provider_builder=MockBuilder(provider), backend=backend,
+        clock=MockClock(),
+    ))
+    client.fail = True
+    c.run_once()  # must not raise
+    assert c.node_groups["buildeng"].scale_delta == 0
+
+
+def test_provider_refresh_retries(backend):
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    w = World(make_opts(), nodes=nodes, backend=backend)
+    w.provider.fail_refreshes = 1
+    w.tick()  # retries and succeeds via rebuild
+    assert w.provider.refresh_count >= 2
+
+
+def test_multi_tick_scale_down_lifecycle(backend):
+    """Full lifecycle: idle cluster -> taint -> grace passes -> reap -> minimum."""
+    nodes = build_test_nodes(6, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(1, PodOpts(
+        cpu=[100], mem=[10**8],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    pods[0].node_name = nodes[0].name
+    w = World(make_opts(min_nodes=1), nodes=nodes, pods=pods, backend=backend)
+
+    for _ in range(4):
+        w.tick()
+        w.clock.advance(60)
+    # fast rate 2/tick, clamped at min 1: 5 tainted after 3+ ticks
+    assert len(w.tainted_nodes()) == 5
+
+    # let soft grace (5m) pass; empty tainted nodes get reaped
+    w.clock.advance(300)
+    w.tick()
+    live = {n.name for n in w.client.list_nodes()}
+    assert len(live) == 1 + len(w.tainted_nodes())
+    # the pod-bearing node was never tainted (it's the only untainted one)
+    assert nodes[0].name in live

@@ -1,0 +1,221 @@
+"""Leader election + CLI surface tests."""
+
+import json
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from escalator_tpu.k8s.election import (
+    FileResourceLock,
+    InMemoryResourceLock,
+    LeaderElectionConfig,
+    LeaderElector,
+    LeaderRecord,
+)
+from escalator_tpu.utils.clock import MockClock
+
+FAST = LeaderElectionConfig(
+    lease_duration_sec=5.0, renew_deadline_sec=3.0, retry_period_sec=0.5
+)
+
+
+class TestLeaderElection:
+    def test_single_candidate_becomes_leader(self):
+        lock = InMemoryResourceLock()
+        e = LeaderElector(lock, FAST, identity="a", clock=MockClock())
+        assert e.run(blocking_acquire_timeout=1)
+        assert e.is_leader
+        assert lock.get().holder == "a"
+        e.stop()
+
+    def test_second_candidate_blocks_until_lease_expires(self):
+        clock = MockClock()
+        lock = InMemoryResourceLock()
+        a = LeaderElector(lock, FAST, identity="a", clock=clock)
+        assert a.run(blocking_acquire_timeout=1)
+        a.stop()  # a stops renewing (simulates death) but holds the lease record
+
+        b = LeaderElector(lock, FAST, identity="b", clock=clock)
+        assert not b.run(blocking_acquire_timeout=1)  # lease still fresh
+        clock.advance(10)  # lease expires
+        assert b.run(blocking_acquire_timeout=1)
+        assert lock.get().holder == "b"
+        b.stop()
+
+    def test_deposed_callback_on_lost_lease(self):
+        clock = MockClock()
+        lock = InMemoryResourceLock()
+        deposed = threading.Event()
+        a = LeaderElector(lock, FAST, identity="a", clock=clock,
+                          on_deposed=deposed.set)
+        assert a.run(blocking_acquire_timeout=1)
+        # usurper takes the lock out from under a
+        lock.create_or_update(LeaderRecord("b", clock.now(), clock.now()), "a")
+        a._renew_loop()  # run one renew cycle synchronously
+        assert deposed.is_set()
+        assert not a.is_leader
+
+    def test_file_lock_round_trip(self, tmp_path):
+        lock = FileResourceLock(str(tmp_path / "lease.json"))
+        assert lock.get() is None
+        rec = LeaderRecord("me", 1.0, 2.0)
+        assert lock.create_or_update(rec, None)
+        got = lock.get()
+        assert got.holder == "me" and got.renew_time == 2.0
+        # CAS fails for wrong expected holder
+        assert not lock.create_or_update(LeaderRecord("you", 3.0, 3.0), "other")
+        assert lock.create_or_update(LeaderRecord("you", 3.0, 3.0), "me")
+        assert lock.get().holder == "you"
+
+
+NODEGROUPS_YAML = """
+node_groups:
+  - name: "buildeng"
+    label_key: "customer"
+    label_value: "buildeng"
+    cloud_provider_group_name: "buildeng-asg"
+    min_nodes: 1
+    max_nodes: 100
+    taint_upper_capacity_threshold_percent: 45
+    taint_lower_capacity_threshold_percent: 30
+    scale_up_threshold_percent: 70
+    slow_node_removal_rate: 1
+    fast_node_removal_rate: 2
+    soft_delete_grace_period: 5m
+    hard_delete_grace_period: 15m
+    scale_up_cool_down_period: 10m
+"""
+
+SIM_STATE_YAML = """
+nodes:
+  - {name: n1, labels: {customer: buildeng}, cpu_milli: 1000, mem_bytes: 4000000000}
+  - {name: n2, labels: {customer: buildeng}, cpu_milli: 1000, mem_bytes: 4000000000}
+pods:
+  - {name: p1, cpu_milli: 500, mem_bytes: 1000000000, node_selector: {customer: buildeng}}
+  - {name: p2, cpu_milli: 500, mem_bytes: 1000000000, node_selector: {customer: buildeng}}
+  - {name: p3, cpu_milli: 500, mem_bytes: 1000000000, node_selector: {customer: buildeng}}
+  - {name: p4, cpu_milli: 500, mem_bytes: 1000000000, node_selector: {customer: buildeng}}
+"""
+
+
+class TestCLI:
+    def _write(self, tmp_path):
+        ng = tmp_path / "nodegroups.yaml"
+        ng.write_text(NODEGROUPS_YAML)
+        sim = tmp_path / "state.yaml"
+        sim.write_text(SIM_STATE_YAML)
+        return ng, sim
+
+    def test_once_prints_deltas(self, tmp_path):
+        ng, sim = self._write(tmp_path)
+        from escalator_tpu.cli import main
+
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main([
+                "--nodegroups", str(ng), "--sim-state", str(sim),
+                "--backend", "golden", "--once",
+            ])
+        assert rc == 0
+        out = json.loads(buf.getvalue())
+        # 2000m req / 2000m cap = 100% -> delta ceil(2*(100-70)/70) = 1
+        assert out["deltas"] == {"buildeng": 1}
+        assert out["provider_targets"] == {"buildeng": 3}
+
+    def test_invalid_config_fails_fast(self, tmp_path):
+        ng = tmp_path / "bad.yaml"
+        ng.write_text("node_groups:\n  - name: x\n")
+        from escalator_tpu.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["--nodegroups", str(ng), "--once"])
+
+    def test_missing_cluster_source_errors(self, tmp_path):
+        ng, _ = self._write(tmp_path)
+        from escalator_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="no cluster source"):
+            main(["--nodegroups", str(ng), "--once"])
+
+
+class TestElectionCAS:
+    def test_no_split_brain_on_empty_lock(self):
+        """Strict CAS: with no record, exactly one of two racing candidates wins."""
+        lock = InMemoryResourceLock()
+        a_won = lock.create_or_update(LeaderRecord("a", 0, 0), None)
+        b_won = lock.create_or_update(LeaderRecord("b", 0, 0), None)
+        assert a_won and not b_won
+        assert lock.get().holder == "a"
+
+    def test_file_lock_cross_process_exclusion(self, tmp_path):
+        """Two separate processes race to acquire the same empty file lease;
+        exactly one must win (fcntl-serialized CAS)."""
+        import subprocess, sys
+        path = tmp_path / "lease.json"
+        code = f"""
+import sys
+sys.path.insert(0, {str(__import__('pathlib').Path(__file__).parents[1])!r})
+from escalator_tpu.k8s.election import FileResourceLock, LeaderRecord
+lock = FileResourceLock({str(path)!r})
+won = lock.create_or_update(LeaderRecord(sys.argv[1], 0, 0), None)
+print(int(won))
+"""
+        procs = [
+            subprocess.Popen([sys.executable, "-c", code, who],
+                             stdout=subprocess.PIPE)
+            for who in ("a", "b")
+        ]
+        results = [int(p.communicate()[0].strip()) for p in procs]
+        assert sum(results) == 1
+
+    def test_renew_retries_until_deadline(self):
+        """A transiently failing lock does not depose before the renew deadline."""
+        clock = MockClock()
+
+        class FlakyLock(InMemoryResourceLock):
+            fail = False
+
+            def create_or_update(self, record, expected):
+                if self.fail:
+                    raise OSError("transient")
+                return super().create_or_update(record, expected)
+
+        lock = FlakyLock()
+        deposed = threading.Event()
+        e = LeaderElector(lock, FAST, identity="a", clock=clock,
+                          on_deposed=deposed.set)
+        assert e.run(blocking_acquire_timeout=1)
+        lock.fail = True
+        # two failed rounds (1.0s elapsed) < renew_deadline (3.0s): must NOT depose
+        e._stop = FakeStopOnce(clock, FAST.retry_period_sec, rounds=2)
+        e._renew_loop()
+        assert not deposed.is_set()
+        # eight more failed rounds (4.0s) > renew_deadline: must depose
+        e._stop = FakeStopOnce(clock, FAST.retry_period_sec, rounds=8)
+        e._renew_loop()
+        assert deposed.is_set()
+        assert not e.is_leader
+
+
+class FakeStopOnce:
+    """Stop event that advances a mock clock per wait and stops after N rounds."""
+
+    def __init__(self, clock, period, rounds):
+        self.clock = clock
+        self.period = period
+        self.rounds = rounds
+
+    def wait(self, timeout):
+        if self.rounds <= 0:
+            return True
+        self.rounds -= 1
+        self.clock.advance(self.period)
+        return False
+
+    def is_set(self):
+        return self.rounds <= 0

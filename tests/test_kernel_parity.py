@@ -223,3 +223,20 @@ def test_huge_delta_clamped_identically():
     assert want.nodes_delta == sem.MAX_DELTA
     assert int(out.nodes_delta[0]) == want.nodes_delta
     assert int(out.status[0]) == int(want.status)
+
+
+def test_scale_up_delta_float_order_parity():
+    """Op-order regression: Go computes n*((pct-thr)/thr); the grouping changes the
+    result by one node on this input (543 nodes, 5430m cap, 1632m req, thr 15)."""
+    from escalator_tpu.testsupport.builders import build_test_nodes, build_test_pods
+
+    cfg = sem.GroupConfig(min_nodes=0, max_nodes=10**6, taint_lower_percent=1,
+                          taint_upper_percent=2, scale_up_percent=15,
+                          slow_removal_rate=1, fast_removal_rate=2)
+    nodes = build_test_nodes(543, NodeOpts(cpu=10, mem=10**6))
+    pods = build_test_pods(1, PodOpts(cpu=[1632], mem=[10**5]))
+    want = sem.evaluate_node_group(pods, nodes, cfg, sem.GroupState())
+    assert want.nodes_delta == 545  # ceil(543*((30.055...-15)/15))
+    cluster = pack_cluster([(pods, nodes, cfg, sem.GroupState())])
+    out = kernel.decide_jit(cluster, np.int64(NOW))
+    assert int(out.nodes_delta[0]) == want.nodes_delta

@@ -1,0 +1,183 @@
+"""Config schema + validation + filter tests, ported from the reference's
+node_group_test.go tables."""
+
+import pytest
+
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.testsupport.builders import PodOpts, build_test_pod
+
+VALID_YAML = """
+node_groups:
+  - name: "shared"
+    label_key: "customer"
+    label_value: "shared"
+    cloud_provider_group_name: "shared-nodes"
+    min_nodes: 1
+    max_nodes: 30
+    dry_mode: false
+    taint_upper_capacity_threshold_percent: 40
+    taint_lower_capacity_threshold_percent: 10
+    scale_up_threshold_percent: 70
+    slow_node_removal_rate: 2
+    fast_node_removal_rate: 5
+    soft_delete_grace_period: 1m
+    hard_delete_grace_period: 10m
+    scale_up_cool_down_period: 2m
+    taint_effect: NoExecute
+    aws:
+      launch_template_id: lt-123
+      launch_template_version: "2"
+      lifecycle: spot
+      instance_type_overrides: ["m5.large", "m5.xlarge"]
+      resource_tagging: true
+  - name: "default"
+    label_key: "customer"
+    label_value: "buildeng"
+    cloud_provider_group_name: "buildeng-nodes"
+    min_nodes: 1
+    max_nodes: 10
+    taint_upper_capacity_threshold_percent: 40
+    taint_lower_capacity_threshold_percent: 10
+    scale_up_threshold_percent: 70
+    slow_node_removal_rate: 1
+    fast_node_removal_rate: 2
+    soft_delete_grace_period: 30s
+    hard_delete_grace_period: 1m30s
+    scale_up_cool_down_period: 2m
+"""
+
+
+class TestUnmarshal:
+    def test_parse(self):
+        groups = ngmod.unmarshal_node_group_options(VALID_YAML)
+        assert len(groups) == 2
+        g = groups[0]
+        assert g.name == "shared"
+        assert g.min_nodes == 1 and g.max_nodes == 30
+        assert g.taint_effect == "NoExecute"
+        assert g.aws.launch_template_id == "lt-123"
+        assert g.aws.lifecycle == "spot"
+        assert g.aws.instance_type_overrides == ["m5.large", "m5.xlarge"]
+        assert g.aws.resource_tagging is True
+        assert groups[1].hard_delete_grace_period_duration() == 90.0
+
+    def test_hard_delete_yaml_tag_fixed(self):
+        """The reference drops hard_delete_grace_period from YAML due to a wrong
+        struct tag (node_group.go:40). We parse it correctly — deliberate fix."""
+        g = ngmod.unmarshal_node_group_options(VALID_YAML)[0]
+        assert g.hard_delete_grace_period == "10m"
+        assert g.hard_delete_grace_period_duration() == 600.0
+
+    def test_validate_ok(self):
+        for g in ngmod.unmarshal_node_group_options(VALID_YAML):
+            assert ngmod.validate_node_group(g) == []
+
+    def test_unknown_fields_ignored(self):
+        g = ngmod.unmarshal_node_group_options(
+            "node_groups:\n  - name: x\n    bogus_field: 1\n"
+        )
+        assert g[0].name == "x"
+
+
+class TestDurations:
+    @pytest.mark.parametrize("s,want", [
+        ("300ms", 0.3), ("10s", 10.0), ("2m", 120.0), ("1.5h", 5400.0),
+        ("2h45m", 9900.0), ("1m30s", 90.0), ("", 0.0), ("bogus", 0.0),
+        ("-5s", -5.0),
+    ])
+    def test_parse(self, s, want):
+        assert ngmod.parse_duration(s) == want
+
+
+class TestValidation:
+    def _valid(self):
+        return ngmod.unmarshal_node_group_options(VALID_YAML)[0]
+
+    def test_ordering_violations(self):
+        g = self._valid()
+        g.taint_lower_capacity_threshold_percent = 50
+        problems = ngmod.validate_node_group(g)
+        assert any("taint_lower" in p for p in problems)
+
+        g = self._valid()
+        g.scale_up_threshold_percent = 30
+        problems = ngmod.validate_node_group(g)
+        assert any("taint_upper" in p for p in problems)
+
+    def test_min_max(self):
+        g = self._valid()
+        g.min_nodes, g.max_nodes = 30, 10
+        assert any("min_nodes" in p for p in ngmod.validate_node_group(g))
+
+    def test_auto_discovery_allows_zero_min_max(self):
+        g = self._valid()
+        g.min_nodes = g.max_nodes = 0
+        assert g.auto_discover_min_max_node_options()
+        assert ngmod.validate_node_group(g) == []
+
+    def test_grace_ordering(self):
+        g = self._valid()
+        g.soft_delete_grace_period, g.hard_delete_grace_period = "10m", "1m"
+        assert any("soft_delete" in p for p in ngmod.validate_node_group(g))
+
+    def test_removal_rate_ordering(self):
+        g = self._valid()
+        g.slow_node_removal_rate, g.fast_node_removal_rate = 5, 2
+        assert any("removal_rate" in p for p in ngmod.validate_node_group(g))
+
+    def test_taint_effect(self):
+        g = self._valid()
+        g.taint_effect = "EvictPlz"
+        assert any("taint_effect" in p for p in ngmod.validate_node_group(g))
+        g.taint_effect = ""
+        assert ngmod.validate_node_group(g) == []
+
+    def test_aws_lifecycle(self):
+        g = self._valid()
+        g.aws.lifecycle = "weird"
+        assert any("lifecycle" in p for p in ngmod.validate_node_group(g))
+
+
+class TestFilters:
+    def test_affinity_filter_selector_match(self):
+        f = ngmod.new_pod_affinity_filter_func("customer", "shared")
+        assert f(build_test_pod(PodOpts(
+            cpu=[1], mem=[1],
+            node_selector_key="customer", node_selector_value="shared")))
+        assert not f(build_test_pod(PodOpts(
+            cpu=[1], mem=[1],
+            node_selector_key="customer", node_selector_value="other")))
+        # daemonsets excluded even when matching
+        assert not f(build_test_pod(PodOpts(
+            cpu=[1], mem=[1], owner="DaemonSet",
+            node_selector_key="customer", node_selector_value="shared")))
+
+    def test_affinity_filter_affinity_match(self):
+        f = ngmod.new_pod_affinity_filter_func("customer", "shared")
+        assert f(build_test_pod(PodOpts(
+            cpu=[1], mem=[1],
+            node_affinity_key="customer", node_affinity_value="shared")))
+        # NotIn operator unsupported -> no match (reference: node_group.go:241)
+        assert not f(build_test_pod(PodOpts(
+            cpu=[1], mem=[1],
+            node_affinity_key="customer", node_affinity_value="shared",
+            node_affinity_op="NotIn")))
+
+    def test_default_filter(self):
+        f = ngmod.new_pod_default_filter_func()
+        assert f(build_test_pod(PodOpts(cpu=[1], mem=[1])))
+        assert not f(build_test_pod(PodOpts(cpu=[1], mem=[1], owner="DaemonSet")))
+        assert not f(build_test_pod(PodOpts(cpu=[1], mem=[1], static=True)))
+        assert not f(build_test_pod(PodOpts(
+            cpu=[1], mem=[1],
+            node_selector_key="customer", node_selector_value="x")))
+        assert not f(build_test_pod(PodOpts(
+            cpu=[1], mem=[1],
+            node_affinity_key="customer", node_affinity_value="x")))
+
+    def test_node_label_filter(self):
+        f = ngmod.new_node_label_filter_func("customer", "shared")
+        assert f(k8s.Node(name="a", labels={"customer": "shared"}))
+        assert not f(k8s.Node(name="b", labels={"customer": "other"}))
+        assert not f(k8s.Node(name="c"))
