@@ -110,6 +110,34 @@ def _round_up(n: int, minimum: int = 64) -> int:
     return 1 << (size - 1).bit_length()
 
 
+class PaddedPacker:
+    """pack_cluster with high-water-mark power-of-two padding — the shared shape
+    stabilization policy for every array-feeding backend (local jit and remote
+    plugin alike)."""
+
+    def __init__(self):
+        self._pad_pods = 0
+        self._pad_nodes = 0
+        self._pad_groups = 0
+
+    def pack(self, group_inputs, dry_mode_flags=None, taint_trackers=None):
+        from escalator_tpu.core.arrays import pack_cluster
+
+        total_pods = sum(len(p) for p, *_ in group_inputs)
+        total_nodes = sum(len(n) for _, n, *_ in group_inputs)
+        self._pad_pods = max(self._pad_pods, _round_up(total_pods))
+        self._pad_nodes = max(self._pad_nodes, _round_up(total_nodes))
+        self._pad_groups = max(self._pad_groups, _round_up(len(group_inputs), 8))
+        return pack_cluster(
+            group_inputs,
+            dry_mode_flags=dry_mode_flags,
+            taint_trackers=taint_trackers,
+            pad_pods=self._pad_pods,
+            pad_nodes=self._pad_nodes,
+            pad_groups=self._pad_groups,
+        )
+
+
 def _unpack(out, group_inputs) -> List[GroupDecision]:
     """Shared kernel-output -> GroupDecision conversion for array backends."""
     status = np.asarray(out.status)
@@ -181,28 +209,11 @@ class JaxBackend(ComputeBackend):
         from escalator_tpu.ops import kernel  # defers jax import
 
         self._kernel = kernel
-        self._pad_pods = 0
-        self._pad_nodes = 0
-        self._pad_groups = 0
+        self._packer = PaddedPacker()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
-        from escalator_tpu.core.arrays import pack_cluster
-
-        total_pods = sum(len(p) for p, *_ in group_inputs)
-        total_nodes = sum(len(n) for _, n, *_ in group_inputs)
-        self._pad_pods = max(self._pad_pods, _round_up(total_pods))
-        self._pad_nodes = max(self._pad_nodes, _round_up(total_nodes))
-        self._pad_groups = max(self._pad_groups, _round_up(len(group_inputs), 8))
-
         t0 = time.perf_counter()
-        cluster = pack_cluster(
-            group_inputs,
-            dry_mode_flags=dry_mode_flags,
-            taint_trackers=taint_trackers,
-            pad_pods=self._pad_pods,
-            pad_nodes=self._pad_nodes,
-            pad_groups=self._pad_groups,
-        )
+        cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
         t1 = time.perf_counter()
         out = self._kernel.decide_jit(cluster, np.int64(now_sec))
         import jax

@@ -1,0 +1,267 @@
+// Incremental cluster state store — the native host-side data path.
+//
+// SURVEY.md §7 calls out the host<->device path as a hard part: packing 100k pods
+// from Python objects every tick is O(cluster) Python-loop work. This store keeps
+// the kernel's structure-of-arrays resident in C++ and applies watch-style deltas
+// (upsert/delete pod/node) in O(1) each; Python views the buffers zero-copy via
+// numpy and hands them straight to jax.device_put. The reference has no equivalent
+// component (its per-tick cost is the same O(cluster) Go loops at
+// /root/reference/pkg/k8s/util.go:27-51, rebuilt every tick).
+//
+// Concurrency: single-writer (the ingest thread); readers must not overlap writes
+// (the Python wrapper snapshots under its own lock). Slots are freelist-reused;
+// `valid` masks dead lanes, so buffers never compact and views stay stable.
+//
+// C ABI only — consumed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PodColumns {
+  std::vector<int32_t> group;
+  std::vector<int64_t> cpu_milli;
+  std::vector<int64_t> mem_bytes;
+  std::vector<int32_t> node;
+  std::vector<uint8_t> valid;
+
+  // reserve() up to max first so later resize() within max NEVER reallocates —
+  // exported buffer pointers (and the numpy views over them) stay stable for the
+  // store's lifetime. Reserved-but-unused pages cost only virtual address space.
+  void reserve_max(size_t max) {
+    group.reserve(max);
+    cpu_milli.reserve(max);
+    mem_bytes.reserve(max);
+    node.reserve(max);
+    valid.reserve(max);
+  }
+
+  void resize(size_t n) {
+    group.resize(n, 0);
+    cpu_milli.resize(n, 0);
+    mem_bytes.resize(n, 0);
+    node.resize(n, -1);
+    valid.resize(n, 0);
+  }
+};
+
+struct NodeColumns {
+  std::vector<int32_t> group;
+  std::vector<int64_t> cpu_milli;
+  std::vector<int64_t> mem_bytes;
+  std::vector<int64_t> creation_ns;
+  std::vector<uint8_t> tainted;
+  std::vector<uint8_t> cordoned;
+  std::vector<uint8_t> no_delete;
+  std::vector<int64_t> taint_time_sec;
+  std::vector<uint8_t> valid;
+
+  void reserve_max(size_t max) {
+    group.reserve(max);
+    cpu_milli.reserve(max);
+    mem_bytes.reserve(max);
+    creation_ns.reserve(max);
+    tainted.reserve(max);
+    cordoned.reserve(max);
+    no_delete.reserve(max);
+    taint_time_sec.reserve(max);
+    valid.reserve(max);
+  }
+
+  void resize(size_t n) {
+    group.resize(n, 0);
+    cpu_milli.resize(n, 0);
+    mem_bytes.resize(n, 0);
+    creation_ns.resize(n, 0);
+    tainted.resize(n, 0);
+    cordoned.resize(n, 0);
+    no_delete.resize(n, 0);
+    // matches escalator_tpu.core.arrays.NO_TAINT_TIME
+    taint_time_sec.resize(n, INT64_C(-4611686018427387904));
+    valid.resize(n, 0);
+  }
+};
+
+struct Registry {
+  std::unordered_map<std::string, int64_t> index;
+  std::vector<int64_t> free_slots;
+  int64_t capacity = 0;
+  int64_t high_water = 0;  // one past the highest slot ever used
+
+  // returns slot or -1 when full and key is new
+  int64_t acquire(const std::string& key) {
+    auto it = index.find(key);
+    if (it != index.end()) return it->second;
+    int64_t slot;
+    if (!free_slots.empty()) {
+      slot = free_slots.back();
+      free_slots.pop_back();
+    } else if (high_water < capacity) {
+      slot = high_water++;
+    } else {
+      return -1;
+    }
+    index.emplace(key, slot);
+    return slot;
+  }
+
+  int64_t release(const std::string& key) {
+    auto it = index.find(key);
+    if (it == index.end()) return -1;
+    int64_t slot = it->second;
+    index.erase(it);
+    free_slots.push_back(slot);
+    return slot;
+  }
+
+  int64_t lookup(const std::string& key) const {
+    auto it = index.find(key);
+    return it == index.end() ? -1 : it->second;
+  }
+};
+
+}  // namespace
+
+struct StateStore {
+  PodColumns pods;
+  NodeColumns nodes;
+  Registry pod_reg;
+  Registry node_reg;
+  int64_t max_pods = 0;
+  int64_t max_nodes = 0;
+};
+
+extern "C" {
+
+// max_* bound the store's lifetime growth; all columns reserve to max up front so
+// exported pointers never move (grow beyond max fails instead of reallocating).
+StateStore* ess_new(int64_t pod_capacity, int64_t node_capacity,
+                    int64_t max_pods, int64_t max_nodes) {
+  if (pod_capacity > max_pods || node_capacity > max_nodes) return nullptr;
+  auto* s = new StateStore();
+  s->max_pods = max_pods;
+  s->max_nodes = max_nodes;
+  s->pods.reserve_max(static_cast<size_t>(max_pods));
+  s->nodes.reserve_max(static_cast<size_t>(max_nodes));
+  s->pods.resize(static_cast<size_t>(pod_capacity));
+  s->nodes.resize(static_cast<size_t>(node_capacity));
+  s->pod_reg.capacity = pod_capacity;
+  s->node_reg.capacity = node_capacity;
+  return s;
+}
+
+void ess_free(StateStore* s) { delete s; }
+
+int64_t ess_pod_capacity(StateStore* s) { return s->pod_reg.capacity; }
+int64_t ess_node_capacity(StateStore* s) { return s->node_reg.capacity; }
+int64_t ess_pod_count(StateStore* s) {
+  return static_cast<int64_t>(s->pod_reg.index.size());
+}
+int64_t ess_node_count(StateStore* s) {
+  return static_cast<int64_t>(s->node_reg.index.size());
+}
+
+// Grow capacity within the reserved maxima. Pointers stay valid (reserve_max
+// guarantees no reallocation), but previously-created views don't see the new
+// lanes — the Python wrapper bumps a generation counter and re-views.
+// Returns 0 on success, -1 when the requested capacity exceeds the lifetime max.
+int32_t ess_grow(StateStore* s, int64_t pod_capacity, int64_t node_capacity) {
+  if (pod_capacity > s->max_pods || node_capacity > s->max_nodes) return -1;
+  if (pod_capacity > s->pod_reg.capacity) {
+    s->pods.resize(static_cast<size_t>(pod_capacity));
+    s->pod_reg.capacity = pod_capacity;
+  }
+  if (node_capacity > s->node_reg.capacity) {
+    s->nodes.resize(static_cast<size_t>(node_capacity));
+    s->node_reg.capacity = node_capacity;
+  }
+  return 0;
+}
+
+int64_t ess_upsert_pod(StateStore* s, const char* uid, int32_t group,
+                       int64_t cpu_milli, int64_t mem_bytes, int32_t node_slot) {
+  int64_t slot = s->pod_reg.acquire(uid);
+  if (slot < 0) return -1;
+  s->pods.group[slot] = group;
+  s->pods.cpu_milli[slot] = cpu_milli;
+  s->pods.mem_bytes[slot] = mem_bytes;
+  s->pods.node[slot] = node_slot;
+  s->pods.valid[slot] = 1;
+  return slot;
+}
+
+int64_t ess_delete_pod(StateStore* s, const char* uid) {
+  int64_t slot = s->pod_reg.release(uid);
+  if (slot < 0) return -1;
+  s->pods.valid[slot] = 0;
+  s->pods.cpu_milli[slot] = 0;
+  s->pods.mem_bytes[slot] = 0;
+  s->pods.node[slot] = -1;
+  return slot;
+}
+
+int64_t ess_upsert_node(StateStore* s, const char* name, int32_t group,
+                        int64_t cpu_milli, int64_t mem_bytes,
+                        int64_t creation_ns, uint8_t tainted, uint8_t cordoned,
+                        uint8_t no_delete, int64_t taint_time_sec) {
+  int64_t slot = s->node_reg.acquire(name);
+  if (slot < 0) return -1;
+  s->nodes.group[slot] = group;
+  s->nodes.cpu_milli[slot] = cpu_milli;
+  s->nodes.mem_bytes[slot] = mem_bytes;
+  s->nodes.creation_ns[slot] = creation_ns;
+  s->nodes.tainted[slot] = tainted;
+  s->nodes.cordoned[slot] = cordoned;
+  s->nodes.no_delete[slot] = no_delete;
+  s->nodes.taint_time_sec[slot] = taint_time_sec;
+  s->nodes.valid[slot] = 1;
+  return slot;
+}
+
+int64_t ess_delete_node(StateStore* s, const char* name) {
+  int64_t slot = s->node_reg.release(name);
+  if (slot < 0) return -1;
+  s->nodes.valid[slot] = 0;
+  return slot;
+}
+
+int64_t ess_node_slot(StateStore* s, const char* name) {
+  return s->node_reg.lookup(name);
+}
+
+int64_t ess_pod_slot(StateStore* s, const char* uid) {
+  return s->pod_reg.lookup(uid);
+}
+
+// Buffer pointer exports, one per column. Field ids keep the ABI append-only.
+void* ess_pod_buffer(StateStore* s, int32_t field) {
+  switch (field) {
+    case 0: return s->pods.group.data();
+    case 1: return s->pods.cpu_milli.data();
+    case 2: return s->pods.mem_bytes.data();
+    case 3: return s->pods.node.data();
+    case 4: return s->pods.valid.data();
+    default: return nullptr;
+  }
+}
+
+void* ess_node_buffer(StateStore* s, int32_t field) {
+  switch (field) {
+    case 0: return s->nodes.group.data();
+    case 1: return s->nodes.cpu_milli.data();
+    case 2: return s->nodes.mem_bytes.data();
+    case 3: return s->nodes.creation_ns.data();
+    case 4: return s->nodes.tainted.data();
+    case 5: return s->nodes.cordoned.data();
+    case 6: return s->nodes.no_delete.data();
+    case 7: return s->nodes.taint_time_sec.data();
+    case 8: return s->nodes.valid.data();
+    default: return nullptr;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,261 @@
+"""ctypes wrapper over the C++ incremental state store (statestore.cpp).
+
+Builds the shared library on first use (g++ available in this image; no pybind11
+needed — C ABI + ctypes + zero-copy numpy views). Falls back gracefully: callers
+check ``available()`` and use the pure-Python packer otherwise.
+
+The store holds the kernel's pod/node columns; ``views()`` returns numpy arrays
+aliasing the C++ buffers (no copy). Snapshot discipline: the caller must not apply
+deltas while a jitted computation may still be reading a device transfer of the
+views — in practice `jax.device_put` copies synchronously, so ticking is safe.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+log = logging.getLogger("escalator_tpu.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "statestore.cpp")
+_LIB = os.path.join(_HERE, "libessstate.so")
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+#: must match NO_TAINT_TIME in escalator_tpu.core.arrays
+NO_TAINT_TIME = -(2**62)
+
+_POD_FIELDS = [
+    ("group", np.int32), ("cpu_milli", np.int64), ("mem_bytes", np.int64),
+    ("node", np.int32), ("valid", np.uint8),
+]
+_NODE_FIELDS = [
+    ("group", np.int32), ("cpu_milli", np.int64), ("mem_bytes", np.int64),
+    ("creation_ns", np.int64), ("tainted", np.uint8), ("cordoned", np.uint8),
+    ("no_delete", np.uint8), ("taint_time_sec", np.int64), ("valid", np.uint8),
+]
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB) or (
+            os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            cmd = [
+                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                "-o", _LIB, _SRC,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            except (subprocess.CalledProcessError, OSError) as e:
+                stderr = getattr(e, "stderr", "")
+                log.warning("native statestore build failed: %s %s", e, stderr)
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            log.warning("native statestore load failed: %s", e)
+            _build_failed = True
+            return None
+        lib.ess_new.restype = ctypes.c_void_p
+        lib.ess_new.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.ess_free.argtypes = [ctypes.c_void_p]
+        for fn in ("ess_pod_capacity", "ess_node_capacity", "ess_pod_count",
+                   "ess_node_count"):
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.ess_grow.restype = ctypes.c_int32
+        lib.ess_grow.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.ess_upsert_pod.restype = ctypes.c_int64
+        lib.ess_upsert_pod.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32,
+        ]
+        lib.ess_delete_pod.restype = ctypes.c_int64
+        lib.ess_delete_pod.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ess_upsert_node.restype = ctypes.c_int64
+        lib.ess_upsert_node.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint8, ctypes.c_uint8,
+            ctypes.c_uint8, ctypes.c_int64,
+        ]
+        lib.ess_delete_node.restype = ctypes.c_int64
+        lib.ess_delete_node.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ess_node_slot.restype = ctypes.c_int64
+        lib.ess_node_slot.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ess_pod_slot.restype = ctypes.c_int64
+        lib.ess_pod_slot.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ess_pod_buffer.restype = ctypes.c_void_p
+        lib.ess_pod_buffer.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.ess_node_buffer.restype = ctypes.c_void_p
+        lib.ess_node_buffer.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _build() is not None
+
+
+class NativeStateStore:
+    """Incremental SoA cluster state with zero-copy numpy views.
+
+    Buffer pointers are stable for the store's lifetime (the C++ side reserves
+    ``max_*`` capacity up front, so growth never reallocates). Growth DOES mean
+    previously-created views are too short to see new lanes — check ``generation``
+    and re-view when it changed. Views keep the store alive (they hold a reference),
+    so dropping the store while views exist is safe.
+    """
+
+    def __init__(self, pod_capacity: int = 1 << 17, node_capacity: int = 1 << 15,
+                 max_pods: int = 1 << 21, max_nodes: int = 1 << 18):
+        lib = _build()
+        if lib is None:
+            raise RuntimeError("native statestore unavailable (build failed)")
+        self._lib = lib
+        self._ptr = lib.ess_new(pod_capacity, node_capacity, max_pods, max_nodes)
+        if not self._ptr:
+            raise MemoryError("ess_new failed (capacity > max?)")
+        self.generation = 0
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.ess_free(ptr)
+            self._ptr = None
+
+    # -- capacities ----------------------------------------------------------
+    @property
+    def pod_capacity(self) -> int:
+        return self._lib.ess_pod_capacity(self._ptr)
+
+    @property
+    def node_capacity(self) -> int:
+        return self._lib.ess_node_capacity(self._ptr)
+
+    @property
+    def pod_count(self) -> int:
+        return self._lib.ess_pod_count(self._ptr)
+
+    @property
+    def node_count(self) -> int:
+        return self._lib.ess_node_count(self._ptr)
+
+    def grow(self, pod_capacity: int, node_capacity: int) -> None:
+        if self._lib.ess_grow(self._ptr, pod_capacity, node_capacity) != 0:
+            raise MemoryError(
+                f"grow({pod_capacity}, {node_capacity}) exceeds the store's"
+                " lifetime max capacity"
+            )
+        self.generation += 1
+
+    def _ensure_pod_capacity(self) -> None:
+        if self.pod_count >= self.pod_capacity:
+            self.grow(self.pod_capacity * 2, self.node_capacity)
+
+    def _ensure_node_capacity(self) -> None:
+        if self.node_count >= self.node_capacity:
+            self.grow(self.pod_capacity, self.node_capacity * 2)
+
+    # -- deltas --------------------------------------------------------------
+    def upsert_pod(self, uid: str, group: int, cpu_milli: int, mem_bytes: int,
+                   node_slot: int = -1) -> int:
+        self._ensure_pod_capacity()
+        slot = self._lib.ess_upsert_pod(
+            self._ptr, uid.encode(), group, cpu_milli, mem_bytes, node_slot
+        )
+        if slot < 0:
+            raise MemoryError("pod capacity exhausted")
+        return slot
+
+    def delete_pod(self, uid: str) -> int:
+        return self._lib.ess_delete_pod(self._ptr, uid.encode())
+
+    def upsert_node(self, name: str, group: int, cpu_milli: int, mem_bytes: int,
+                    creation_ns: int = 0, tainted: bool = False,
+                    cordoned: bool = False, no_delete: bool = False,
+                    taint_time_sec: int = NO_TAINT_TIME) -> int:
+        self._ensure_node_capacity()
+        slot = self._lib.ess_upsert_node(
+            self._ptr, name.encode(), group, cpu_milli, mem_bytes, creation_ns,
+            int(tainted), int(cordoned), int(no_delete), taint_time_sec,
+        )
+        if slot < 0:
+            raise MemoryError("node capacity exhausted")
+        return slot
+
+    def delete_node(self, name: str) -> int:
+        return self._lib.ess_delete_node(self._ptr, name.encode())
+
+    def node_slot(self, name: str) -> int:
+        return self._lib.ess_node_slot(self._ptr, name.encode())
+
+    def pod_slot(self, uid: str) -> int:
+        return self._lib.ess_pod_slot(self._ptr, uid.encode())
+
+    # -- views ---------------------------------------------------------------
+    def _view(self, getter, field_id: int, dtype, count: int) -> np.ndarray:
+        ptr = getter(self._ptr, field_id)
+        buf = (ctypes.c_char * (count * np.dtype(dtype).itemsize)).from_address(ptr)
+        # the ctypes buffer becomes the array's base; pinning the store on it keeps
+        # the C++ allocation alive as long as any view exists
+        buf._escalator_store = self
+        return np.frombuffer(buf, dtype=dtype, count=count)
+
+    def pod_views(self) -> Dict[str, np.ndarray]:
+        n = self.pod_capacity
+        return {
+            name: self._view(self._lib.ess_pod_buffer, i, dt, n)
+            for i, (name, dt) in enumerate(_POD_FIELDS)
+        }
+
+    def node_views(self) -> Dict[str, np.ndarray]:
+        n = self.node_capacity
+        return {
+            name: self._view(self._lib.ess_node_buffer, i, dt, n)
+            for i, (name, dt) in enumerate(_NODE_FIELDS)
+        }
+
+    def as_pod_node_arrays(self):
+        """(PodArrays, NodeArrays) viewing the live buffers zero-copy. bool columns
+        are reinterpreted views of the uint8 buffers."""
+        from escalator_tpu.core.arrays import NodeArrays, PodArrays
+
+        pv = self.pod_views()
+        nv = self.node_views()
+        pods = PodArrays(
+            group=pv["group"],
+            cpu_milli=pv["cpu_milli"],
+            mem_bytes=pv["mem_bytes"],
+            node=pv["node"],
+            valid=pv["valid"].view(bool),
+        )
+        nodes = NodeArrays(
+            group=nv["group"],
+            cpu_milli=nv["cpu_milli"],
+            mem_bytes=nv["mem_bytes"],
+            creation_ns=nv["creation_ns"],
+            tainted=nv["tainted"].view(bool),
+            cordoned=nv["cordoned"].view(bool),
+            no_delete=nv["no_delete"].view(bool),
+            taint_time_sec=nv["taint_time_sec"],
+            valid=nv["valid"].view(bool),
+        )
+        return pods, nodes

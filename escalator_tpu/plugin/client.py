@@ -1,0 +1,92 @@
+"""Compute-plugin client + the controller-facing remote backend with CPU fallback.
+
+``GrpcBackend`` implements ``ComputeBackend``: it packs object state to arrays,
+ships one columnar frame to the plugin service, and unpacks the decision frame. When
+the service is unreachable (or a call fails), it falls back to a local backend —
+the north-star requirement ("controller calls the TPU solver over a local gRPC shim
+and falls back to the existing CPU path when no device is present")."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import grpc
+import msgpack
+import numpy as np
+
+from escalator_tpu.controller.backend import (
+    ComputeBackend,
+    GoldenBackend,
+    PaddedPacker,
+    _unpack,
+)
+from escalator_tpu.plugin import codec
+from escalator_tpu.plugin.server import SERVICE_NAME
+
+log = logging.getLogger("escalator_tpu.plugin")
+
+
+class ComputeClient:
+    """Thin RPC wrapper. bytes in / bytes out, codec at the edges."""
+
+    def __init__(self, address: str = "127.0.0.1:50551",
+                 timeout_sec: float = 10.0):
+        self.address = address
+        self.timeout_sec = timeout_sec
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_receive_message_length", -1),
+                ("grpc.max_send_message_length", -1),
+            ],
+        )
+        self._decide = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Decide",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x,
+        )
+        self._health = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Health",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x,
+        )
+
+    def health(self) -> dict:
+        return msgpack.unpackb(self._health(b"", timeout=self.timeout_sec))
+
+    def decide_arrays(self, cluster, now_sec: int):
+        frame = codec.encode_cluster(cluster, now_sec)
+        resp = self._decide(frame, timeout=self.timeout_sec)
+        return codec.decode_decision(resp)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class GrpcBackend(ComputeBackend):
+    """ComputeBackend over the plugin service, with automatic local fallback."""
+
+    name = "grpc"
+
+    def __init__(self, address: str = "127.0.0.1:50551",
+                 fallback: Optional[ComputeBackend] = None,
+                 timeout_sec: float = 10.0):
+        self.client = ComputeClient(address, timeout_sec)
+        self.fallback = fallback or GoldenBackend()
+        self._packer = PaddedPacker()
+
+    def decide(self, group_inputs, now_sec, dry_mode_flags=None,
+               taint_trackers=None):
+        cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
+        try:
+            out = self.client.decide_arrays(cluster, now_sec)
+        except grpc.RpcError as e:
+            log.warning(
+                "compute plugin unavailable (%s); falling back to %s backend",
+                e.code() if hasattr(e, "code") else e, self.fallback.name,
+            )
+            return self.fallback.decide(
+                group_inputs, now_sec, dry_mode_flags, taint_trackers
+            )
+        return _unpack(out, group_inputs)

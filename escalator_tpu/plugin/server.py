@@ -1,0 +1,117 @@
+"""gRPC compute-plugin service: the device solver behind a local socket.
+
+The SURVEY.md §2.7 "compute plugin" slot: a non-Python controller (e.g. a Go shell
+like the reference) calls ``/escalator.Compute/Decide`` with a columnar cluster frame
+(see codec.py) and gets the full decision frame back. Method handlers are registered
+generically with bytes-level serializers — no protoc codegen, no per-pod message
+overhead.
+
+Methods:
+- ``Decide``: cluster frame -> decision frame (batched kernel on the server's device)
+- ``Health``: empty -> msgpack {device, backend, version}
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import msgpack
+import numpy as np
+
+from escalator_tpu import __version__
+from escalator_tpu.metrics import metrics
+from escalator_tpu.plugin import codec
+
+log = logging.getLogger("escalator_tpu.plugin")
+
+SERVICE_NAME = "escalator.Compute"
+
+
+class _ComputeService:
+    """Runs the batched kernel on whatever device JAX resolved (TPU when present,
+    XLA-CPU otherwise — same traced program, the parity-preserving fallback)."""
+
+    def __init__(self):
+        from escalator_tpu.ops import kernel  # defer jax init to server start
+
+        self._kernel = kernel
+        import jax
+
+        self._device = str(jax.devices()[0])
+
+    def decide(self, request: bytes, context) -> bytes:
+        import time
+
+        cluster, now_sec = codec.decode_cluster(request)
+        t0 = time.perf_counter()
+        out = self._kernel.decide_jit(cluster, np.int64(now_sec))
+        import jax
+
+        jax.block_until_ready(out)
+        metrics.solver_decide_latency.labels("grpc-server").observe(
+            time.perf_counter() - t0
+        )
+        return codec.encode_decision(out)
+
+    def health(self, request: bytes, context) -> bytes:
+        return msgpack.packb(
+            {"device": self._device, "version": __version__, "ok": True}
+        )
+
+
+def _identity(x: bytes) -> bytes:
+    return x
+
+
+def make_server(
+    address: str = "127.0.0.1:50551", max_workers: int = 4
+) -> grpc.Server:
+    """Build (not start) the plugin server bound to ``address``."""
+    service = _ComputeService()
+    handlers = {
+        "Decide": grpc.unary_unary_rpc_method_handler(
+            service.decide,
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            service.health,
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+    }
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            # cluster frames are ~5 MB at 100k pods; the 4 MiB default would fail
+            # exactly at the scale this plugin exists to serve
+            ("grpc.max_receive_message_length", -1),
+            ("grpc.max_send_message_length", -1),
+        ],
+    )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+    bound = server.add_insecure_port(address)
+    if bound == 0:
+        raise RuntimeError(f"failed to bind compute plugin to {address}")
+    server._escalator_bound_port = bound  # convenience for tests with port 0
+    log.info("compute plugin bound to %s (port %d)", address, bound)
+    return server
+
+
+def serve(address: str = "127.0.0.1:50551") -> None:  # pragma: no cover - CLI
+    server = make_server(address)
+    server.start()
+    log.info("compute plugin serving on %s", address)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    logging.basicConfig(level=logging.INFO)
+    serve(sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1:50551")

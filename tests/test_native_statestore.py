@@ -1,0 +1,154 @@
+"""Native C++ state store: build, delta semantics, zero-copy views, kernel feed."""
+
+import numpy as np
+import pytest
+
+from escalator_tpu.native import statestore
+
+pytestmark = pytest.mark.skipif(
+    not statestore.available(), reason="native build unavailable"
+)
+
+
+@pytest.fixture
+def store():
+    return statestore.NativeStateStore(pod_capacity=64, node_capacity=32)
+
+
+class TestDeltas:
+    def test_upsert_and_views(self, store):
+        s1 = store.upsert_pod("p1", group=0, cpu_milli=500, mem_bytes=10**9)
+        s2 = store.upsert_pod("p2", group=1, cpu_milli=250, mem_bytes=10**8)
+        assert s1 != s2
+        pv = store.pod_views()
+        assert pv["cpu_milli"][s1] == 500
+        assert pv["group"][s2] == 1
+        assert pv["valid"][s1] == 1
+        assert store.pod_count == 2
+
+    def test_upsert_same_uid_updates_in_place(self, store):
+        s1 = store.upsert_pod("p1", 0, 500, 10**9)
+        s2 = store.upsert_pod("p1", 0, 999, 10**9)
+        assert s1 == s2
+        assert store.pod_views()["cpu_milli"][s1] == 999
+        assert store.pod_count == 1
+
+    def test_delete_and_slot_reuse(self, store):
+        s1 = store.upsert_pod("p1", 0, 500, 10**9)
+        assert store.delete_pod("p1") == s1
+        assert store.pod_views()["valid"][s1] == 0
+        assert store.pod_count == 0
+        s2 = store.upsert_pod("p2", 0, 100, 10**8)
+        assert s2 == s1  # freelist reuse
+
+    def test_delete_missing_returns_minus_one(self, store):
+        assert store.delete_pod("ghost") == -1
+        assert store.delete_node("ghost") == -1
+
+    def test_node_fields(self, store):
+        s = store.upsert_node(
+            "n1", group=2, cpu_milli=4000, mem_bytes=16 * 10**9,
+            creation_ns=123, tainted=True, cordoned=False, no_delete=True,
+            taint_time_sec=1_700_000_000,
+        )
+        nv = store.node_views()
+        assert nv["creation_ns"][s] == 123
+        assert nv["tainted"][s] == 1
+        assert nv["no_delete"][s] == 1
+        assert nv["taint_time_sec"][s] == 1_700_000_000
+        assert store.node_slot("n1") == s
+        assert store.node_slot("nope") == -1
+
+    def test_views_are_zero_copy(self, store):
+        s = store.upsert_pod("p1", 0, 500, 10**9)
+        view = store.pod_views()["cpu_milli"]
+        store.upsert_pod("p1", 0, 777, 10**9)
+        assert view[s] == 777  # same memory, no snapshot
+
+    def test_growth(self):
+        store = statestore.NativeStateStore(pod_capacity=2, node_capacity=2)
+        for i in range(10):
+            store.upsert_pod(f"p{i}", 0, i, i)
+        assert store.pod_count == 10
+        assert store.pod_capacity >= 10
+        pv = store.pod_views()
+        slots = [store.pod_slot(f"p{i}") for i in range(10)]
+        assert sorted(pv["cpu_milli"][slots]) == list(range(10))
+
+
+class TestKernelFeed:
+    def test_decide_from_native_store(self):
+        """End-to-end: deltas into the store, zero-copy views into the kernel."""
+        from escalator_tpu.core import semantics as sem
+        from escalator_tpu.core.arrays import ClusterArrays, GroupArrays
+        from escalator_tpu.ops import kernel
+
+        store = statestore.NativeStateStore(pod_capacity=64, node_capacity=32)
+        for i in range(10):
+            store.upsert_pod(f"p{i}", 0, 500, 10**9)
+        for i in range(2):
+            store.upsert_node(f"n{i}", 0, 1000, 4 * 10**9)
+
+        pods, nodes = store.as_pod_node_arrays()
+        G = 1
+        groups = GroupArrays(
+            min_nodes=np.zeros(G, np.int32),
+            max_nodes=np.full(G, 100, np.int32),
+            taint_lower=np.full(G, 30, np.int32),
+            taint_upper=np.full(G, 45, np.int32),
+            scale_up_thr=np.full(G, 70, np.int32),
+            slow_rate=np.ones(G, np.int32),
+            fast_rate=np.full(G, 2, np.int32),
+            locked=np.zeros(G, bool),
+            requested_nodes=np.zeros(G, np.int32),
+            cached_cpu_milli=np.zeros(G, np.int64),
+            cached_mem_bytes=np.zeros(G, np.int64),
+            soft_grace_sec=np.full(G, 300, np.int64),
+            hard_grace_sec=np.full(G, 900, np.int64),
+            valid=np.ones(G, bool),
+        )
+        cluster = ClusterArrays(groups=groups, pods=pods, nodes=nodes)
+        out = kernel.decide_jit(cluster, np.int64(0))
+        # 5000m/2000m = 250% -> ceil(2*(250-70)/70) = 6
+        assert int(out.nodes_delta[0]) == 6
+
+        # incremental delta: half the pods finish; decision flips to scale-down
+        for i in range(9):
+            store.delete_pod(f"p{i}")
+        out = kernel.decide_jit(cluster, np.int64(0))
+        # 500/2000 = 25% < 30 -> -fast
+        assert int(out.nodes_delta[0]) == -2
+
+
+class TestViewSafety:
+    def test_views_stable_across_growth(self):
+        """Growth within the lifetime max never reallocates: old views still read
+        the same memory (they just don't see new lanes); generation bumps."""
+        store = statestore.NativeStateStore(
+            pod_capacity=2, node_capacity=2, max_pods=64, max_nodes=64)
+        s0 = store.upsert_pod("p0", 0, 111, 1)
+        old_view = store.pod_views()["cpu_milli"]
+        gen0 = store.generation
+        for i in range(1, 20):  # forces growth past capacity 2
+            store.upsert_pod(f"p{i}", 0, i, 1)
+        assert store.generation > gen0
+        assert old_view[s0] == 111  # old view still valid memory
+        assert len(store.pod_views()["cpu_milli"]) == store.pod_capacity
+
+    def test_growth_beyond_max_raises(self):
+        store = statestore.NativeStateStore(
+            pod_capacity=2, node_capacity=2, max_pods=4, max_nodes=4)
+        for i in range(4):
+            store.upsert_pod(f"p{i}", 0, i, 1)
+        import pytest as _pytest
+        with _pytest.raises(MemoryError):
+            store.upsert_pod("p-over", 0, 1, 1)
+
+    def test_views_keep_store_alive(self):
+        import gc
+        store = statestore.NativeStateStore(pod_capacity=8, node_capacity=8)
+        s = store.upsert_pod("p1", 0, 424242, 1)
+        view = store.pod_views()["cpu_milli"]
+        del store
+        gc.collect()
+        assert view[s] == 424242  # store freed only when views die

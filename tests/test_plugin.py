@@ -1,0 +1,103 @@
+"""gRPC compute plugin: codec round-trip, service end-to-end on a local socket,
+controller running over GrpcBackend, and the CPU fallback path."""
+
+import random
+
+import numpy as np
+import pytest
+
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.core.arrays import pack_cluster
+from escalator_tpu.ops import kernel
+from escalator_tpu.plugin import codec
+from escalator_tpu.plugin.client import ComputeClient, GrpcBackend
+from escalator_tpu.plugin.server import make_server
+
+from tests.test_kernel_parity import NOW, random_group
+
+
+@pytest.fixture(scope="module")
+def plugin():
+    server = make_server("127.0.0.1:0")
+    port = server._escalator_bound_port
+    server.start()
+    client = ComputeClient(f"127.0.0.1:{port}")
+    yield client
+    client.close()
+    server.stop(grace=None)
+
+
+def test_codec_round_trip():
+    rng = random.Random(1)
+    groups = [random_group(rng, gi) for gi in range(6)]
+    cluster = pack_cluster(groups, pad_pods=256, pad_nodes=128, pad_groups=8)
+    frame = codec.encode_cluster(cluster, NOW)
+    decoded, now = codec.decode_cluster(frame)
+    assert now == NOW
+    for section in ("groups", "pods", "nodes"):
+        a, b = getattr(cluster, section), getattr(decoded, section)
+        for f in a.__dataclass_fields__:
+            np.testing.assert_array_equal(getattr(a, f), getattr(b, f), err_msg=f)
+
+
+def test_codec_rejects_garbage():
+    with pytest.raises(ValueError, match="bad magic"):
+        codec.decode_cluster(b"NOPE" + b"\0" * 64)
+
+
+def test_health(plugin):
+    h = plugin.health()
+    assert h["ok"] is True
+    assert "device" in h and "version" in h
+
+
+def test_remote_decide_matches_local(plugin):
+    rng = random.Random(9)
+    groups = [random_group(rng, gi) for gi in range(12)]
+    cluster = pack_cluster(groups, pad_pods=512, pad_nodes=256, pad_groups=16)
+    remote = plugin.decide_arrays(cluster, NOW)
+    local = kernel.decide_jit(cluster, np.int64(NOW))
+    np.testing.assert_array_equal(remote.status, np.asarray(local.status))
+    np.testing.assert_array_equal(remote.nodes_delta, np.asarray(local.nodes_delta))
+    np.testing.assert_array_equal(remote.cpu_percent, np.asarray(local.cpu_percent))
+    np.testing.assert_array_equal(
+        remote.scale_down_order, np.asarray(local.scale_down_order)
+    )
+    np.testing.assert_array_equal(remote.reap_mask, np.asarray(local.reap_mask))
+
+
+def test_controller_over_grpc_backend(plugin):
+    """Full controller tick with the decision served over the socket."""
+    from tests.test_controller import World, make_opts
+    from escalator_tpu.testsupport.builders import (
+        NodeOpts, PodOpts, build_test_nodes, build_test_pods,
+    )
+
+    backend = GrpcBackend(plugin.address)
+    pods = build_test_pods(10, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key="customer", node_selector_value="buildeng"))
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    w = World(make_opts(), nodes=nodes, pods=pods, backend=backend)
+    w.tick()
+    assert w.state.scale_delta == 6
+    assert w.group.target_size() == 8
+
+
+def test_fallback_when_server_unreachable():
+    """The north-star CPU fallback: plugin down -> golden backend, same answer."""
+    from escalator_tpu.testsupport.builders import (
+        NodeOpts, PodOpts, build_test_nodes, build_test_pods,
+    )
+
+    backend = GrpcBackend("127.0.0.1:1", timeout_sec=0.5)  # nothing listens here
+    pods = build_test_pods(4, PodOpts(cpu=[500], mem=[10**8]))
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    cfg = sem.GroupConfig(
+        min_nodes=0, max_nodes=100, taint_lower_percent=30, taint_upper_percent=45,
+        scale_up_percent=70, slow_removal_rate=1, fast_removal_rate=2,
+    )
+    out = backend.decide([(pods, nodes, cfg, sem.GroupState())], NOW)
+    assert out[0].decision.status == sem.DecisionStatus.OK
+    # 2000/2000 = 100% -> ceil(2*(100-70)/70) = 1
+    assert out[0].decision.nodes_delta == 1
