@@ -79,8 +79,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sim-state", default="",
                    help="YAML cluster state for in-memory simulation mode")
     p.add_argument("--backend", default="auto",
-                   choices=["auto", "jax", "sharded-jax", "golden"],
-                   help="compute backend for the scale decision")
+                   choices=["auto", "jax", "sharded-jax", "golden", "native",
+                            "grpc"],
+                   help="compute backend for the scale decision (native ="
+                        " event-driven C++ state store + jax kernel; grpc ="
+                        " remote compute plugin)")
+    p.add_argument("--plugin-address", default="127.0.0.1:50551",
+                   help="compute plugin address for --backend grpc")
     p.add_argument("--once", action="store_true",
                    help="run a single tick and exit (prints per-group deltas)")
     p.add_argument("--leader-elect", action="store_true")
@@ -135,7 +140,9 @@ def setup_node_groups(path: str) -> List[ngmod.NodeGroupOptions]:
     return node_groups
 
 
-def load_sim_state(path: str) -> InMemoryKubernetesClient:
+def load_sim_state(path: str) -> "EventfulClient":
+    from escalator_tpu.k8s.cache import EventfulClient
+
     with open(path) as f:
         doc = yaml.safe_load(f) or {}
     nodes = []
@@ -170,7 +177,7 @@ def load_sim_state(path: str) -> InMemoryKubernetesClient:
             node_selector=dict(spec.get("node_selector", {})),
             owner_kind=spec.get("owner_kind", ""),
         ))
-    return InMemoryKubernetesClient(nodes=nodes, pods=pods)
+    return EventfulClient(nodes=nodes, pods=pods)
 
 
 def setup_cloud_provider(args, node_groups, client) -> MockBuilder:
@@ -257,6 +264,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         threading.Thread(target=watch_deposed, daemon=True).start()
 
+    if args.backend == "native":
+        from escalator_tpu.controller.native_backend import make_native_backend
+
+        backend = make_native_backend(client, node_groups)
+    elif args.backend == "grpc":
+        from escalator_tpu.plugin.client import GrpcBackend
+
+        backend = GrpcBackend(args.plugin_address)
+    else:
+        backend = make_backend(args.backend)
+
     controller = ctl.Controller(
         ctl.Opts(
             client=client,
@@ -264,7 +282,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             cloud_provider_builder=builder,
             scan_interval_sec=ngmod.parse_duration(args.scaninterval) or 60.0,
             dry_mode=args.drymode,
-            backend=make_backend(args.backend),
+            backend=backend,
         ),
         stop_event=stop_event,
     )

@@ -46,11 +46,15 @@ class GroupDecision:
     scale_down_order: List[k8s.Node] = field(default_factory=list)  # oldest-first
     untaint_order: List[k8s.Node] = field(default_factory=list)     # newest-first
     reap_nodes: List[k8s.Node] = field(default_factory=list)
+    cordoned_nodes: List[k8s.Node] = field(default_factory=list)
     node_pods_remaining: Dict[str, int] = field(default_factory=dict)
 
 
 class ComputeBackend(abc.ABC):
     name = "abstract"
+    #: False for event-driven backends that source cluster state themselves (the
+    #: controller then skips its O(cluster) lister walk and passes empty lists)
+    needs_objects = True
 
     @abc.abstractmethod
     def decide(
@@ -76,7 +80,7 @@ class GoldenBackend(ComputeBackend):
             decision = semantics.evaluate_node_group(
                 pods, nodes, config, state, dry, tracker
             )
-            untainted, tainted, _ = semantics.filter_nodes(nodes, dry, tracker)
+            untainted, tainted, cordoned = semantics.filter_nodes(nodes, dry, tracker)
             info = k8s.create_node_name_to_info_map(list(pods), list(nodes))
             reap_idx = semantics.reap_eligible(
                 tainted, info, config.soft_delete_grace_sec,
@@ -92,6 +96,7 @@ class GoldenBackend(ComputeBackend):
                         tainted[i] for i in semantics.nodes_newest_first(tainted)
                     ],
                     reap_nodes=[tainted[i] for i in reap_idx],
+                    cordoned_nodes=cordoned,
                     node_pods_remaining={
                         name: sum(
                             1 for p in entry[1] if not k8s.pod_is_daemonset(p)
@@ -151,6 +156,8 @@ def _unpack(out, group_inputs) -> List[GroupDecision]:
     n_unt = np.asarray(out.num_untainted)
     n_tnt = np.asarray(out.num_tainted)
     n_crd = np.asarray(out.num_cordoned)
+    n_all = np.asarray(out.num_nodes)
+    n_pods = np.asarray(out.num_pods)
     down = np.asarray(out.scale_down_order)
     up = np.asarray(out.untaint_order)
     u_off = np.asarray(out.untainted_offsets)
@@ -177,6 +184,8 @@ def _unpack(out, group_inputs) -> List[GroupDecision]:
             num_untainted=int(n_unt[gi]),
             num_tainted=int(n_tnt[gi]),
             num_cordoned=int(n_crd[gi]),
+            num_nodes=int(n_all[gi]),
+            num_pods=int(n_pods[gi]),
         )
         down_nodes = [flat_nodes[i] for i in down[u_off[gi] : u_off[gi + 1]]]
         up_nodes = [flat_nodes[i] for i in up[t_off[gi] : t_off[gi + 1]]]

@@ -179,14 +179,21 @@ class Controller:
                 self.cloud_provider.name(), cloud_ng.id(), ng_opts.name
             ).set(cloud_ng.size())
 
-            try:
-                pods = state.pod_lister.list()
-                nodes = state.node_lister.list()
-            except Exception as e:
-                log.error("failed to list pods/nodes for %s: %s", ng_opts.name, e)
-                metrics.node_group_scale_delta.labels(ng_opts.name).set(0)
-                state.scale_delta = 0
-                continue
+            if self.backend.needs_objects:
+                try:
+                    pods = state.pod_lister.list()
+                    nodes = state.node_lister.list()
+                except Exception as e:
+                    log.error(
+                        "failed to list pods/nodes for %s: %s", ng_opts.name, e
+                    )
+                    metrics.node_group_scale_delta.labels(ng_opts.name).set(0)
+                    state.scale_delta = 0
+                    continue
+            else:
+                # event-driven backend sources cluster state itself (O(changes)
+                # ingestion instead of an O(cluster) walk per tick)
+                pods, nodes = [], []
             # sync the kernel's view of the scale lock
             state.kernel_state.locked = state.scale_lock.locked()
             state.kernel_state.requested_nodes = state.scale_lock.requested_nodes
@@ -234,28 +241,29 @@ class Controller:
         (reference: controller.go:213-396). Returns the per-group delta the
         reference would return."""
         d = gd.decision
-        untainted, tainted, cordoned = semantics.filter_nodes(
-            nodes, self._dry_mode(state), state.taint_tracker
-        )
+        # membership comes from the decision's ordered selections (identical sets
+        # to filterNodes' partitions; ordering already applied by the backend)
+        untainted = gd.scale_down_order
+        tainted = gd.untaint_order
 
-        metrics.node_group_nodes.labels(nodegroup).set(len(nodes))
+        metrics.node_group_nodes.labels(nodegroup).set(d.num_nodes)
         metrics.node_group_nodes_cordoned.labels(nodegroup).set(d.num_cordoned)
         metrics.node_group_nodes_untainted.labels(nodegroup).set(d.num_untainted)
         metrics.node_group_nodes_tainted.labels(nodegroup).set(d.num_tainted)
-        metrics.node_group_pods.labels(nodegroup).set(len(pods))
+        metrics.node_group_pods.labels(nodegroup).set(d.num_pods)
 
         if d.status == semantics.DecisionStatus.NOOP_EMPTY:
             return 0
         if d.status == semantics.DecisionStatus.ERR_BELOW_MIN:
             log.warning(
                 "[%s] node count %d less than minimum %d",
-                nodegroup, len(nodes), state.opts.min_nodes,
+                nodegroup, d.num_nodes, state.opts.min_nodes,
             )
             return 0
         if d.status == semantics.DecisionStatus.ERR_ABOVE_MAX:
             log.warning(
                 "[%s] node count %d larger than maximum %d",
-                nodegroup, len(nodes), state.opts.max_nodes,
+                nodegroup, d.num_nodes, state.opts.max_nodes,
             )
             return 0
 
@@ -301,7 +309,10 @@ class Controller:
             log.info("[%s] waiting for scale to finish", nodegroup)
             return state.scale_lock.requested_nodes
 
-        self._calculate_new_node_metrics(nodegroup, state, nodes)
+        self._calculate_new_node_metrics(
+            nodegroup, state,
+            nodes if nodes else untainted + tainted + gd.cordoned_nodes,
+        )
 
         if d.status == semantics.DecisionStatus.ERR_NEG_DELTA:
             log.error("[%s] negative scale up delta", nodegroup)

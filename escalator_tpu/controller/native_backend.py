@@ -1,0 +1,224 @@
+"""Event-driven native backend: O(changes) per tick instead of O(cluster).
+
+``NativeJaxBackend`` subscribes a ``WatchBridge`` to the cluster's event stream at
+construction; from then on the kernel's pod/node columns live in the C++ state store
+and are always current. ``decide`` therefore needs NO object lists (the controller
+skips its lister walk: ``needs_objects = False``) — it assembles the small ``[G]``
+group arrays, device-puts the zero-copy column views, and runs the batched kernel.
+
+Cross-tick host state remains in the controller's ``GroupState`` (locks, cached
+capacity). Cached capacity is refreshed from the group's lowest-slot live node
+(the reference uses the first lister-order node, controller.go:208-211 — both are
+"an arbitrary node of the group"; documented divergence under slot reuse).
+
+Dry-mode groups get a per-tick corrected view of the tainted column (the in-memory
+taint tracker substitutes for real taints, and cordons are ignored), matching
+filterNodes' dry-mode branch (controller.go:126-138) without mutating the store.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from escalator_tpu.controller.backend import ComputeBackend, GroupDecision, _round_up
+from escalator_tpu.core import semantics
+from escalator_tpu.core.arrays import ClusterArrays, NodeArrays, pack_groups
+from escalator_tpu.k8s.cache import EventfulClient, GroupFilters, WatchBridge
+from escalator_tpu.metrics import metrics
+
+
+class NativeJaxBackend(ComputeBackend):
+    name = "native-jax"
+    needs_objects = False
+
+    def __init__(self, client: EventfulClient, groups: Sequence[GroupFilters],
+                 pod_capacity: int = 1 << 17, node_capacity: int = 1 << 15):
+        from escalator_tpu.native.statestore import NativeStateStore
+        from escalator_tpu.ops import kernel
+
+        self._kernel = kernel
+        self.store = NativeStateStore(
+            pod_capacity=pod_capacity, node_capacity=node_capacity
+        )
+        self.bridge = WatchBridge(self.store, groups)
+        client.subscribe(self.bridge.apply, replay=True)
+
+    def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
+        """First live node per group -> GroupState cached capacity
+        (reference: controller.go:208-211)."""
+        valid_idx = np.nonzero(nodes.valid)[0]
+        if valid_idx.size == 0:
+            return
+        node_groups = nodes.group[valid_idx]
+        uniq, first = np.unique(node_groups, return_index=True)
+        first_slot = {int(gid): int(valid_idx[fi]) for gid, fi in zip(uniq, first)}
+        for gi, (_, _, config, state) in enumerate(group_inputs):
+            slot = first_slot.get(gi)
+            if slot is not None:
+                state.cached_cpu_milli = int(nodes.cpu_milli[slot])
+                state.cached_mem_bytes = int(nodes.mem_bytes[slot])
+
+    def _dry_mode_view(self, nodes: NodeArrays, group_inputs, dry_mode_flags,
+                       taint_trackers) -> NodeArrays:
+        """Per-tick corrected taint/cordon columns for dry-mode groups."""
+        if not dry_mode_flags or not any(dry_mode_flags):
+            return nodes
+        tainted = np.array(nodes.tainted, copy=True)
+        cordoned = np.array(nodes.cordoned, copy=True)
+        dry_groups = {gi for gi, f in enumerate(dry_mode_flags) if f}
+        in_dry = np.isin(nodes.group, list(dry_groups)) & nodes.valid
+        tainted[in_dry] = False
+        cordoned[in_dry] = False
+        if taint_trackers:
+            for gi in dry_groups:
+                for name in taint_trackers[gi] or ():
+                    slot = self.store.node_slot(name)
+                    if slot >= 0:
+                        tainted[slot] = True
+        return NodeArrays(
+            group=nodes.group, cpu_milli=nodes.cpu_milli,
+            mem_bytes=nodes.mem_bytes, creation_ns=nodes.creation_ns,
+            tainted=tainted, cordoned=cordoned, no_delete=nodes.no_delete,
+            taint_time_sec=nodes.taint_time_sec, valid=nodes.valid,
+        )
+
+    # -- decide ------------------------------------------------------------------
+    def decide(self, group_inputs, now_sec, dry_mode_flags=None,
+               taint_trackers=None):
+        import jax
+
+        t0 = time.perf_counter()
+        pods, nodes = self.store.as_pod_node_arrays()
+        self._refresh_cached_capacity(group_inputs, nodes)
+        nodes = self._dry_mode_view(
+            nodes, group_inputs, dry_mode_flags, taint_trackers
+        )
+        groups = pack_groups(
+            [(config, state) for _, _, config, state in group_inputs],
+            pad_groups=_round_up(len(group_inputs), 8),
+        )
+        cluster = ClusterArrays(groups=groups, pods=pods, nodes=nodes)
+        t1 = time.perf_counter()
+        out = self._kernel.decide_jit(cluster, np.int64(now_sec))
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
+        metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+        return self._unpack(out, group_inputs, nodes)
+
+    def _unpack(self, out, group_inputs, nodes: NodeArrays) -> List[GroupDecision]:
+        """Slot-order-agnostic unpack: node indices resolve through the bridge."""
+        status = np.asarray(out.status)
+        delta = np.asarray(out.nodes_delta)
+        cpu_pct = np.asarray(out.cpu_percent)
+        mem_pct = np.asarray(out.mem_percent)
+        cpu_req = np.asarray(out.cpu_request_milli)
+        mem_req = np.asarray(out.mem_request_bytes)
+        cpu_cap = np.asarray(out.cpu_capacity_milli)
+        mem_cap = np.asarray(out.mem_capacity_bytes)
+        n_unt = np.asarray(out.num_untainted)
+        n_tnt = np.asarray(out.num_tainted)
+        n_crd = np.asarray(out.num_cordoned)
+        n_all = np.asarray(out.num_nodes)
+        n_pods = np.asarray(out.num_pods)
+        down = np.asarray(out.scale_down_order)
+        up = np.asarray(out.untaint_order)
+        u_off = np.asarray(out.untainted_offsets)
+        t_off = np.asarray(out.tainted_offsets)
+        reap = np.asarray(out.reap_mask)
+        remaining = np.asarray(out.node_pods_remaining)
+
+        node_at = self.bridge.node_at_slot
+        # nodes is the snapshot decide() ran on — no store re-read here, so reap
+        # grouping is consistent with the decided state even under live events
+        reap_slots = np.nonzero(reap)[0]
+        reap_by_group: Dict[int, list] = {}
+        for slot in reap_slots:
+            reap_by_group.setdefault(int(nodes.group[slot]), []).append(
+                node_at(int(slot))
+            )
+        cordoned_slots = np.nonzero(nodes.valid & nodes.cordoned)[0]
+        cordoned_by_group: Dict[int, list] = {}
+        for slot in cordoned_slots:
+            cordoned_by_group.setdefault(int(nodes.group[slot]), []).append(
+                node_at(int(slot))
+            )
+
+        results = []
+        for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+            decision = semantics.Decision(
+                status=semantics.DecisionStatus(int(status[gi])),
+                nodes_delta=int(delta[gi]),
+                cpu_percent=float(cpu_pct[gi]),
+                mem_percent=float(mem_pct[gi]),
+                cpu_request_milli=int(cpu_req[gi]),
+                mem_request_bytes=int(mem_req[gi]),
+                cpu_capacity_milli=int(cpu_cap[gi]),
+                mem_capacity_bytes=int(mem_cap[gi]),
+                num_untainted=int(n_unt[gi]),
+                num_tainted=int(n_tnt[gi]),
+                num_cordoned=int(n_crd[gi]),
+                num_nodes=int(n_all[gi]),
+                num_pods=int(n_pods[gi]),
+            )
+            down_nodes = [
+                node_at(int(i)) for i in down[u_off[gi] : u_off[gi + 1]]
+            ]
+            up_nodes = [node_at(int(i)) for i in up[t_off[gi] : t_off[gi + 1]]]
+            results.append(
+                GroupDecision(
+                    decision=decision,
+                    scale_down_order=[n for n in down_nodes if n is not None],
+                    untaint_order=[n for n in up_nodes if n is not None],
+                    reap_nodes=[
+                        n for n in reap_by_group.get(gi, []) if n is not None
+                    ],
+                    cordoned_nodes=[
+                        n for n in cordoned_by_group.get(gi, []) if n is not None
+                    ],
+                    node_pods_remaining={
+                        n.name: int(remaining[self.store.node_slot(n.name)])
+                        for n in down_nodes + up_nodes
+                        if n is not None
+                    },
+                )
+            )
+        return results
+
+
+def make_native_backend(
+    client: EventfulClient,
+    node_group_options,
+    pod_capacity: int = 1 << 12,
+    node_capacity: int = 1 << 10,
+) -> NativeJaxBackend:
+    """Wire group filters from NodeGroupOptions (same filters the listers use).
+
+    Initial capacities start small — kernel shapes equal store capacity, so a modest
+    start keeps the first XLA compile fast; the store doubles (one recompile per
+    tier) as the cluster grows toward the 1<<21/1<<18 lifetime maxima."""
+    from escalator_tpu.controller import node_group as ngmod
+
+    filters = []
+    for opts in node_group_options:
+        if opts.name == ngmod.DEFAULT_NODE_GROUP:
+            pod_filter = ngmod.new_pod_default_filter_func()
+        else:
+            pod_filter = ngmod.new_pod_affinity_filter_func(
+                opts.label_key, opts.label_value
+            )
+        filters.append(
+            GroupFilters(
+                name=opts.name,
+                pod_filter=pod_filter,
+                node_filter=ngmod.new_node_label_filter_func(
+                    opts.label_key, opts.label_value
+                ),
+            )
+        )
+    return NativeJaxBackend(
+        client, filters, pod_capacity=pod_capacity, node_capacity=node_capacity
+    )

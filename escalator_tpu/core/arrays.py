@@ -120,6 +120,49 @@ def _pad_to(n: int, pad: Optional[int]) -> int:
     return max(pad, 1)
 
 
+def pack_groups(
+    config_states: Sequence[Tuple[semantics.GroupConfig, semantics.GroupState]],
+    pad_groups: Optional[int] = None,
+) -> GroupArrays:
+    """[G] group config+state vectors — the single source of truth for the
+    GroupConfig/GroupState -> GroupArrays field mapping (used by pack_cluster and
+    the event-driven native backend alike)."""
+    G = len(config_states)
+    GP = _pad_to(G, pad_groups)
+    g = GroupArrays(
+        min_nodes=np.zeros(GP, np.int32),
+        max_nodes=np.zeros(GP, np.int32),
+        taint_lower=np.zeros(GP, np.int32),
+        taint_upper=np.zeros(GP, np.int32),
+        scale_up_thr=np.ones(GP, np.int32),  # avoid /0 on padding lanes
+        slow_rate=np.zeros(GP, np.int32),
+        fast_rate=np.zeros(GP, np.int32),
+        locked=np.zeros(GP, bool),
+        requested_nodes=np.zeros(GP, np.int32),
+        cached_cpu_milli=np.zeros(GP, np.int64),
+        cached_mem_bytes=np.zeros(GP, np.int64),
+        soft_grace_sec=np.zeros(GP, np.int64),
+        hard_grace_sec=np.zeros(GP, np.int64),
+        valid=np.zeros(GP, bool),
+    )
+    for gi, (config, state) in enumerate(config_states):
+        g.min_nodes[gi] = config.min_nodes
+        g.max_nodes[gi] = config.max_nodes
+        g.taint_lower[gi] = config.taint_lower_percent
+        g.taint_upper[gi] = config.taint_upper_percent
+        g.scale_up_thr[gi] = config.scale_up_percent
+        g.slow_rate[gi] = config.slow_removal_rate
+        g.fast_rate[gi] = config.fast_removal_rate
+        g.locked[gi] = state.locked
+        g.requested_nodes[gi] = state.requested_nodes
+        g.cached_cpu_milli[gi] = state.cached_cpu_milli
+        g.cached_mem_bytes[gi] = state.cached_mem_bytes
+        g.soft_grace_sec[gi] = config.soft_delete_grace_sec
+        g.hard_grace_sec[gi] = config.hard_delete_grace_sec
+        g.valid[gi] = True
+    return g
+
+
 def pack_cluster(
     group_inputs: Sequence[
         Tuple[
@@ -146,27 +189,19 @@ def pack_cluster(
     as cordoned (reference: controller.go:126-138).
     """
     G = len(group_inputs)
-    GP = _pad_to(G, pad_groups)
     total_pods = sum(len(p) for p, *_ in group_inputs)
     total_nodes = sum(len(n) for _, n, *_ in group_inputs)
     P = _pad_to(total_pods, pad_pods)
     N = _pad_to(total_nodes, pad_nodes)
 
-    g = GroupArrays(
-        min_nodes=np.zeros(GP, np.int32),
-        max_nodes=np.zeros(GP, np.int32),
-        taint_lower=np.zeros(GP, np.int32),
-        taint_upper=np.zeros(GP, np.int32),
-        scale_up_thr=np.ones(GP, np.int32),  # avoid /0 on padding lanes
-        slow_rate=np.zeros(GP, np.int32),
-        fast_rate=np.zeros(GP, np.int32),
-        locked=np.zeros(GP, bool),
-        requested_nodes=np.zeros(GP, np.int32),
-        cached_cpu_milli=np.zeros(GP, np.int64),
-        cached_mem_bytes=np.zeros(GP, np.int64),
-        soft_grace_sec=np.zeros(GP, np.int64),
-        hard_grace_sec=np.zeros(GP, np.int64),
-        valid=np.zeros(GP, bool),
+    # refresh cached capacity BEFORE packing group rows (controller.go:208-211)
+    for pods, nodes, config, state in group_inputs:
+        if nodes:
+            state.cached_cpu_milli = nodes[0].cpu_allocatable_milli
+            state.cached_mem_bytes = nodes[0].mem_allocatable_bytes
+
+    g = pack_groups(
+        [(config, state) for _, _, config, state in group_inputs], pad_groups
     )
     p = PodArrays(
         group=np.zeros(P, np.int32),
@@ -192,25 +227,6 @@ def pack_cluster(
     for gi, (pods, nodes, config, state) in enumerate(group_inputs):
         dry = bool(dry_mode_flags[gi]) if dry_mode_flags is not None else False
         tracker = set(taint_trackers[gi]) if taint_trackers is not None else set()
-
-        if nodes:
-            state.cached_cpu_milli = nodes[0].cpu_allocatable_milli
-            state.cached_mem_bytes = nodes[0].mem_allocatable_bytes
-
-        g.min_nodes[gi] = config.min_nodes
-        g.max_nodes[gi] = config.max_nodes
-        g.taint_lower[gi] = config.taint_lower_percent
-        g.taint_upper[gi] = config.taint_upper_percent
-        g.scale_up_thr[gi] = config.scale_up_percent
-        g.slow_rate[gi] = config.slow_removal_rate
-        g.fast_rate[gi] = config.fast_removal_rate
-        g.locked[gi] = state.locked
-        g.requested_nodes[gi] = state.requested_nodes
-        g.cached_cpu_milli[gi] = state.cached_cpu_milli
-        g.cached_mem_bytes[gi] = state.cached_mem_bytes
-        g.soft_grace_sec[gi] = config.soft_delete_grace_sec
-        g.hard_grace_sec[gi] = config.hard_delete_grace_sec
-        g.valid[gi] = True
 
         node_index = {}
         for node in nodes:

@@ -96,6 +96,8 @@ class Decision:
     num_untainted: int = 0
     num_tainted: int = 0
     num_cordoned: int = 0
+    num_nodes: int = 0
+    num_pods: int = 0
 
 
 def calc_percent_usage(
@@ -243,6 +245,8 @@ def evaluate_node_group(
         num_untainted=len(untainted),
         num_tainted=len(tainted),
         num_cordoned=len(cordoned),
+        num_nodes=len(nodes),
+        num_pods=len(pods),
     )
 
     if len(nodes) == 0 and len(pods) == 0:

@@ -1,0 +1,178 @@
+"""First-fit-decreasing bin packing on device: true per-pod placement feasibility.
+
+The reference models capacity as a whole-group average and documents the resulting
+single-instance-type assumption (docs/calculations.md:8,
+docs/best-practices-issues-gotchas.md:36-38): it can say "utilisation is 120%" but
+not "these pods actually FIT on those heterogeneous nodes". This kernel lifts that:
+given each group's pods and its (heterogeneous) nodes' free capacity, FFD-place every
+pod and report how many NEW nodes (of the group's template capacity) are needed for
+the overflow — a packing-aware scale-up delta.
+
+Formulation: pods sorted descending by dominant share, then a ``lax.scan`` over the
+pod axis with the per-bin remaining-capacity vector as carry; ``vmap`` over groups.
+One scan step is a [G, M] broadcast (fits-mask, first-fit argmax, masked subtract) —
+fully vectorized across groups, so the sequential depth is pods-per-group, not
+total pods.
+
+Shapes: pods [G, P] (padded per group), bins [G, M] where the first slots are real
+nodes and the trailing ``new_bin_budget`` slots are virtual new nodes of template
+capacity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+_F64 = jnp.float64
+
+
+@dataclass
+class PackResult:
+    assignment: jnp.ndarray        # int32 [G, P] bin index per pod, -1 unplaced
+    new_nodes_needed: jnp.ndarray  # int32 [G] virtual bins actually used
+    unplaced: jnp.ndarray          # int32 [G] pods that fit nowhere
+    bins_remaining_cpu: jnp.ndarray  # int64 [G, M]
+    bins_remaining_mem: jnp.ndarray  # int64 [G, M]
+
+    def tree_flatten(self):
+        return (
+            [self.assignment, self.new_nodes_needed, self.unplaced,
+             self.bins_remaining_cpu, self.bins_remaining_mem],
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+jax.tree_util.register_pytree_node(
+    PackResult, PackResult.tree_flatten, PackResult.tree_unflatten
+)
+
+
+def _sort_pods_desc(pod_cpu, pod_mem, pod_valid, ref_cpu, ref_mem):
+    """Order pods by descending dominant share (max of cpu/mem normalized by the
+    group's template capacity); invalid pods last. Returns permutation [G, P]."""
+    safe_ref_cpu = jnp.where(ref_cpu == 0, 1, ref_cpu).astype(_F64)[:, None]
+    safe_ref_mem = jnp.where(ref_mem == 0, 1, ref_mem).astype(_F64)[:, None]
+    dominant = jnp.maximum(
+        pod_cpu.astype(_F64) / safe_ref_cpu, pod_mem.astype(_F64) / safe_ref_mem
+    )
+    key = jnp.where(pod_valid, -dominant, jnp.inf)
+    return jnp.argsort(key, axis=1, stable=True)
+
+
+@partial(jax.jit, static_argnames=("new_bin_budget",))
+def ffd_pack(
+    pod_cpu: jnp.ndarray,     # int64 [G, P] pod cpu requests (milli)
+    pod_mem: jnp.ndarray,     # int64 [G, P] pod mem requests (bytes)
+    pod_valid: jnp.ndarray,   # bool [G, P]
+    bin_cpu: jnp.ndarray,     # int64 [G, M] free cpu per existing node
+    bin_mem: jnp.ndarray,     # int64 [G, M]
+    bin_valid: jnp.ndarray,   # bool [G, M]
+    template_cpu: jnp.ndarray,  # int64 [G] new-node capacity (cached per-node)
+    template_mem: jnp.ndarray,  # int64 [G]
+    new_bin_budget: int,
+) -> PackResult:
+    """FFD-place each group's pods into its nodes + up to new_bin_budget virtual
+    new nodes. Groups are packed simultaneously (vmap); within a group, placement
+    is sequential FFD (scan)."""
+    G, P = pod_cpu.shape
+    M = bin_cpu.shape[1]
+
+    # append virtual bins of template capacity
+    vb_cpu = jnp.broadcast_to(template_cpu[:, None], (G, new_bin_budget))
+    vb_mem = jnp.broadcast_to(template_mem[:, None], (G, new_bin_budget))
+    all_cpu = jnp.concatenate([jnp.where(bin_valid, bin_cpu, -1), vb_cpu], axis=1)
+    all_mem = jnp.concatenate([jnp.where(bin_valid, bin_mem, -1), vb_mem], axis=1)
+
+    perm = _sort_pods_desc(
+        pod_cpu, pod_mem, pod_valid, template_cpu, template_mem
+    )
+    sorted_cpu = jnp.take_along_axis(pod_cpu, perm, axis=1)
+    sorted_mem = jnp.take_along_axis(pod_mem, perm, axis=1)
+    sorted_valid = jnp.take_along_axis(pod_valid, perm, axis=1)
+
+    def step(carry, xs):
+        rem_cpu, rem_mem = carry            # [G, M+B]
+        cpu, mem, valid = xs                # [G]
+        fits = (rem_cpu >= cpu[:, None]) & (rem_mem >= mem[:, None])
+        fits = fits & valid[:, None]
+        any_fit = fits.any(axis=1)
+        # first-fit: lowest bin index that fits
+        chosen = jnp.argmax(fits, axis=1)
+        place = any_fit & valid
+        onehot = (
+            jax.nn.one_hot(chosen, rem_cpu.shape[1], dtype=_I64)
+            * place[:, None].astype(_I64)
+        )
+        rem_cpu = rem_cpu - onehot * cpu[:, None]
+        rem_mem = rem_mem - onehot * mem[:, None]
+        assigned = jnp.where(place, chosen.astype(_I32), jnp.int32(-1))
+        return (rem_cpu, rem_mem), assigned
+
+    (rem_cpu, rem_mem), assigned_sorted = jax.lax.scan(
+        step,
+        (all_cpu, all_mem),
+        (sorted_cpu.T, sorted_mem.T, sorted_valid.T),
+    )
+    assigned_sorted = assigned_sorted.T       # [G, P] in sorted order
+
+    # un-permute assignments back to input pod order
+    inv = jnp.argsort(perm, axis=1, stable=True)
+    assignment = jnp.take_along_axis(assigned_sorted, inv, axis=1)
+
+    used_virtual = (
+        (rem_cpu[:, M:] < vb_cpu) | (rem_mem[:, M:] < vb_mem)
+    ).sum(axis=1).astype(_I32)
+    unplaced = (
+        (assignment < 0) & pod_valid
+    ).sum(axis=1).astype(_I32)
+    return PackResult(
+        assignment=assignment,
+        new_nodes_needed=used_virtual,
+        unplaced=unplaced,
+        bins_remaining_cpu=rem_cpu,
+        bins_remaining_mem=rem_mem,
+    )
+
+
+def ffd_pack_reference(pods, bins, template, new_bin_budget):
+    """Pure-Python FFD with identical tie-breaking — the golden model for tests.
+    pods: list[(cpu, mem)]; bins: list[(cpu, mem)]; template: (cpu, mem)."""
+    ref_cpu = template[0] or 1
+    ref_mem = template[1] or 1
+    order = sorted(
+        range(len(pods)),
+        key=lambda i: (-max(pods[i][0] / ref_cpu, pods[i][1] / ref_mem), i),
+    )
+    capacity = [list(b) for b in bins] + [
+        [template[0], template[1]] for _ in range(new_bin_budget)
+    ]
+    assignment = [-1] * len(pods)
+    for i in order:
+        cpu, mem = pods[i]
+        for bi, (bc, bm) in enumerate(capacity):
+            if bc >= cpu and bm >= mem:
+                capacity[bi][0] -= cpu
+                capacity[bi][1] -= mem
+                assignment[i] = bi
+                break
+    used_virtual = sum(
+        1
+        for bi in range(len(bins), len(capacity))
+        if capacity[bi][0] < template[0] or capacity[bi][1] < template[1]
+    )
+    unplaced = sum(1 for a in assignment if a < 0)
+    return assignment, used_virtual, unplaced

@@ -1,0 +1,139 @@
+"""What-if simulation: sweep candidate scale deltas for every nodegroup at once.
+
+The reference can only compute THE delta its formula prescribes
+(/root/reference/pkg/controller/util.go:13-46). The dense formulation buys more
+(SURVEY.md §7 step 6): evaluate *all* candidate deltas — and candidate instance
+types — in one batched sweep, answering "what would utilisation be if group g added
+d nodes of type t?" for the whole fleet in one device program. Capacity planners and
+the simulation CLI use this for fleet-scale dry-runs the reference cannot do.
+
+Shapes: ``[G]`` groups x ``[D]`` candidate deltas (x ``[T]`` instance types for the
+typed variant). All dense, jit-once, MXU/VPU-friendly broadcasting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+
+from escalator_tpu.core.arrays import ClusterArrays
+from escalator_tpu.ops.kernel import _segsum
+
+_F64 = jnp.float64
+_I64 = jnp.int64
+
+
+@dataclass
+class DeltaSweep:
+    """[G, D] post-delta utilisation and feasibility, plus the minimal feasible
+    delta per group (D = infeasible-at-any-candidate sentinel)."""
+
+    post_cpu_percent: jnp.ndarray   # float64 [G, D]
+    post_mem_percent: jnp.ndarray   # float64 [G, D]
+    feasible: jnp.ndarray           # bool [G, D] both percents <= threshold
+    min_feasible_delta: jnp.ndarray  # int32 [G]
+
+    def tree_flatten(self):
+        return (
+            [self.post_cpu_percent, self.post_mem_percent, self.feasible,
+             self.min_feasible_delta],
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+jax.tree_util.register_pytree_node(
+    DeltaSweep, DeltaSweep.tree_flatten, DeltaSweep.tree_unflatten
+)
+
+
+def _group_aggregates(cluster: ClusterArrays):
+    g, p, n = cluster.groups, cluster.pods, cluster.nodes
+    G = g.valid.shape[0]
+    pw = p.valid.astype(_I64)
+    pgroup = jnp.where(p.valid, p.group, 0)
+    cpu_req = _segsum(p.cpu_milli * pw, pgroup, G)
+    mem_req = _segsum(p.mem_bytes * pw, pgroup, G)
+    untainted = n.valid & ~n.tainted & ~n.cordoned
+    uw = untainted.astype(_I64)
+    ngroup = jnp.where(n.valid, n.group, 0)
+    cpu_cap = _segsum(n.cpu_milli * uw, ngroup, G)
+    mem_cap = _segsum(n.mem_bytes * uw, ngroup, G)
+    return cpu_req, mem_req, cpu_cap, mem_cap
+
+
+def sweep_deltas(cluster: ClusterArrays, num_candidates: int) -> DeltaSweep:
+    """Candidate deltas d in [0, num_candidates): each adds d nodes of the group's
+    cached per-node capacity to its untainted capacity."""
+    g = cluster.groups
+    cpu_req, mem_req, cpu_cap, mem_cap = _group_aggregates(cluster)
+    d = jnp.arange(num_candidates, dtype=_I64)[None, :]           # [1, D]
+    add_cpu = g.cached_cpu_milli[:, None] * d                     # [G, D]
+    add_mem = g.cached_mem_bytes[:, None] * d
+    total_cpu = (cpu_cap[:, None] + add_cpu).astype(_F64)
+    total_mem = (mem_cap[:, None] + add_mem).astype(_F64)
+    safe_cpu = jnp.where(total_cpu == 0, 1.0, total_cpu)
+    safe_mem = jnp.where(total_mem == 0, 1.0, total_mem)
+    post_cpu = jnp.where(
+        total_cpu == 0, jnp.inf, cpu_req[:, None].astype(_F64) / safe_cpu * 100.0
+    )
+    post_mem = jnp.where(
+        total_mem == 0, jnp.inf, mem_req[:, None].astype(_F64) / safe_mem * 100.0
+    )
+    thr = g.scale_up_thr.astype(_F64)[:, None]
+    feasible = (post_cpu <= thr) & (post_mem <= thr) & g.valid[:, None]
+    # first feasible candidate; num_candidates when none
+    min_delta = jnp.where(
+        feasible.any(axis=1),
+        jnp.argmax(feasible, axis=1),
+        num_candidates,
+    ).astype(jnp.int32)
+    return DeltaSweep(post_cpu, post_mem, feasible, min_delta)
+
+
+def sweep_deltas_by_type(
+    cluster: ClusterArrays,
+    type_cpu_milli: jnp.ndarray,   # int64 [T] per-node cpu of each instance type
+    type_mem_bytes: jnp.ndarray,   # int64 [T]
+    num_candidates: int,
+):
+    """[G, T, D] what-if: post-delta percents if group g added d nodes of type t.
+    Returns (post_cpu, post_mem, feasible, min_delta[G, T])."""
+    g = cluster.groups
+    cpu_req, mem_req, cpu_cap, mem_cap = _group_aggregates(cluster)
+    d = jnp.arange(num_candidates, dtype=_I64)[None, None, :]       # [1,1,D]
+    add_cpu = type_cpu_milli[None, :, None] * d                     # [1,T,D]
+    add_mem = type_mem_bytes[None, :, None] * d
+    total_cpu = (cpu_cap[:, None, None] + add_cpu).astype(_F64)     # [G,T,D]
+    total_mem = (mem_cap[:, None, None] + add_mem).astype(_F64)
+    safe_cpu = jnp.where(total_cpu == 0, 1.0, total_cpu)
+    safe_mem = jnp.where(total_mem == 0, 1.0, total_mem)
+    post_cpu = jnp.where(
+        total_cpu == 0, jnp.inf,
+        cpu_req[:, None, None].astype(_F64) / safe_cpu * 100.0,
+    )
+    post_mem = jnp.where(
+        total_mem == 0, jnp.inf,
+        mem_req[:, None, None].astype(_F64) / safe_mem * 100.0,
+    )
+    thr = g.scale_up_thr.astype(_F64)[:, None, None]
+    feasible = (post_cpu <= thr) & (post_mem <= thr) & g.valid[:, None, None]
+    min_delta = jnp.where(
+        feasible.any(axis=2), jnp.argmax(feasible, axis=2), num_candidates
+    ).astype(jnp.int32)
+    return post_cpu, post_mem, feasible, min_delta
+
+
+sweep_deltas_jit = jax.jit(sweep_deltas, static_argnames=("num_candidates",))
+sweep_deltas_by_type_jit = jax.jit(
+    sweep_deltas_by_type, static_argnames=("num_candidates",)
+)

@@ -12,7 +12,9 @@ import pytest
 from escalator_tpu.controller import controller as ctl
 from escalator_tpu.controller import node_group as ngmod
 from escalator_tpu.controller.backend import GoldenBackend, JaxBackend
+from escalator_tpu.controller.native_backend import make_native_backend
 from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.cache import EventfulClient
 from escalator_tpu.k8s.client import InMemoryKubernetesClient
 from escalator_tpu.testsupport.builders import (
     NodeOpts,
@@ -60,7 +62,9 @@ class World:
         self.clock = MockClock()
         for n in nodes or []:
             n.labels = {LABEL_KEY: LABEL_VALUE}
-        self.client = InMemoryKubernetesClient(nodes=nodes or [], pods=pods or [])
+        self.client = EventfulClient(nodes=nodes or [], pods=pods or [])
+        if callable(backend) and not hasattr(backend, "decide"):
+            backend = backend(self.client, [ng_opts])
         self.provider = MockCloudProvider()
         self.group = MockNodeGroup(
             "buildeng-asg", "buildeng",
@@ -99,12 +103,17 @@ class World:
             self.client.add_node(n)
 
 
-BACKENDS = [GoldenBackend, JaxBackend]
+BACKENDS = {
+    "golden": lambda: GoldenBackend(),
+    "jax": lambda: JaxBackend(),
+    # factory taking (client, ng_opts_list); World detects and applies it
+    "native": lambda: make_native_backend,
+}
 
 
-@pytest.fixture(params=BACKENDS, ids=["golden", "jax"])
+@pytest.fixture(params=list(BACKENDS), ids=list(BACKENDS))
 def backend(request):
-    return request.param()
+    return BACKENDS[request.param]()
 
 
 def test_scale_up_increases_provider(backend):
@@ -322,6 +331,9 @@ def test_scale_up_from_zero_with_cache(backend):
 
 
 def test_lister_error_skips_group(backend):
+    if not hasattr(backend, "decide"):
+        pytest.skip("event-driven backend has no lister path")
+
     class FailingClient(InMemoryKubernetesClient):
         fail = False
 
@@ -378,3 +390,64 @@ def test_multi_tick_scale_down_lifecycle(backend):
     assert len(live) == 1 + len(w.tainted_nodes())
     # the pod-bearing node was never tainted (it's the only untainted one)
     assert nodes[0].name in live
+
+
+class TestWatchBridgeRebinding:
+    """Out-of-order and slot-reuse pod<->node binding (cache.py rebind maps)."""
+
+    def _bridge(self):
+        from escalator_tpu.controller.native_backend import make_native_backend
+
+        client = EventfulClient()
+        backend = make_native_backend(client, [make_opts()])
+        return client, backend
+
+    def test_pod_before_node_heals(self):
+        from escalator_tpu.testsupport.builders import (
+            NodeOpts, PodOpts, build_test_node, build_test_pod,
+        )
+
+        client, backend = self._bridge()
+        pod = build_test_pod(PodOpts(
+            name="early", cpu=[100], mem=[100], node_name="late-node",
+            node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+        client.add_pod(pod)
+        store = backend.store
+        uid = f"{pod.namespace}/{pod.name}"
+        assert store.pod_views()["node"][store.pod_slot(uid)] == -1
+        node = build_test_node(NodeOpts(name="late-node", cpu=1000, mem=10**9,
+                                        label_key=LABEL_KEY,
+                                        label_value=LABEL_VALUE))
+        client.add_node(node)
+        slot = store.node_slot("late-node")
+        assert store.pod_views()["node"][store.pod_slot(uid)] == slot
+
+    def test_node_delete_unbinds_and_slot_reuse_clean(self):
+        from escalator_tpu.testsupport.builders import (
+            NodeOpts, PodOpts, build_test_node, build_test_pod,
+        )
+
+        client, backend = self._bridge()
+        store = backend.store
+        node_a = build_test_node(NodeOpts(name="a", cpu=1000, mem=10**9,
+                                          label_key=LABEL_KEY,
+                                          label_value=LABEL_VALUE))
+        client.add_node(node_a)
+        pod = build_test_pod(PodOpts(
+            name="rider", cpu=[100], mem=[100], node_name="a",
+            node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+        client.add_pod(pod)
+        slot_a = store.node_slot("a")
+        uid = f"{pod.namespace}/{pod.name}"
+        assert store.pod_views()["node"][store.pod_slot(uid)] == slot_a
+
+        client.delete_node("a")
+        assert store.pod_views()["node"][store.pod_slot(uid)] == -1
+
+        # new node reuses the freed slot; must NOT inherit the old pod binding
+        node_b = build_test_node(NodeOpts(name="b", cpu=1000, mem=10**9,
+                                          label_key=LABEL_KEY,
+                                          label_value=LABEL_VALUE))
+        client.add_node(node_b)
+        assert store.node_slot("b") == slot_a  # freelist reuse
+        assert store.pod_views()["node"][store.pod_slot(uid)] == -1

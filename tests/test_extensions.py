@@ -1,0 +1,182 @@
+"""What-if delta sweep + FFD bin-packing kernels (the capability extensions the
+dense formulation buys, SURVEY.md §7 step 6)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.core.arrays import pack_cluster
+from escalator_tpu.ops import binpack, simulate
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_nodes,
+    build_test_pods,
+)
+
+
+def _cluster(num_pods=20, pod_cpu=500, node_cpu=1000, num_nodes=4, thr=70):
+    cfg = sem.GroupConfig(
+        min_nodes=0, max_nodes=1000, taint_lower_percent=30,
+        taint_upper_percent=45, scale_up_percent=thr,
+        slow_removal_rate=1, fast_removal_rate=2,
+    )
+    pods = build_test_pods(num_pods, PodOpts(cpu=[pod_cpu], mem=[10**8]))
+    nodes = build_test_nodes(num_nodes, NodeOpts(cpu=node_cpu, mem=16 * 10**9))
+    st = sem.GroupState()
+    return pack_cluster([(pods, nodes, cfg, st)])
+
+
+class TestDeltaSweep:
+    def test_min_feasible_matches_manual(self):
+        # req 10000m over cap 4000m; each added node brings 1000m (cached)
+        # post(d) = 10000/(4000+1000d)*100 <= 70  =>  d >= 10.28 => 11
+        cluster = _cluster()
+        sweep = simulate.sweep_deltas_jit(cluster, 16)
+        assert int(sweep.min_feasible_delta[0]) == 11
+        assert not bool(sweep.feasible[0, 10])
+        assert bool(sweep.feasible[0, 11])
+        np.testing.assert_allclose(
+            float(sweep.post_cpu_percent[0, 0]), 250.0
+        )
+
+    def test_delta_zero_feasible_when_under_threshold(self):
+        cluster = _cluster(num_pods=2)
+        sweep = simulate.sweep_deltas_jit(cluster, 4)
+        assert int(sweep.min_feasible_delta[0]) == 0
+
+    def test_infeasible_sentinel(self):
+        cluster = _cluster(num_pods=1000)
+        sweep = simulate.sweep_deltas_jit(cluster, 4)
+        assert int(sweep.min_feasible_delta[0]) == 4  # sentinel = D
+
+    def test_by_type_sweep(self):
+        cluster = _cluster()
+        post_cpu, post_mem, feasible, min_delta = simulate.sweep_deltas_by_type_jit(
+            cluster,
+            np.array([1000, 4000], np.int64),
+            np.array([16 * 10**9, 64 * 10**9], np.int64),
+            16,
+        )
+        assert min_delta.shape == (cluster.num_groups, 2)
+        # bigger nodes -> fewer needed: 10000/(4000+4000d) <= 70% -> d >= 2.57 -> 3
+        assert int(min_delta[0, 0]) == 11
+        assert int(min_delta[0, 1]) == 3
+
+
+class TestFFD:
+    def _run_case(self, pods, bins, template, budget):
+        G, P, M = 1, max(len(pods), 1), max(len(bins), 1)
+        pod_cpu = np.zeros((G, P), np.int64)
+        pod_mem = np.zeros((G, P), np.int64)
+        pod_valid = np.zeros((G, P), bool)
+        for i, (c, m) in enumerate(pods):
+            pod_cpu[0, i], pod_mem[0, i], pod_valid[0, i] = c, m, True
+        bin_cpu = np.zeros((G, M), np.int64)
+        bin_mem = np.zeros((G, M), np.int64)
+        bin_valid = np.zeros((G, M), bool)
+        for i, (c, m) in enumerate(bins):
+            bin_cpu[0, i], bin_mem[0, i], bin_valid[0, i] = c, m, True
+        out = binpack.ffd_pack(
+            pod_cpu, pod_mem, pod_valid, bin_cpu, bin_mem, bin_valid,
+            np.array([template[0]], np.int64), np.array([template[1]], np.int64),
+            new_bin_budget=budget,
+        )
+        want_assign, want_new, want_unplaced = binpack.ffd_pack_reference(
+            pods, bins, template, budget
+        )
+        got_assign = [int(a) for a in np.asarray(out.assignment[0])[: len(pods)]]
+        assert got_assign == want_assign
+        assert int(out.new_nodes_needed[0]) == want_new
+        assert int(out.unplaced[0]) == want_unplaced
+        return out
+
+    def test_simple_overflow_to_new_nodes(self):
+        # 2 nodes with 1000m free each; 5 pods of 600m -> 2 placed, 3 new nodes
+        self._run_case(
+            pods=[(600, 10**8)] * 5,
+            bins=[(1000, 10**9), (1000, 10**9)],
+            template=(1000, 10**9),
+            budget=4,
+        )
+
+    def test_heterogeneous_bins(self):
+        # big pod only fits the big node; smalls fill the rest
+        self._run_case(
+            pods=[(3000, 10**8), (500, 10**8), (500, 10**8), (900, 10**8)],
+            bins=[(1000, 10**9), (4000, 10**9)],
+            template=(1000, 10**9),
+            budget=2,
+        )
+
+    def test_mem_constrained(self):
+        self._run_case(
+            pods=[(100, 8 * 10**8), (100, 8 * 10**8), (100, 8 * 10**8)],
+            bins=[(4000, 10**9)],
+            template=(4000, 10**9),
+            budget=3,
+        )
+
+    def test_unplaceable_pod(self):
+        # pod bigger than any bin incl. template -> unplaced
+        self._run_case(
+            pods=[(9000, 10**8)],
+            bins=[(1000, 10**9)],
+            template=(1000, 10**9),
+            budget=2,
+        )
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_randomized_against_reference(self, seed):
+        rng = random.Random(seed)
+        G = 8
+        P, M, B = 24, 6, 8
+        pod_cpu = np.zeros((G, P), np.int64)
+        pod_mem = np.zeros((G, P), np.int64)
+        pod_valid = np.zeros((G, P), bool)
+        bin_cpu = np.zeros((G, M), np.int64)
+        bin_mem = np.zeros((G, M), np.int64)
+        bin_valid = np.zeros((G, M), bool)
+        tmpl_cpu = np.zeros(G, np.int64)
+        tmpl_mem = np.zeros(G, np.int64)
+        cases = []
+        for g in range(G):
+            np_ = rng.randint(0, P)
+            nb = rng.randint(0, M)
+            pods = [
+                (rng.choice([100, 250, 500, 1000, 2000]),
+                 rng.choice([10**8, 5 * 10**8, 10**9]))
+                for _ in range(np_)
+            ]
+            bins = [
+                (rng.choice([1000, 2000, 4000]), rng.choice([10**9, 4 * 10**9]))
+                for _ in range(nb)
+            ]
+            tmpl = (rng.choice([1000, 4000]), rng.choice([10**9, 8 * 10**9]))
+            cases.append((pods, bins, tmpl))
+            for i, (c, m) in enumerate(pods):
+                pod_cpu[g, i], pod_mem[g, i], pod_valid[g, i] = c, m, True
+            for i, (c, m) in enumerate(bins):
+                bin_cpu[g, i], bin_mem[g, i], bin_valid[g, i] = c, m, True
+            tmpl_cpu[g], tmpl_mem[g] = tmpl
+
+        out = binpack.ffd_pack(
+            pod_cpu, pod_mem, pod_valid, bin_cpu, bin_mem, bin_valid,
+            tmpl_cpu, tmpl_mem, new_bin_budget=B,
+        )
+        for g, (pods, bins, tmpl) in enumerate(cases):
+            want_assign, want_new, want_unplaced = binpack.ffd_pack_reference(
+                pods, bins, tmpl, B
+            )
+            # virtual bin indices shift by (M - len(bins)) padding offset
+            got = []
+            for a in np.asarray(out.assignment[g])[: len(pods)]:
+                a = int(a)
+                if a >= M:
+                    a = a - M + len(bins)
+                got.append(a)
+            assert got == want_assign, f"group {g}"
+            assert int(out.new_nodes_needed[g]) == want_new, f"group {g}"
+            assert int(out.unplaced[g]) == want_unplaced, f"group {g}"
