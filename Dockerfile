@@ -1,0 +1,19 @@
+# escalator-tpu controller image. For TPU nodepools, swap the base for an image
+# with libtpu and jax[tpu]; the program is identical on XLA-CPU.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml msgpack grpcio \
+    prometheus-client
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY escalator_tpu ./escalator_tpu
+RUN pip install --no-cache-dir -e . \
+    # pre-build the native state store so first start needs no compiler warm-up
+    && python -c "from escalator_tpu.native import statestore; assert statestore.available()"
+
+EXPOSE 8080
+ENTRYPOINT ["python", "-m", "escalator_tpu"]
