@@ -50,6 +50,7 @@ from escalator_tpu.k8s.election import (
 )
 from escalator_tpu.metrics import metrics
 from escalator_tpu.testsupport.cloud_provider import MockBuilder, MockCloudProvider, MockNodeGroup
+from escalator_tpu.utils.tracing import TickTracer, start_profiler_server
 
 log = logging.getLogger("escalator_tpu")
 
@@ -88,6 +89,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="compute plugin address for --backend grpc")
     p.add_argument("--once", action="store_true",
                    help="run a single tick and exit (prints per-group deltas)")
+    p.add_argument("--profile-dir", default="",
+                   help="capture an XLA profiler trace of the first ticks to this"
+                        " directory (TensorBoard-loadable)")
+    p.add_argument("--profile-ticks", type=int, default=5,
+                   help="number of ticks to include in the profiler trace")
+    p.add_argument("--profiler-port", type=int, default=0,
+                   help="start the live jax profiler server on this port")
     p.add_argument("--leader-elect", action="store_true")
     p.add_argument("--leader-elect-lock-file", default="/tmp/escalator-tpu.lease")
     p.add_argument("--leader-elect-lease-duration", default="15s")
@@ -275,6 +283,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         backend = make_backend(args.backend)
 
+    if args.profiler_port:
+        start_profiler_server(args.profiler_port)
+
+    tracer = TickTracer(args.profile_dir or None, args.profile_ticks)
     controller = ctl.Controller(
         ctl.Opts(
             client=client,
@@ -283,12 +295,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             scan_interval_sec=ngmod.parse_duration(args.scaninterval) or 60.0,
             dry_mode=args.drymode,
             backend=backend,
+            tracer=tracer,
         ),
         stop_event=stop_event,
     )
 
     if args.once:
         controller.run_once()
+        tracer.close()
         deltas = {
             name: state.scale_delta
             for name, state in controller.node_groups.items()
@@ -303,6 +317,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         controller.run_forever(run_immediately=True)
     finally:
+        tracer.close()
         if server is not None:
             server.shutdown()
     return 0

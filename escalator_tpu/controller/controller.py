@@ -29,6 +29,7 @@ from escalator_tpu.k8s.client import KubernetesClient
 from escalator_tpu.k8s.listers import NodeLister, PodLister
 from escalator_tpu.metrics import metrics
 from escalator_tpu.utils.clock import Clock
+from escalator_tpu.utils.tracing import TickTracer
 
 log = logging.getLogger("escalator_tpu.controller")
 
@@ -60,6 +61,7 @@ class Opts:
     dry_mode: bool = False
     backend: Optional[ComputeBackend] = None
     clock: Clock = field(default_factory=Clock)
+    tracer: TickTracer = field(default_factory=TickTracer)
 
 
 @dataclass
@@ -131,6 +133,10 @@ class Controller:
     # ------------------------------------------------------------------ tick
     def run_once(self) -> None:
         """One tick over all nodegroups (reference: controller.go:400-451)."""
+        with self.opts.tracer.tick():
+            self._run_once_inner()
+
+    def _run_once_inner(self) -> None:
         start = self.clock.now()
 
         # Provider refresh with stale-credential retries (controller.go:403-414).

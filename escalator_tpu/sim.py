@@ -1,0 +1,230 @@
+"""Fleet simulation harness: multi-tick what-if runs with a synthetic cloud.
+
+Drives the REAL controller (executors, locks, taints, reaper — everything) against
+the in-memory cluster with a virtual clock and a cloud that fulfills provider
+target changes after a configurable latency. This is the framework's
+shadow-testing / capacity-planning tool: replay a workload timeline and read the
+scaling behavior off the emitted per-tick records, without touching a cluster.
+
+The reference has only single-tick dry-mode; multi-tick simulation is one of the
+capabilities the dense decision core makes cheap (SURVEY.md §7 step 6).
+
+Workload timeline YAML::
+
+    events:
+      - at_tick: 0
+        add_pods: {count: 200, cpu_milli: 500, mem_bytes: 1000000000,
+                   node_selector: {customer: buildeng}}
+      - at_tick: 10
+        finish_pods: {count: 150}     # oldest running pods complete
+
+Usage::
+
+    python -m escalator_tpu.sim --nodegroups ng.yaml --sim-state state.yaml \
+        --ticks 30 --tick-interval 60 --node-ready-ticks 3 [--workload wl.yaml] \
+        [--backend auto]
+
+Emits one JSON line per tick: deltas, provider targets, node/pod counts, util.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import logging
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import yaml
+
+from escalator_tpu.cli import load_sim_state, setup_node_groups
+from escalator_tpu.controller import controller as ctl
+from escalator_tpu.controller.backend import make_backend
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.cache import EventfulClient
+from escalator_tpu.testsupport.builders import NodeOpts, build_test_node
+from escalator_tpu.testsupport.cloud_provider import MockBuilder, MockCloudProvider, MockNodeGroup
+from escalator_tpu.utils.clock import MockClock
+
+log = logging.getLogger("escalator_tpu.sim")
+
+_uid = itertools.count()
+
+
+@dataclass
+class SyntheticCloud:
+    """Brings provider target-size changes to life as registered nodes after a
+    latency of ``node_ready_ticks`` ticks (models boot + registration lag)."""
+
+    client: EventfulClient
+    provider: MockCloudProvider
+    group_labels: Dict[str, Dict[str, str]]  # provider group id -> node labels
+    group_capacity: Dict[str, Dict[str, int]]  # id -> {cpu_milli, mem_bytes}
+    node_ready_ticks: int = 2
+    clock: Optional[MockClock] = None
+    _pending: List = field(default_factory=list)  # (ready_at_tick, group_id)
+    _tick: int = 0
+
+    def observe(self) -> None:
+        """Queue newly requested capacity (target > live+pending)."""
+        for ng in self.provider.node_groups():
+            gid = ng.id()
+            live = sum(
+                1 for n in self.client.list_nodes()
+                if all(
+                    n.labels.get(k) == v
+                    for k, v in self.group_labels[gid].items()
+                )
+            )
+            pending = sum(1 for _, g in self._pending if g == gid)
+            missing = ng.target_size() - live - pending
+            for _ in range(max(0, missing)):
+                self._pending.append((self._tick + self.node_ready_ticks, gid))
+
+    def deliver(self) -> None:
+        ready = [(t, g) for t, g in self._pending if t <= self._tick]
+        self._pending = [(t, g) for t, g in self._pending if t > self._tick]
+        for _, gid in ready:
+            cap = self.group_capacity[gid]
+            node = build_test_node(NodeOpts(
+                name=f"sim-node-{next(_uid)}",
+                cpu=cap["cpu_milli"], mem=cap["mem_bytes"],
+                creation_time_ns=int((self.clock.now() if self.clock else 0) * 1e9),
+            ))
+            node.labels = dict(self.group_labels[gid])
+            self.client.add_node(node)
+
+    def advance(self) -> None:
+        self._tick += 1
+        self.observe()
+        self.deliver()
+
+
+def apply_workload_event(client: EventfulClient, event: dict) -> None:
+    add = event.get("add_pods")
+    if add:
+        for _ in range(int(add["count"])):
+            client.add_pod(k8s.Pod(
+                name=f"sim-pod-{next(_uid)}",
+                containers=[k8s.ResourceRequests(
+                    cpu_milli=int(add.get("cpu_milli", 0)),
+                    mem_bytes=int(add.get("mem_bytes", 0)),
+                )],
+                node_selector=dict(add.get("node_selector", {})),
+                node_name=add.get("node_name", ""),
+            ))
+    finish = event.get("finish_pods")
+    if finish:
+        count = int(finish["count"])
+        for pod in client.list_pods()[:count]:
+            client.remove_pod(pod)
+
+
+def run_simulation(
+    node_groups,
+    client: EventfulClient,
+    ticks: int,
+    tick_interval_sec: float,
+    node_ready_ticks: int,
+    workload_events: Optional[List[dict]] = None,
+    backend=None,
+) -> List[dict]:
+    clock = MockClock()
+    provider = MockCloudProvider()
+    group_labels = {}
+    group_capacity = {}
+    for ng in node_groups:
+        nodes = [
+            n for n in client.list_nodes()
+            if n.labels.get(ng.label_key) == ng.label_value
+        ]
+        cap = {
+            "cpu_milli": nodes[0].cpu_allocatable_milli if nodes else 4000,
+            "mem_bytes": nodes[0].mem_allocatable_bytes if nodes else 16 * 10**9,
+        }
+        gid = ng.cloud_provider_group_name
+        group_labels[gid] = {ng.label_key: ng.label_value}
+        group_capacity[gid] = cap
+        provider.register_node_group(MockNodeGroup(
+            gid, ng.name, min_size=ng.min_nodes,
+            max_size=max(ng.max_nodes, len(nodes)), target_size=len(nodes),
+        ))
+
+    cloud = SyntheticCloud(
+        client=client, provider=provider, group_labels=group_labels,
+        group_capacity=group_capacity, node_ready_ticks=node_ready_ticks,
+        clock=clock,
+    )
+    controller = ctl.Controller(ctl.Opts(
+        client=client, node_groups=node_groups,
+        cloud_provider_builder=MockBuilder(provider),
+        backend=backend, clock=clock,
+    ))
+
+    by_tick: Dict[int, List[dict]] = {}
+    for ev in workload_events or []:
+        by_tick.setdefault(int(ev.get("at_tick", 0)), []).append(ev)
+
+    timeline = []
+    for tick in range(ticks):
+        for ev in by_tick.get(tick, []):
+            apply_workload_event(client, ev)
+        controller.run_once()
+        cloud.advance()
+
+        nodes = client.list_nodes()
+        record = {
+            "tick": tick,
+            "time": clock.now(),
+            "pods": len(client.list_pods()),
+            "nodes": len(nodes),
+            "tainted": sum(
+                1 for n in nodes if k8s.get_to_be_removed_taint(n) is not None
+            ),
+            "deltas": {
+                name: st.scale_delta
+                for name, st in controller.node_groups.items()
+            },
+            "provider_targets": {
+                ng.name(): ng.target_size() for ng in provider.node_groups()
+            },
+        }
+        timeline.append(record)
+        clock.advance(tick_interval_sec)
+    return timeline
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="escalator-tpu-sim")
+    p.add_argument("--nodegroups", required=True)
+    p.add_argument("--sim-state", required=True)
+    p.add_argument("--workload", default="")
+    p.add_argument("--ticks", type=int, default=30)
+    p.add_argument("--tick-interval", type=float, default=60.0)
+    p.add_argument("--node-ready-ticks", type=int, default=2)
+    p.add_argument("--backend", default="golden",
+                   choices=["auto", "jax", "sharded-jax", "golden"])
+    p.add_argument("--loglevel", default="warn")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=getattr(logging, args.loglevel.upper(), 30))
+
+    node_groups = setup_node_groups(args.nodegroups)
+    client = load_sim_state(args.sim_state)
+    events = []
+    if args.workload:
+        with open(args.workload) as f:
+            events = (yaml.safe_load(f) or {}).get("events", [])
+
+    timeline = run_simulation(
+        node_groups, client, args.ticks, args.tick_interval,
+        args.node_ready_ticks, events, make_backend(args.backend),
+    )
+    for record in timeline:
+        print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""Profiling hooks around the device step.
+
+The reference has no tracing beyond a per-run wall-time debug log
+(/root/reference/pkg/controller/controller.go:448-449); SURVEY.md §5 calls for real
+tracing in the rebuild. Two facilities:
+
+- ``trace_ticks(dir, n)`` — capture the first ``n`` controller ticks as an XLA
+  profiler trace (TensorBoard-loadable) via ``jax.profiler``.
+- ``start_profiler_server(port)`` — live profiling endpoint for
+  ``tensorboard --logdir`` remote capture.
+
+Both are no-ops when unset, and degrade to warnings if the profiler is unavailable
+on the platform.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Iterator, Optional
+
+log = logging.getLogger("escalator_tpu.tracing")
+
+
+class TickTracer:
+    """Captures the first ``max_ticks`` ticks into an XLA profiler trace."""
+
+    def __init__(self, trace_dir: Optional[str] = None, max_ticks: int = 5):
+        self.trace_dir = trace_dir
+        self.max_ticks = max_ticks
+        self._remaining = max_ticks if trace_dir else 0
+        self._active = False
+
+    @contextlib.contextmanager
+    def tick(self) -> Iterator[None]:
+        if self._remaining <= 0:
+            yield
+            return
+        try:
+            import jax
+
+            if not self._active:
+                jax.profiler.start_trace(self.trace_dir)
+                self._active = True
+                log.info(
+                    "profiler trace started -> %s (%d ticks)",
+                    self.trace_dir, self._remaining,
+                )
+        except Exception as e:  # pragma: no cover - platform-dependent
+            log.warning("could not start profiler trace: %s", e)
+            self._remaining = 0
+            yield
+            return
+        try:
+            with jax.profiler.TraceAnnotation("escalator_tick"):
+                yield
+        finally:
+            self._remaining -= 1
+            if self._remaining <= 0:
+                self.close()
+
+    def close(self) -> None:
+        """Flush an in-flight trace. Called automatically after max_ticks; call on
+        shutdown (the CLI does) so --once runs and interrupts don't lose it."""
+        if not self._active:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", self.trace_dir)
+        except Exception as e:  # pragma: no cover
+            log.warning("could not stop profiler trace: %s", e)
+        self._active = False
+        self._remaining = 0
+
+
+def start_profiler_server(port: int) -> None:
+    """Expose the live-profiling gRPC endpoint (no-op on failure)."""
+    try:
+        import jax
+
+        jax.profiler.start_server(port)
+        log.info("jax profiler server on port %d", port)
+    except Exception as e:  # pragma: no cover - platform-dependent
+        log.warning("could not start profiler server: %s", e)

@@ -1,0 +1,94 @@
+"""Simulation harness: multi-tick closed-loop behavior with the synthetic cloud."""
+
+import json
+
+import pytest
+
+from escalator_tpu import sim
+from escalator_tpu.controller.backend import GoldenBackend
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.cache import EventfulClient
+from escalator_tpu.testsupport.builders import NodeOpts, build_test_nodes
+
+from tests.test_controller import LABEL_KEY, LABEL_VALUE, make_opts
+
+
+def make_client(num_nodes=4):
+    nodes = build_test_nodes(num_nodes, NodeOpts(
+        cpu=1000, mem=4 * 10**9, label_key=LABEL_KEY, label_value=LABEL_VALUE))
+    return EventfulClient(nodes=nodes)
+
+
+def test_scale_up_then_converge():
+    """Demand spike -> scale up -> synthetic cloud delivers -> deltas go to zero."""
+    client = make_client(4)
+    # cooldown must cover delivery latency (2 ticks = 120s) or the controller
+    # double-buys — the exact hysteresis the scale lock exists for
+    ng = make_opts(scale_up_cool_down_period="5m")
+    workload = [{
+        "at_tick": 0,
+        "add_pods": {"count": 30, "cpu_milli": 500, "mem_bytes": 10**8,
+                     "node_selector": {LABEL_KEY: LABEL_VALUE}},
+    }]
+    timeline = sim.run_simulation(
+        [ng], client, ticks=12, tick_interval_sec=60, node_ready_ticks=2,
+        workload_events=workload, backend=GoldenBackend(),
+    )
+    assert timeline[0]["deltas"]["buildeng"] > 0       # spike triggers scale-up
+    assert timeline[-1]["deltas"]["buildeng"] == 0     # converged
+    assert timeline[-1]["nodes"] > 4                   # cloud delivered capacity
+    # post-convergence utilisation at/below the slack target
+    final_nodes = timeline[-1]["nodes"]
+    assert 30 * 500 / (final_nodes * 1000) * 100 <= ng.scale_up_threshold_percent
+
+
+def test_scale_down_and_reap_cycle():
+    """Workload drains -> taint, grace passes, reaper deletes down to min."""
+    client = make_client(8)
+    ng = make_opts(min_nodes=2, fast_node_removal_rate=3,
+                   soft_delete_grace_period="2m", hard_delete_grace_period="20m")
+    timeline = sim.run_simulation(
+        [ng], client, ticks=15, tick_interval_sec=60, node_ready_ticks=2,
+        workload_events=[], backend=GoldenBackend(),
+    )
+    # idle cluster: nodes tainted then reaped down toward the minimum
+    assert timeline[0]["deltas"]["buildeng"] < 0
+    assert timeline[-1]["nodes"] == 2
+    assert timeline[-1]["tainted"] == 0
+
+
+def test_cli_main_emits_json(tmp_path, capsys):
+    from tests.test_election_and_cli import NODEGROUPS_YAML, SIM_STATE_YAML
+
+    ngf = tmp_path / "ng.yaml"
+    ngf.write_text(NODEGROUPS_YAML)
+    stf = tmp_path / "state.yaml"
+    stf.write_text(SIM_STATE_YAML)
+    rc = sim.main([
+        "--nodegroups", str(ngf), "--sim-state", str(stf),
+        "--ticks", "3", "--backend", "golden",
+    ])
+    assert rc == 0
+    lines = [json.loads(x) for x in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 3
+    assert all("deltas" in r and "provider_targets" in r for r in lines)
+
+
+def test_short_cooldown_overscales_then_recovers():
+    """Negative-space check: a cooldown shorter than delivery latency causes a
+    double-buy, which the slow-removal path then corrects — the documented
+    reason scale_up_cool_down_period must cover boot+registration time."""
+    client = make_client(4)
+    ng = make_opts(scale_up_cool_down_period="30s", min_nodes=1)
+    workload = [{
+        "at_tick": 0,
+        "add_pods": {"count": 30, "cpu_milli": 500, "mem_bytes": 10**8,
+                     "node_selector": {LABEL_KEY: LABEL_VALUE}},
+    }]
+    timeline = sim.run_simulation(
+        [ng], client, ticks=10, tick_interval_sec=60, node_ready_ticks=2,
+        workload_events=workload, backend=GoldenBackend(),
+    )
+    peak = max(r["nodes"] for r in timeline)
+    assert peak > 22  # double-bought past the single-shot answer (4 + 18)
+    assert any(r["deltas"]["buildeng"] < 0 for r in timeline)  # corrects back
