@@ -45,6 +45,12 @@ class NativeJaxBackend(ComputeBackend):
         )
         self.bridge = WatchBridge(self.store, groups)
         client.subscribe(self.bridge.apply, replay=True)
+        # Device-resident cluster cache (ops/device_state.py): built on first
+        # decide, scatter-updated with the store's dirty slots per tick.
+        self._cache = None
+        # node slots whose device lanes were overridden by last tick's dry-mode
+        # view — they must be re-scattered (possibly back to raw) this tick
+        self._overridden_slots = np.empty(0, np.int64)
 
     def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
         """First live node per group -> GroupState cached capacity
@@ -90,19 +96,46 @@ class NativeJaxBackend(ComputeBackend):
                taint_trackers=None):
         import jax
 
+        from escalator_tpu.ops.device_state import DeviceClusterCache
+
         t0 = time.perf_counter()
-        pods, nodes = self.store.as_pod_node_arrays()
-        self._refresh_cached_capacity(group_inputs, nodes)
+        pods, nodes_raw = self.store.as_pod_node_arrays()
+        self._refresh_cached_capacity(group_inputs, nodes_raw)
         nodes = self._dry_mode_view(
-            nodes, group_inputs, dry_mode_flags, taint_trackers
+            nodes_raw, group_inputs, dry_mode_flags, taint_trackers
         )
         groups = pack_groups(
             [(config, state) for _, _, config, state in group_inputs],
             pad_groups=_round_up(len(group_inputs), 8),
         )
-        cluster = ClusterArrays(groups=groups, pods=pods, nodes=nodes)
+        pod_dirty, node_dirty = self.store.drain_dirty()
+        overridden = (
+            np.nonzero(
+                (nodes.tainted != nodes_raw.tainted)
+                | (nodes.cordoned != nodes_raw.cordoned)
+            )[0].astype(np.int64)
+            if nodes is not nodes_raw
+            else np.empty(0, np.int64)
+        )
+        if (
+            self._cache is None
+            or self._cache.pod_capacity != self.store.pod_capacity
+            or self._cache.node_capacity != self.store.node_capacity
+        ):
+            # first tick or store growth: one full upload; drained marks are
+            # already reflected in it
+            self._cache = DeviceClusterCache(
+                ClusterArrays(groups=groups, pods=pods, nodes=nodes)
+            )
+        else:
+            node_dirty = np.unique(
+                np.concatenate([node_dirty, self._overridden_slots, overridden])
+            )
+            self._cache.set_host(pods, nodes)
+            self._cache.apply_dirty(pod_dirty, node_dirty, groups)
+        self._overridden_slots = overridden
         t1 = time.perf_counter()
-        out = self._kernel.decide_jit(cluster, np.int64(now_sec))
+        out = self._kernel.decide_jit(self._cache.cluster, np.int64(now_sec))
         jax.block_until_ready(out)
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)

@@ -124,6 +124,38 @@ struct Registry {
   }
 };
 
+// Per-tick dirty-slot set. Slots touched since the last drain, deduplicated via
+// a per-slot epoch stamp (O(1) mark, no clearing pass between ticks). This is
+// what makes the host->device path O(changes): the Python side drains the slot
+// list, gathers just those lanes from the column views, and scatter-updates the
+// device-resident arrays (ops/device_state.py).
+struct DirtySet {
+  std::vector<int64_t> slots;
+  std::vector<uint64_t> epoch_of;
+  uint64_t epoch = 1;
+
+  void init(size_t max) { epoch_of.assign(max, 0); }
+
+  void mark(int64_t slot) {
+    if (epoch_of[static_cast<size_t>(slot)] != epoch) {
+      epoch_of[static_cast<size_t>(slot)] = epoch;
+      slots.push_back(slot);
+    }
+  }
+
+  int64_t count() const { return static_cast<int64_t>(slots.size()); }
+
+  int64_t drain(int64_t* out) {
+    int64_t n = static_cast<int64_t>(slots.size());
+    if (out != nullptr && n > 0) {
+      std::memcpy(out, slots.data(), static_cast<size_t>(n) * sizeof(int64_t));
+    }
+    slots.clear();
+    ++epoch;
+    return n;
+  }
+};
+
 }  // namespace
 
 struct StateStore {
@@ -131,6 +163,8 @@ struct StateStore {
   NodeColumns nodes;
   Registry pod_reg;
   Registry node_reg;
+  DirtySet pod_dirty;
+  DirtySet node_dirty;
   int64_t max_pods = 0;
   int64_t max_nodes = 0;
 };
@@ -147,6 +181,8 @@ StateStore* ess_new(int64_t pod_capacity, int64_t node_capacity,
   s->max_nodes = max_nodes;
   s->pods.reserve_max(static_cast<size_t>(max_pods));
   s->nodes.reserve_max(static_cast<size_t>(max_nodes));
+  s->pod_dirty.init(static_cast<size_t>(max_pods));
+  s->node_dirty.init(static_cast<size_t>(max_nodes));
   s->pods.resize(static_cast<size_t>(pod_capacity));
   s->nodes.resize(static_cast<size_t>(node_capacity));
   s->pod_reg.capacity = pod_capacity;
@@ -191,6 +227,7 @@ int64_t ess_upsert_pod(StateStore* s, const char* uid, int32_t group,
   s->pods.mem_bytes[slot] = mem_bytes;
   s->pods.node[slot] = node_slot;
   s->pods.valid[slot] = 1;
+  s->pod_dirty.mark(slot);
   return slot;
 }
 
@@ -201,6 +238,7 @@ int64_t ess_delete_pod(StateStore* s, const char* uid) {
   s->pods.cpu_milli[slot] = 0;
   s->pods.mem_bytes[slot] = 0;
   s->pods.node[slot] = -1;
+  s->pod_dirty.mark(slot);
   return slot;
 }
 
@@ -219,6 +257,7 @@ int64_t ess_upsert_node(StateStore* s, const char* name, int32_t group,
   s->nodes.no_delete[slot] = no_delete;
   s->nodes.taint_time_sec[slot] = taint_time_sec;
   s->nodes.valid[slot] = 1;
+  s->node_dirty.mark(slot);
   return slot;
 }
 
@@ -226,6 +265,7 @@ int64_t ess_delete_node(StateStore* s, const char* name) {
   int64_t slot = s->node_reg.release(name);
   if (slot < 0) return -1;
   s->nodes.valid[slot] = 0;
+  s->node_dirty.mark(slot);
   return slot;
 }
 
@@ -235,6 +275,60 @@ int64_t ess_node_slot(StateStore* s, const char* name) {
 
 int64_t ess_pod_slot(StateStore* s, const char* uid) {
   return s->pod_reg.lookup(uid);
+}
+
+// Batched ingest: one ctypes crossing per watch-delta batch instead of one per
+// event. Returns the number of entries applied; stops early (returning i) when
+// a new key hits capacity, so the caller can grow and resume from i.
+int64_t ess_upsert_pods_batch(StateStore* s, const char* const* uids,
+                              const int32_t* group, const int64_t* cpu_milli,
+                              const int64_t* mem_bytes, const int32_t* node_slot,
+                              int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = s->pod_reg.acquire(uids[i]);
+    if (slot < 0) return i;
+    s->pods.group[slot] = group[i];
+    s->pods.cpu_milli[slot] = cpu_milli[i];
+    s->pods.mem_bytes[slot] = mem_bytes[i];
+    s->pods.node[slot] = node_slot[i];
+    s->pods.valid[slot] = 1;
+    s->pod_dirty.mark(slot);
+  }
+  return n;
+}
+
+int64_t ess_upsert_nodes_batch(StateStore* s, const char* const* names,
+                               const int32_t* group, const int64_t* cpu_milli,
+                               const int64_t* mem_bytes,
+                               const int64_t* creation_ns, const uint8_t* tainted,
+                               const uint8_t* cordoned, const uint8_t* no_delete,
+                               const int64_t* taint_time_sec, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = s->node_reg.acquire(names[i]);
+    if (slot < 0) return i;
+    s->nodes.group[slot] = group[i];
+    s->nodes.cpu_milli[slot] = cpu_milli[i];
+    s->nodes.mem_bytes[slot] = mem_bytes[i];
+    s->nodes.creation_ns[slot] = creation_ns[i];
+    s->nodes.tainted[slot] = tainted[i];
+    s->nodes.cordoned[slot] = cordoned[i];
+    s->nodes.no_delete[slot] = no_delete[i];
+    s->nodes.taint_time_sec[slot] = taint_time_sec[i];
+    s->nodes.valid[slot] = 1;
+    s->node_dirty.mark(slot);
+  }
+  return n;
+}
+
+// Dirty-slot tracking: count + drain (copies the deduplicated slot list into
+// `out`, which must have room for the count, then resets for the next tick).
+int64_t ess_pod_dirty_count(StateStore* s) { return s->pod_dirty.count(); }
+int64_t ess_node_dirty_count(StateStore* s) { return s->node_dirty.count(); }
+int64_t ess_drain_pod_dirty(StateStore* s, int64_t* out) {
+  return s->pod_dirty.drain(out);
+}
+int64_t ess_drain_node_dirty(StateStore* s, int64_t* out) {
+  return s->node_dirty.drain(out);
 }
 
 // Buffer pointer exports, one per column. Field ids keep the ABI append-only.

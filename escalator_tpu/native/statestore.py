@@ -106,6 +106,27 @@ def _build() -> Optional[ctypes.CDLL]:
         lib.ess_pod_buffer.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.ess_node_buffer.restype = ctypes.c_void_p
         lib.ess_node_buffer.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64ptr = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        ppchar = ctypes.POINTER(ctypes.c_char_p)
+        lib.ess_upsert_pods_batch.restype = ctypes.c_int64
+        lib.ess_upsert_pods_batch.argtypes = [
+            ctypes.c_void_p, ppchar, i32p, i64ptr, i64ptr, i32p, ctypes.c_int64,
+        ]
+        lib.ess_upsert_nodes_batch.restype = ctypes.c_int64
+        lib.ess_upsert_nodes_batch.argtypes = [
+            ctypes.c_void_p, ppchar, i32p, i64ptr, i64ptr, i64ptr, u8p, u8p, u8p,
+            i64ptr, ctypes.c_int64,
+        ]
+        for fn in ("ess_pod_dirty_count", "ess_node_dirty_count"):
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        for fn in ("ess_drain_pod_dirty", "ess_drain_node_dirty"):
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)
+            ]
         _lib = lib
         return lib
 
@@ -204,8 +225,125 @@ class NativeStateStore:
     def delete_node(self, name: str) -> int:
         return self._lib.ess_delete_node(self._ptr, name.encode())
 
+    def upsert_pods_batch(self, uids, group, cpu_milli, mem_bytes,
+                          node_slot=None) -> None:
+        """Apply a batch of pod upserts in one native call (one ctypes crossing
+        per tick's watch deltas instead of one per event)."""
+        n = len(uids)
+        if n == 0:
+            return
+        group = np.ascontiguousarray(group, np.int32)
+        cpu_milli = np.ascontiguousarray(cpu_milli, np.int64)
+        mem_bytes = np.ascontiguousarray(mem_bytes, np.int64)
+        if node_slot is None:
+            node_slot = np.full(n, -1, np.int32)
+        node_slot = np.ascontiguousarray(node_slot, np.int32)
+        for name, arr in (("group", group), ("cpu_milli", cpu_milli),
+                          ("mem_bytes", mem_bytes), ("node_slot", node_slot)):
+            if len(arr) != n:
+                raise ValueError(f"{name} has length {len(arr)}, expected {n}")
+        c_uids = (ctypes.c_char_p * n)(*[u.encode() for u in uids])
+        done = 0
+        while done < n:
+            applied = self._lib.ess_upsert_pods_batch(
+                self._ptr,
+                ctypes.cast(
+                    ctypes.byref(c_uids, done * ctypes.sizeof(ctypes.c_char_p)),
+                    ctypes.POINTER(ctypes.c_char_p),
+                ),
+                group[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                cpu_milli[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                mem_bytes[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                node_slot[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n - done,
+            )
+            done += applied
+            if done < n:
+                self.grow(self.pod_capacity * 2, self.node_capacity)
+
+    def upsert_nodes_batch(self, names, group, cpu_milli, mem_bytes,
+                           creation_ns=None, tainted=None, cordoned=None,
+                           no_delete=None, taint_time_sec=None) -> None:
+        n = len(names)
+        if n == 0:
+            return
+        group = np.ascontiguousarray(group, np.int32)
+        cpu_milli = np.ascontiguousarray(cpu_milli, np.int64)
+        mem_bytes = np.ascontiguousarray(mem_bytes, np.int64)
+        creation_ns = np.ascontiguousarray(
+            creation_ns if creation_ns is not None else np.zeros(n), np.int64
+        )
+        u8 = lambda v: np.ascontiguousarray(
+            v if v is not None else np.zeros(n), np.uint8
+        )
+        tainted, cordoned, no_delete = u8(tainted), u8(cordoned), u8(no_delete)
+        taint_time_sec = np.ascontiguousarray(
+            taint_time_sec
+            if taint_time_sec is not None
+            else np.full(n, NO_TAINT_TIME),
+            np.int64,
+        )
+        for name, arr in (("group", group), ("cpu_milli", cpu_milli),
+                          ("mem_bytes", mem_bytes), ("creation_ns", creation_ns),
+                          ("tainted", tainted), ("cordoned", cordoned),
+                          ("no_delete", no_delete),
+                          ("taint_time_sec", taint_time_sec)):
+            if len(arr) != n:
+                raise ValueError(f"{name} has length {len(arr)}, expected {n}")
+        c_names = (ctypes.c_char_p * n)(*[s.encode() for s in names])
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        done = 0
+        while done < n:
+            applied = self._lib.ess_upsert_nodes_batch(
+                self._ptr,
+                ctypes.cast(
+                    ctypes.byref(c_names, done * ctypes.sizeof(ctypes.c_char_p)),
+                    ctypes.POINTER(ctypes.c_char_p),
+                ),
+                group[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                cpu_milli[done:].ctypes.data_as(i64p),
+                mem_bytes[done:].ctypes.data_as(i64p),
+                creation_ns[done:].ctypes.data_as(i64p),
+                tainted[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                cordoned[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                no_delete[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                taint_time_sec[done:].ctypes.data_as(i64p),
+                n - done,
+            )
+            done += applied
+            if done < n:
+                self.grow(self.pod_capacity, self.node_capacity * 2)
+
     def node_slot(self, name: str) -> int:
         return self._lib.ess_node_slot(self._ptr, name.encode())
+
+    # -- dirty tracking ------------------------------------------------------
+    @property
+    def pod_dirty_count(self) -> int:
+        return self._lib.ess_pod_dirty_count(self._ptr)
+
+    @property
+    def node_dirty_count(self) -> int:
+        return self._lib.ess_node_dirty_count(self._ptr)
+
+    def drain_dirty(self):
+        """(pod_slots, node_slots) touched since the last drain, as int64 arrays.
+
+        Deduplicated on the C++ side; draining resets the sets for the next tick.
+        Feed these to ``ops.device_state.DeviceClusterCache.apply_dirty`` for the
+        O(changes) host->device path.
+        """
+        i64p = ctypes.POINTER(ctypes.c_int64)
+
+        def _drain(count, drain_fn):
+            out = np.empty(max(count, 1), np.int64)
+            n = drain_fn(self._ptr, out.ctypes.data_as(i64p))
+            return out[:n]
+
+        return (
+            _drain(self.pod_dirty_count, self._lib.ess_drain_pod_dirty),
+            _drain(self.node_dirty_count, self._lib.ess_drain_node_dirty),
+        )
 
     def pod_slot(self, uid: str) -> int:
         return self._lib.ess_pod_slot(self._ptr, uid.encode())

@@ -1,0 +1,211 @@
+"""Device-resident cluster state: the O(changes) host->device data path.
+
+SURVEY.md §7 names the host<->device path as a hard part: at 100k pods, re-uploading
+the packed arrays every tick costs tens of ms — more than the decision kernel itself.
+The reference has no analog (its Go loops rebuild aggregate state from the watch cache
+each tick, pkg/controller/controller.go:192-272); the TPU-native design instead keeps
+the ``ClusterArrays`` resident in device HBM and applies each tick's watch deltas as a
+scatter update:
+
+- the native C++ store (``native/statestore.cpp``) marks dirty slots as watch events
+  are ingested and drains a deduplicated slot list per tick;
+- the host gathers just those lanes from the zero-copy column views (numpy fancy
+  indexing, O(changes));
+- one jitted scatter (``jnp.ndarray.at[idx].set``) with **donated** operands updates
+  the resident arrays in place — XLA aliases input and output buffers, so HBM traffic
+  per tick is O(changes), not O(cluster).
+
+Delta batches are padded to power-of-two buckets so jit compiles a handful of shapes
+total (no recompilation storm as churn fluctuates). Padding lanes target a dedicated
+scratch lane (index ``P``/``N`` — the resident arrays carry one extra, never-valid
+lane) and all write the same constants, keeping duplicate-index scatter deterministic.
+
+Group config/state ([G]-sized, mutated by the controller every tick: locks, cached
+capacity, requested nodes) rides along in the same jit call — it is tiny, so it is
+simply re-uploaded rather than diffed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import fields
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+from escalator_tpu.core.arrays import (
+    NO_TAINT_TIME,
+    ClusterArrays,
+    GroupArrays,
+    NodeArrays,
+    PodArrays,
+)
+from escalator_tpu.ops import kernel as _kernel  # noqa: F401  (ClusterArrays pytree)
+
+
+def _register(cls):
+    tree_util.register_pytree_node(
+        cls,
+        lambda obj: ([getattr(obj, f.name) for f in fields(cls)], None),
+        lambda aux, leaves: cls(*leaves),
+    )
+
+
+_register(PodArrays)
+_register(NodeArrays)
+_register(GroupArrays)
+
+_MIN_BUCKET = 64
+
+
+def _bucket(n: int) -> int:
+    """Smallest power-of-two >= n (min 64): bounds the set of compiled shapes."""
+    return max(_MIN_BUCKET, 1 << (max(n, 1) - 1).bit_length())
+
+
+_POD_PAD = {"node": -1}
+_NODE_PAD = {"taint_time_sec": NO_TAINT_TIME}
+
+
+def _pad_one_lane(soa, pad_defaults):
+    """Copy of a Pod/NodeArrays with one extra scratch lane (valid=False)."""
+    out = {}
+    for f in fields(soa):
+        arr = getattr(soa, f.name)
+        fill = pad_defaults.get(f.name, 0)
+        out[f.name] = np.concatenate([arr, np.full(1, fill, arr.dtype)])
+    return type(soa)(**out)
+
+
+def _gather_padded(soa, slots: np.ndarray, bucket: int, scratch: int, pad_defaults):
+    """(idx[int32 bucket], values SoA of [bucket]) for a dirty-slot batch.
+
+    Pad lanes point at the scratch lane and write that lane's invariant values
+    (valid=False etc.), so duplicate-index scatter stays deterministic.
+    """
+    k = len(slots)
+    idx = np.full(bucket, scratch, np.int32)
+    idx[:k] = slots
+    vals = {}
+    for f in fields(soa):
+        arr = getattr(soa, f.name)
+        fill = pad_defaults.get(f.name, 0)
+        v = np.full(bucket, fill, arr.dtype)
+        if k:
+            v[:k] = arr[slots]
+        vals[f.name] = v
+    return idx, type(soa)(**vals)
+
+
+# Pods/nodes are donated (in-place on device); groups is NOT — it may be either a
+# fresh host upload or the pass-through resident value, and donating a buffer that
+# is also returned untouched would invalidate the caller's reference.
+@partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_update(
+    pods: PodArrays,
+    nodes: NodeArrays,
+    groups: GroupArrays,
+    pod_idx: jnp.ndarray,
+    pod_vals: PodArrays,
+    node_idx: jnp.ndarray,
+    node_vals: NodeArrays,
+) -> ClusterArrays:
+    def upd(soa, idx, vals):
+        return type(soa)(
+            **{
+                f.name: getattr(soa, f.name).at[idx].set(getattr(vals, f.name))
+                for f in fields(soa)
+            }
+        )
+
+    return ClusterArrays(
+        groups=groups,
+        pods=upd(pods, pod_idx, pod_vals),
+        nodes=upd(nodes, node_idx, node_vals),
+    )
+
+
+class DeviceClusterCache:
+    """Keeps the packed cluster resident on one device across ticks.
+
+    Construct from host-side arrays (typically the native store's zero-copy views),
+    then per tick call :meth:`apply_dirty` with the store's drained dirty-slot lists.
+    ``cluster`` is the jit-ready device value for ``ops.kernel.decide``.
+    """
+
+    def __init__(self, host: ClusterArrays, device=None):
+        self._device = device if device is not None else jax.devices()[0]
+        self._host_pods = host.pods
+        self._host_nodes = host.nodes
+        self.pod_capacity = int(host.pods.valid.shape[0])
+        self.node_capacity = int(host.nodes.valid.shape[0])
+        self._cluster = jax.device_put(
+            ClusterArrays(
+                groups=host.groups,
+                pods=_pad_one_lane(host.pods, _POD_PAD),
+                nodes=_pad_one_lane(host.nodes, _NODE_PAD),
+            ),
+            self._device,
+        )
+
+    @property
+    def cluster(self) -> ClusterArrays:
+        return self._cluster
+
+    def set_host(self, pods: PodArrays, nodes: NodeArrays) -> None:
+        """Rebind the host-side views gathers read from. Needed when the store
+        re-views its buffers (growth) or a per-tick corrected view (dry mode)
+        replaces the raw columns. Shapes must match the resident capacity."""
+        if (
+            int(pods.valid.shape[0]) != self.pod_capacity
+            or int(nodes.valid.shape[0]) != self.node_capacity
+        ):
+            raise ValueError(
+                "host view shape changed; use refresh_full() after store growth"
+            )
+        self._host_pods = pods
+        self._host_nodes = nodes
+
+    def apply_dirty(
+        self,
+        pod_slots: np.ndarray,
+        node_slots: np.ndarray,
+        groups: Optional[GroupArrays] = None,
+    ) -> ClusterArrays:
+        """Scatter this tick's dirty lanes (plus fresh group state) into the
+        resident arrays. O(changes) host work + transfer; returns the updated
+        device cluster."""
+        if groups is None:
+            groups = self._cluster.groups
+        pidx, pvals = _gather_padded(
+            self._host_pods,
+            np.asarray(pod_slots, np.int64),
+            _bucket(len(pod_slots)),
+            self.pod_capacity,
+            _POD_PAD,
+        )
+        nidx, nvals = _gather_padded(
+            self._host_nodes,
+            np.asarray(node_slots, np.int64),
+            _bucket(len(node_slots)),
+            self.node_capacity,
+            _NODE_PAD,
+        )
+        self._cluster = _scatter_update(
+            self._cluster.pods, self._cluster.nodes, groups, pidx, pvals, nidx, nvals
+        )
+        return self._cluster
+
+    def refresh_full(self, host: ClusterArrays) -> ClusterArrays:
+        """Full re-upload after a capacity change (store growth re-views buffers;
+        resident shapes must follow). Rare by design — capacities double."""
+        self.__init__(host, self._device)
+        return self._cluster

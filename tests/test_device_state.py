@@ -1,0 +1,167 @@
+"""Device-resident cluster cache: O(changes) scatter path == full repack.
+
+The invariant that makes the incremental path safe: after any sequence of store
+mutations + drain/apply cycles, decisions computed from the resident arrays must be
+identical to decisions computed from a fresh full upload of the store's views.
+"""
+
+import numpy as np
+import pytest
+
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.core.arrays import ClusterArrays, pack_groups
+from escalator_tpu.native import statestore
+from escalator_tpu.ops.device_state import DeviceClusterCache, _bucket
+from escalator_tpu.ops.kernel import decide_jit
+
+pytestmark = pytest.mark.skipif(
+    not statestore.available(), reason="native build unavailable"
+)
+
+CFG = sem.GroupConfig(
+    min_nodes=0,
+    max_nodes=10**6,
+    taint_lower_percent=30,
+    taint_upper_percent=45,
+    scale_up_percent=70,
+    slow_removal_rate=1,
+    fast_removal_rate=2,
+    soft_delete_grace_sec=300,
+    hard_delete_grace_sec=900,
+)
+
+
+def _groups(n):
+    return pack_groups(
+        [
+            (CFG, sem.GroupState(cached_cpu_milli=4000, cached_mem_bytes=16 * 10**9))
+            for _ in range(n)
+        ]
+    )
+
+
+def _decide_full(store, groups, now):
+    import jax
+
+    pods, nodes = store.as_pod_node_arrays()
+    cluster = ClusterArrays(groups=groups, pods=pods, nodes=nodes)
+    # fresh full upload (copies the views), deliberately NOT the cache path
+    return decide_jit(jax.device_put(cluster), now)
+
+
+def _assert_same_decisions(a, b):
+    np.testing.assert_array_equal(np.asarray(a.status), np.asarray(b.status))
+    np.testing.assert_array_equal(
+        np.asarray(a.nodes_delta), np.asarray(b.nodes_delta)
+    )
+    np.testing.assert_array_equal(np.asarray(a.num_pods), np.asarray(b.num_pods))
+    np.testing.assert_array_equal(
+        np.asarray(a.cpu_request_milli), np.asarray(b.cpu_request_milli)
+    )
+    np.testing.assert_array_equal(np.asarray(a.reap_mask[:-1]), np.asarray(b.reap_mask))
+
+
+class TestBucket:
+    def test_power_of_two_floor_64(self):
+        assert _bucket(0) == 64
+        assert _bucket(1) == 64
+        assert _bucket(64) == 64
+        assert _bucket(65) == 128
+        assert _bucket(1000) == 1024
+
+
+class TestIncrementalParity:
+    def test_random_churn_matches_full_repack(self):
+        rng = np.random.default_rng(42)
+        store = statestore.NativeStateStore(pod_capacity=256, node_capacity=128)
+        groups = _groups(8)
+        now = np.int64(1_700_000_000)
+
+        for i in range(100):
+            store.upsert_pod(f"p{i}", int(rng.integers(0, 8)), 500, 10**9)
+        for i in range(40):
+            store.upsert_node(
+                f"n{i}", int(rng.integers(0, 8)), 4000, 16 * 10**9,
+                creation_ns=int(rng.integers(1, 10**12)),
+            )
+        store.drain_dirty()
+        pods, nodes = store.as_pod_node_arrays()
+        cache = DeviceClusterCache(ClusterArrays(groups=groups, pods=pods, nodes=nodes))
+
+        for tick in range(5):
+            # mixed churn: updates, inserts, deletes, node taints
+            for _ in range(30):
+                op = rng.integers(0, 4)
+                if op == 0:
+                    store.upsert_pod(
+                        f"p{rng.integers(0, 120)}", int(rng.integers(0, 8)),
+                        int(rng.choice([100, 250, 500, 1000])), 10**9,
+                    )
+                elif op == 1:
+                    store.delete_pod(f"p{rng.integers(0, 120)}")
+                elif op == 2:
+                    store.upsert_node(
+                        f"n{rng.integers(0, 50)}", int(rng.integers(0, 8)),
+                        4000, 16 * 10**9,
+                        creation_ns=int(rng.integers(1, 10**12)),
+                        tainted=bool(rng.integers(0, 2)),
+                        taint_time_sec=now - int(rng.integers(0, 2000)),
+                    )
+                else:
+                    store.delete_node(f"n{rng.integers(0, 50)}")
+            ps, ns = store.drain_dirty()
+            cache.apply_dirty(ps, ns, groups)
+            incremental = decide_jit(cache.cluster, now)
+            full = _decide_full(store, groups, now)
+            _assert_same_decisions(incremental, full)
+
+    def test_empty_delta_tick(self):
+        store = statestore.NativeStateStore(pod_capacity=64, node_capacity=32)
+        groups = _groups(2)
+        store.upsert_pod("p0", 0, 500, 10**9)
+        store.upsert_node("n0", 0, 4000, 16 * 10**9)
+        store.drain_dirty()
+        pods, nodes = store.as_pod_node_arrays()
+        cache = DeviceClusterCache(ClusterArrays(groups=groups, pods=pods, nodes=nodes))
+        before = decide_jit(cache.cluster, np.int64(0))
+        ps, ns = store.drain_dirty()
+        cache.apply_dirty(ps, ns, groups)
+        after = decide_jit(cache.cluster, np.int64(0))
+        np.testing.assert_array_equal(
+            np.asarray(before.nodes_delta), np.asarray(after.nodes_delta)
+        )
+
+    def test_group_state_rides_along(self):
+        """Lock flips (host GroupState) must reach the device without node churn."""
+        store = statestore.NativeStateStore(pod_capacity=64, node_capacity=32)
+        store.upsert_pod("p0", 0, 3900, 10**9)
+        store.upsert_node("n0", 0, 4000, 16 * 10**9)
+        store.drain_dirty()
+        pods, nodes = store.as_pod_node_arrays()
+        groups = _groups(1)
+        cache = DeviceClusterCache(ClusterArrays(groups=groups, pods=pods, nodes=nodes))
+        out = decide_jit(cache.cluster, np.int64(0))
+        assert int(out.status[0]) == sem.DecisionStatus.OK
+
+        locked = _groups(1)
+        locked.locked[0] = True
+        locked.requested_nodes[0] = 5
+        cache.apply_dirty(np.empty(0, np.int64), np.empty(0, np.int64), locked)
+        out2 = decide_jit(cache.cluster, np.int64(0))
+        assert int(out2.status[0]) == sem.DecisionStatus.LOCKED
+        assert int(out2.nodes_delta[0]) == 5
+
+    def test_set_host_shape_mismatch_raises(self):
+        store = statestore.NativeStateStore(pod_capacity=64, node_capacity=32)
+        store.upsert_pod("p0", 0, 500, 10**9)
+        pods, nodes = store.as_pod_node_arrays()
+        cache = DeviceClusterCache(
+            ClusterArrays(groups=_groups(1), pods=pods, nodes=nodes)
+        )
+        store.grow(128, 32)
+        pods2, nodes2 = store.as_pod_node_arrays()
+        with pytest.raises(ValueError):
+            cache.set_host(pods2, nodes2)
+        # refresh_full is the growth path
+        cache.refresh_full(ClusterArrays(groups=_groups(1), pods=pods2, nodes=nodes2))
+        assert cache.pod_capacity == 128

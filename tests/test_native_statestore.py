@@ -152,3 +152,80 @@ class TestViewSafety:
         del store
         gc.collect()
         assert view[s] == 424242  # store freed only when views die
+
+
+class TestDirtyTracking:
+    def test_marks_and_drains(self, store):
+        s1 = store.upsert_pod("p1", 0, 500, 10**9)
+        s2 = store.upsert_pod("p2", 1, 250, 10**8)
+        n1 = store.upsert_node("n1", 0, 4000, 16 * 10**9)
+        assert store.pod_dirty_count == 2
+        assert store.node_dirty_count == 1
+        ps, ns = store.drain_dirty()
+        assert sorted(ps.tolist()) == sorted([s1, s2])
+        assert ns.tolist() == [n1]
+        # drained: reset for the next tick
+        assert store.pod_dirty_count == 0
+        ps2, ns2 = store.drain_dirty()
+        assert len(ps2) == 0 and len(ns2) == 0
+
+    def test_dedupes_repeat_touches(self, store):
+        s1 = store.upsert_pod("p1", 0, 500, 10**9)
+        store.upsert_pod("p1", 0, 600, 10**9)
+        store.upsert_pod("p1", 0, 700, 10**9)
+        assert store.pod_dirty_count == 1
+        ps, _ = store.drain_dirty()
+        assert ps.tolist() == [s1]
+
+    def test_delete_marks_dirty(self, store):
+        s1 = store.upsert_pod("p1", 0, 500, 10**9)
+        n1 = store.upsert_node("n1", 0, 4000, 16 * 10**9)
+        store.drain_dirty()
+        store.delete_pod("p1")
+        store.delete_node("n1")
+        ps, ns = store.drain_dirty()
+        assert ps.tolist() == [s1]
+        assert ns.tolist() == [n1]
+
+    def test_remark_after_drain(self, store):
+        s1 = store.upsert_pod("p1", 0, 500, 10**9)
+        store.drain_dirty()
+        store.upsert_pod("p1", 0, 999, 10**9)
+        ps, _ = store.drain_dirty()
+        assert ps.tolist() == [s1]
+
+
+class TestBatchIngest:
+    def test_pods_batch_matches_single(self, store):
+        store.upsert_pods_batch(
+            ["a", "b", "c"], [0, 1, 2], [100, 200, 300],
+            [10**8, 2 * 10**8, 3 * 10**8], [5, -1, 7],
+        )
+        assert store.pod_count == 3
+        pv = store.pod_views()
+        sa = store.pod_slot("a")
+        assert pv["cpu_milli"][sa] == 100
+        assert pv["node"][store.pod_slot("c")] == 7
+        assert store.pod_dirty_count == 3
+
+    def test_nodes_batch(self, store):
+        store.upsert_nodes_batch(
+            ["n1", "n2"], [0, 1], [4000, 8000], [16 * 10**9, 32 * 10**9],
+            creation_ns=[10, 20], tainted=[0, 1], taint_time_sec=[0, 12345],
+        )
+        nv = store.node_views()
+        s2 = store.node_slot("n2")
+        assert nv["tainted"][s2] == 1
+        assert nv["taint_time_sec"][s2] == 12345
+        assert store.node_dirty_count == 2
+
+    def test_batch_grows_on_capacity(self):
+        s = statestore.NativeStateStore(pod_capacity=4, node_capacity=4)
+        s.upsert_pods_batch(
+            [f"p{i}" for i in range(20)], np.zeros(20), np.full(20, 100),
+            np.full(20, 10**8),
+        )
+        assert s.pod_count == 20
+        assert s.pod_capacity >= 20
+        ps, _ = s.drain_dirty()
+        assert len(ps) == 20
