@@ -1,0 +1,78 @@
+"""Multi-host runtime wiring: ``jax.distributed`` + hybrid-mesh construction.
+
+The reference scales out only as active/passive HA — one leader process does all
+the work, standbys wait on a Lease (/root/reference/pkg/k8s/election.go:25,
+cmd/main.go:157-185). The TPU framework ADDS scale-out of the decision plane
+itself: N hosts × M chips form a global ``(dcn, ici)`` mesh, the nodegroup axis is
+sharded over all chips, and fleet reductions ride layered collectives
+(``parallel.mesh.make_fleet_decider``). Leader election remains for the
+side-effect executors (taints, cloud API calls must have one writer); the compute
+plane needs no leader — every host runs the same SPMD program.
+
+Single-host (or test) use never needs this module: ``make_mesh``/``make_hybrid_mesh``
+work on whatever ``jax.devices()`` shows. Call :func:`initialize` once per process
+before first device use to join a multi-host fleet.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("escalator_tpu.parallel")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join this process to a multi-host JAX fleet.
+
+    Arguments default from the standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``); on TPU pods JAX can also infer all
+    three from the platform metadata, in which case calling with no arguments is
+    correct. Returns True when distributed mode was initialised, False when the
+    configuration is absent (single-host mode — not an error).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    if num_processes is None and env_np is not None:
+        num_processes = int(env_np)
+    if process_id is None and env_pid is not None:
+        process_id = int(env_pid)
+
+    if coordinator_address is None and num_processes is None:
+        log.debug("no distributed configuration; staying single-host")
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "joined distributed fleet: process %s/%s, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return True
+
+
+def global_hybrid_mesh():
+    """The fleet-wide ``(dcn, ici)`` mesh for this (possibly multi-process) runtime.
+
+    Under ``initialize()`` each process sees the same global ``jax.devices()`` list;
+    the mesh therefore has one ``dcn`` row per host and every process compiles the
+    identical SPMD program (shard_map handles the local-device addressing).
+    """
+    from escalator_tpu.parallel.mesh import make_hybrid_mesh
+
+    return make_hybrid_mesh()

@@ -38,11 +38,64 @@ from escalator_tpu.ops.kernel import DecisionArrays, decide
 
 GROUP_AXIS = "groups"
 
+#: Hybrid mesh axis names: ``dcn`` spans hosts (slow data-center links), ``ici``
+#: spans each host's chips (fast inter-chip interconnect).
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """1-D mesh over the nodegroup axis. Multi-host: pass the global device list."""
     devs = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devs), (GROUP_AXIS,))
+
+
+def make_hybrid_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_hosts: Optional[int] = None,
+) -> Mesh:
+    """2-D ``(dcn, ici)`` mesh for multi-host fleets.
+
+    The nodegroup shard axis is laid over BOTH axes (see ``_group_spec``), so
+    neighbouring shards live on the same host: per-group decisions need no
+    communication at all, and the fleet reductions ``psum`` over ``ici`` first
+    (riding the fast intra-host interconnect) before the small cross-host ``dcn``
+    hop — the layout recipe from the scaling-book playbook. Axis order matters:
+    the trailing mesh axis gets the fastest links.
+
+    ``num_hosts`` defaults to the number of distinct JAX processes (1 in
+    single-host tests, the real host count under multi-process ``jax.distributed``
+    initialisation — see ``parallel.distributed.initialize``).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_hosts is None:
+        num_hosts = max(1, len({d.process_index for d in devs}))
+    if len(devs) % num_hosts != 0:
+        raise ValueError(
+            f"{len(devs)} devices do not divide evenly over {num_hosts} hosts"
+        )
+    # Keep each host's devices contiguous on the ici axis. jax.devices() orders by
+    # (process_index, local id); sort defensively for caller-provided lists.
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+    arr = np.array(devs).reshape(num_hosts, -1)
+    # When the list spans real processes, every dcn row must be a single host —
+    # otherwise the "ici = fast intra-host links" layout claim is silently false.
+    # (Single-process device lists may be split into virtual hosts for testing.)
+    if len({d.process_index for d in devs}) > 1:
+        for row in arr:
+            if len({d.process_index for d in row}) != 1:
+                raise ValueError(
+                    "uneven devices-per-host: a dcn row would span hosts; pass a "
+                    "device list with equal per-host device counts"
+                )
+    return Mesh(arr, (DCN_AXIS, ICI_AXIS))
+
+
+def _group_spec(mesh: Mesh) -> P:
+    """PartitionSpec placing the leading shard axis over ALL mesh axes (works for
+    both the 1-D ``groups`` mesh and the 2-D ``(dcn, ici)`` hybrid mesh)."""
+    names = tuple(mesh.axis_names)
+    return P(names if len(names) > 1 else names[0])
 
 
 def assign_shards(group_inputs, num_shards: int) -> List[List[int]]:
@@ -139,15 +192,17 @@ def pack_cluster_sharded(
 
 def make_sharded_decider(mesh: Mesh):
     """jitted ``(sharded_cluster, now_sec) -> DecisionArrays`` with the leading shard
-    axis partitioned over the mesh. Local blocks may hold several shards (vmap'ed);
-    no collectives are emitted — per-group decisions are shard-local by construction."""
+    axis partitioned over the mesh (1-D or hybrid). Local blocks may hold several
+    shards (vmap'ed); no collectives are emitted — per-group decisions are
+    shard-local by construction."""
+    spec = _group_spec(mesh)
 
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(GROUP_AXIS), P()),
-        out_specs=P(GROUP_AXIS),
+        in_specs=(spec, P()),
+        out_specs=spec,
     )
     def sharded_decide(cluster: ClusterArrays, now_sec) -> DecisionArrays:
         return jax.vmap(decide, in_axes=(0, None))(cluster, now_sec)
@@ -155,9 +210,60 @@ def make_sharded_decider(mesh: Mesh):
     return sharded_decide
 
 
+#: Fleet-total field -> DecisionArrays source expression, shared by the device
+#: (psum) and host (numpy) reduction paths so they cannot drift.
+_FLEET_FIELDS = {
+    "pods": lambda o: o.num_pods,
+    "nodes": lambda o: o.num_nodes,
+    "untainted": lambda o: o.num_untainted,
+    "tainted": lambda o: o.num_tainted,
+    "cordoned": lambda o: o.num_cordoned,
+    "cpu_request_milli": lambda o: o.cpu_request_milli,
+    "mem_request_bytes": lambda o: o.mem_request_bytes,
+    "scale_up_groups": lambda o: (o.nodes_delta > 0).astype(jnp.int32),
+    "scale_down_groups": lambda o: (o.nodes_delta < 0).astype(jnp.int32),
+}
+
+
+def make_fleet_decider(mesh: Mesh):
+    """Like :func:`make_sharded_decider` but also returns fleet-wide totals reduced
+    **inside** the device program with ``jax.lax.psum`` over the mesh axes. On a
+    hybrid mesh the reduction is staged ``ici`` then ``dcn``, so the big per-chip
+    partials combine over fast intra-host links and only one small vector crosses
+    hosts — the layered-collective pattern the reference has no analog of (its
+    "fleet view" is 25 Prometheus gauges scraped over HTTP, pkg/metrics/metrics.go).
+    """
+    spec = _group_spec(mesh)
+    axis_names = tuple(mesh.axis_names)
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, P()),
+        out_specs=(spec, P()),
+    )
+    def fleet_decide(cluster: ClusterArrays, now_sec):
+        out = jax.vmap(decide, in_axes=(0, None))(cluster, now_sec)
+        # one vector, one staged reduction — not one collective per field
+        local = jnp.stack(
+            [jnp.sum(get(out).astype(jnp.int64)) for get in _FLEET_FIELDS.values()]
+        )
+        if len(axis_names) > 1:
+            # staged: fast axis first, then the cross-host hop
+            local = jax.lax.psum(local, ICI_AXIS)
+            local = jax.lax.psum(local, DCN_AXIS)
+        else:
+            local = jax.lax.psum(local, axis_names[0])
+        totals = {name: local[i] for i, name in enumerate(_FLEET_FIELDS)}
+        return out, totals
+
+    return fleet_decide
+
+
 def shard_cluster_arrays(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
     """Place stacked cluster arrays so the shard axis lives on the mesh devices."""
-    sharding = NamedSharding(mesh, P(GROUP_AXIS))
+    sharding = NamedSharding(mesh, _group_spec(mesh))
     leaves, aux = cluster.tree_flatten()
     placed = [jax.device_put(leaf, sharding) for leaf in leaves]
     return ClusterArrays.tree_unflatten(aux, placed)
@@ -166,15 +272,6 @@ def shard_cluster_arrays(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
 def fleet_totals(out: DecisionArrays) -> dict:
     """Fleet-wide aggregates over all shards/groups (the reference's global metrics
     analog). Computed as reductions over the sharded outputs — XLA turns these into
-    psum-style collectives over ICI when the outputs are device-resident."""
-    return {
-        "pods": int(jnp.sum(out.num_pods)),
-        "nodes": int(jnp.sum(out.num_nodes)),
-        "untainted": int(jnp.sum(out.num_untainted)),
-        "tainted": int(jnp.sum(out.num_tainted)),
-        "cordoned": int(jnp.sum(out.num_cordoned)),
-        "cpu_request_milli": int(jnp.sum(out.cpu_request_milli)),
-        "mem_request_bytes": int(jnp.sum(out.mem_request_bytes)),
-        "scale_up_groups": int(jnp.sum(out.nodes_delta > 0)),
-        "scale_down_groups": int(jnp.sum(out.nodes_delta < 0)),
-    }
+    psum-style collectives over ICI when the outputs are device-resident. For the
+    in-program staged reduction, use :func:`make_fleet_decider`."""
+    return {name: int(jnp.sum(get(out))) for name, get in _FLEET_FIELDS.items()}

@@ -92,3 +92,73 @@ def test_fleet_totals(cpu_mesh):
     totals = meshlib.fleet_totals(out)
     assert totals["pods"] == sum(len(p) for p, *_ in groups)
     assert totals["nodes"] == sum(len(n) for _, n, *_ in groups)
+
+
+class TestHybridMesh:
+    def test_hybrid_matches_1d(self, cpu_mesh):
+        rng = random.Random(7)
+        groups = [random_group(rng, gi) for gi in range(32)]
+
+        def fresh(groups):
+            return [
+                (p, n, c, sem.GroupState(**vars(s))) for (p, n, c, s) in groups
+            ]
+
+        sharded, _ = meshlib.pack_cluster_sharded(fresh(groups), num_shards=8)
+        out1 = meshlib.make_sharded_decider(cpu_mesh)(
+            meshlib.shard_cluster_arrays(sharded, cpu_mesh), NOW
+        )
+
+        hybrid = meshlib.make_hybrid_mesh(jax.devices(), num_hosts=2)
+        assert hybrid.axis_names == (meshlib.DCN_AXIS, meshlib.ICI_AXIS)
+        assert hybrid.devices.shape == (2, 4)
+        out2 = meshlib.make_sharded_decider(hybrid)(
+            meshlib.shard_cluster_arrays(sharded, hybrid), NOW
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out1.nodes_delta), np.asarray(out2.nodes_delta)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out1.status), np.asarray(out2.status)
+        )
+
+    def test_fleet_decider_staged_psum(self, cpu_mesh):
+        rng = random.Random(13)
+        groups = [random_group(rng, gi) for gi in range(16)]
+        sharded, _ = meshlib.pack_cluster_sharded(groups, num_shards=8)
+
+        hybrid = meshlib.make_hybrid_mesh(jax.devices(), num_hosts=2)
+        placed = meshlib.shard_cluster_arrays(sharded, hybrid)
+        out, totals = meshlib.make_fleet_decider(hybrid)(placed, NOW)
+        host_totals = meshlib.fleet_totals(out)
+        for name, val in host_totals.items():
+            assert int(totals[name]) == val, name
+
+    def test_fleet_decider_1d(self, cpu_mesh):
+        rng = random.Random(17)
+        groups = [random_group(rng, gi) for gi in range(8)]
+        sharded, _ = meshlib.pack_cluster_sharded(groups, num_shards=8)
+        placed = meshlib.shard_cluster_arrays(sharded, cpu_mesh)
+        out, totals = meshlib.make_fleet_decider(cpu_mesh)(placed, NOW)
+        assert int(totals["pods"]) == sum(len(p) for p, *_ in groups)
+
+    def test_uneven_hosts_rejected(self):
+        with pytest.raises(ValueError):
+            meshlib.make_hybrid_mesh(jax.devices(), num_hosts=3)
+
+
+class TestDistributedInit:
+    def test_no_config_stays_single_host(self, monkeypatch):
+        from escalator_tpu.parallel import distributed
+
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                    "JAX_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        assert distributed.initialize() is False
+
+    def test_global_hybrid_mesh(self):
+        from escalator_tpu.parallel import distributed
+
+        mesh = distributed.global_hybrid_mesh()
+        assert mesh.devices.size == 8  # all virtual devices, 1 "host"
+        assert mesh.devices.shape[0] == 1
