@@ -51,8 +51,10 @@ def _rng_cluster_arrays(
     else:
         pod_cpu = np.full(P, 500, np.int64)
         pod_mem = np.full(P, 10**9, np.int64)
-    pod_group = rng.integers(0, G, P).astype(np.int32)
-    node_group = rng.integers(0, G, N).astype(np.int32)
+    # group-contiguous layout, as the packer / native store emit (pods and nodes
+    # are appended per group): required by the Pallas windowed-sweep fast path
+    pod_group = np.sort(rng.integers(0, G, P)).astype(np.int32)
+    node_group = np.sort(rng.integers(0, G, N)).astype(np.int32)
     if heterogeneous:
         node_cpu = rng.choice([2000, 4000, 8000, 16000], N).astype(np.int64)
         node_mem = rng.choice([8, 16, 32, 64], N).astype(np.int64) * 10**9
@@ -86,17 +88,17 @@ def _rng_cluster_arrays(
     return ClusterArrays(groups=groups, pods=pods, nodes=nodes)
 
 
-def _time_decide(cluster, now, iters=20):
+def _time_decide(cluster, now, iters=20, impl="xla"):
     import jax
 
     from escalator_tpu.ops.kernel import decide_jit
 
-    out = decide_jit(cluster, now)  # compile + warm
+    out = decide_jit(cluster, now, impl=impl)  # compile + warm
     jax.block_until_ready(out)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = decide_jit(cluster, now)
+        out = decide_jit(cluster, now, impl=impl)
         jax.block_until_ready(out)
         times.append((time.perf_counter() - t0) * 1e3)
     return float(np.median(times))
@@ -137,6 +139,11 @@ def main() -> None:
     )
     headline = _time_decide(headline_cluster, now)
     detail["cfg4_2048ng_100kpods_ms"] = headline
+    # same config through the fused Pallas aggregation sweep (ops/pallas_kernel)
+    try:
+        detail["cfg4_pallas_ms"] = _time_decide(headline_cluster, now, impl="pallas")
+    except Exception as e:  # pragma: no cover - keep bench robust to platform gaps
+        detail["cfg4_pallas_error"] = str(e)
     # 5. scale-down ordering: 10k pods, heavy taint/cordon masking
     detail["cfg5_scaledown_10kpods_ms"] = _time_decide(
         put(

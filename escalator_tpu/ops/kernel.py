@@ -101,8 +101,16 @@ def _grouped_order(keys, selected, group, num_groups):
     return perm1[perm2].astype(_I32)
 
 
-def decide(cluster: ClusterArrays, now_sec: jnp.ndarray) -> DecisionArrays:
-    """Evaluate every nodegroup's scale decision. Pure; shapes static; jit-safe."""
+def decide(
+    cluster: ClusterArrays, now_sec: jnp.ndarray, impl: str = "xla"
+) -> DecisionArrays:
+    """Evaluate every nodegroup's scale decision. Pure; shapes static; jit-safe.
+
+    impl selects the aggregation sweep: "xla" = one scatter-add per column
+    (jax.ops.segment_sum); "pallas" = the fused windowed one-hot-matmul MXU
+    kernel (ops.pallas_kernel), which self-falls-back to the scatter path on
+    device when its layout/range preconditions fail. Outputs are bit-identical.
+    """
     g: GroupArrays = cluster.groups
     p: PodArrays = cluster.pods
     n: NodeArrays = cluster.nodes
@@ -112,23 +120,55 @@ def decide(cluster: ClusterArrays, now_sec: jnp.ndarray) -> DecisionArrays:
     pvalid = p.valid
     pgroup = jnp.where(pvalid, p.group, 0)
     pw = pvalid.astype(_I64)
-    cpu_req = _segsum(p.cpu_milli * pw, pgroup, G)
-    mem_req = _segsum(p.mem_bytes * pw, pgroup, G)
-    num_pods = _segsum(pw, pgroup, G).astype(_I32)
 
     nvalid = n.valid
     ngroup = jnp.where(nvalid, n.group, 0)
     untainted_sel = nvalid & ~n.tainted & ~n.cordoned
     tainted_sel = nvalid & n.tainted & ~n.cordoned
     cordoned_sel = nvalid & n.cordoned
-
     uw = untainted_sel.astype(_I64)
-    cpu_cap = _segsum(n.cpu_milli * uw, ngroup, G)
-    mem_cap = _segsum(n.mem_bytes * uw, ngroup, G)
-    num_nodes = _segsum(nvalid.astype(_I64), ngroup, G).astype(_I32)
-    num_untainted = _segsum(uw, ngroup, G).astype(_I32)
-    num_tainted = _segsum(tainted_sel.astype(_I64), ngroup, G).astype(_I32)
-    num_cordoned = _segsum(cordoned_sel.astype(_I64), ngroup, G).astype(_I32)
+
+    if impl == "pallas":
+        from escalator_tpu.ops import pallas_kernel
+
+        pod_sums = pallas_kernel.fused_segment_sums(
+            pgroup,
+            pvalid,
+            {"cpu_req": p.cpu_milli * pw, "mem_req": p.mem_bytes * pw},
+            {"num_pods": pvalid},
+            num_segments=G,
+        )
+        node_sums = pallas_kernel.fused_segment_sums(
+            ngroup,
+            nvalid,
+            {"cpu_cap": n.cpu_milli * uw, "mem_cap": n.mem_bytes * uw},
+            {
+                "num_nodes": nvalid,
+                "num_untainted": untainted_sel,
+                "num_tainted": tainted_sel,
+                "num_cordoned": cordoned_sel,
+            },
+            num_segments=G,
+        )
+        cpu_req = pod_sums["cpu_req"]
+        mem_req = pod_sums["mem_req"]
+        num_pods = pod_sums["num_pods"].astype(_I32)
+        cpu_cap = node_sums["cpu_cap"]
+        mem_cap = node_sums["mem_cap"]
+        num_nodes = node_sums["num_nodes"].astype(_I32)
+        num_untainted = node_sums["num_untainted"].astype(_I32)
+        num_tainted = node_sums["num_tainted"].astype(_I32)
+        num_cordoned = node_sums["num_cordoned"].astype(_I32)
+    else:
+        cpu_req = _segsum(p.cpu_milli * pw, pgroup, G)
+        mem_req = _segsum(p.mem_bytes * pw, pgroup, G)
+        num_pods = _segsum(pw, pgroup, G).astype(_I32)
+        cpu_cap = _segsum(n.cpu_milli * uw, ngroup, G)
+        mem_cap = _segsum(n.mem_bytes * uw, ngroup, G)
+        num_nodes = _segsum(nvalid.astype(_I64), ngroup, G).astype(_I32)
+        num_untainted = _segsum(uw, ngroup, G).astype(_I32)
+        num_tainted = _segsum(tainted_sel.astype(_I64), ngroup, G).astype(_I32)
+        num_cordoned = _segsum(cordoned_sel.astype(_I64), ngroup, G).astype(_I32)
 
     # ---- percent usage (pkg/controller/util.go:58-81) ----
     # Memory percent uses MilliValue (= bytes*1000) in the reference; replicate the
@@ -313,4 +353,4 @@ def decide(cluster: ClusterArrays, now_sec: jnp.ndarray) -> DecisionArrays:
 
 #: jitted entry point; backend chosen by JAX (TPU when present, else CPU) — the CPU
 #: fallback is the same traced program, keeping parity guarantees cheap (SURVEY.md §7).
-decide_jit = jax.jit(decide)
+decide_jit = jax.jit(decide, static_argnames=("impl",))

@@ -1,0 +1,259 @@
+"""Pallas TPU kernel for the hot aggregation op: exact int64 segment sums.
+
+The decision kernel's dominant data-shaped work is segment-summing the flat
+``[P]`` pod and ``[N]`` node columns into ``[G]`` per-group aggregates
+(replacing the reference's per-pod Go loops, /root/reference/pkg/k8s/util.go:27-51).
+XLA lowers ``jax.ops.segment_sum`` to one scatter-add per column — eight
+independent sweeps over the arrays. This module fuses them into ONE Pallas
+sweep that rides the MXU:
+
+- the packer stores pods/nodes group-contiguously, so each tile of ``T=512``
+  lanes touches a narrow, contiguous window of group ids; the tile's
+  contribution to the per-group totals is then a one-hot matmul
+  ``onehot[W, T] @ columns[T, C]`` — the classic TPU recipe for sorted-segment
+  reduction (scatter becomes a systolic-array contraction);
+- int64 columns are decomposed into six 8-bit limbs lifted to f32. 8-bit
+  integers survive the MXU's bf16 input rounding exactly (f32 matmuls on TPU
+  run as bf16 passes by default), per-tile partial sums stay below 2^24 where
+  f32 accumulation is exact, and the on-chip cross-tile accumulator is int32
+  (exact below 2^31 — safe for ≤ 2^23 lanes). The limbs recombine to int64
+  outside the kernel. The result is **bit-exact** against the XLA scatter
+  path for values < 2^48;
+- per-tile window bases ride in as scalar-prefetch arguments (SMEM), aligned
+  down to the 128-lane boundary so the accumulator store is a static-size,
+  aligned dynamic slice.
+
+The group-contiguity invariant can be broken by the device-resident
+incremental path (``ops.device_state`` reuses free slots across groups), and
+values ≥ 2^48 (256 TB memory requests) exceed the limb range, so the wrapper
+checks both preconditions on device and falls back to the XLA scatter path
+via ``lax.cond`` — same outputs either way, so callers see one function.
+
+No reference analog: Escalator has no accelerator kernels at all (SURVEY.md
+§1 "no native code"); this is the TPU-first replacement for its hot loop.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict
+
+import numpy as np
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: lanes (pods/nodes) per grid step
+TILE = 512
+#: group-id window per tile; contributions land in [base, base+WINDOW)
+WINDOW = 512
+#: window bases are aligned down to this (TPU lane/sublane friendliness)
+ALIGN = 128
+#: max (max_id - min_id) per tile for the fast path: base >= min-(ALIGN-1)
+#: and max <= base+WINDOW-1 must hold
+MAX_SPREAD = WINDOW - ALIGN
+#: limb decomposition of int64 columns: LIMBS limbs of LIMB_BITS bits each.
+#: 8-bit limbs are exactly representable in bf16, so the MXU's single-pass
+#: bf16 f32-matmul is exact regardless of precision flags; per-tile partials
+#: stay < 2^24 (exact f32 accumulation) and the cross-tile int32 accumulator
+#: is exact for up to 2^23 lanes.
+LIMB_BITS = 8
+LIMBS = 6
+#: supported value range for the fast path
+MAX_VALUE = 1 << (LIMB_BITS * LIMBS)  # 2^48
+#: column capacity of one kernel invocation (f32 sublane multiple)
+MAX_COLS = 16
+
+_interp_env = os.environ.get("ESCALATOR_TPU_PALLAS_INTERPRET")
+
+
+def _use_interpret() -> bool:
+    """Interpret off-TPU (tests on the CPU backend); compiled on TPU."""
+    if _interp_env is not None:
+        return _interp_env not in ("0", "false", "")
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _agg_kernel(bases_ref, ids_ref, cols_ref, out_ref):
+    """One grid step: tile i's one-hot matmul contribution, accumulated.
+
+    bases_ref: [n_tiles] int32 (SMEM, scalar-prefetched) aligned window bases
+    ids_ref:   (1, 1, TILE) int32 group ids of this tile
+    cols_ref:  (MAX_COLS, TILE) f32 limb/count columns of this tile
+    out_ref:   (G_out, MAX_COLS) int32 running totals (whole array in VMEM)
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
+
+    base = bases_ref[i]
+    rel = ids_ref[0, 0, :] - base  # (TILE,) in [0, WINDOW) for in-window lanes
+    lane = jax.lax.broadcasted_iota(jnp.int32, (WINDOW, TILE), 0)
+    onehot = (lane == jnp.broadcast_to(rel[None, :], (WINDOW, TILE))).astype(
+        jnp.float32
+    )
+    # (WINDOW, TILE) @ (MAX_COLS, TILE)^T -> (WINDOW, MAX_COLS) on the MXU;
+    # every partial is an integer < 2^24, exact in f32.
+    contrib = lax.dot_general(
+        onehot,
+        cols_ref[:, :],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    win = pl.ds(base, WINDOW)
+    out_ref[win, :] = out_ref[win, :] + contrib.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def _pallas_partials(ids, cols, bases, num_segments: int, interpret: bool):
+    """[G_out, MAX_COLS] int32 totals from the tiled one-hot-matmul sweep."""
+    n_tiles = ids.shape[0]
+    g_out = _round_up(num_segments, ALIGN) + WINDOW
+    # index maps must emit int32: under jax_enable_x64 a Python literal 0
+    # traces as i64, which Mosaic refuses to legalize in the block-transform
+    # function — np.int32 keeps the dtype without capturing a tracer
+    zero = np.int32(0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, TILE), lambda i, *_: (i, zero, zero), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (MAX_COLS, TILE), lambda i, *_: (zero, i), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (g_out, MAX_COLS), lambda i, *_: (zero, zero), memory_space=pltpu.VMEM
+        ),
+    )
+    return pl.pallas_call(
+        _agg_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((g_out, MAX_COLS), jnp.int32),
+        interpret=interpret,
+    )(bases, ids, cols)
+
+
+def fused_segment_sums(
+    ids,
+    valid,
+    int_columns: Dict[str, jnp.ndarray],
+    count_columns: Dict[str, jnp.ndarray],
+    num_segments: int,
+    interpret: bool | None = None,
+) -> Dict[str, jnp.ndarray]:
+    """Exact per-segment sums of all columns in one fused device sweep.
+
+    ids:           [P] int32 segment (group) ids
+    valid:         [P] bool; invalid lanes contribute nothing
+    int_columns:   name -> [P] int64 (values must be pre-masked: invalid
+                   lanes zero). Fast path requires 0 <= v < 2^48.
+    count_columns: name -> [P] bool/int 0-1 weights (pre-masked likewise)
+    returns        name -> [num_segments] int64
+
+    Chooses between the Pallas windowed-matmul sweep and plain XLA
+    ``segment_sum`` per column with ``lax.cond``, based on on-device
+    precondition checks (group-contiguous layout, value range). Traceable;
+    fixed shapes; jit-safe.
+    """
+    n_int = len(int_columns)
+    n_cnt = len(count_columns)
+    if n_int * LIMBS + n_cnt > MAX_COLS:
+        raise ValueError("too many columns for one fused sweep")
+    if interpret is None:
+        interpret = _use_interpret()
+
+    P = ids.shape[0]
+    P_pad = _round_up(max(P, TILE), TILE)
+    n_tiles = P_pad // TILE
+    names = list(int_columns) + list(count_columns)
+
+    ids32 = ids.astype(jnp.int32)
+    pad = P_pad - P
+    # edge-pad ids (keeps per-tile spread tight); zero-pad values
+    ids_p = jnp.pad(ids32, (0, pad), mode="edge" if P else "constant")
+    valid_p = jnp.pad(valid, (0, pad))
+
+    ids2 = ids_p.reshape(n_tiles, TILE)
+    valid2 = valid_p.reshape(n_tiles, TILE)
+    big = jnp.int32(1 << 30)
+    tile_min = jnp.min(jnp.where(valid2, ids2, big), axis=1)
+    tile_max = jnp.max(jnp.where(valid2, ids2, -1), axis=1)
+    spread_ok = jnp.all(tile_max - tile_min <= MAX_SPREAD)
+    in_range = jnp.bool_(True)
+    for col in int_columns.values():
+        in_range &= jnp.all((col >= 0) & (col < MAX_VALUE))
+
+    def xla_path(_):
+        out = []
+        for name in names:
+            col = int_columns.get(name)
+            if col is None:
+                col = count_columns[name].astype(jnp.int64)
+            out.append(
+                jax.ops.segment_sum(
+                    col.astype(jnp.int64), ids32, num_segments=num_segments
+                )
+            )
+        return tuple(out)
+
+    limb_mask = (1 << LIMB_BITS) - 1
+
+    def pallas_path(_):
+        # invalid lanes: point ids at the tile's window (their values are zero)
+        tile_min_ok = jnp.where(tile_min == big, 0, tile_min)
+        ids_clean = jnp.where(valid2, ids2, tile_min_ok[:, None])
+        g_out = _round_up(num_segments, ALIGN) + WINDOW
+        bases = jnp.clip((tile_min_ok // ALIGN) * ALIGN, 0, g_out - WINDOW).astype(
+            jnp.int32
+        )
+
+        col_rows = []
+        for col in int_columns.values():
+            col_p = jnp.pad(col, (0, pad))
+            for k in range(LIMBS):
+                col_rows.append(
+                    ((col_p >> (LIMB_BITS * k)) & limb_mask).astype(jnp.float32)
+                )
+        for col in count_columns.values():
+            col_rows.append(jnp.pad(col.astype(jnp.float32), (0, pad)))
+        while len(col_rows) < MAX_COLS:
+            col_rows.append(jnp.zeros(P_pad, jnp.float32))
+        cols = jnp.stack(col_rows)  # [MAX_COLS, P_pad]
+
+        totals = _pallas_partials(
+            ids_clean[:, None, :], cols, bases,
+            num_segments=num_segments, interpret=interpret,
+        ).astype(jnp.int64)  # [G_out, MAX_COLS]
+
+        out = []
+        ci = 0
+        for _ in int_columns:
+            v = jnp.zeros(num_segments, jnp.int64)
+            for k in range(LIMBS):
+                v = v + (totals[:num_segments, ci] << (LIMB_BITS * k))
+                ci += 1
+            out.append(v)
+        for _ in count_columns:
+            out.append(totals[:num_segments, ci])
+            ci += 1
+        return tuple(out)
+
+    results = lax.cond(spread_ok & in_range, pallas_path, xla_path, None)
+    return dict(zip(names, results))

@@ -49,6 +49,15 @@ def initialize(
         process_id = int(env_pid)
 
     if coordinator_address is None and num_processes is None:
+        if process_id is not None:
+            # A lone JAX_PROCESS_ID means a broken fleet template, not intentional
+            # single-host mode: degrading silently would leave every OTHER host
+            # blocked in jax.distributed.initialize waiting for this one to join.
+            raise RuntimeError(
+                "partial distributed configuration: process_id is set but "
+                "coordinator_address/num_processes are missing (check "
+                "JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES)"
+            )
         log.debug("no distributed configuration; staying single-host")
         return False
 

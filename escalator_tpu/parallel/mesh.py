@@ -81,12 +81,14 @@ def make_hybrid_mesh(
     # When the list spans real processes, every dcn row must be a single host —
     # otherwise the "ici = fast intra-host links" layout claim is silently false.
     # (Single-process device lists may be split into virtual hosts for testing.)
-    if len({d.process_index for d in devs}) > 1:
+    real_hosts = len({d.process_index for d in devs})
+    if real_hosts > 1:
         for row in arr:
             if len({d.process_index for d in row}) != 1:
                 raise ValueError(
-                    "uneven devices-per-host: a dcn row would span hosts; pass a "
-                    "device list with equal per-host device counts"
+                    f"a dcn row would span processes: num_hosts={num_hosts} does "
+                    f"not match the {real_hosts} distinct processes in the device "
+                    "list (or per-host device counts are uneven)"
                 )
     return Mesh(arr, (DCN_AXIS, ICI_AXIS))
 
