@@ -187,9 +187,11 @@ def main() -> None:
         for t in range(10):
             churn_uids = [f"p{(t * 1000 + i) % 100_000}" for i in range(1000)]
             churn_groups = rng.integers(0, 2048, 1000)
+            churn_cpu = np.full(1000, 250)
+            churn_mem = np.full(1000, 10**9)
             t0 = time.perf_counter()
             store.upsert_pods_batch(  # 1% churn, one native call
-                churn_uids, churn_groups, np.full(1000, 250), np.full(1000, 10**9)
+                churn_uids, churn_groups, churn_cpu, churn_mem
             )
             pod_dirty, node_dirty = store.drain_dirty()
             cache.apply_dirty(pod_dirty, node_dirty)

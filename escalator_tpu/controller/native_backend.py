@@ -132,6 +132,9 @@ class NativeJaxBackend(ComputeBackend):
                 np.concatenate([node_dirty, self._overridden_slots, overridden])
             )
             self._cache.set_host(pods, nodes)
+            # two async dispatches (scatter, then decide) pipeline back-to-back;
+            # measured faster than the fused single-program alternative
+            # (DeviceClusterCache.apply_dirty_and_decide) on the v5e tunnel
             self._cache.apply_dirty(pod_dirty, node_dirty, groups)
         self._overridden_slots = overridden
         t1 = time.perf_counter()

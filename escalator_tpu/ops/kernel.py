@@ -111,6 +111,8 @@ def decide(
     kernel (ops.pallas_kernel), which self-falls-back to the scatter path on
     device when its layout/range preconditions fail. Outputs are bit-identical.
     """
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown aggregation impl {impl!r}")
     g: GroupArrays = cluster.groups
     p: PodArrays = cluster.pods
     n: NodeArrays = cluster.nodes

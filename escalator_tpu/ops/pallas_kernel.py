@@ -69,6 +69,9 @@ LIMB_BITS = 8
 LIMBS = 6
 #: supported value range for the fast path
 MAX_VALUE = 1 << (LIMB_BITS * LIMBS)  # 2^48
+#: lane-count ceiling for the fast path: per-segment limb totals must stay
+#: below 2^31 in the int32 accumulator (lanes * (2^LIMB_BITS - 1) < 2^31)
+MAX_LANES = 1 << 23
 #: column capacity of one kernel invocation (f32 sublane multiple)
 MAX_COLS = 16
 
@@ -180,6 +183,16 @@ def fused_segment_sums(
         interpret = _use_interpret()
 
     P = ids.shape[0]
+    if P > MAX_LANES:
+        # beyond the int32 accumulator's exactness bound: the scatter path is
+        # the only exact option (static shapes, so this is a trace-time branch)
+        ids32 = ids.astype(jnp.int32)
+        out = {}
+        for name, col in {**int_columns, **count_columns}.items():
+            out[name] = jax.ops.segment_sum(
+                col.astype(jnp.int64), ids32, num_segments=num_segments
+            )
+        return out
     P_pad = _round_up(max(P, TILE), TILE)
     n_tiles = P_pad // TILE
     names = list(int_columns) + list(count_columns)

@@ -115,6 +115,44 @@ class TestIncrementalParity:
             full = _decide_full(store, groups, now)
             _assert_same_decisions(incremental, full)
 
+    def test_fused_apply_and_decide_matches_two_step(self):
+        """apply_dirty_and_decide == apply_dirty + decide_jit on the same churn."""
+        rng = np.random.default_rng(7)
+        store = statestore.NativeStateStore(pod_capacity=256, node_capacity=128)
+        store2 = statestore.NativeStateStore(pod_capacity=256, node_capacity=128)
+        groups = _groups(8)
+        now = np.int64(1_700_000_000)
+        for s in (store, store2):
+            for i in range(100):
+                s.upsert_pod(f"p{i}", i % 8, 500, 10**9)
+            for i in range(40):
+                s.upsert_node(f"n{i}", i % 8, 4000, 16 * 10**9, creation_ns=i + 1)
+            s.drain_dirty()
+        p1, n1 = store.as_pod_node_arrays()
+        p2, n2 = store2.as_pod_node_arrays()
+        fused = DeviceClusterCache(ClusterArrays(groups=groups, pods=p1, nodes=n1))
+        twostep = DeviceClusterCache(ClusterArrays(groups=groups, pods=p2, nodes=n2))
+
+        for tick in range(3):
+            for k in range(20):
+                for s in (store, store2):
+                    s.upsert_pod(f"p{(tick * 20 + k) % 110}", k % 8, 250, 10**9)
+                    if k % 5 == 0:
+                        s.delete_node(f"n{(tick + k) % 45}")
+            ps, ns = store.drain_dirty()
+            out_fused = fused.apply_dirty_and_decide(ps, ns, now, groups)
+            ps2, ns2 = store2.drain_dirty()
+            twostep.apply_dirty(ps2, ns2, groups)
+            out_two = decide_jit(twostep.cluster, now)
+            # both sides carry the cache's scratch lane: compare verbatim
+            for f in ("status", "nodes_delta", "num_pods", "cpu_request_milli",
+                      "reap_mask", "node_pods_remaining", "num_untainted"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(out_fused, f)),
+                    np.asarray(getattr(out_two, f)),
+                    err_msg=f,
+                )
+
     def test_empty_delta_tick(self):
         store = statestore.NativeStateStore(pod_capacity=64, node_capacity=32)
         groups = _groups(2)
