@@ -101,34 +101,31 @@ def _grouped_order(keys, selected, group, num_groups):
     return perm1[perm2].astype(_I32)
 
 
-def decide(
-    cluster: ClusterArrays, now_sec: jnp.ndarray, impl: str = "xla"
-) -> DecisionArrays:
-    """Evaluate every nodegroup's scale decision. Pure; shapes static; jit-safe.
+def aggregate_pods(p: PodArrays, node_group: jnp.ndarray, G: int, N: int,
+                   impl: str = "xla"):
+    """Per-group pod-request sums + per-node pod counts — the O(P) sweep
+    (replaces pkg/k8s/util.go:27-38). Separable from the node sweep so the
+    pod-axis-sharded path (parallel/podaxis.py) can psum partial results:
+    every output is a plain sum over pods, so partial sums over pod shards
+    combine exactly.
 
-    impl selects the aggregation sweep: "xla" = one scatter-add per column
-    (jax.ops.segment_sum); "pallas" = the fused windowed one-hot-matmul MXU
-    kernel (ops.pallas_kernel), which self-falls-back to the scatter path on
-    device when its layout/range preconditions fail. Outputs are bit-identical.
+    node_group is the full ``[N]`` node->group vector (needed for the
+    same-group pod filter of node_pods_remaining, controller.go:259).
+    Returns (cpu_req[G] i64, mem_req[G] i64, num_pods[G] i64,
+    node_pods_remaining[N] i64) — callers downcast counts.
     """
-    if impl not in ("xla", "pallas"):
-        raise ValueError(f"unknown aggregation impl {impl!r}")
-    g: GroupArrays = cluster.groups
-    p: PodArrays = cluster.pods
-    n: NodeArrays = cluster.nodes
-    G = g.valid.shape[0]
-
-    # ---- aggregation (replaces pkg/k8s/util.go:27-51 per-group loops) ----
     pvalid = p.valid
     pgroup = jnp.where(pvalid, p.group, 0)
     pw = pvalid.astype(_I64)
 
-    nvalid = n.valid
-    ngroup = jnp.where(nvalid, n.group, 0)
-    untainted_sel = nvalid & ~n.tainted & ~n.cordoned
-    tainted_sel = nvalid & n.tainted & ~n.cordoned
-    cordoned_sel = nvalid & n.cordoned
-    uw = untainted_sel.astype(_I64)
+    pod_node = jnp.where(pvalid & (p.node >= 0), p.node, 0)
+    pod_on_node_w = (
+        pvalid
+        & (p.node >= 0)
+        # a pod only counts for its own group's node-info map (the reference
+        # builds the map from group-filtered pod+node lists, controller.go:259)
+        & (p.group == node_group[jnp.clip(p.node, 0, N - 1)])
+    )
 
     if impl == "pallas":
         from escalator_tpu.ops import pallas_kernel
@@ -140,6 +137,31 @@ def decide(
             {"num_pods": pvalid},
             num_segments=G,
         )
+        cpu_req = pod_sums["cpu_req"]
+        mem_req = pod_sums["mem_req"]
+        num_pods = pod_sums["num_pods"]
+    else:
+        cpu_req = _segsum(p.cpu_milli * pw, pgroup, G)
+        mem_req = _segsum(p.mem_bytes * pw, pgroup, G)
+        num_pods = _segsum(pw, pgroup, G)
+    node_pods_remaining = _segsum(pod_on_node_w.astype(_I64), pod_node, N)
+    return cpu_req, mem_req, num_pods, node_pods_remaining
+
+
+def aggregate_nodes(n: NodeArrays, G: int, impl: str = "xla"):
+    """Per-group node capacity sums and partition counts — the O(N) sweep
+    (replaces pkg/k8s/util.go:41-51 and filterNodes counting). Pure sums, so
+    node-shard partials also combine by addition."""
+    nvalid = n.valid
+    ngroup = jnp.where(nvalid, n.group, 0)
+    untainted_sel = nvalid & ~n.tainted & ~n.cordoned
+    tainted_sel = nvalid & n.tainted & ~n.cordoned
+    cordoned_sel = nvalid & n.cordoned
+    uw = untainted_sel.astype(_I64)
+
+    if impl == "pallas":
+        from escalator_tpu.ops import pallas_kernel
+
         node_sums = pallas_kernel.fused_segment_sums(
             ngroup,
             nvalid,
@@ -152,25 +174,67 @@ def decide(
             },
             num_segments=G,
         )
-        cpu_req = pod_sums["cpu_req"]
-        mem_req = pod_sums["mem_req"]
-        num_pods = pod_sums["num_pods"].astype(_I32)
-        cpu_cap = node_sums["cpu_cap"]
-        mem_cap = node_sums["mem_cap"]
-        num_nodes = node_sums["num_nodes"].astype(_I32)
-        num_untainted = node_sums["num_untainted"].astype(_I32)
-        num_tainted = node_sums["num_tainted"].astype(_I32)
-        num_cordoned = node_sums["num_cordoned"].astype(_I32)
+        return (
+            node_sums["cpu_cap"],
+            node_sums["mem_cap"],
+            node_sums["num_nodes"],
+            node_sums["num_untainted"],
+            node_sums["num_tainted"],
+            node_sums["num_cordoned"],
+        )
+    return (
+        _segsum(n.cpu_milli * uw, ngroup, G),
+        _segsum(n.mem_bytes * uw, ngroup, G),
+        _segsum(nvalid.astype(_I64), ngroup, G),
+        _segsum(uw, ngroup, G),
+        _segsum(tainted_sel.astype(_I64), ngroup, G),
+        _segsum(cordoned_sel.astype(_I64), ngroup, G),
+    )
+
+
+def decide(
+    cluster: ClusterArrays,
+    now_sec: jnp.ndarray,
+    impl: str = "xla",
+    aggregates=None,
+) -> DecisionArrays:
+    """Evaluate every nodegroup's scale decision. Pure; shapes static; jit-safe.
+
+    impl selects the aggregation sweep: "xla" = one scatter-add per column
+    (jax.ops.segment_sum); "pallas" = the fused windowed one-hot-matmul MXU
+    kernel (ops.pallas_kernel), which self-falls-back to the scatter path on
+    device when its layout/range preconditions fail. Outputs are bit-identical.
+
+    aggregates optionally injects precomputed (pod_aggs, node_aggs) from
+    :func:`aggregate_pods`/:func:`aggregate_nodes` — used by the pod-axis
+    sharded path, which psums shard-partial sums into exactly these values.
+    """
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown aggregation impl {impl!r}")
+    g: GroupArrays = cluster.groups
+    p: PodArrays = cluster.pods
+    n: NodeArrays = cluster.nodes
+    G = g.valid.shape[0]
+    N = n.valid.shape[0]
+
+    # ---- aggregation (replaces pkg/k8s/util.go:27-51 per-group loops) ----
+    if aggregates is None:
+        pod_aggs = aggregate_pods(p, n.group, G, N, impl)
+        node_aggs = aggregate_nodes(n, G, impl)
     else:
-        cpu_req = _segsum(p.cpu_milli * pw, pgroup, G)
-        mem_req = _segsum(p.mem_bytes * pw, pgroup, G)
-        num_pods = _segsum(pw, pgroup, G).astype(_I32)
-        cpu_cap = _segsum(n.cpu_milli * uw, ngroup, G)
-        mem_cap = _segsum(n.mem_bytes * uw, ngroup, G)
-        num_nodes = _segsum(nvalid.astype(_I64), ngroup, G).astype(_I32)
-        num_untainted = _segsum(uw, ngroup, G).astype(_I32)
-        num_tainted = _segsum(tainted_sel.astype(_I64), ngroup, G).astype(_I32)
-        num_cordoned = _segsum(cordoned_sel.astype(_I64), ngroup, G).astype(_I32)
+        pod_aggs, node_aggs = aggregates
+    cpu_req, mem_req, num_pods64, node_pods_remaining64 = pod_aggs
+    cpu_cap, mem_cap, nn64, nu64, nt64, nc64 = node_aggs
+    num_pods = num_pods64.astype(_I32)
+    num_nodes = nn64.astype(_I32)
+    num_untainted = nu64.astype(_I32)
+    num_tainted = nt64.astype(_I32)
+    num_cordoned = nc64.astype(_I32)
+
+    nvalid = n.valid
+    ngroup = jnp.where(nvalid, n.group, 0)
+    untainted_sel = nvalid & ~n.tainted & ~n.cordoned
+    tainted_sel = nvalid & n.tainted & ~n.cordoned
 
     # ---- percent usage (pkg/controller/util.go:58-81) ----
     # Memory percent uses MilliValue (= bytes*1000) in the reference; replicate the
@@ -309,17 +373,7 @@ def decide(
     tainted_offsets = offsets(tainted_sel)
 
     # ---- reaper eligibility (pkg/controller/scale_down.go:51-99) ----
-    N = n.valid.shape[0]
-    pod_node = jnp.where(pvalid & (p.node >= 0), p.node, 0)
-    pod_on_node_w = (
-        pvalid
-        & (p.node >= 0)
-        # a pod only counts for its own group's node-info map (the reference builds
-        # the map from group-filtered pod+node lists, pkg/controller/controller.go:259)
-        & (p.group == n.group[jnp.clip(p.node, 0, N - 1)])
-    ).astype(_I64)
-    node_pods_remaining = _segsum(pod_on_node_w, pod_node, N).astype(_I32)
-
+    node_pods_remaining = node_pods_remaining64.astype(_I32)
     has_tt = n.taint_time_sec != NO_TAINT_TIME
     age = now_sec.astype(_I64) - n.taint_time_sec
     reap_mask = (

@@ -1,0 +1,128 @@
+"""Pod-axis sharding: the sequence-parallelism analog (SURVEY.md §5).
+
+``parallel.mesh`` shards the NODEGROUP axis — perfect when there are many
+groups, useless when one giant group holds most of the pods (a single
+million-pod `default` group saturates one device while the rest idle; the
+reference degrades the same way, one serial O(P) Go loop,
+/root/reference/pkg/k8s/util.go:27-38). This module shards the POD axis
+instead, the way sequence parallelism splits a long sequence:
+
+- the flat ``[P]`` pod arrays are split across the mesh devices (any split —
+  no group locality required, sums are order-free);
+- each device segment-sums its local pod shard into full ``[G]`` / ``[N]``
+  partials (requests per group, pods per node);
+- one ``jax.lax.psum`` over the mesh combines the partials — integer sums,
+  so the result is **bit-identical** to the single-device kernel;
+- the small replicated tail (``[G]`` percent/threshold math, ``[N]`` node
+  selections) runs identically on every device.
+
+Node arrays ride along replicated: N is orders of magnitude smaller than P
+(50k nodes vs 1M pods), and the selections need global argsorts anyway.
+
+Composes with the group-axis path: use ``mesh.ShardedJaxBackend`` for many
+groups, this for few-but-huge groups; both produce the same DecisionArrays
+contract.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from escalator_tpu.core.arrays import ClusterArrays, PodArrays
+from escalator_tpu.ops import device_state as _ds  # noqa: F401  (registers SoA pytrees)
+from escalator_tpu.ops import kernel
+
+
+def _pod_spec(mesh: Mesh) -> P:
+    names = tuple(mesh.axis_names)
+    return P(names if len(names) > 1 else names[0])
+
+
+def pad_pods_for_mesh(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
+    """Pad the pod axis to a multiple of the mesh size (shard_map needs equal
+    shards). Padding lanes are valid=False; masked inside the kernel."""
+    ndev = int(mesh.devices.size)
+    P_ = int(cluster.pods.valid.shape[0])
+    pad = (-P_) % ndev
+    if pad == 0:
+        return cluster
+    p = cluster.pods
+    pods = PodArrays(
+        group=np.concatenate([p.group, np.zeros(pad, p.group.dtype)]),
+        cpu_milli=np.concatenate([p.cpu_milli, np.zeros(pad, p.cpu_milli.dtype)]),
+        mem_bytes=np.concatenate([p.mem_bytes, np.zeros(pad, p.mem_bytes.dtype)]),
+        node=np.concatenate([p.node, np.full(pad, -1, p.node.dtype)]),
+        valid=np.concatenate([p.valid, np.zeros(pad, bool)]),
+    )
+    return ClusterArrays(groups=cluster.groups, pods=pods, nodes=cluster.nodes)
+
+
+def place(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
+    """Device-put with the pod axis sharded over the mesh, everything else
+    replicated — so the big transfer is split across devices too."""
+    pod_sharding = NamedSharding(mesh, _pod_spec(mesh))
+    repl = NamedSharding(mesh, P())
+    put = lambda soa, sh: type(soa)(
+        **{
+            f: jax.device_put(getattr(soa, f), sh)
+            for f in soa.__dataclass_fields__
+        }
+    )
+    return ClusterArrays(
+        groups=put(cluster.groups, repl),
+        pods=put(cluster.pods, pod_sharding),
+        nodes=put(cluster.nodes, repl),
+    )
+
+
+def make_podaxis_decider(mesh: Mesh, impl: str = "xla"):
+    """jitted ``(cluster, now_sec) -> DecisionArrays`` with the O(P) pod sweep
+    sharded over the mesh and combined with psum. Bit-identical to
+    ``kernel.decide`` on the same cluster (integer partial sums commute).
+
+    The pod axis length must be a multiple of the mesh size
+    (:func:`pad_pods_for_mesh`).
+    """
+    names = tuple(mesh.axis_names)
+    pod_spec = _pod_spec(mesh)
+
+    @jax.jit
+    def decide_podaxis(cluster: ClusterArrays, now_sec) -> kernel.DecisionArrays:
+        G = cluster.groups.valid.shape[0]
+        N = cluster.nodes.valid.shape[0]
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(pod_spec, P()),
+            out_specs=P(),
+            # pallas_call (impl="pallas") cannot express varying-mesh-axes
+            # metadata yet; the psum in the body establishes replication
+            check_vma=False,
+        )
+        def pod_sweep(pods: PodArrays, node_group):
+            partials = kernel.aggregate_pods(pods, node_group, G, N, impl)
+            summed = []
+            for x in partials:
+                for ax in reversed(names):
+                    x = jax.lax.psum(x, ax)
+                summed.append(x)
+            return tuple(summed)
+
+        pod_aggs = pod_sweep(cluster.pods, cluster.nodes.group)
+        node_aggs = kernel.aggregate_nodes(cluster.nodes, G, impl)
+        return kernel.decide(
+            cluster, now_sec, impl=impl, aggregates=(pod_aggs, node_aggs)
+        )
+
+    return decide_podaxis

@@ -1,0 +1,141 @@
+"""Pod-axis sharding (sequence-parallel analog): bit-exact vs single-device.
+
+Partial segment sums over pod shards psum to exactly the single-device
+aggregates (integer addition commutes), so the full DecisionArrays must match
+field-for-field on the 8-device virtual CPU mesh the conftest provides.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from escalator_tpu.core.arrays import (  # noqa: E402
+    NO_TAINT_TIME, ClusterArrays, GroupArrays, NodeArrays, PodArrays,
+)
+from escalator_tpu.ops import kernel  # noqa: E402
+from escalator_tpu.parallel import podaxis  # noqa: E402
+from escalator_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+NOW = np.int64(1_700_000_000)
+
+ALL_FIELDS = (
+    "status nodes_delta cpu_percent mem_percent cpu_request_milli "
+    "mem_request_bytes cpu_capacity_milli mem_capacity_bytes num_pods "
+    "num_nodes num_untainted num_tainted num_cordoned scale_down_order "
+    "untainted_offsets untaint_order tainted_offsets reap_mask "
+    "node_pods_remaining"
+).split()
+
+
+def _random_cluster(rng, G, P, N, giant_group=False):
+    if giant_group:
+        # one group owns ~90% of the pods: the case group-sharding cannot split
+        pod_group = np.where(
+            rng.random(P) < 0.9, 0, rng.integers(0, G, P)
+        ).astype(np.int32)
+    else:
+        pod_group = rng.integers(0, G, P).astype(np.int32)
+    tainted = rng.random(N) < 0.25
+    return ClusterArrays(
+        groups=GroupArrays(
+            min_nodes=rng.integers(0, 2, G).astype(np.int32),
+            max_nodes=np.full(G, 10**6, np.int32),
+            taint_lower=np.full(G, 30, np.int32),
+            taint_upper=np.full(G, 45, np.int32),
+            scale_up_thr=np.full(G, 70, np.int32),
+            slow_rate=np.ones(G, np.int32),
+            fast_rate=np.full(G, 3, np.int32),
+            locked=rng.random(G) < 0.1,
+            requested_nodes=rng.integers(0, 4, G).astype(np.int32),
+            cached_cpu_milli=np.full(G, 4000, np.int64),
+            cached_mem_bytes=np.full(G, 16 * 10**9, np.int64),
+            soft_grace_sec=np.full(G, 300, np.int64),
+            hard_grace_sec=np.full(G, 900, np.int64),
+            valid=np.ones(G, bool),
+        ),
+        pods=PodArrays(
+            group=pod_group,
+            cpu_milli=rng.integers(0, 8000, P).astype(np.int64),
+            mem_bytes=rng.integers(0, 32 * 10**9, P).astype(np.int64),
+            node=rng.integers(-1, N, P).astype(np.int32),
+            valid=rng.random(P) < 0.95,
+        ),
+        nodes=NodeArrays(
+            group=rng.integers(0, G, N).astype(np.int32),
+            cpu_milli=np.full(N, 4000, np.int64),
+            mem_bytes=np.full(N, 16 * 10**9, np.int64),
+            creation_ns=rng.integers(1, 10**12, N).astype(np.int64),
+            tainted=tainted,
+            cordoned=(~tainted) & (rng.random(N) < 0.05),
+            no_delete=rng.random(N) < 0.02,
+            taint_time_sec=np.where(
+                tainted, int(NOW) - rng.integers(0, 2000, N), NO_TAINT_TIME
+            ).astype(np.int64),
+            valid=rng.random(N) < 0.97,
+        ),
+    )
+
+
+@pytest.mark.parametrize("giant_group", [False, True])
+@pytest.mark.parametrize("P", [1000, 1001, 4096])  # 1001: exercises pod padding
+def test_podaxis_matches_single_device(P, giant_group):
+    rng = np.random.default_rng(P + int(giant_group))
+    cluster = _random_cluster(rng, G=16, P=P, N=200, giant_group=giant_group)
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+
+    mesh = make_mesh()
+    assert mesh.devices.size == 8  # conftest's virtual CPU mesh
+    padded = podaxis.pad_pods_for_mesh(cluster, mesh)
+    placed = podaxis.place(padded, mesh)
+    decider = podaxis.make_podaxis_decider(mesh)
+    sharded = decider(placed, NOW)
+
+    for f in ALL_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(single, f)), np.asarray(getattr(sharded, f)),
+            err_msg=f,
+        )
+
+
+def test_podaxis_on_hybrid_mesh_matches_single_device():
+    """The (dcn, ici) two-axis mesh path: multi-axis pod spec + staged psum."""
+    from escalator_tpu.parallel.mesh import make_hybrid_mesh
+
+    rng = np.random.default_rng(11)
+    cluster = _random_cluster(rng, G=8, P=1003, N=120, giant_group=True)
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+    hybrid = make_hybrid_mesh(num_hosts=2)  # 2 virtual hosts x 4 chips
+    placed = podaxis.place(podaxis.pad_pods_for_mesh(cluster, hybrid), hybrid)
+    sharded = podaxis.make_podaxis_decider(hybrid)(placed, NOW)
+    for f in ALL_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(single, f)), np.asarray(getattr(sharded, f)),
+            err_msg=f,
+        )
+
+
+def test_pad_pods_for_mesh_is_noop_when_divisible():
+    rng = np.random.default_rng(0)
+    cluster = _random_cluster(rng, G=4, P=64, N=16)
+    mesh = make_mesh()
+    assert podaxis.pad_pods_for_mesh(cluster, mesh) is cluster
+
+
+def test_podaxis_pallas_impl_matches():
+    """impl='pallas' inside the shard region (interpret on CPU) stays exact."""
+    rng = np.random.default_rng(5)
+    cluster = _random_cluster(rng, G=8, P=2048, N=100)
+    # group-contiguous pods so the fast path can engage inside shards
+    order = np.argsort(cluster.pods.group, kind="stable")
+    for f in cluster.pods.__dataclass_fields__:
+        setattr(cluster.pods, f, getattr(cluster.pods, f)[order])
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+    mesh = make_mesh()
+    placed = podaxis.place(podaxis.pad_pods_for_mesh(cluster, mesh), mesh)
+    sharded = podaxis.make_podaxis_decider(mesh, impl="pallas")(placed, NOW)
+    for f in ALL_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(single, f)), np.asarray(getattr(sharded, f)),
+            err_msg=f,
+        )
