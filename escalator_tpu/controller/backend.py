@@ -293,14 +293,51 @@ class ShardedJaxBackend(ComputeBackend):
         return [r for r in results if r is not None]
 
 
+class PodAxisJaxBackend(ComputeBackend):
+    """Pod-axis-sharded kernel (parallel.podaxis): the flat pod axis is split
+    over the device mesh and partial segment sums psum together. Use when ONE
+    group dominates the pod count — group-axis sharding (ShardedJaxBackend)
+    cannot split a single giant group, this can. Bit-identical decisions."""
+
+    name = "podaxis-jax"
+
+    def __init__(self, mesh=None):
+        from escalator_tpu.parallel import mesh as meshlib, podaxis
+
+        self._podaxis = podaxis
+        self._mesh = mesh if mesh is not None else meshlib.make_mesh()
+        self._decider = podaxis.make_podaxis_decider(self._mesh)
+        self._packer = PaddedPacker()
+
+    def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        import jax
+
+        t0 = time.perf_counter()
+        cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
+        placed = self._podaxis.place(
+            self._podaxis.pad_pods_for_mesh(cluster, self._mesh), self._mesh
+        )
+        t1 = time.perf_counter()
+        out = self._decider(placed, np.int64(now_sec))
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
+        metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+        return _unpack(out, group_inputs)
+
+
 def make_backend(kind: str = "auto") -> ComputeBackend:
-    """auto: sharded-jax when >1 device, jax when jax imports, else golden."""
+    """auto: sharded-jax when >1 device, jax when jax imports, else golden.
+    podaxis-jax must be chosen explicitly — it pays collectives per tick and
+    only wins when one group holds most of the pods."""
     if kind == "golden":
         return GoldenBackend()
     if kind == "jax":
         return JaxBackend()
     if kind == "sharded-jax":
         return ShardedJaxBackend()
+    if kind == "podaxis-jax":
+        return PodAxisJaxBackend()
     if kind != "auto":
         raise ValueError(f"unknown backend {kind!r}")
     try:

@@ -11,7 +11,11 @@ import pytest
 
 from escalator_tpu.controller import controller as ctl
 from escalator_tpu.controller import node_group as ngmod
-from escalator_tpu.controller.backend import GoldenBackend, JaxBackend
+from escalator_tpu.controller.backend import (
+    GoldenBackend,
+    JaxBackend,
+    PodAxisJaxBackend,
+)
 from escalator_tpu.controller.native_backend import make_native_backend
 from escalator_tpu.k8s import types as k8s
 from escalator_tpu.k8s.cache import EventfulClient
@@ -106,6 +110,7 @@ class World:
 BACKENDS = {
     "golden": lambda: GoldenBackend(),
     "jax": lambda: JaxBackend(),
+    "podaxis": lambda: PodAxisJaxBackend(),
     # factory taking (client, ng_opts_list); World detects and applies it
     "native": lambda: make_native_backend,
 }
