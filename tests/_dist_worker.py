@@ -1,0 +1,62 @@
+"""Worker process for the multi-process distributed test.
+
+Joins a 2-process jax.distributed fleet on CPU, builds the global hybrid
+(dcn, ici) mesh (one row per host), and runs a staged psum over it —
+proving the multi-host communication backend end-to-end. argv: port, pid.
+"""
+
+import os
+import sys
+from functools import partial
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from escalator_tpu.parallel import distributed  # noqa: E402
+from escalator_tpu.parallel.mesh import DCN_AXIS, ICI_AXIS  # noqa: E402
+
+
+def main() -> None:
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+    ok = distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert ok, "distributed.initialize returned False with full config"
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 2  # one CPU device per process, global view
+
+    mesh = distributed.global_hybrid_mesh()
+    assert mesh.devices.shape == (2, 1), mesh.devices.shape
+    # every dcn row must be one host
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data = np.arange(4, dtype=np.int64)
+    sharding = NamedSharding(mesh, P(DCN_AXIS))
+    arr = jax.make_array_from_callback((4,), sharding, lambda idx: data[idx])
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(DCN_AXIS), out_specs=P())
+    def staged_total(x):
+        s = jax.numpy.sum(x)
+        s = jax.lax.psum(s, ICI_AXIS)  # fast intra-host axis first
+        return jax.lax.psum(s, DCN_AXIS)  # then the cross-host hop
+
+    total = int(staged_total(arr))
+    assert total == 6, total
+    print(f"WORKER_OK pid={pid} total={total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

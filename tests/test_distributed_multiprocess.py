@@ -1,0 +1,61 @@
+"""Real multi-process jax.distributed: 2 CPU processes form one fleet.
+
+The reference's only multi-process story is active/passive leader election
+(SURVEY.md §2.7); escalator-tpu's compute plane scales out with
+jax.distributed + a hybrid (dcn, ici) mesh. This spawns two actual worker
+processes that join one coordinator, build the global mesh (one dcn row per
+host), and agree on a staged psum — the multi-host communication backend
+validated end-to-end, not just shape-checked.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_dist_worker.py")
+
+
+def test_two_process_fleet_staged_psum():
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(port), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=100)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER_OK pid={pid} total=6" in out, out
+
+
+def test_partial_config_raises():
+    """A lone process_id is a broken fleet template, not single-host mode."""
+    from escalator_tpu.parallel import distributed
+
+    with pytest.raises(RuntimeError, match="partial distributed configuration"):
+        distributed.initialize(process_id=3)
