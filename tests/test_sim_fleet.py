@@ -1,0 +1,105 @@
+"""Fleet-scale soak: many nodegroups, full lifecycle, sharded mesh backend.
+
+Drives the REAL controller through spike -> delivery -> drain -> scale-down
+for 32 node groups at once, with the decision kernel sharded over the
+8-device virtual mesh — the closed-loop, fleet-sized counterpart of the
+single-group sim tests (reference analog: the multi-run convergence tests in
+controller_scale_node_group_test.go, which cover one group on fakes).
+"""
+
+import numpy as np
+
+from escalator_tpu import sim
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.controller.backend import ShardedJaxBackend
+from escalator_tpu.k8s.cache import EventfulClient
+from escalator_tpu.testsupport.builders import NodeOpts, build_test_nodes
+
+NUM_GROUPS = 32
+KEY = "customer"
+
+
+def _group_opts(i: int) -> ngmod.NodeGroupOptions:
+    return ngmod.NodeGroupOptions(
+        name=f"team{i}",
+        label_key=KEY,
+        label_value=f"team{i}",
+        cloud_provider_group_name=f"team{i}-asg",
+        min_nodes=1,
+        max_nodes=60,
+        taint_upper_capacity_threshold_percent=45,
+        taint_lower_capacity_threshold_percent=30,
+        scale_up_threshold_percent=70,
+        slow_node_removal_rate=2,
+        fast_node_removal_rate=4,
+        soft_delete_grace_period="1m",
+        hard_delete_grace_period="3m",
+        scale_up_cool_down_period="4m",
+    )
+
+
+def test_fleet_spike_and_drain_converges():
+    rng = np.random.default_rng(0)
+    nodes = []
+    for i in range(NUM_GROUPS):
+        nodes += build_test_nodes(
+            2, NodeOpts(cpu=2000, mem=8 * 10**9, label_key=KEY, label_value=f"team{i}"),
+        )
+    client = EventfulClient(nodes=nodes)
+    groups = [_group_opts(i) for i in range(NUM_GROUPS)]
+
+    workload = []
+    for i in range(NUM_GROUPS):
+        count = int(rng.integers(10, 40))
+        workload.append({
+            "at_tick": 0,
+            "add_pods": {"count": count, "cpu_milli": 500,
+                         "mem_bytes": 10**8,
+                         "node_selector": {KEY: f"team{i}"}},
+        })
+        # drain: most pods finish mid-run
+        workload.append({"at_tick": 14, "finish_pods": {"count": count - 2}})
+
+    timeline = sim.run_simulation(
+        groups, client, ticks=26, tick_interval_sec=60, node_ready_ticks=2,
+        workload_events=workload, backend=ShardedJaxBackend(),
+    )
+
+    first, last = timeline[0], timeline[-1]
+    # every group saw the spike and scaled up
+    assert all(d > 0 for d in first["deltas"].values()), first["deltas"]
+    peak_nodes = max(r["nodes"] for r in timeline)
+    assert peak_nodes > NUM_GROUPS * 2  # the cloud delivered capacity
+    # after the drain, every group is either converged or tainting down
+    assert all(d <= 0 for d in last["deltas"].values()), last["deltas"]
+    # scale-down engaged fleet-wide: tainted nodes present after the drain
+    assert any(r["tainted"] > 0 for r in timeline[15:])
+    # no group exceeded its max or dropped below min on the provider
+    for ng in last["provider_targets"]:
+        assert 1 <= last["provider_targets"][ng] <= 60
+
+
+def test_fleet_provider_targets_track_demand():
+    """Per-group targets must scale with each group's own demand (no
+    cross-group bleed through the batched kernel)."""
+    nodes = []
+    for i in range(4):
+        nodes += build_test_nodes(
+            2, NodeOpts(cpu=2000, mem=8 * 10**9, label_key=KEY, label_value=f"team{i}"),
+        )
+    client = EventfulClient(nodes=nodes)
+    groups = [_group_opts(i) for i in range(4)]
+    # only team2 gets load
+    workload = [{
+        "at_tick": 0,
+        "add_pods": {"count": 40, "cpu_milli": 500, "mem_bytes": 10**8,
+                     "node_selector": {KEY: "team2"}},
+    }]
+    timeline = sim.run_simulation(
+        groups, client, ticks=8, tick_interval_sec=60, node_ready_ticks=2,
+        workload_events=workload, backend=ShardedJaxBackend(),
+    )
+    last = timeline[-1]["provider_targets"]
+    assert last["team2"] > 2
+    for other in ("team0", "team1", "team3"):
+        assert last[other] <= 2, last
