@@ -26,24 +26,33 @@ _VERSION = 1
 
 def _encode_arrays(named: List[Tuple[str, np.ndarray]]) -> bytes:
     header = []
-    buffers = []
+    arrays = []
     offset = 0
     for name, arr in named:
         arr = np.ascontiguousarray(arr)
-        raw = arr.tobytes()
         header.append(
             {
                 "name": name,
                 "dtype": arr.dtype.str,
                 "shape": list(arr.shape),
                 "offset": offset,
-                "nbytes": len(raw),
+                "nbytes": arr.nbytes,
             }
         )
-        buffers.append(raw)
-        offset += len(raw)
+        arrays.append(arr)
+        offset += arr.nbytes
     head = msgpack.packb({"v": _VERSION, "arrays": header})
-    return _MAGIC + struct.pack("<I", len(head)) + head + b"".join(buffers)
+    # single-copy assembly: offsets are known up front, so each array buffer
+    # lands directly in the frame (tobytes() + join would copy twice)
+    base = 8 + len(head)
+    frame = bytearray(base + offset)
+    frame[:4] = _MAGIC
+    struct.pack_into("<I", frame, 4, len(head))
+    frame[8:base] = head
+    for spec, arr in zip(header, arrays):
+        start = base + spec["offset"]
+        frame[start : start + spec["nbytes"]] = memoryview(arr).cast("B")
+    return bytes(frame)
 
 
 def _decode_arrays(data: bytes) -> Dict[str, np.ndarray]:

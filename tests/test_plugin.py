@@ -45,6 +45,24 @@ def test_codec_rejects_garbage():
         codec.decode_cluster(b"NOPE" + b"\0" * 64)
 
 
+def test_codec_round_trip_at_scale():
+    """100k-pod frame: the marshalling hard part (SURVEY §7) across the plugin
+    boundary — every column exact through the single-copy encoder."""
+    import bench as benchmod
+
+    nprng = np.random.default_rng(0)
+    cluster = benchmod._rng_cluster_arrays(nprng, 512, 100_000, 20_000,
+                                           mixed=True, heterogeneous=True,
+                                           tainted_frac=0.1)
+    frame = codec.encode_cluster(cluster, NOW)
+    decoded, now = codec.decode_cluster(frame)
+    assert now == NOW
+    for section in ("groups", "pods", "nodes"):
+        a, b = getattr(cluster, section), getattr(decoded, section)
+        for f in a.__dataclass_fields__:
+            np.testing.assert_array_equal(getattr(a, f), getattr(b, f), err_msg=f)
+
+
 def test_health(plugin):
     h = plugin.health()
     assert h["ok"] is True
