@@ -263,6 +263,23 @@ def make_fleet_decider(mesh: Mesh):
     return fleet_decide
 
 
+def make_sharded_sweeper(mesh: Mesh, num_candidates: int):
+    """jitted sharded what-if sweep (ops.simulate.sweep_deltas over the mesh):
+    post-delta utilisation for every (group, candidate delta) pair, nodegroup
+    axis sharded exactly like the decision path — capacity planning for the
+    whole fleet in one device program (no reference analog)."""
+    from escalator_tpu.ops.simulate import sweep_deltas
+
+    spec = _group_spec(mesh)
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    def sharded_sweep(cluster: ClusterArrays):
+        return jax.vmap(lambda c: sweep_deltas(c, num_candidates))(cluster)
+
+    return sharded_sweep
+
+
 def shard_cluster_arrays(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
     """Place stacked cluster arrays so the shard axis lives on the mesh devices."""
     sharding = NamedSharding(mesh, _group_spec(mesh))

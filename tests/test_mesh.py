@@ -162,3 +162,40 @@ class TestDistributedInit:
         mesh = distributed.global_hybrid_mesh()
         assert mesh.devices.size == 8  # all virtual devices, 1 "host"
         assert mesh.devices.shape[0] == 1
+
+
+def test_sharded_sweeper_matches_unsharded(cpu_mesh):
+    """What-if sweeps sharded like the decision path: [S, G, D] results must
+    equal the single-device sweep_deltas per shard block."""
+    from escalator_tpu.ops import simulate
+
+    rng = random.Random(23)
+    groups = [random_group(rng, gi) for gi in range(32)]
+
+    def fresh(groups):
+        return [
+            (p, n, c, sem.GroupState(**s.__dict__)) for (p, n, c, s) in groups
+        ]
+
+    D = 16
+    sharded, assignment = meshlib.pack_cluster_sharded(fresh(groups), num_shards=8)
+    placed = meshlib.shard_cluster_arrays(sharded, cpu_mesh)
+    sweep = meshlib.make_sharded_sweeper(cpu_mesh, D)(placed)
+
+    # reference: per-shard single-device sweep on the same packed blocks
+    leaves, aux = sharded.tree_flatten()
+    for s in range(8):
+        block = type(sharded).tree_unflatten(aux, [leaf[s] for leaf in leaves])
+        ref = simulate.sweep_deltas_jit(jax.device_put(block), num_candidates=D)
+        np.testing.assert_array_equal(
+            np.asarray(sweep.min_feasible_delta[s]),
+            np.asarray(ref.min_feasible_delta),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sweep.feasible[s]), np.asarray(ref.feasible)
+        )
+        np.testing.assert_allclose(
+            np.asarray(sweep.post_cpu_percent[s]),
+            np.asarray(ref.post_cpu_percent),
+            rtol=0, atol=0,
+        )
