@@ -43,6 +43,7 @@ def _rng_cluster_arrays(
         cached_mem_bytes=np.full(G, 16 * 10**9, np.int64),
         soft_grace_sec=np.full(G, 300, np.int64),
         hard_grace_sec=np.full(G, 900, np.int64),
+        emptiest=np.zeros(G, bool),
         valid=np.ones(G, bool),
     )
     if mixed:

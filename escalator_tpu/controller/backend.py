@@ -43,7 +43,9 @@ class GroupDecision:
     """Backend output for one nodegroup, at object level."""
 
     decision: semantics.Decision
-    scale_down_order: List[k8s.Node] = field(default_factory=list)  # oldest-first
+    #: untainted nodes in victim order (per the group's scale_down_selection:
+    #: oldest-first by default, emptiest-first when configured)
+    scale_down_order: List[k8s.Node] = field(default_factory=list)
     untaint_order: List[k8s.Node] = field(default_factory=list)     # newest-first
     reap_nodes: List[k8s.Node] = field(default_factory=list)
     cordoned_nodes: List[k8s.Node] = field(default_factory=list)
@@ -86,12 +88,17 @@ class GoldenBackend(ComputeBackend):
                 tainted, info, config.soft_delete_grace_sec,
                 config.hard_delete_grace_sec, now_sec,
             )
+            if config.scale_down_selection == "emptiest_first":
+                remaining = [
+                    k8s.node_pods_remaining(nd, info)[0] for nd in untainted
+                ]
+                victim_order = semantics.nodes_emptiest_first(untainted, remaining)
+            else:
+                victim_order = semantics.nodes_oldest_first(untainted)
             out.append(
                 GroupDecision(
                     decision=decision,
-                    scale_down_order=[
-                        untainted[i] for i in semantics.nodes_oldest_first(untainted)
-                    ],
+                    scale_down_order=[untainted[i] for i in victim_order],
                     untaint_order=[
                         tainted[i] for i in semantics.nodes_newest_first(tainted)
                     ],

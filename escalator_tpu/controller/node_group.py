@@ -107,6 +107,9 @@ class NodeGroupOptions:
     hard_delete_grace_period: str = ""
     scale_up_cool_down_period: str = ""
     taint_effect: str = ""
+    #: scale-down victim ordering: "" / "oldest_first" (reference behavior) or
+    #: "emptiest_first" (fewest non-daemonset pods first, ties oldest-first)
+    scale_down_selection: str = ""
     aws: AWSNodeGroupOptions = field(default_factory=AWSNodeGroupOptions)
 
     def soft_delete_grace_period_duration(self) -> float:
@@ -135,6 +138,7 @@ class NodeGroupOptions:
             fast_removal_rate=self.fast_node_removal_rate,
             soft_delete_grace_sec=int(self.soft_delete_grace_period_duration()),
             hard_delete_grace_sec=int(self.hard_delete_grace_period_duration()),
+            scale_down_selection=self.scale_down_selection or "oldest_first",
         )
 
 
@@ -254,6 +258,10 @@ def validate_node_group(ng: NodeGroupOptions) -> List[str]:
 
     check(_valid_taint_effect(ng.taint_effect),
           "taint_effect must be valid kubernetes taint")
+    check(
+        ng.scale_down_selection in ("", "oldest_first", "emptiest_first"),
+        "scale_down_selection must be 'oldest_first' or 'emptiest_first'",
+    )
     check(
         _valid_aws_lifecycle(ng.aws.lifecycle),
         f"aws.lifecycle must be '{LIFECYCLE_ON_DEMAND}' or '{LIFECYCLE_SPOT}' "

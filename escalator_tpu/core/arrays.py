@@ -53,6 +53,7 @@ class GroupArrays:
     cached_mem_bytes: np.ndarray   # int64
     soft_grace_sec: np.ndarray     # int64
     hard_grace_sec: np.ndarray     # int64
+    emptiest: np.ndarray           # bool: scale_down_selection == emptiest_first
     valid: np.ndarray              # bool
 
 
@@ -143,6 +144,7 @@ def pack_groups(
         cached_mem_bytes=np.zeros(GP, np.int64),
         soft_grace_sec=np.zeros(GP, np.int64),
         hard_grace_sec=np.zeros(GP, np.int64),
+        emptiest=np.zeros(GP, bool),
         valid=np.zeros(GP, bool),
     )
     for gi, (config, state) in enumerate(config_states):
@@ -159,6 +161,7 @@ def pack_groups(
         g.cached_mem_bytes[gi] = state.cached_mem_bytes
         g.soft_grace_sec[gi] = config.soft_delete_grace_sec
         g.hard_grace_sec[gi] = config.hard_delete_grace_sec
+        g.emptiest[gi] = config.scale_down_selection == "emptiest_first"
         g.valid[gi] = True
     return g
 

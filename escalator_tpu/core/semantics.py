@@ -69,6 +69,11 @@ class GroupConfig:
     fast_removal_rate: int = 0
     soft_delete_grace_sec: int = 0
     hard_delete_grace_sec: int = 0
+    #: scale-down victim ordering: "oldest_first" (reference behavior,
+    #: sort.go:12-24) or "emptiest_first" (fewest non-daemonset pods first,
+    #: ties oldest-first — the selection method the reference's
+    #: node-termination doc names as future work and never shipped)
+    scale_down_selection: str = "oldest_first"
 
 
 @dataclass
@@ -325,6 +330,19 @@ def nodes_newest_first(nodes: Sequence[k8s.Node]) -> List[int]:
     """Indices of nodes ordered newest creation time first — untaint order
     (reference: pkg/controller/sort.go:27-39)."""
     return sorted(range(len(nodes)), key=lambda i: (-nodes[i].creation_time_ns, i))
+
+
+def nodes_emptiest_first(
+    nodes: Sequence[k8s.Node], pods_remaining: Sequence[int]
+) -> List[int]:
+    """Indices ordered by (non-daemonset pod count asc, creation asc, index) —
+    the eviction-minimizing scale-down order (``scale_down_selection:
+    emptiest_first``). No reference implementation exists; its node-termination
+    doc lists alternative selection methods as future work."""
+    return sorted(
+        range(len(nodes)),
+        key=lambda i: (pods_remaining[i], nodes[i].creation_time_ns, i),
+    )
 
 
 def reap_eligible(

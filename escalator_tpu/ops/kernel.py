@@ -92,13 +92,19 @@ def _segsum(values, segment_ids, num_segments):
     return jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
 
 
-def _grouped_order(keys, selected, group, num_groups):
-    """Stable order of selected entries by (group asc, key asc); non-selected pushed
-    to the tail. Two stable argsorts compose to a lexicographic sort."""
-    perm1 = jnp.argsort(keys, stable=True)
+def _grouped_order(keys, selected, group, num_groups, primary=None):
+    """Stable order of selected entries by (group asc, [primary asc,] key asc);
+    non-selected pushed to the tail. Stable argsorts compose minor-key-first
+    into a lexicographic sort. ``primary`` (optional, per-node) outranks
+    ``keys`` — used for emptiest-first scale-down, where it is the pod count
+    for nodes of emptiest_first groups and 0 elsewhere (0 everywhere keeps the
+    reference's pure creation-time order bit-for-bit)."""
+    perm = jnp.argsort(keys, stable=True)
+    if primary is not None:
+        perm = perm[jnp.argsort(primary[perm], stable=True)]
     major = jnp.where(selected, group.astype(_I64), jnp.int64(num_groups))
-    perm2 = jnp.argsort(major[perm1], stable=True)
-    return perm1[perm2].astype(_I32)
+    perm = perm[jnp.argsort(major[perm], stable=True)]
+    return perm.astype(_I32)
 
 
 def aggregate_pods(p: PodArrays, node_group: jnp.ndarray, G: int, N: int,
@@ -360,7 +366,14 @@ def decide(
     mem_pct_out = jnp.where(pct_computed, mem_pct, 0.0)
 
     # ---- selections (pkg/controller/sort.go; scale_up.go:118; scale_down.go:171) ----
-    scale_down_order = _grouped_order(n.creation_ns, untainted_sel, ngroup, G)
+    # emptiest_first groups rank victims by pod count before age; elsewhere the
+    # primary key is 0, reducing to the reference's oldest-first order exactly
+    victim_primary = jnp.where(
+        g.emptiest[ngroup], node_pods_remaining64, jnp.int64(0)
+    )
+    scale_down_order = _grouped_order(
+        n.creation_ns, untainted_sel, ngroup, G, primary=victim_primary
+    )
     untaint_order = _grouped_order(-n.creation_ns, tainted_sel, ngroup, G)
 
     def offsets(sel):

@@ -105,6 +105,7 @@ class TestKernelFeed:
             cached_mem_bytes=np.zeros(G, np.int64),
             soft_grace_sec=np.full(G, 300, np.int64),
             hard_grace_sec=np.full(G, 900, np.int64),
+            emptiest=np.zeros(G, bool),
             valid=np.ones(G, bool),
         )
         cluster = ClusterArrays(groups=groups, pods=pods, nodes=nodes)

@@ -52,6 +52,7 @@ def _random_cluster(rng, G, P, N, giant_group=False):
             cached_mem_bytes=np.full(G, 16 * 10**9, np.int64),
             soft_grace_sec=np.full(G, 300, np.int64),
             hard_grace_sec=np.full(G, 900, np.int64),
+            emptiest=np.zeros(G, bool),
             valid=np.ones(G, bool),
         ),
         pods=PodArrays(

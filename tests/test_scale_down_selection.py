@@ -1,0 +1,147 @@
+"""scale_down_selection: emptiest_first victim ordering across every layer.
+
+The reference ships only oldest-first and documents alternative selection
+methods as future work (docs/node-termination.md); emptiest_first ranks
+victims by non-daemonset pod count (ties oldest-first), minimizing evictions.
+Golden model, batched kernel, and controller must agree; oldest_first groups
+must stay bit-identical to the reference order even in mixed-mode batches.
+"""
+
+import numpy as np
+import pytest
+
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.core.arrays import pack_cluster
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.ops import kernel
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_node,
+    build_test_pod,
+)
+
+NOW = np.int64(1_700_000_000)
+
+
+def _cfg(selection="oldest_first"):
+    return sem.GroupConfig(
+        min_nodes=0, max_nodes=100, taint_lower_percent=30,
+        taint_upper_percent=45, scale_up_percent=70, slow_removal_rate=1,
+        fast_removal_rate=2, soft_delete_grace_sec=300,
+        hard_delete_grace_sec=900, scale_down_selection=selection,
+    )
+
+
+def _group(selection, n_nodes=6, pods_on=()):
+    """n_nodes nodes aged oldest-first by index; pods_on[i] pods on node i."""
+    nodes = [
+        build_test_node(NodeOpts(name=f"{selection}-n{i}", cpu=4000,
+                                 mem=16 * 10**9, creation_time_ns=(i + 1) * 10**9))
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for i, count in enumerate(pods_on):
+        for j in range(count):
+            pods.append(
+                build_test_pod(PodOpts(name=f"{selection}-p{i}-{j}", cpu=[100],
+                                       mem=[10**8], node_name=nodes[i].name))
+            )
+    return (pods, nodes, _cfg(selection), sem.GroupState())
+
+
+class TestSemantics:
+    def test_emptiest_first_ordering(self):
+        pods, nodes, _, _ = _group("emptiest_first", 4, pods_on=(3, 0, 2, 0))
+        from escalator_tpu.k8s import types as k8s
+
+        info = k8s.create_node_name_to_info_map(pods, nodes)
+        remaining = [
+            sum(1 for p in info.get(n.name, (None, []))[1]
+                if not k8s.pod_is_daemonset(p))
+            for n in nodes
+        ]
+        order = sem.nodes_emptiest_first(nodes, remaining)
+        # empty nodes first (oldest of the empties leads), then 2 pods, then 3
+        assert order == [1, 3, 2, 0]
+
+    def test_config_default_is_oldest(self):
+        assert _cfg().scale_down_selection == "oldest_first"
+
+
+class TestKernelParity:
+    def test_mixed_modes_in_one_batch(self):
+        """One batch holding both modes: each group gets ITS order; the
+        oldest_first group's order is byte-identical to the pure-age sort."""
+        g_old = _group("oldest_first", 4, pods_on=(2, 0, 1, 0))
+        g_empty = _group("emptiest_first", 4, pods_on=(3, 0, 2, 0))
+        cluster = pack_cluster([g_old, g_empty])
+        out = kernel.decide_jit(cluster, NOW)
+        down = np.asarray(out.scale_down_order)
+        offs = np.asarray(out.untainted_offsets)
+
+        # group 0 (oldest_first): ages ascending -> flat indices 0..3
+        assert list(down[offs[0]:offs[1]]) == [0, 1, 2, 3]
+        # group 1 (emptiest_first): flat indices 4..7, pods (3,0,2,0)
+        assert list(down[offs[1]:offs[2]]) == [5, 7, 6, 4]
+
+    def test_kernel_matches_golden_backend(self):
+        from escalator_tpu.controller.backend import GoldenBackend, JaxBackend
+
+        groups = [
+            _group("emptiest_first", 5, pods_on=(1, 4, 0, 2, 0)),
+            _group("oldest_first", 5, pods_on=(1, 4, 0, 2, 0)),
+        ]
+
+        def fresh():
+            return [
+                (p, n, c, sem.GroupState(**s.__dict__)) for p, n, c, s in groups
+            ]
+
+        golden = GoldenBackend().decide(fresh(), int(NOW))
+        jaxed = JaxBackend().decide(fresh(), int(NOW))
+        for g, j in zip(golden, jaxed):
+            assert [n.name for n in g.scale_down_order] == [
+                n.name for n in j.scale_down_order
+            ]
+
+
+class TestConfig:
+    def test_yaml_and_validation(self):
+        opts = ngmod.unmarshal_node_group_options(
+            """
+node_groups:
+  - name: "empty-first"
+    label_key: customer
+    label_value: shared
+    cloud_provider_group_name: asg1
+    min_nodes: 1
+    max_nodes: 10
+    taint_upper_capacity_threshold_percent: 45
+    taint_lower_capacity_threshold_percent: 30
+    scale_up_threshold_percent: 70
+    slow_node_removal_rate: 1
+    fast_node_removal_rate: 2
+    soft_delete_grace_period: 5m
+    hard_delete_grace_period: 15m
+    scale_up_cool_down_period: 10m
+    scale_down_selection: emptiest_first
+"""
+        )
+        assert opts[0].scale_down_selection == "emptiest_first"
+        assert ngmod.validate_node_group(opts[0]) == []
+        assert opts[0].to_group_config().scale_down_selection == "emptiest_first"
+
+    def test_invalid_selection_rejected(self):
+        opts = ngmod.NodeGroupOptions(
+            name="x", label_key="k", label_value="v",
+            cloud_provider_group_name="asg", min_nodes=1, max_nodes=5,
+            taint_upper_capacity_threshold_percent=45,
+            taint_lower_capacity_threshold_percent=30,
+            scale_up_threshold_percent=70, slow_node_removal_rate=1,
+            fast_node_removal_rate=2, soft_delete_grace_period="5m",
+            hard_delete_grace_period="15m", scale_up_cool_down_period="10m",
+            scale_down_selection="newest_first",
+        )
+        problems = ngmod.validate_node_group(opts)
+        assert any("scale_down_selection" in p for p in problems), problems
