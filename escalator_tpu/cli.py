@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="path to the nodegroups YAML config")
     p.add_argument("--drymode", action="store_true",
                    help="skip all mutations, track taints in memory")
+    p.add_argument("--aws-assume-role-arn", default="",
+                   help="AWS role arn to assume at startup (aws provider only,"
+                        " reference: cmd/main.go:38)")
+    p.add_argument("--aws-region", default="",
+                   help="AWS region override (defaults to the SDK chain)")
     p.add_argument("--cloud-provider", default="sim", choices=["sim", "aws"],
                    help="cloud provider backend")
     p.add_argument("--kubeconfig", default="",
@@ -195,7 +200,11 @@ def setup_cloud_provider(args, node_groups, client) -> MockBuilder:
     if args.cloud_provider == "aws":
         from escalator_tpu.cloudprovider.aws.builder import AWSBuilder
 
-        return AWSBuilder(node_groups)
+        return AWSBuilder(
+            node_groups,
+            region=args.aws_region,
+            assume_role_arn=args.aws_assume_role_arn,
+        )
     provider = MockCloudProvider()
     for ng in node_groups:
         group_nodes = [
