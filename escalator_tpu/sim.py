@@ -130,6 +130,7 @@ def run_simulation(
     node_ready_ticks: int,
     workload_events: Optional[List[dict]] = None,
     backend=None,
+    sweep_candidates: int = 0,
 ) -> List[dict]:
     clock = MockClock()
     provider = MockCloudProvider()
@@ -193,6 +194,29 @@ def run_simulation(
         }
         timeline.append(record)
         clock.advance(tick_interval_sec)
+
+    if sweep_candidates and timeline:
+        # capacity-planning summary off the final state: for each group, the
+        # minimal node delta whose post-delta utilisation clears the scale-up
+        # threshold (ops/simulate — no reference equivalent)
+        from escalator_tpu.core.arrays import pack_cluster
+        from escalator_tpu.ops.simulate import sweep_deltas_jit
+
+        gi, names = [], []
+        for ng in node_groups:
+            st = controller.node_groups[ng.name]
+            gi.append((
+                st.pod_lister.list(), st.node_lister.list(),
+                st.opts.to_group_config(), st.kernel_state,
+            ))
+            names.append(ng.name)
+        sweep = sweep_deltas_jit(
+            pack_cluster(gi), num_candidates=sweep_candidates
+        )
+        timeline[-1]["sweep_min_feasible_delta"] = {
+            name: int(sweep.min_feasible_delta[i])
+            for i, name in enumerate(names)
+        }
     return timeline
 
 
@@ -205,7 +229,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--tick-interval", type=float, default=60.0)
     p.add_argument("--node-ready-ticks", type=int, default=2)
     p.add_argument("--backend", default="golden",
-                   choices=["auto", "jax", "sharded-jax", "golden"])
+                   choices=["auto", "jax", "sharded-jax", "podaxis-jax",
+                            "golden"])
+    p.add_argument("--sweep-deltas", type=int, default=0,
+                   help="after the run, report each group's minimal feasible"
+                        " scale-up delta over this many candidates")
     p.add_argument("--loglevel", default="warn")
     args = p.parse_args(argv)
     logging.basicConfig(level=getattr(logging, args.loglevel.upper(), 30))
@@ -220,6 +248,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     timeline = run_simulation(
         node_groups, client, args.ticks, args.tick_interval,
         args.node_ready_ticks, events, make_backend(args.backend),
+        sweep_candidates=args.sweep_deltas,
     )
     for record in timeline:
         print(json.dumps(record))

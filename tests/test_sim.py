@@ -92,3 +92,26 @@ def test_short_cooldown_overscales_then_recovers():
     peak = max(r["nodes"] for r in timeline)
     assert peak > 22  # double-bought past the single-shot answer (4 + 18)
     assert any(r["deltas"]["buildeng"] < 0 for r in timeline)  # corrects back
+
+
+def test_sweep_summary_on_final_tick():
+    """--sweep-deltas: the final record carries each group's minimal feasible
+    scale-up delta (or the num_candidates sentinel when out of range)."""
+    from escalator_tpu.controller.backend import JaxBackend
+
+    client = make_client(4)
+    ng = make_opts(scale_up_cool_down_period="30m")  # stay locked: demand unmet
+    workload = [{
+        "at_tick": 0,
+        "add_pods": {"count": 30, "cpu_milli": 500, "mem_bytes": 10**8,
+                     "node_selector": {LABEL_KEY: LABEL_VALUE}},
+    }]
+    timeline = sim.run_simulation(
+        [ng], client, ticks=3, tick_interval_sec=60, node_ready_ticks=10,
+        workload_events=workload, backend=JaxBackend(), sweep_candidates=64,
+    )
+    sweep = timeline[-1]["sweep_min_feasible_delta"]
+    # 30 pods x 500m on 4x1000m nodes = 375%; needs more nodes; candidate range
+    # 64 is enough, so a real (non-sentinel) delta comes back
+    assert 0 < sweep["buildeng"] < 64
+    assert "sweep_min_feasible_delta" not in timeline[0]
