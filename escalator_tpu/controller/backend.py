@@ -215,23 +215,33 @@ def _unpack(out, group_inputs) -> List[GroupDecision]:
     return results
 
 
+def _kernel_impl() -> str:
+    """Aggregation sweep selector: "xla" (default) or "pallas" (the fused MXU
+    kernel, ops/pallas_kernel.py). Env-switched so any backend/CLI user can
+    opt in without new flags; invalid values fail fast in decide()."""
+    import os
+
+    return os.environ.get("ESCALATOR_TPU_KERNEL_IMPL", "xla")
+
+
 class JaxBackend(ComputeBackend):
     """Single-device (or data-parallel-free) batched kernel. The jit cache is keyed
     on padded shapes; capacities grow by powers of two."""
 
     name = "jax"
 
-    def __init__(self):
+    def __init__(self, impl: Optional[str] = None):
         from escalator_tpu.ops import kernel  # defers jax import
 
         self._kernel = kernel
         self._packer = PaddedPacker()
+        self._impl = impl if impl is not None else _kernel_impl()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
         t0 = time.perf_counter()
         cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
         t1 = time.perf_counter()
-        out = self._kernel.decide_jit(cluster, np.int64(now_sec))
+        out = self._kernel.decide_jit(cluster, np.int64(now_sec), impl=self._impl)
         import jax
 
         jax.block_until_ready(out)

@@ -138,7 +138,11 @@ class NativeJaxBackend(ComputeBackend):
             self._cache.apply_dirty(pod_dirty, node_dirty, groups)
         self._overridden_slots = overridden
         t1 = time.perf_counter()
-        out = self._kernel.decide_jit(self._cache.cluster, np.int64(now_sec))
+        from escalator_tpu.controller.backend import _kernel_impl
+
+        out = self._kernel.decide_jit(
+            self._cache.cluster, np.int64(now_sec), impl=_kernel_impl()
+        )
         jax.block_until_ready(out)
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
