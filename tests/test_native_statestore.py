@@ -230,3 +230,55 @@ class TestBatchIngest:
         assert s.pod_capacity >= 20
         ps, _ = s.drain_dirty()
         assert len(ps) == 20
+
+
+class TestModelFuzz:
+    """Randomized op sequences vs a Python dict model: live-set contents,
+    slot stability, and dirty-set semantics must match exactly."""
+
+    def test_random_ops_match_model(self):
+        rng = np.random.default_rng(42)
+        store = statestore.NativeStateStore(pod_capacity=64, node_capacity=64)
+        model = {}            # uid -> (group, cpu, mem)
+        dirty_expected = set()  # slots touched since last drain
+
+        for step in range(3000):
+            op = rng.integers(0, 10)
+            uid = f"p{rng.integers(0, 80)}"
+            if op < 6:  # upsert (mix of insert + update)
+                vals = (int(rng.integers(0, 8)), int(rng.integers(1, 10**6)),
+                        int(rng.integers(1, 10**12)))
+                store.upsert_pod(uid, *vals)
+                model[uid] = vals
+                dirty_expected.add(store.pod_slot(uid))
+            elif op < 8:  # delete
+                slot = store.delete_pod(uid)
+                if uid in model:
+                    assert slot >= 0
+                    del model[uid]
+                    dirty_expected.add(slot)
+                else:
+                    assert slot == -1
+            else:  # drain and cross-check dirty set
+                pod_dirty, _ = store.drain_dirty()
+                assert set(int(s) for s in pod_dirty) == dirty_expected
+                dirty_expected.clear()
+
+            if step % 500 == 0:
+                pods, _ = store.as_pod_node_arrays()
+                live = {
+                    u: (int(pods.group[s]), int(pods.cpu_milli[s]),
+                        int(pods.mem_bytes[s]))
+                    for u in model
+                    for s in [store.pod_slot(u)]
+                }
+                assert live == model
+                assert int(pods.valid.sum()) == len(model)
+
+        # final full cross-check
+        pods, _ = store.as_pod_node_arrays()
+        assert int(pods.valid.sum()) == len(model)
+        for u, vals in model.items():
+            s = store.pod_slot(u)
+            assert (int(pods.group[s]), int(pods.cpu_milli[s]),
+                    int(pods.mem_bytes[s])) == vals
