@@ -122,7 +122,10 @@ def _accelerator_alive(timeout_sec: float = 90.0) -> bool:
             ).returncode
             == 0
         )
-    except subprocess.TimeoutExpired:
+    except Exception:
+        # TimeoutExpired, but also OSError/missing interpreter in exotic envs:
+        # any probe failure means "do not trust the accelerator" (matches
+        # __graft_entry__._find_devices)
         return False
 
 

@@ -256,12 +256,13 @@ class ShardedJaxBackend(ComputeBackend):
 
     name = "sharded-jax"
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, impl: Optional[str] = None):
         from escalator_tpu.parallel import mesh as meshlib
 
         self._meshlib = meshlib
         self._mesh = mesh if mesh is not None else meshlib.make_mesh()
-        self._decider = meshlib.make_sharded_decider(self._mesh)
+        self._impl = impl if impl is not None else _kernel_impl()
+        self._decider = meshlib.make_sharded_decider(self._mesh, impl=self._impl)
         self._num_shards = self._mesh.devices.size
         # high-water-mark per-shard pads: same recompile-avoidance as JaxBackend
         self._pad_pods = 0
@@ -314,16 +315,24 @@ class PodAxisJaxBackend(ComputeBackend):
     """Pod-axis-sharded kernel (parallel.podaxis): the flat pod axis is split
     over the device mesh and partial segment sums psum together. Use when ONE
     group dominates the pod count — group-axis sharding (ShardedJaxBackend)
-    cannot split a single giant group, this can. Bit-identical decisions."""
+    cannot split a single giant group, this can. Bit-identical decisions.
+
+    Transfer note: unlike the native/event-driven path (DeviceClusterCache),
+    this backend re-places the full packed cluster each tick — per-tick
+    host->device traffic is O(cluster), not O(changes). The placement is at
+    least split across devices (podaxis.place shards the big pod axis), but
+    callers with tiny churn and huge clusters should prefer the native
+    backend; this one targets the few-groups/many-pods decide-bound regime."""
 
     name = "podaxis-jax"
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, impl: Optional[str] = None):
         from escalator_tpu.parallel import mesh as meshlib, podaxis
 
         self._podaxis = podaxis
         self._mesh = mesh if mesh is not None else meshlib.make_mesh()
-        self._decider = podaxis.make_podaxis_decider(self._mesh)
+        self._impl = impl if impl is not None else _kernel_impl()
+        self._decider = podaxis.make_podaxis_decider(self._mesh, impl=self._impl)
         self._packer = PaddedPacker()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):

@@ -30,6 +30,13 @@ from escalator_tpu.k8s.cache import EventfulClient, GroupFilters, WatchBridge
 from escalator_tpu.metrics import metrics
 
 
+def _copy_soa(soa):
+    """Deep copy of a Pod/NodeArrays whose columns may alias live C++ buffers."""
+    return type(soa)(
+        **{f: np.array(getattr(soa, f)) for f in soa.__dataclass_fields__}
+    )
+
+
 class NativeJaxBackend(ComputeBackend):
     name = "native-jax"
     needs_objects = False
@@ -99,43 +106,66 @@ class NativeJaxBackend(ComputeBackend):
         from escalator_tpu.ops.device_state import DeviceClusterCache
 
         t0 = time.perf_counter()
-        pods, nodes_raw = self.store.as_pod_node_arrays()
-        self._refresh_cached_capacity(group_inputs, nodes_raw)
-        nodes = self._dry_mode_view(
-            nodes_raw, group_inputs, dry_mode_flags, taint_trackers
-        )
-        groups = pack_groups(
-            [(config, state) for _, _, config, state in group_inputs],
-            pad_groups=_round_up(len(group_inputs), 8),
-        )
-        pod_dirty, node_dirty = self.store.drain_dirty()
-        overridden = (
-            np.nonzero(
-                (nodes.tainted != nodes_raw.tainted)
-                | (nodes.cordoned != nodes_raw.cordoned)
-            )[0].astype(np.int64)
-            if nodes is not nodes_raw
-            else np.empty(0, np.int64)
-        )
-        if (
-            self._cache is None
-            or self._cache.pod_capacity != self.store.pod_capacity
-            or self._cache.node_capacity != self.store.node_capacity
-        ):
-            # first tick or store growth: one full upload; drained marks are
-            # already reflected in it
-            self._cache = DeviceClusterCache(
-                ClusterArrays(groups=groups, pods=pods, nodes=nodes)
+        # Hold the store's single-writer lock across the whole host phase
+        # (view -> dirty drain -> gather -> scatter dispatch): a concurrent
+        # watch thread can then never tear the tick's snapshot or race the
+        # dirty-list drain. The long device decide below runs OUTSIDE the
+        # lock — ingestion overlaps compute, the -race-analog soak test
+        # (tests/test_concurrency_soak.py) exercises exactly this interleaving.
+        with self.store.lock:
+            pods, nodes_raw = self.store.as_pod_node_arrays()
+            self._refresh_cached_capacity(group_inputs, nodes_raw)
+            nodes = self._dry_mode_view(
+                nodes_raw, group_inputs, dry_mode_flags, taint_trackers
             )
-        else:
-            node_dirty = np.unique(
-                np.concatenate([node_dirty, self._overridden_slots, overridden])
+            groups = pack_groups(
+                [(config, state) for _, _, config, state in group_inputs],
+                pad_groups=_round_up(len(group_inputs), 8),
+            )
+            pod_dirty, node_dirty = self.store.drain_dirty()
+            overridden = (
+                np.nonzero(
+                    (nodes.tainted != nodes_raw.tainted)
+                    | (nodes.cordoned != nodes_raw.cordoned)
+                )[0].astype(np.int64)
+                if nodes is not nodes_raw
+                else np.empty(0, np.int64)
+            )
+            # Snapshot the tiny per-node columns _unpack reads after the lock is
+            # released (the SoA views alias the live C++ buffers; result
+            # assembly must group by the DECIDED state, not whatever a watch
+            # thread wrote since).
+            unpack_group = np.array(nodes.group)
+            unpack_cordoned = np.array(nodes.valid) & np.array(nodes.cordoned)
+            rebuild = (
+                self._cache is None
+                or self._cache.pod_capacity != self.store.pod_capacity
+                or self._cache.node_capacity != self.store.node_capacity
+            )
+            if rebuild:
+                # first tick or store growth: copy the full columns under the
+                # lock; the O(cluster) device upload happens AFTER release so
+                # watch ingestion never stalls behind a transfer/compile
+                pods_snap = _copy_soa(pods)
+                nodes_snap = _copy_soa(nodes)
+            else:
+                node_dirty = np.unique(
+                    np.concatenate([node_dirty, self._overridden_slots, overridden])
+                )
+                self._cache.set_host(pods, nodes)
+                # two async dispatches (scatter, then decide) pipeline back-to-back;
+                # measured faster than the fused single-program alternative
+                # (DeviceClusterCache.apply_dirty_and_decide) on the v5e tunnel.
+                # The gather inside copies the dirty lanes, so releasing the lock
+                # before the async transfer completes is safe.
+                self._cache.apply_dirty(pod_dirty, node_dirty, groups)
+        if rebuild:
+            # outside the lock: upload the snapshot copies, then rebind the
+            # live views for future O(changes) gathers
+            self._cache = DeviceClusterCache(
+                ClusterArrays(groups=groups, pods=pods_snap, nodes=nodes_snap)
             )
             self._cache.set_host(pods, nodes)
-            # two async dispatches (scatter, then decide) pipeline back-to-back;
-            # measured faster than the fused single-program alternative
-            # (DeviceClusterCache.apply_dirty_and_decide) on the v5e tunnel
-            self._cache.apply_dirty(pod_dirty, node_dirty, groups)
         self._overridden_slots = overridden
         t1 = time.perf_counter()
         from escalator_tpu.controller.backend import _kernel_impl
@@ -147,9 +177,10 @@ class NativeJaxBackend(ComputeBackend):
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        return self._unpack(out, group_inputs, nodes)
+        return self._unpack(out, group_inputs, unpack_group, unpack_cordoned)
 
-    def _unpack(self, out, group_inputs, nodes: NodeArrays) -> List[GroupDecision]:
+    def _unpack(self, out, group_inputs, node_group: np.ndarray,
+                cordoned_mask: np.ndarray) -> List[GroupDecision]:
         """Slot-order-agnostic unpack: node indices resolve through the bridge."""
         status = np.asarray(out.status)
         delta = np.asarray(out.nodes_delta)
@@ -171,61 +202,74 @@ class NativeJaxBackend(ComputeBackend):
         reap = np.asarray(out.reap_mask)
         remaining = np.asarray(out.node_pods_remaining)
 
-        node_at = self.bridge.node_at_slot
-        # nodes is the snapshot decide() ran on — no store re-read here, so reap
-        # grouping is consistent with the decided state even under live events
-        reap_slots = np.nonzero(reap)[0]
-        reap_by_group: Dict[int, list] = {}
-        for slot in reap_slots:
-            reap_by_group.setdefault(int(nodes.group[slot]), []).append(
-                node_at(int(slot))
-            )
-        cordoned_slots = np.nonzero(nodes.valid & nodes.cordoned)[0]
-        cordoned_by_group: Dict[int, list] = {}
-        for slot in cordoned_slots:
-            cordoned_by_group.setdefault(int(nodes.group[slot]), []).append(
-                node_at(int(slot))
-            )
-
-        results = []
-        for gi, (pods, nodes, config, state) in enumerate(group_inputs):
-            decision = semantics.Decision(
-                status=semantics.DecisionStatus(int(status[gi])),
-                nodes_delta=int(delta[gi]),
-                cpu_percent=float(cpu_pct[gi]),
-                mem_percent=float(mem_pct[gi]),
-                cpu_request_milli=int(cpu_req[gi]),
-                mem_request_bytes=int(mem_req[gi]),
-                cpu_capacity_milli=int(cpu_cap[gi]),
-                mem_capacity_bytes=int(mem_cap[gi]),
-                num_untainted=int(n_unt[gi]),
-                num_tainted=int(n_tnt[gi]),
-                num_cordoned=int(n_crd[gi]),
-                num_nodes=int(n_all[gi]),
-                num_pods=int(n_pods[gi]),
-            )
-            down_nodes = [
-                node_at(int(i)) for i in down[u_off[gi] : u_off[gi + 1]]
-            ]
-            up_nodes = [node_at(int(i)) for i in up[t_off[gi] : t_off[gi + 1]]]
-            results.append(
-                GroupDecision(
-                    decision=decision,
-                    scale_down_order=[n for n in down_nodes if n is not None],
-                    untaint_order=[n for n in up_nodes if n is not None],
-                    reap_nodes=[
-                        n for n in reap_by_group.get(gi, []) if n is not None
-                    ],
-                    cordoned_nodes=[
-                        n for n in cordoned_by_group.get(gi, []) if n is not None
-                    ],
-                    node_pods_remaining={
-                        n.name: int(remaining[self.store.node_slot(n.name)])
-                        for n in down_nodes + up_nodes
-                        if n is not None
-                    },
+        # node_group/cordoned_mask are COPIES captured under the store lock at
+        # decide time, so grouping reflects the decided state even if a watch
+        # thread has since rewritten lanes. Slot->object resolution goes through
+        # the bridge under the lock for a mutually-consistent name map; a slot
+        # recycled mid-decide resolves to None (or the new object) and is
+        # filtered — self-correcting next tick, same TOCTOU the reference has
+        # between its lister snapshot and its API writes.
+        with self.store.lock:
+            node_at = self.bridge.node_at_slot
+            reap_slots = np.nonzero(reap)[0]
+            reap_by_group: Dict[int, list] = {}
+            for slot in reap_slots:
+                reap_by_group.setdefault(int(node_group[slot]), []).append(
+                    node_at(int(slot))
                 )
-            )
+            cordoned_slots = np.nonzero(cordoned_mask)[0]
+            cordoned_by_group: Dict[int, list] = {}
+            for slot in cordoned_slots:
+                cordoned_by_group.setdefault(int(node_group[slot]), []).append(
+                    node_at(int(slot))
+                )
+
+            results = []
+            for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+                decision = semantics.Decision(
+                    status=semantics.DecisionStatus(int(status[gi])),
+                    nodes_delta=int(delta[gi]),
+                    cpu_percent=float(cpu_pct[gi]),
+                    mem_percent=float(mem_pct[gi]),
+                    cpu_request_milli=int(cpu_req[gi]),
+                    mem_request_bytes=int(mem_req[gi]),
+                    cpu_capacity_milli=int(cpu_cap[gi]),
+                    mem_capacity_bytes=int(mem_cap[gi]),
+                    num_untainted=int(n_unt[gi]),
+                    num_tainted=int(n_tnt[gi]),
+                    num_cordoned=int(n_crd[gi]),
+                    num_nodes=int(n_all[gi]),
+                    num_pods=int(n_pods[gi]),
+                )
+                # keep (slot, node) pairs: pods-remaining indexes by the DECIDED
+                # slot, never by a post-decide store lookup (a deleted node's
+                # node_slot() is -1, which would silently read the last lane)
+                down_pairs = [
+                    (int(i), node_at(int(i)))
+                    for i in down[u_off[gi] : u_off[gi + 1]]
+                ]
+                up_pairs = [
+                    (int(i), node_at(int(i)))
+                    for i in up[t_off[gi] : t_off[gi + 1]]
+                ]
+                results.append(
+                    GroupDecision(
+                        decision=decision,
+                        scale_down_order=[n for _, n in down_pairs if n is not None],
+                        untaint_order=[n for _, n in up_pairs if n is not None],
+                        reap_nodes=[
+                            n for n in reap_by_group.get(gi, []) if n is not None
+                        ],
+                        cordoned_nodes=[
+                            n for n in cordoned_by_group.get(gi, []) if n is not None
+                        ],
+                        node_pods_remaining={
+                            n.name: int(remaining[slot])
+                            for slot, n in down_pairs + up_pairs
+                            if n is not None
+                        },
+                    )
+                )
         return results
 
 

@@ -60,46 +60,59 @@ class EventfulClient(InMemoryKubernetesClient):
     def subscribe(self, watcher: Callable[[WatchEvent], None],
                   replay: bool = True) -> None:
         """Add a watcher; replay=True first delivers the current state as ADDED
-        events (list-then-watch semantics)."""
-        if replay:
-            for node in self.list_nodes():
-                watcher(WatchEvent("node", ADDED, node))
-            for pod in self.list_pods():
-                watcher(WatchEvent("pod", ADDED, pod))
-        self.watchers.append(watcher)
+        events (list-then-watch semantics). Runs under the client lock so no
+        mutation can slip between the replay and the subscription."""
+        with self._lock:
+            if replay:
+                for node in self.list_nodes():
+                    watcher(WatchEvent("node", ADDED, node))
+                for pod in self.list_pods():
+                    watcher(WatchEvent("pod", ADDED, pod))
+            self.watchers.append(watcher)
 
     # -- mutations emit events ----------------------------------------------
+    # Each mutation emits UNDER the client lock (RLock, so the nested super()
+    # call is fine): a real apiserver watch stream delivers events in
+    # apply-order, and emitting outside the lock would let two threads'
+    # events arrive transposed — the bridge's state would then permanently
+    # diverge from the client's (caught by tests/test_concurrency_soak.py).
     def add_node(self, node: k8s.Node) -> None:
-        super().add_node(node)
-        self._emit(WatchEvent("node", ADDED, node))
+        with self._lock:
+            super().add_node(node)
+            self._emit(WatchEvent("node", ADDED, node))
 
     def update_node(self, node: k8s.Node) -> k8s.Node:
-        out = super().update_node(node)
-        self._emit(WatchEvent("node", MODIFIED, out))
+        with self._lock:
+            out = super().update_node(node)
+            self._emit(WatchEvent("node", MODIFIED, out))
         return out
 
     def delete_node(self, name: str) -> None:
-        node = self.get_node(name)
-        super().delete_node(name)
-        if node is not None:
-            self._emit(WatchEvent("node", DELETED, node))
+        with self._lock:
+            node = self.get_node(name)
+            super().delete_node(name)
+            if node is not None:
+                self._emit(WatchEvent("node", DELETED, node))
 
     def add_pod(self, pod: k8s.Pod) -> None:
-        super().add_pod(pod)
-        if pod.phase not in ("Succeeded", "Failed"):
-            self._emit(WatchEvent("pod", ADDED, pod))
+        with self._lock:
+            super().add_pod(pod)
+            if pod.phase not in ("Succeeded", "Failed"):
+                self._emit(WatchEvent("pod", ADDED, pod))
 
     def update_pod(self, pod: k8s.Pod) -> None:
-        super().add_pod(pod)  # upsert
-        if pod.phase in ("Succeeded", "Failed"):
-            # informer field-selector semantics: completed pods drop out
-            self._emit(WatchEvent("pod", DELETED, pod))
-        else:
-            self._emit(WatchEvent("pod", MODIFIED, pod))
+        with self._lock:
+            super().add_pod(pod)  # upsert
+            if pod.phase in ("Succeeded", "Failed"):
+                # informer field-selector semantics: completed pods drop out
+                self._emit(WatchEvent("pod", DELETED, pod))
+            else:
+                self._emit(WatchEvent("pod", MODIFIED, pod))
 
     def remove_pod(self, pod: k8s.Pod) -> None:
-        super().remove_pod(pod)
-        self._emit(WatchEvent("pod", DELETED, pod))
+        with self._lock:
+            super().remove_pod(pod)
+            self._emit(WatchEvent("pod", DELETED, pod))
 
 
 @dataclass
@@ -115,7 +128,10 @@ class WatchBridge:
     """Applies watch events to a NativeStateStore; keeps slot<->name maps."""
 
     def __init__(self, store, groups: Sequence[GroupFilters]):
+        import threading
+
         self.store = store
+        self._fallback_lock = threading.RLock()
         self.groups = list(groups)
         self.node_objects: Dict[str, k8s.Node] = {}
         self._node_slot_names: Dict[int, str] = {}
@@ -142,10 +158,18 @@ class WatchBridge:
 
     # -- event application ---------------------------------------------------
     def apply(self, event: WatchEvent) -> None:
-        if event.kind == "pod":
-            self._apply_pod(event)
-        else:
-            self._apply_node(event)
+        # Events may arrive on a watch thread while the backend reads the store
+        # on the controller thread; the store's lock is the single-writer
+        # contract both sides share (NativeStateStore.lock). Falls back to a
+        # bridge-local lock for store fakes without one.
+        lock = getattr(self.store, "lock", None)
+        if lock is None:
+            lock = self._fallback_lock
+        with lock:
+            if event.kind == "pod":
+                self._apply_pod(event)
+            else:
+                self._apply_node(event)
 
     def _forget_pod(self, uid: str) -> None:
         record = self._pod_records.pop(uid, None)

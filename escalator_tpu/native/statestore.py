@@ -5,9 +5,12 @@ needed — C ABI + ctypes + zero-copy numpy views). Falls back gracefully: calle
 check ``available()`` and use the pure-Python packer otherwise.
 
 The store holds the kernel's pod/node columns; ``views()`` returns numpy arrays
-aliasing the C++ buffers (no copy). Snapshot discipline: the caller must not apply
-deltas while a jitted computation may still be reading a device transfer of the
-views — in practice `jax.device_put` copies synchronously, so ticking is safe.
+aliasing the C++ buffers (no copy). Concurrency: the C++ side is single-writer;
+``NativeStateStore.lock`` (an RLock) is the shared contract — every mutating
+wrapper method acquires it, and readers that need a consistent multi-array
+snapshot (the native backend's view->gather->scatter phase) hold it across the
+whole read. The threaded soak test (tests/test_concurrency_soak.py) is the
+``go test -race`` analog exercising this.
 """
 
 from __future__ import annotations
@@ -155,6 +158,13 @@ class NativeStateStore:
         if not self._ptr:
             raise MemoryError("ess_new failed (capacity > max?)")
         self.generation = 0
+        # The C++ side is single-writer, readers-must-not-overlap-writes
+        # (statestore.cpp header). This lock is that contract made concrete:
+        # the ingest path (WatchBridge.apply) holds it per event, and the
+        # backend holds it across its read phase (view -> gather -> scatter),
+        # so a watch thread can never tear a tick's snapshot. RLock because
+        # the batch upserts call grow() internally.
+        self.lock = threading.RLock()
 
     def __del__(self):
         ptr = getattr(self, "_ptr", None)
@@ -198,32 +208,36 @@ class NativeStateStore:
     # -- deltas --------------------------------------------------------------
     def upsert_pod(self, uid: str, group: int, cpu_milli: int, mem_bytes: int,
                    node_slot: int = -1) -> int:
-        self._ensure_pod_capacity()
-        slot = self._lib.ess_upsert_pod(
-            self._ptr, uid.encode(), group, cpu_milli, mem_bytes, node_slot
-        )
+        with self.lock:
+            self._ensure_pod_capacity()
+            slot = self._lib.ess_upsert_pod(
+                self._ptr, uid.encode(), group, cpu_milli, mem_bytes, node_slot
+            )
         if slot < 0:
             raise MemoryError("pod capacity exhausted")
         return slot
 
     def delete_pod(self, uid: str) -> int:
-        return self._lib.ess_delete_pod(self._ptr, uid.encode())
+        with self.lock:
+            return self._lib.ess_delete_pod(self._ptr, uid.encode())
 
     def upsert_node(self, name: str, group: int, cpu_milli: int, mem_bytes: int,
                     creation_ns: int = 0, tainted: bool = False,
                     cordoned: bool = False, no_delete: bool = False,
                     taint_time_sec: int = NO_TAINT_TIME) -> int:
-        self._ensure_node_capacity()
-        slot = self._lib.ess_upsert_node(
-            self._ptr, name.encode(), group, cpu_milli, mem_bytes, creation_ns,
-            int(tainted), int(cordoned), int(no_delete), taint_time_sec,
-        )
+        with self.lock:
+            self._ensure_node_capacity()
+            slot = self._lib.ess_upsert_node(
+                self._ptr, name.encode(), group, cpu_milli, mem_bytes, creation_ns,
+                int(tainted), int(cordoned), int(no_delete), taint_time_sec,
+            )
         if slot < 0:
             raise MemoryError("node capacity exhausted")
         return slot
 
     def delete_node(self, name: str) -> int:
-        return self._lib.ess_delete_node(self._ptr, name.encode())
+        with self.lock:
+            return self._lib.ess_delete_node(self._ptr, name.encode())
 
     def upsert_pods_batch(self, uids, group, cpu_milli, mem_bytes,
                           node_slot=None) -> None:
@@ -244,22 +258,23 @@ class NativeStateStore:
                 raise ValueError(f"{name} has length {len(arr)}, expected {n}")
         c_uids = (ctypes.c_char_p * n)(*[u.encode() for u in uids])
         done = 0
-        while done < n:
-            applied = self._lib.ess_upsert_pods_batch(
-                self._ptr,
-                ctypes.cast(
-                    ctypes.byref(c_uids, done * ctypes.sizeof(ctypes.c_char_p)),
-                    ctypes.POINTER(ctypes.c_char_p),
-                ),
-                group[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                cpu_milli[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                mem_bytes[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                node_slot[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                n - done,
-            )
-            done += applied
-            if done < n:
-                self.grow(self.pod_capacity * 2, self.node_capacity)
+        with self.lock:
+            while done < n:
+                applied = self._lib.ess_upsert_pods_batch(
+                    self._ptr,
+                    ctypes.cast(
+                        ctypes.byref(c_uids, done * ctypes.sizeof(ctypes.c_char_p)),
+                        ctypes.POINTER(ctypes.c_char_p),
+                    ),
+                    group[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    cpu_milli[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    mem_bytes[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    node_slot[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    n - done,
+                )
+                done += applied
+                if done < n:
+                    self.grow(self.pod_capacity * 2, self.node_capacity)
 
     def upsert_nodes_batch(self, names, group, cpu_milli, mem_bytes,
                            creation_ns=None, tainted=None, cordoned=None,
@@ -293,26 +308,27 @@ class NativeStateStore:
         c_names = (ctypes.c_char_p * n)(*[s.encode() for s in names])
         i64p = ctypes.POINTER(ctypes.c_int64)
         done = 0
-        while done < n:
-            applied = self._lib.ess_upsert_nodes_batch(
-                self._ptr,
-                ctypes.cast(
-                    ctypes.byref(c_names, done * ctypes.sizeof(ctypes.c_char_p)),
-                    ctypes.POINTER(ctypes.c_char_p),
-                ),
-                group[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                cpu_milli[done:].ctypes.data_as(i64p),
-                mem_bytes[done:].ctypes.data_as(i64p),
-                creation_ns[done:].ctypes.data_as(i64p),
-                tainted[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                cordoned[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                no_delete[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                taint_time_sec[done:].ctypes.data_as(i64p),
-                n - done,
-            )
-            done += applied
-            if done < n:
-                self.grow(self.pod_capacity, self.node_capacity * 2)
+        with self.lock:
+            while done < n:
+                applied = self._lib.ess_upsert_nodes_batch(
+                    self._ptr,
+                    ctypes.cast(
+                        ctypes.byref(c_names, done * ctypes.sizeof(ctypes.c_char_p)),
+                        ctypes.POINTER(ctypes.c_char_p),
+                    ),
+                    group[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    cpu_milli[done:].ctypes.data_as(i64p),
+                    mem_bytes[done:].ctypes.data_as(i64p),
+                    creation_ns[done:].ctypes.data_as(i64p),
+                    tainted[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    cordoned[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    no_delete[done:].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    taint_time_sec[done:].ctypes.data_as(i64p),
+                    n - done,
+                )
+                done += applied
+                if done < n:
+                    self.grow(self.pod_capacity, self.node_capacity * 2)
 
     def node_slot(self, name: str) -> int:
         return self._lib.ess_node_slot(self._ptr, name.encode())
@@ -340,10 +356,11 @@ class NativeStateStore:
             n = drain_fn(self._ptr, out.ctypes.data_as(i64p))
             return out[:n]
 
-        return (
-            _drain(self.pod_dirty_count, self._lib.ess_drain_pod_dirty),
-            _drain(self.node_dirty_count, self._lib.ess_drain_node_dirty),
-        )
+        with self.lock:
+            return (
+                _drain(self.pod_dirty_count, self._lib.ess_drain_pod_dirty),
+                _drain(self.node_dirty_count, self._lib.ess_drain_node_dirty),
+            )
 
     def pod_slot(self, uid: str) -> int:
         return self._lib.ess_pod_slot(self._ptr, uid.encode())

@@ -192,11 +192,12 @@ def pack_cluster_sharded(
     return ClusterArrays.tree_unflatten(None, stacked), assignment
 
 
-def make_sharded_decider(mesh: Mesh):
+def make_sharded_decider(mesh: Mesh, impl: str = "xla"):
     """jitted ``(sharded_cluster, now_sec) -> DecisionArrays`` with the leading shard
     axis partitioned over the mesh (1-D or hybrid). Local blocks may hold several
     shards (vmap'ed); no collectives are emitted — per-group decisions are
-    shard-local by construction."""
+    shard-local by construction. ``impl`` selects the aggregation sweep exactly
+    as in ``ops.kernel.decide`` (so ESCALATOR_TPU_KERNEL_IMPL applies here too)."""
     spec = _group_spec(mesh)
 
     @jax.jit
@@ -205,9 +206,14 @@ def make_sharded_decider(mesh: Mesh):
         mesh=mesh,
         in_specs=(spec, P()),
         out_specs=spec,
+        # pallas_call (impl="pallas") cannot express varying-mesh-axes
+        # metadata yet; outputs are shard-local so no replication is claimed
+        check_vma=(impl != "pallas"),
     )
     def sharded_decide(cluster: ClusterArrays, now_sec) -> DecisionArrays:
-        return jax.vmap(decide, in_axes=(0, None))(cluster, now_sec)
+        return jax.vmap(lambda c, t: decide(c, t, impl=impl), in_axes=(0, None))(
+            cluster, now_sec
+        )
 
     return sharded_decide
 

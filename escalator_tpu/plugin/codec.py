@@ -23,6 +23,17 @@ from escalator_tpu.core.arrays import ClusterArrays, GroupArrays, NodeArrays, Po
 _MAGIC = b"ESCT"
 _VERSION = 1
 
+#: Fields added to the wire format after v1 frames shipped, with the default a
+#: decoder must assume when a peer's frame predates them. Keyed by frame array
+#: name; the value is (dtype, fill) — the array is materialised against the
+#: section's lane count. Keeping explicit defaults (rather than bumping
+#: _VERSION) lets mixed-version peers interoperate with *defined* semantics:
+#: an old frame decodes as "no group uses emptiest-first", which is exactly
+#: what an old encoder meant.
+_OPTIONAL_DEFAULTS = {
+    "g.emptiest": (np.bool_, False),
+}
+
 
 def _encode_arrays(named: List[Tuple[str, np.ndarray]]) -> bytes:
     header = []
@@ -86,18 +97,36 @@ def encode_cluster(cluster: ClusterArrays, now_sec: int) -> bytes:
     return _encode_arrays(named)
 
 
+def _section(arrays: Dict[str, np.ndarray], prefix: str, cls):
+    """Build one SoA section, filling documented defaults for fields an older
+    peer's frame predates (see _OPTIONAL_DEFAULTS). A missing field with no
+    documented default is a hard, *named* error rather than a KeyError."""
+    lanes = next(
+        (len(arrays[prefix + f.name]) for f in fields(cls) if prefix + f.name in arrays),
+        0,
+    )
+    out = {}
+    for f in fields(cls):
+        key = prefix + f.name
+        arr = arrays.get(key)
+        if arr is None:
+            if key not in _OPTIONAL_DEFAULTS:
+                raise ValueError(
+                    f"frame is missing required array {key!r} "
+                    "(peer speaks an incompatible codec revision)"
+                )
+            dtype, fill = _OPTIONAL_DEFAULTS[key]
+            arr = np.full(lanes, fill, dtype)
+        out[f.name] = arr
+    return cls(**out)
+
+
 def decode_cluster(data: bytes) -> Tuple[ClusterArrays, int]:
     arrays = _decode_arrays(data)
     now_sec = int(arrays.pop("__now__")[0])
-    g = GroupArrays(**{
-        f.name: arrays["g." + f.name] for f in fields(GroupArrays)
-    })
-    p = PodArrays(**{
-        f.name: arrays["p." + f.name] for f in fields(PodArrays)
-    })
-    n = NodeArrays(**{
-        f.name: arrays["n." + f.name] for f in fields(NodeArrays)
-    })
+    g = _section(arrays, "g.", GroupArrays)
+    p = _section(arrays, "p.", PodArrays)
+    n = _section(arrays, "n.", NodeArrays)
     return ClusterArrays(groups=g, pods=p, nodes=n), now_sec
 
 

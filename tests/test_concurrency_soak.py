@@ -1,0 +1,185 @@
+"""Threaded soak test — the ``go test -race`` analog (SURVEY.md §5: the reference
+runs its whole suite under the race detector, Makefile:13-14). Python has no tsan,
+so this drives the actual racy interleaving instead: the controller ticks on one
+thread while watch events mutate the cluster from others, across the two backends
+that share state with the ingest path (golden via the RLock'd in-memory client,
+native via the C++ store's single-writer lock). Correctness oracle: after the
+mutators quiesce, one more decision through the soaked backend must match a fresh
+golden evaluation of the same final state — a torn snapshot or a lost dirty mark
+would leave the device-resident arrays permanently diverged, which is exactly what
+this catches."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from escalator_tpu.controller import controller as ctl
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.controller.backend import GoldenBackend
+from escalator_tpu.controller.native_backend import make_native_backend
+from escalator_tpu.k8s.cache import EventfulClient
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_nodes,
+    build_test_pods,
+)
+from escalator_tpu.testsupport.cloud_provider import (
+    MockBuilder,
+    MockCloudProvider,
+    MockNodeGroup,
+)
+from escalator_tpu.utils.clock import MockClock
+
+LABEL_KEY = "customer"
+LABEL_VALUE = "soak"
+
+TICKS = 12
+EVENTS_PER_THREAD = 150
+MUTATOR_THREADS = 2
+
+
+def _opts():
+    return ngmod.NodeGroupOptions(
+        name="soak",
+        label_key=LABEL_KEY,
+        label_value=LABEL_VALUE,
+        cloud_provider_group_name="soak-asg",
+        min_nodes=1,
+        max_nodes=300,
+        taint_upper_capacity_threshold_percent=45,
+        taint_lower_capacity_threshold_percent=30,
+        scale_up_threshold_percent=70,
+        slow_node_removal_rate=1,
+        fast_node_removal_rate=2,
+        soft_delete_grace_period="5m",
+        hard_delete_grace_period="15m",
+        scale_up_cool_down_period="10m",
+    )
+
+
+def _build_world(backend_kind: str):
+    opts = _opts()
+    nodes = build_test_nodes(
+        12,
+        NodeOpts(cpu=4000, mem=16 << 30, label_key=LABEL_KEY,
+                 label_value=LABEL_VALUE),
+    )
+    pods = build_test_pods(
+        60,
+        PodOpts(cpu=[200], mem=[512 << 20], node_selector_key=LABEL_KEY,
+                node_selector_value=LABEL_VALUE),
+    )
+    client = EventfulClient(nodes=nodes, pods=pods)
+    if backend_kind == "native":
+        backend = make_native_backend(client, [opts])
+    else:
+        backend = GoldenBackend()
+    provider = MockCloudProvider()
+    provider.register_node_group(
+        MockNodeGroup("soak-asg", "soak", min_size=1, max_size=300,
+                      target_size=len(nodes))
+    )
+    controller = ctl.Controller(
+        ctl.Opts(
+            client=client,
+            node_groups=[opts],
+            cloud_provider_builder=MockBuilder(provider),
+            dry_mode=False,
+            backend=backend,
+            clock=MockClock(),
+        )
+    )
+    return client, controller
+
+
+def _mutator(client: EventfulClient, seed: int, stop: threading.Event,
+             errors: list):
+    """Churn pods and nodes through the watch path: adds, deletes, phase flips
+    (which the informer semantics turn into watch deletes), node adds."""
+    rng = np.random.default_rng(seed)
+    try:
+        for _ in range(EVENTS_PER_THREAD):
+            if stop.is_set():
+                return
+            roll = int(rng.integers(0, 10))
+            if roll < 4:
+                client.add_pod(
+                    build_test_pods(1, PodOpts(
+                        cpu=[int(rng.integers(50, 400))],
+                        mem=[int(rng.integers(1, 4)) << 28],
+                        node_selector_key=LABEL_KEY,
+                        node_selector_value=LABEL_VALUE))[0]
+                )
+            elif roll < 6:
+                pods = client.list_pods()
+                if pods:
+                    client.remove_pod(pods[int(rng.integers(0, len(pods)))])
+            elif roll < 8:
+                pods = client.list_pods()
+                if pods:
+                    p = pods[int(rng.integers(0, len(pods)))]
+                    p.phase = "Succeeded" if roll == 6 else "Running"
+                    client.update_pod(p)
+            else:
+                client.add_node(
+                    build_test_nodes(1, NodeOpts(
+                        cpu=4000, mem=16 << 30, label_key=LABEL_KEY,
+                        label_value=LABEL_VALUE))[0]
+                )
+    except Exception as e:  # pragma: no cover - the failure this test hunts
+        errors.append(e)
+
+
+@pytest.mark.parametrize("backend_kind", ["golden", "native"])
+def test_soak_ticks_while_watch_mutates(backend_kind):
+    client, controller = _build_world(backend_kind)
+    stop = threading.Event()
+    errors: list = []
+    threads = [
+        threading.Thread(
+            target=_mutator, args=(client, 1000 + t, stop, errors), daemon=True
+        )
+        for t in range(MUTATOR_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(TICKS):
+            controller.run_once()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    assert not errors, f"mutator thread crashed: {errors[0]!r}"
+    assert all(not t.is_alive() for t in threads)
+
+    # Quiesced oracle: the soaked backend must agree with a fresh golden
+    # evaluation of the same final cluster state.
+    state = controller.node_groups["soak"]
+    state.kernel_state.locked = state.scale_lock.locked()
+    state.kernel_state.requested_nodes = state.scale_lock.requested_nodes
+    now_sec = int(controller.clock.now())
+    pods = state.pod_lister.list()
+    nodes = state.node_lister.list()
+    backend_objects = (pods, nodes) if controller.backend.needs_objects else ([], [])
+    soaked = controller.backend.decide(
+        [(backend_objects[0], backend_objects[1],
+          state.opts.to_group_config(), state.kernel_state)],
+        now_sec,
+        dry_mode_flags=[False],
+        taint_trackers=[state.taint_tracker],
+    )[0].decision
+    golden = GoldenBackend().decide(
+        [(pods, nodes, state.opts.to_group_config(), state.kernel_state)],
+        now_sec,
+        dry_mode_flags=[False],
+        taint_trackers=[state.taint_tracker],
+    )[0].decision
+    assert soaked.status == golden.status
+    assert soaked.nodes_delta == golden.nodes_delta
+    assert soaked.num_pods == golden.num_pods
+    assert soaked.num_nodes == golden.num_nodes
+    assert soaked.cpu_request_milli == golden.cpu_request_milli
+    assert soaked.mem_request_bytes == golden.mem_request_bytes

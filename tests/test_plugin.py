@@ -45,6 +45,46 @@ def test_codec_rejects_garbage():
         codec.decode_cluster(b"NOPE" + b"\0" * 64)
 
 
+def test_codec_tolerates_pre_emptiest_frames():
+    """A frame from a peer that predates the g.emptiest column must decode with
+    the documented default (no group uses emptiest-first), not KeyError —
+    mixed-version interop is explicit, not accidental."""
+    rng = random.Random(2)
+    groups = [random_group(rng, gi) for gi in range(4)]
+    cluster = pack_cluster(groups, pad_pods=128, pad_nodes=64, pad_groups=8)
+    named = [("__now__", np.array([NOW], np.int64))]
+    for prefix, section in (
+        ("g.", cluster.groups), ("p.", cluster.pods), ("n.", cluster.nodes)
+    ):
+        for f in section.__dataclass_fields__:
+            if prefix + f == "g.emptiest":
+                continue  # the old peer never heard of it
+            named.append((prefix + f, getattr(section, f)))
+    old_frame = codec._encode_arrays(named)
+    decoded, now = codec.decode_cluster(old_frame)
+    assert now == NOW
+    assert decoded.groups.emptiest.dtype == np.bool_
+    assert not decoded.groups.emptiest.any()
+    np.testing.assert_array_equal(decoded.groups.valid, cluster.groups.valid)
+
+
+def test_codec_missing_required_field_is_named_error():
+    with pytest.raises(ValueError, match="p.cpu_milli"):
+        named = [("__now__", np.array([NOW], np.int64))]
+        rng = random.Random(3)
+        cluster = pack_cluster(
+            [random_group(rng, 0)], pad_pods=64, pad_nodes=32, pad_groups=8
+        )
+        for prefix, section in (
+            ("g.", cluster.groups), ("p.", cluster.pods), ("n.", cluster.nodes)
+        ):
+            for f in section.__dataclass_fields__:
+                if prefix + f == "p.cpu_milli":
+                    continue
+                named.append((prefix + f, getattr(section, f)))
+        codec.decode_cluster(codec._encode_arrays(named))
+
+
 def test_codec_round_trip_at_scale():
     """100k-pod frame: the marshalling hard part (SURVEY §7) across the plugin
     boundary — every column exact through the single-copy encoder."""
