@@ -216,12 +216,10 @@ def _unpack(out, group_inputs) -> List[GroupDecision]:
 
 
 def _kernel_impl() -> str:
-    """Aggregation sweep selector: "xla" (default) or "pallas" (the fused MXU
-    kernel, ops/pallas_kernel.py). Env-switched so any backend/CLI user can
-    opt in without new flags; invalid values fail fast in decide()."""
-    import os
+    """Aggregation sweep selector (see ops.kernel.default_impl)."""
+    from escalator_tpu.ops.kernel import default_impl
 
-    return os.environ.get("ESCALATOR_TPU_KERNEL_IMPL", "xla")
+    return default_impl()
 
 
 class JaxBackend(ComputeBackend):

@@ -153,19 +153,23 @@ class NativeJaxBackend(ComputeBackend):
                     np.concatenate([node_dirty, self._overridden_slots, overridden])
                 )
                 self._cache.set_host(pods, nodes)
-                # two async dispatches (scatter, then decide) pipeline back-to-back;
-                # measured faster than the fused single-program alternative
-                # (DeviceClusterCache.apply_dirty_and_decide) on the v5e tunnel.
-                # The gather inside copies the dirty lanes, so releasing the lock
-                # before the async transfer completes is safe.
-                self._cache.apply_dirty(pod_dirty, node_dirty, groups)
+                # lock covers only the host gather (reads the live views);
+                # the device dispatch — and any jit compile a new delta-bucket
+                # size triggers — happens after release, so watch ingestion
+                # never convoys behind a transfer or compile
+                gathered = self._cache.gather_deltas(pod_dirty, node_dirty)
         if rebuild:
-            # outside the lock: upload the snapshot copies, then rebind the
-            # live views for future O(changes) gathers
+            # outside the lock: upload the snapshot copies. The cache's host
+            # views rebind on the next tick's set_host before any gather, so
+            # no live-view binding is needed (or safe) here.
             self._cache = DeviceClusterCache(
                 ClusterArrays(groups=groups, pods=pods_snap, nodes=nodes_snap)
             )
-            self._cache.set_host(pods, nodes)
+        else:
+            # two async dispatches (scatter, then decide) pipeline back-to-back;
+            # measured faster than the fused single-program alternative
+            # (DeviceClusterCache.apply_dirty_and_decide) on the v5e tunnel
+            self._cache.apply_gathered(gathered, groups)
         self._overridden_slots = overridden
         t1 = time.perf_counter()
         from escalator_tpu.controller.backend import _kernel_impl

@@ -204,6 +204,28 @@ class DeviceClusterCache:
         )
         return pidx, pvals, nidx, nvals
 
+    def gather_deltas(self, pod_slots: np.ndarray, node_slots: np.ndarray):
+        """The host-side half of :meth:`apply_dirty`: copy the dirty lanes out
+        of the (live, possibly shared) host views into padded numpy buffers.
+        Callers that share the views with a writer thread run THIS under the
+        store lock and :meth:`apply_gathered` outside it — the gather is the
+        only part that reads shared memory; the device dispatch (and any jit
+        compile it triggers) must not stall ingestion."""
+        return self._gather_deltas(pod_slots, node_slots)
+
+    def apply_gathered(
+        self, gathered, groups: Optional[GroupArrays] = None
+    ) -> ClusterArrays:
+        """Device half of :meth:`apply_dirty`: scatter a `gather_deltas` batch
+        (already-copied buffers — safe to run unlocked) into the resident arrays."""
+        if groups is None:
+            groups = self._cluster.groups
+        pidx, pvals, nidx, nvals = gathered
+        self._cluster = _scatter_update(
+            self._cluster.pods, self._cluster.nodes, groups, pidx, pvals, nidx, nvals
+        )
+        return self._cluster
+
     def apply_dirty(
         self,
         pod_slots: np.ndarray,
@@ -213,13 +235,7 @@ class DeviceClusterCache:
         """Scatter this tick's dirty lanes (plus fresh group state) into the
         resident arrays. O(changes) host work + transfer; returns the updated
         device cluster."""
-        if groups is None:
-            groups = self._cluster.groups
-        pidx, pvals, nidx, nvals = self._gather_deltas(pod_slots, node_slots)
-        self._cluster = _scatter_update(
-            self._cluster.pods, self._cluster.nodes, groups, pidx, pvals, nidx, nvals
-        )
-        return self._cluster
+        return self.apply_gathered(self.gather_deltas(pod_slots, node_slots), groups)
 
     def apply_dirty_and_decide(
         self,

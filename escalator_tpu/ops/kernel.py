@@ -88,6 +88,17 @@ _I64 = jnp.int64
 _F64 = jnp.float64
 
 
+def default_impl() -> str:
+    """Aggregation-sweep selector from ESCALATOR_TPU_KERNEL_IMPL: "xla"
+    (default, one scatter-add per column) or "pallas" (the fused MXU sweep).
+    Read by every decider constructor that doesn't get an explicit ``impl`` —
+    backends, the mesh-sharded and pod-axis deciders alike — so the env switch
+    means the same thing everywhere. Invalid values fail fast in decide()."""
+    import os
+
+    return os.environ.get("ESCALATOR_TPU_KERNEL_IMPL", "xla")
+
+
 def _segsum(values, segment_ids, num_segments):
     return jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
 
@@ -208,8 +219,9 @@ def decide(
 
     impl selects the aggregation sweep: "xla" = one scatter-add per column
     (jax.ops.segment_sum); "pallas" = the fused windowed one-hot-matmul MXU
-    kernel (ops.pallas_kernel), which self-falls-back to the scatter path on
-    device when its layout/range preconditions fail. Outputs are bit-identical.
+    kernel (ops.pallas_kernel), which self-sorts group-interleaved lanes on
+    device and falls back to the scatter path only for out-of-range values or
+    sub-lane-per-group pathology. Outputs are bit-identical either way.
 
     aggregates optionally injects precomputed (pod_aggs, node_aggs) from
     :func:`aggregate_pods`/:func:`aggregate_nodes` — used by the pod-axis

@@ -24,10 +24,17 @@ sweep that rides the MXU:
   aligned dynamic slice.
 
 The group-contiguity invariant can be broken by the device-resident
-incremental path (``ops.device_state`` reuses free slots across groups), and
-values ≥ 2^48 (256 TB memory requests) exceed the limb range, so the wrapper
-checks both preconditions on device and falls back to the XLA scatter path
-via ``lax.cond`` — same outputs either way, so callers see one function.
+incremental path (``ops.device_state`` reuses free slots across groups). That
+no longer forces the scatter fallback: when the layout check fails, the
+wrapper SORTS the lanes by group id on device (one argsort + gathers — cheap
+next to eight scatter sweeps) and runs the same windowed kernel on the sorted
+layout, so the event-driven native tick rides the MXU even on churned
+clusters with interleaved slots. The XLA scatter path remains only for the
+genuinely incompatible cases: values ≥ 2^48 (256 TB memory requests) exceed
+the limb range, and a sorted tile can still span > MAX_SPREAD distinct groups
+when groups average < ~1 lane each (tiny-group pathology). All selection is
+on-device via nested ``lax.cond`` — same outputs either way, so callers see
+one function; :func:`path_report` reproduces the choice for tests/benchmarks.
 
 No reference analog: Escalator has no accelerator kernels at all (SURVEY.md
 §1 "no native code"); this is the TPU-first replacement for its hot loop.
@@ -203,12 +210,25 @@ def fused_segment_sums(
     ids_p = jnp.pad(ids32, (0, pad), mode="edge" if P else "constant")
     valid_p = jnp.pad(valid, (0, pad))
 
-    ids2 = ids_p.reshape(n_tiles, TILE)
-    valid2 = valid_p.reshape(n_tiles, TILE)
     big = jnp.int32(1 << 30)
-    tile_min = jnp.min(jnp.where(valid2, ids2, big), axis=1)
-    tile_max = jnp.max(jnp.where(valid2, ids2, -1), axis=1)
-    spread_ok = jnp.all(tile_max - tile_min <= MAX_SPREAD)
+    g_out = _round_up(num_segments, ALIGN) + WINDOW
+
+    def layout(ids_flat, valid_flat):
+        """(spread_ok, ids_clean[n_tiles,TILE], bases[n_tiles]) for one lane order."""
+        ids2 = ids_flat.reshape(n_tiles, TILE)
+        valid2 = valid_flat.reshape(n_tiles, TILE)
+        tile_min = jnp.min(jnp.where(valid2, ids2, big), axis=1)
+        tile_max = jnp.max(jnp.where(valid2, ids2, -1), axis=1)
+        spread_ok = jnp.all(tile_max - tile_min <= MAX_SPREAD)
+        # invalid lanes: point ids at the tile's window (their values are zero)
+        tile_min_ok = jnp.where(tile_min == big, 0, tile_min)
+        ids_clean = jnp.where(valid2, ids2, tile_min_ok[:, None])
+        bases = jnp.clip((tile_min_ok // ALIGN) * ALIGN, 0, g_out - WINDOW).astype(
+            jnp.int32
+        )
+        return spread_ok, ids_clean, bases
+
+    spread_direct, ids_clean_d, bases_d = layout(ids_p, valid_p)
     in_range = jnp.bool_(True)
     for col in int_columns.values():
         in_range &= jnp.all((col >= 0) & (col < MAX_VALUE))
@@ -228,15 +248,8 @@ def fused_segment_sums(
 
     limb_mask = (1 << LIMB_BITS) - 1
 
-    def pallas_path(_):
-        # invalid lanes: point ids at the tile's window (their values are zero)
-        tile_min_ok = jnp.where(tile_min == big, 0, tile_min)
-        ids_clean = jnp.where(valid2, ids2, tile_min_ok[:, None])
-        g_out = _round_up(num_segments, ALIGN) + WINDOW
-        bases = jnp.clip((tile_min_ok // ALIGN) * ALIGN, 0, g_out - WINDOW).astype(
-            jnp.int32
-        )
-
+    def build_cols():
+        """[MAX_COLS, P_pad] f32 limb/count rows in lane order."""
         col_rows = []
         for col in int_columns.values():
             col_p = jnp.pad(col, (0, pad))
@@ -248,13 +261,13 @@ def fused_segment_sums(
             col_rows.append(jnp.pad(col.astype(jnp.float32), (0, pad)))
         while len(col_rows) < MAX_COLS:
             col_rows.append(jnp.zeros(P_pad, jnp.float32))
-        cols = jnp.stack(col_rows)  # [MAX_COLS, P_pad]
+        return jnp.stack(col_rows)
 
+    def run_pallas(ids_clean, bases, cols):
         totals = _pallas_partials(
             ids_clean[:, None, :], cols, bases,
             num_segments=num_segments, interpret=interpret,
         ).astype(jnp.int64)  # [G_out, MAX_COLS]
-
         out = []
         ci = 0
         for _ in int_columns:
@@ -268,5 +281,86 @@ def fused_segment_sums(
             ci += 1
         return tuple(out)
 
-    results = lax.cond(spread_ok & in_range, pallas_path, xla_path, None)
+    def pallas_direct(_):
+        return run_pallas(ids_clean_d, bases_d, build_cols())
+
+    def pallas_sorted(_):
+        # Lanes are group-interleaved (slot reuse in the incremental store):
+        # restore contiguity on device. One argsort + gathers, then the same
+        # MXU sweep — still far cheaper than eight scatter sweeps. Invalid
+        # lanes key to `big`, so they collect at the tail.
+        perm = jnp.argsort(jnp.where(valid_p, ids_p, big))
+        ids_s = ids_p[perm]
+        valid_s = valid_p[perm]
+        spread_sorted, ids_clean_s, bases_s = layout(ids_s, valid_s)
+        cols_s = build_cols()[:, perm]
+        # a sorted tile can still span > MAX_SPREAD groups when groups average
+        # under ~1 lane each — only then is scatter the right tool
+        return lax.cond(
+            spread_sorted,
+            lambda __: run_pallas(ids_clean_s, bases_s, cols_s),
+            xla_path,
+            None,
+        )
+
+    results = lax.cond(
+        in_range,
+        lambda _: lax.cond(spread_direct, pallas_direct, pallas_sorted, None),
+        xla_path,
+        None,
+    )
     return dict(zip(names, results))
+
+
+def path_report(ids, valid, int_columns=None, num_segments: int = 0) -> Dict[str, bool]:
+    """Which path :func:`fused_segment_sums` takes for this input, as host values.
+
+    Reproduces the on-device predicates (same tile math) so tests and benchmarks
+    can ASSERT the MXU path is reachable rather than trusting that it was.
+    Returns ``{"path": "pallas-direct"|"pallas-sorted"|"xla-scatter", ...}`` with
+    the individual predicates alongside.
+    """
+    import numpy as np
+
+    ids_np = np.asarray(ids, np.int64)
+    valid_np = np.asarray(valid, bool)
+    P = ids_np.shape[0]
+    if P > MAX_LANES:
+        return {
+            "path": "xla-scatter", "lanes": P, "direct_ok": False,
+            "sorted_ok": False, "in_range": False, "too_many_lanes": True,
+        }
+    P_pad = _round_up(max(P, TILE), TILE)
+    n_tiles = P_pad // TILE
+    pad = P_pad - P
+    mode = "edge" if P else "constant"
+    ids_p = np.pad(ids_np, (0, pad), mode=mode)
+    valid_p = np.pad(valid_np, (0, pad))
+    big = 1 << 30
+
+    def spread_ok(ids_flat, valid_flat) -> bool:
+        ids2 = ids_flat.reshape(n_tiles, TILE)
+        valid2 = valid_flat.reshape(n_tiles, TILE)
+        tile_min = np.min(np.where(valid2, ids2, big), axis=1)
+        tile_max = np.max(np.where(valid2, ids2, -1), axis=1)
+        return bool(np.all(tile_max - tile_min <= MAX_SPREAD))
+
+    direct_ok = spread_ok(ids_p, valid_p)
+    perm = np.argsort(np.where(valid_p, ids_p, big), kind="stable")
+    sorted_ok = spread_ok(ids_p[perm], valid_p[perm])
+    in_range = True
+    for col in (int_columns or {}).values():
+        col = np.asarray(col)
+        in_range = in_range and bool(np.all((col >= 0) & (col < MAX_VALUE)))
+    if not in_range:
+        path = "xla-scatter"
+    elif direct_ok:
+        path = "pallas-direct"
+    elif sorted_ok:
+        path = "pallas-sorted"
+    else:
+        path = "xla-scatter"
+    return {
+        "path": path, "lanes": P, "direct_ok": direct_ok,
+        "sorted_ok": sorted_ok, "in_range": in_range, "too_many_lanes": False,
+    }

@@ -192,12 +192,17 @@ def pack_cluster_sharded(
     return ClusterArrays.tree_unflatten(None, stacked), assignment
 
 
-def make_sharded_decider(mesh: Mesh, impl: str = "xla"):
+def make_sharded_decider(mesh: Mesh, impl: Optional[str] = None):
     """jitted ``(sharded_cluster, now_sec) -> DecisionArrays`` with the leading shard
     axis partitioned over the mesh (1-D or hybrid). Local blocks may hold several
     shards (vmap'ed); no collectives are emitted — per-group decisions are
     shard-local by construction. ``impl`` selects the aggregation sweep exactly
-    as in ``ops.kernel.decide`` (so ESCALATOR_TPU_KERNEL_IMPL applies here too)."""
+    as in ``ops.kernel.decide``; when omitted it follows ESCALATOR_TPU_KERNEL_IMPL
+    (ops.kernel.default_impl), so the env switch reaches direct callers too."""
+    from escalator_tpu.ops.kernel import default_impl
+
+    if impl is None:
+        impl = default_impl()
     spec = _group_spec(mesh)
 
     @jax.jit

@@ -85,14 +85,17 @@ def place(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
     )
 
 
-def make_podaxis_decider(mesh: Mesh, impl: str = "xla"):
+def make_podaxis_decider(mesh: Mesh, impl: str | None = None):
     """jitted ``(cluster, now_sec) -> DecisionArrays`` with the O(P) pod sweep
     sharded over the mesh and combined with psum. Bit-identical to
     ``kernel.decide`` on the same cluster (integer partial sums commute).
 
+    ``impl`` defaults to ESCALATOR_TPU_KERNEL_IMPL (ops.kernel.default_impl).
     The pod axis length must be a multiple of the mesh size
     (:func:`pad_pods_for_mesh`).
     """
+    if impl is None:
+        impl = kernel.default_impl()
     names = tuple(mesh.axis_names)
     pod_spec = _pod_spec(mesh)
 

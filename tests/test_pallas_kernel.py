@@ -53,13 +53,19 @@ def test_fused_sums_match_reference_sorted(P, G):
         np.testing.assert_array_equal(np.asarray(got[name]), want[name], err_msg=name)
 
 
-def test_fused_sums_fallback_on_unsorted_layout():
-    """Scattered ids break the window precondition -> XLA branch, same answer."""
+def test_fused_sums_sorts_unsorted_layout_on_device():
+    """Scattered ids break the direct window precondition; the kernel now
+    restores contiguity with an on-device argsort and still rides the MXU
+    (this is the incremental-store slot-reuse layout, ops/device_state.py)."""
     rng = np.random.default_rng(7)
     P, G = 4000, 1024
     ids = rng.integers(0, G, P).astype(np.int32)  # random => huge per-tile spread
     valid = np.ones(P, bool)
     cpu = rng.integers(0, 2**40, P).astype(np.int64)
+
+    report = pk.path_report(ids, valid, {"cpu": cpu})
+    assert report["path"] == "pallas-sorted"
+    assert not report["direct_ok"] and report["sorted_ok"]
 
     got = pk.fused_segment_sums(
         jnp.asarray(ids),
@@ -68,6 +74,68 @@ def test_fused_sums_fallback_on_unsorted_layout():
         {},
         num_segments=G,
         interpret=True,
+    )
+    want = _ref_sums(ids, valid, {"cpu": cpu}, {}, G)
+    np.testing.assert_array_equal(np.asarray(got["cpu"]), want["cpu"])
+
+
+def test_fused_sums_slot_reuse_interleaving_takes_sorted_mxu_path():
+    """The exact churn pattern that used to exile cfg6 to the scatter path:
+    group-contiguous base layout with a fraction of freed slots reused by
+    OTHER groups. Must take the sorted MXU path and stay bit-exact, including
+    invalid (freed) lanes and partially-filled tails."""
+    rng = np.random.default_rng(11)
+    # G must exceed the kernel's WINDOW: with few groups any interleaving still
+    # fits one tile window and the direct path absorbs it
+    P, G = 12000, 2048
+    ids = _sorted_ids(rng, P, G)
+    valid = np.ones(P, bool)
+    # churn: 15% of slots freed, half of those reused by random other groups
+    freed = rng.random(P) < 0.15
+    valid[freed] = False
+    reused = freed & (rng.random(P) < 0.5)
+    ids[reused] = rng.integers(0, G, int(reused.sum())).astype(np.int32)
+    valid[reused] = True
+    cpu = rng.integers(0, 2**40, P).astype(np.int64) * valid
+    mem = rng.integers(0, 2**47, P).astype(np.int64) * valid
+    cnt = valid.copy()
+
+    report = pk.path_report(ids, valid, {"cpu": cpu, "mem": mem})
+    assert report["path"] == "pallas-sorted"
+
+    got = pk.fused_segment_sums(
+        jnp.asarray(ids),
+        jnp.asarray(valid),
+        {"cpu": jnp.asarray(cpu), "mem": jnp.asarray(mem)},
+        {"cnt": jnp.asarray(cnt)},
+        num_segments=G,
+        interpret=True,
+    )
+    want = _ref_sums(
+        ids[valid], np.ones(int(valid.sum()), bool),
+        {"cpu": cpu[valid], "mem": mem[valid]}, {"cnt": cnt[valid]}, G,
+    )
+    for name in want:
+        np.testing.assert_array_equal(np.asarray(got[name]), want[name], err_msg=name)
+
+
+def test_fused_sums_tiny_group_pathology_falls_back_to_scatter():
+    """Under ~1 lane per group even a sorted tile spans > MAX_SPREAD distinct
+    groups — the one layout where scatter genuinely is the right tool."""
+    rng = np.random.default_rng(13)
+    G = 4096
+    P = G  # one lane per group
+    ids = rng.permutation(G).astype(np.int32)
+    valid = np.ones(P, bool)
+    cpu = rng.integers(0, 2**40, P).astype(np.int64)
+
+    report = pk.path_report(ids, valid, {"cpu": cpu})
+    assert report["path"] == "xla-scatter"
+    assert not report["sorted_ok"]
+
+    got = pk.fused_segment_sums(
+        jnp.asarray(ids), jnp.asarray(valid), {"cpu": jnp.asarray(cpu)}, {},
+        num_segments=G, interpret=True,
     )
     want = _ref_sums(ids, valid, {"cpu": cpu}, {}, G)
     np.testing.assert_array_equal(np.asarray(got["cpu"]), want["cpu"])
@@ -101,15 +169,70 @@ def test_fused_sums_empty_groups_between_populated():
     np.testing.assert_array_equal(np.asarray(got["cpu"]), want["cpu"])
 
 
-def test_decide_pallas_impl_matches_xla_impl():
-    """Full decision kernel: impl='pallas' is bit-identical to impl='xla'."""
+def test_native_store_churned_layout_reaches_mxu_path():
+    """cfg6's blocker, lifted: a native store whose freelist recycles slots
+    across groups used to exile the event-driven tick to the scatter path
+    forever. Assert the live store columns now route to the sorted MXU path."""
+    from escalator_tpu.native import statestore
+
+    if not statestore.available():
+        pytest.skip("native statestore unavailable")
+    rng = np.random.default_rng(17)
+    G, per_group = 2048, 8
+    store = statestore.NativeStateStore(
+        pod_capacity=1 << 15, node_capacity=64
+    )
+    uid = 0
+    for g in range(G):
+        for _ in range(per_group):
+            store.upsert_pod(f"p{uid}", g, 100, 1 << 20)
+            uid += 1
+    # churn: delete a random 10%, re-add as pods of random OTHER groups —
+    # the freelist hands their slots to the new pods, interleaving groups
+    victims = rng.choice(uid, size=uid // 10, replace=False)
+    for v in victims:
+        store.delete_pod(f"p{v}")
+    for i, _ in enumerate(victims):
+        store.upsert_pod(f"q{i}", int(rng.integers(0, G)), 100, 1 << 20)
+    pods, _ = store.as_pod_node_arrays()
+    cpu = pods.cpu_milli * pods.valid
+    report = pk.path_report(pods.group, pods.valid, {"cpu": cpu})
+    assert report["path"] == "pallas-sorted", report
+    # and the sums are still exact through the kernel
+    got = pk.fused_segment_sums(
+        jnp.asarray(np.where(pods.valid, pods.group, 0)),
+        jnp.asarray(np.asarray(pods.valid)),
+        {"cpu": jnp.asarray(np.asarray(cpu))},
+        {},
+        num_segments=G,
+        interpret=True,
+    )
+    want = np.zeros(G, np.int64)
+    np.add.at(want, pods.group[pods.valid], cpu[pods.valid])
+    np.testing.assert_array_equal(np.asarray(got["cpu"]), want)
+
+
+@pytest.mark.parametrize("layout", ["packed", "interleaved"])
+def test_decide_pallas_impl_matches_xla_impl(layout):
+    """Full decision kernel: impl='pallas' is bit-identical to impl='xla',
+    on both the packer's group-contiguous layout and the incremental store's
+    slot-reused interleaving."""
     from escalator_tpu.core.arrays import ClusterArrays, GroupArrays, NodeArrays, PodArrays
     from escalator_tpu.core.arrays import NO_TAINT_TIME
 
     rng = np.random.default_rng(3)
-    G, P, N = 64, 3000, 900
-    pod_group = _sorted_ids(rng, P, G)
-    node_group = _sorted_ids(rng, N, G)
+    if layout == "packed":
+        G, P, N = 64, 3000, 900
+        pod_group = _sorted_ids(rng, P, G)
+        node_group = _sorted_ids(rng, N, G)
+    else:
+        # G > WINDOW so interleaving really breaks the direct layout; enough
+        # lanes per group that the pod sweep takes the sorted MXU path (the
+        # sparser node sweep falls to scatter — mixed paths in one decide)
+        G, P, N = 1024, 8000, 1200
+        pod_group = rng.integers(0, G, P).astype(np.int32)
+        node_group = rng.integers(0, G, N).astype(np.int32)
+        assert pk.path_report(pod_group, np.ones(P, bool))["path"] == "pallas-sorted"
     tainted = rng.random(N) < 0.3
     cluster = ClusterArrays(
         groups=GroupArrays(
