@@ -105,38 +105,13 @@ def _time_decide(cluster, now, iters=20, impl="xla"):
     return float(np.median(times))
 
 
-def _accelerator_alive(timeout_sec: float = 90.0) -> bool:
-    """Probe the default JAX platform in a subprocess. The TPU here rides an
-    experimental tunnel that can wedge indefinitely — a hung probe must not
-    hang the bench, so the parent decides from outside."""
-    import subprocess
-    import sys
-
-    code = "import jax; jax.block_until_ready(jax.numpy.ones(8))"
-    try:
-        return (
-            subprocess.run(
-                [sys.executable, "-c", code],
-                timeout=timeout_sec,
-                capture_output=True,
-            ).returncode
-            == 0
-        )
-    except Exception:
-        # TimeoutExpired, but also OSError/missing interpreter in exotic envs:
-        # any probe failure means "do not trust the accelerator" (matches
-        # __graft_entry__._find_devices)
-        return False
-
-
 def main() -> None:
-    degraded = not _accelerator_alive()
-    import jax
+    # probe-and-degrade: a wedged accelerator tunnel must not hang the bench
+    # (shared helper — also guards the CLI; pins XLA-CPU itself on failure)
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
 
-    if degraded:
-        # accelerator unreachable: fall back to XLA-CPU (same traced program)
-        # rather than hanging the benchmark run
-        jax.config.update("jax_platforms", "cpu")
+    degraded = not ensure_responsive_accelerator()
+    import jax
 
     from escalator_tpu.ops import kernel as _kernel  # noqa: F401 registers pytrees
 

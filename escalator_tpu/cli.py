@@ -33,6 +33,7 @@ import logging
 import signal
 import sys
 import threading
+import time
 from typing import List, Optional
 
 import yaml
@@ -269,18 +270,47 @@ def main(argv: Optional[List[str]] = None) -> int:
             ),
             on_deposed=deposed.set,
         )
+        def _election_event(reason: str, message: str) -> None:
+            """Election activity into the cluster event stream, like the
+            reference's election broadcaster (cmd/main.go:166-170). Dry mode
+            records nothing — shadow runs leave no trace in the cluster."""
+            create = getattr(client, "create_event", None)
+            if create is None or args.drymode:
+                return
+            try:
+                create(k8s.Event(
+                    reason=reason, message=message,
+                    involved_kind="Lease", involved_name="escalator-tpu",
+                    timestamp_sec=int(time.time()),
+                ))
+            except Exception as e:
+                log.warning("failed to record election event: %s", e)
+
         log.info("awaiting leadership (%s)", elector.identity)
         if not elector.run():
             return 1
         log.info("became leader")
+        _election_event(
+            "LeaderElected", f"{elector.identity} became leader"
+        )
 
         def watch_deposed():
             deposed.wait()
             # crash-to-restart HA (reference: cmd/main.go:147-154)
             log.critical("lost leadership lease; exiting")
+            _election_event(
+                "LeaderDeposed", f"{elector.identity} lost the leadership lease"
+            )
             stop_event.set()
 
         threading.Thread(target=watch_deposed, daemon=True).start()
+
+    if args.backend != "golden":
+        # a wedged accelerator transport must degrade to XLA-CPU, not hang the
+        # control loop at the first dispatch (same kernels, same decisions)
+        from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+        ensure_responsive_accelerator()
 
     if args.backend == "native":
         from escalator_tpu.controller.native_backend import make_native_backend

@@ -222,6 +222,144 @@ def _kernel_impl() -> str:
     return default_impl()
 
 
+class PackingPostPass:
+    """Packing-aware delta override (GroupConfig.packing_aware): for OK groups
+    not in a scale-down zone, replace the average-based delta with the FFD
+    overflow count from the device kernel ``ops.binpack.ffd_pack`` — the exact
+    array analog of the golden model's ``semantics.packing_scale_up_delta``.
+
+    Shared by every array backend: object-level backends assemble inputs from
+    their ``group_inputs`` (:meth:`apply`); the event-driven native backend
+    assembles the same tuples from its store columns under the store lock and
+    calls :meth:`apply_arrays` directly. High-water power-of-two pads keep the
+    jit cache to a handful of shapes as group sizes fluctuate."""
+
+    def __init__(self):
+        self._pad_pods = 0
+        self._pad_bins = 0
+        self._pad_groups = 0
+
+    @staticmethod
+    def select(results, group_inputs) -> List[int]:
+        """Indices of groups whose delta the packing pass replaces: configured
+        packing_aware, status OK, and not already a scale-down decision."""
+        sel = []
+        for gi, (_pods, _nodes, config, _state) in enumerate(group_inputs):
+            d = results[gi].decision
+            if (
+                getattr(config, "packing_aware", False)
+                and d.status == semantics.DecisionStatus.OK
+                and d.nodes_delta >= 0
+            ):
+                sel.append(gi)
+        return sel
+
+    def apply(self, results, group_inputs, dry_mode_flags=None,
+              taint_trackers=None) -> None:
+        """Object-level assembly (lister-walking backends)."""
+        sel = self.select(results, group_inputs)
+        if not sel:
+            return
+        sel_data = []
+        for gi in sel:
+            pods, nodes, config, state = group_inputs[gi]
+            dry = bool(dry_mode_flags[gi]) if dry_mode_flags else False
+            tracker = taint_trackers[gi] if taint_trackers else None
+            untainted, _, _ = semantics.filter_nodes(nodes, dry, tracker)
+            reqs = [k8s.compute_pod_resource_request(p) for p in pods]
+            sel_data.append((
+                gi,
+                np.array([r.cpu_milli for r in reqs], np.int64),
+                np.array([r.mem_bytes for r in reqs], np.int64),
+                np.array([n.cpu_allocatable_milli for n in untainted], np.int64),
+                np.array([n.mem_allocatable_bytes for n in untainted], np.int64),
+                (state.cached_cpu_milli, state.cached_mem_bytes),
+                int(config.packing_budget),
+            ))
+        self.apply_arrays(results, sel_data)
+
+    def apply_arrays(self, results, sel_data) -> None:
+        """sel_data: [(group_index, pod_cpu[int64], pod_mem, bin_cpu, bin_mem,
+        (template_cpu, template_mem), budget)]. Runs ONE vmapped device FFD
+        for all selected groups and overwrites their decisions' nodes_delta.
+        FFD time is recorded in the solver_packing_latency histogram."""
+        if not sel_data:
+            return
+        t0 = time.perf_counter()
+        try:
+            from escalator_tpu.ops import binpack
+        except ImportError:
+            binpack = None
+
+        # groups the kernel cannot size virtual nodes for take the reference's
+        # +1 no-cache convention (util.go:26-28) without a device call
+        device_rows = []
+        for row in sel_data:
+            gi, pod_cpu, _m, _bc, _bm, template, _b = row
+            if pod_cpu.size == 0:
+                results[gi].decision.nodes_delta = 0
+            elif template[0] == 0 or template[1] == 0:
+                results[gi].decision.nodes_delta = 1
+            else:
+                device_rows.append(row)
+        if not device_rows:
+            return
+        if binpack is None:
+            # jax-less install (golden/grpc-fallback deployments): the pure
+            # FFD is the same math, just per group on the host
+            for gi, pc, pm, bc, bm, template, budget in device_rows:
+                _, used, unplaced = semantics.ffd_pack_pure(
+                    list(zip(pc.tolist(), pm.tolist())),
+                    list(zip(bc.tolist(), bm.tolist())),
+                    template, budget,
+                )
+                results[gi].decision.nodes_delta = used + unplaced
+            metrics.solver_packing_latency.observe(time.perf_counter() - t0)
+            return
+
+        self._pad_pods = max(
+            self._pad_pods, _round_up(max(r[1].size for r in device_rows))
+        )
+        self._pad_bins = max(
+            self._pad_bins, _round_up(max(r[3].size for r in device_rows), 8)
+        )
+        # one call per distinct budget: the virtual-bin count is a static
+        # kernel shape, and padding it would let FFD spill past the configured
+        # budget — diverging from the golden model's exact-budget packing.
+        # Budgets are config values, so distinct ones stay few and the jit
+        # cache stays small.
+        by_budget: Dict[int, list] = {}
+        for row in device_rows:
+            by_budget.setdefault(row[6], []).append(row)
+        for budget, rows in by_budget.items():
+            self._pad_groups = max(self._pad_groups, _round_up(len(rows), 4))
+            Gp, P, M = self._pad_groups, self._pad_pods, self._pad_bins
+            pod_cpu = np.zeros((Gp, P), np.int64)
+            pod_mem = np.zeros((Gp, P), np.int64)
+            pod_valid = np.zeros((Gp, P), bool)
+            bin_cpu = np.zeros((Gp, M), np.int64)
+            bin_mem = np.zeros((Gp, M), np.int64)
+            bin_valid = np.zeros((Gp, M), bool)
+            t_cpu = np.ones(Gp, np.int64)
+            t_mem = np.ones(Gp, np.int64)
+            for i, (gi, pc, pm, bc, bm, template, _b) in enumerate(rows):
+                pod_cpu[i, : pc.size] = pc
+                pod_mem[i, : pm.size] = pm
+                pod_valid[i, : pc.size] = True
+                bin_cpu[i, : bc.size] = bc
+                bin_mem[i, : bm.size] = bm
+                bin_valid[i, : bc.size] = True
+                t_cpu[i], t_mem[i] = template
+            pack = binpack.ffd_pack(
+                pod_cpu, pod_mem, pod_valid, bin_cpu, bin_mem, bin_valid,
+                t_cpu, t_mem, new_bin_budget=budget,
+            )
+            needed = np.asarray(pack.new_nodes_needed) + np.asarray(pack.unplaced)
+            for i, (gi, *_rest) in enumerate(rows):
+                results[gi].decision.nodes_delta = int(needed[i])
+        metrics.solver_packing_latency.observe(time.perf_counter() - t0)
+
+
 class JaxBackend(ComputeBackend):
     """Single-device (or data-parallel-free) batched kernel. The jit cache is keyed
     on padded shapes; capacities grow by powers of two."""
@@ -234,6 +372,7 @@ class JaxBackend(ComputeBackend):
         self._kernel = kernel
         self._packer = PaddedPacker()
         self._impl = impl if impl is not None else _kernel_impl()
+        self._packing = PackingPostPass()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
         t0 = time.perf_counter()
@@ -246,7 +385,9 @@ class JaxBackend(ComputeBackend):
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        return _unpack(out, group_inputs)
+        results = _unpack(out, group_inputs)
+        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
+        return results
 
 
 class ShardedJaxBackend(ComputeBackend):
@@ -262,6 +403,7 @@ class ShardedJaxBackend(ComputeBackend):
         self._impl = impl if impl is not None else _kernel_impl()
         self._decider = meshlib.make_sharded_decider(self._mesh, impl=self._impl)
         self._num_shards = self._mesh.devices.size
+        self._packing = PackingPostPass()
         # high-water-mark per-shard pads: same recompile-avoidance as JaxBackend
         self._pad_pods = 0
         self._pad_nodes = 0
@@ -306,7 +448,9 @@ class ShardedJaxBackend(ComputeBackend):
             shard_results = _unpack(shard_out, shard_inputs)
             for local, gi in enumerate(shard_groups):
                 results[gi] = shard_results[local]
-        return [r for r in results if r is not None]
+        final = [r for r in results if r is not None]
+        self._packing.apply(final, group_inputs, dry_mode_flags, taint_trackers)
+        return final
 
 
 class PodAxisJaxBackend(ComputeBackend):
@@ -332,6 +476,7 @@ class PodAxisJaxBackend(ComputeBackend):
         self._impl = impl if impl is not None else _kernel_impl()
         self._decider = podaxis.make_podaxis_decider(self._mesh, impl=self._impl)
         self._packer = PaddedPacker()
+        self._packing = PackingPostPass()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
         import jax
@@ -347,7 +492,9 @@ class PodAxisJaxBackend(ComputeBackend):
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        return _unpack(out, group_inputs)
+        results = _unpack(out, group_inputs)
+        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
+        return results
 
 
 def make_backend(kind: str = "auto") -> ComputeBackend:

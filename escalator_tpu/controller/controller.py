@@ -130,6 +130,31 @@ class Controller:
         """Reference: controller.go:114-117."""
         return self.opts.dry_mode or state.opts.dry_mode
 
+    # ------------------------------------------------------------------ events
+    def _event(self, state: NodeGroupState, reason: str, message: str,
+               type_: str = "Normal") -> None:
+        """Broadcast a k8s Event for a scaling action (reference analog:
+        cmd/main.go:166-170). Best-effort — a failing event sink must never
+        break the control loop; dry mode records nothing (shadow runs leave no
+        trace in the cluster, controller.go:126-138's contract)."""
+        if self._dry_mode(state):
+            return
+        create = getattr(self.client, "create_event", None)
+        if create is None:
+            return
+        try:
+            create(k8s.Event(
+                reason=reason,
+                message=message,
+                type=type_,
+                involved_kind="NodeGroup",
+                involved_name=state.opts.name,
+                timestamp_sec=int(self.clock.now()),
+            ))
+        except Exception as e:  # pragma: no cover - sink failures are non-fatal
+            log.warning("[%s] failed to record event %s: %s",
+                        state.opts.name, reason, e)
+
     # ------------------------------------------------------------------ tick
     def run_once(self) -> None:
         """One tick over all nodegroups (reference: controller.go:400-451)."""
@@ -412,6 +437,11 @@ class Controller:
         )
         if not dry:
             cloud_ng.increase_size(nodes_to_add)
+            self._event(
+                state, "ScaleUpCloudProvider",
+                f"increased cloud provider node group {cloud_ng.id()} by"
+                f" {nodes_to_add}",
+            )
         return nodes_to_add
 
     def _scale_up_untaint(self, opts: _ScaleOpts) -> int:
@@ -450,6 +480,11 @@ class Controller:
                     state.taint_tracker.remove(node.name)
                     untainted += 1
         log.info("untainted a total of %d nodes", untainted)
+        if untainted > 0:
+            self._event(
+                state, "ScaleUpUntaint",
+                f"untainted {untainted} nodes (newest first)",
+            )
         return untainted
 
     # ------------------------------------------------------------------ scale down
@@ -491,6 +526,11 @@ class Controller:
         log.info("[%s] sent delete request to %d nodes", state.opts.name,
                  len(to_delete))
         metrics.node_group_pods_evicted.labels(state.opts.name).inc(pods_remaining)
+        self._event(
+            state, "DeleteNodes",
+            f"deleted {len(to_delete)} expired tainted nodes"
+            f" ({pods_remaining} pods evicted)",
+        )
         return -len(to_delete)
 
     def _scale_down_taint(self, opts: _ScaleOpts) -> int:
@@ -534,4 +574,9 @@ class Controller:
                 state.taint_tracker.append(node.name)
                 tainted += 1
         log.info("[%s] tainted a total of %d nodes", state.opts.name, tainted)
+        if tainted > 0:
+            self._event(
+                state, "ScaleDownTaint",
+                f"tainted {tainted} nodes for removal",
+            )
         return tainted

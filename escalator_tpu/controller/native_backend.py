@@ -23,7 +23,12 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from escalator_tpu.controller.backend import ComputeBackend, GroupDecision, _round_up
+from escalator_tpu.controller.backend import (
+    ComputeBackend,
+    GroupDecision,
+    PackingPostPass,
+    _round_up,
+)
 from escalator_tpu.core import semantics
 from escalator_tpu.core.arrays import ClusterArrays, NodeArrays, pack_groups
 from escalator_tpu.k8s.cache import EventfulClient, GroupFilters, WatchBridge
@@ -58,6 +63,7 @@ class NativeJaxBackend(ComputeBackend):
         # node slots whose device lanes were overridden by last tick's dry-mode
         # view — they must be re-scattered (possibly back to raw) this tick
         self._overridden_slots = np.empty(0, np.int64)
+        self._packing = PackingPostPass()
 
     def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
         """First live node per group -> GroupState cached capacity
@@ -137,6 +143,9 @@ class NativeJaxBackend(ComputeBackend):
             # thread wrote since).
             unpack_group = np.array(nodes.group)
             unpack_cordoned = np.array(nodes.valid) & np.array(nodes.cordoned)
+            # Packing-aware groups: gather their pod/bin lanes from the same
+            # locked snapshot; the device FFD runs after decide, outside the lock
+            packing_rows = self._gather_packing_inputs(group_inputs, pods, nodes)
             rebuild = (
                 self._cache is None
                 or self._cache.pod_capacity != self.store.pod_capacity
@@ -181,7 +190,47 @@ class NativeJaxBackend(ComputeBackend):
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        return self._unpack(out, group_inputs, unpack_group, unpack_cordoned)
+        results = self._unpack(out, group_inputs, unpack_group, unpack_cordoned)
+        if packing_rows:
+            sel = set(PackingPostPass.select(results, group_inputs))
+            self._packing.apply_arrays(
+                results, [row for row in packing_rows if row[0] in sel]
+            )
+        return results
+
+    def _gather_packing_inputs(self, group_inputs, pods, nodes):
+        """[(gi, pod_cpu, pod_mem, bin_cpu, bin_mem, template, budget)] for
+        packing-aware groups, copied out of the locked store snapshot (caller
+        holds the store lock). Status filtering happens after decide."""
+        packing_gis = [
+            gi for gi, (_p, _n, config, _s) in enumerate(group_inputs)
+            if getattr(config, "packing_aware", False)
+        ]
+        if not packing_gis:
+            return []
+        pod_group = np.asarray(pods.group)
+        pod_valid = np.asarray(pods.valid)
+        node_group = np.asarray(nodes.group)
+        untainted = (
+            np.asarray(nodes.valid)
+            & ~np.asarray(nodes.tainted)
+            & ~np.asarray(nodes.cordoned)
+        )
+        rows = []
+        for gi in packing_gis:
+            _p, _n, config, state = group_inputs[gi]
+            psel = pod_valid & (pod_group == gi)
+            nsel = untainted & (node_group == gi)
+            rows.append((
+                gi,
+                np.asarray(pods.cpu_milli)[psel].astype(np.int64),
+                np.asarray(pods.mem_bytes)[psel].astype(np.int64),
+                np.asarray(nodes.cpu_milli)[nsel].astype(np.int64),
+                np.asarray(nodes.mem_bytes)[nsel].astype(np.int64),
+                (state.cached_cpu_milli, state.cached_mem_bytes),
+                int(config.packing_budget),
+            ))
+        return rows
 
     def _unpack(self, out, group_inputs, node_group: np.ndarray,
                 cordoned_mask: np.ndarray) -> List[GroupDecision]:

@@ -110,6 +110,14 @@ class NodeGroupOptions:
     #: scale-down victim ordering: "" / "oldest_first" (reference behavior) or
     #: "emptiest_first" (fewest non-daemonset pods first, ties oldest-first)
     scale_down_selection: str = ""
+    #: replace the average-based scale-up delta with FFD bin-packing: the delta
+    #: becomes "template nodes the pod overflow actually needs" — correct on
+    #: heterogeneous nodes where the whole-group average is wrong (lifts the
+    #: reference's documented single-instance-type assumption,
+    #: docs/calculations.md:8)
+    packing_aware: bool = False
+    #: cap on virtual new nodes the packing pass may propose per tick
+    packing_budget: int = 128
     aws: AWSNodeGroupOptions = field(default_factory=AWSNodeGroupOptions)
 
     def soft_delete_grace_period_duration(self) -> float:
@@ -139,6 +147,8 @@ class NodeGroupOptions:
             soft_delete_grace_sec=int(self.soft_delete_grace_period_duration()),
             hard_delete_grace_sec=int(self.hard_delete_grace_period_duration()),
             scale_down_selection=self.scale_down_selection or "oldest_first",
+            packing_aware=self.packing_aware,
+            packing_budget=self.packing_budget,
         )
 
 
@@ -261,6 +271,10 @@ def validate_node_group(ng: NodeGroupOptions) -> List[str]:
     check(
         ng.scale_down_selection in ("", "oldest_first", "emptiest_first"),
         "scale_down_selection must be 'oldest_first' or 'emptiest_first'",
+    )
+    check(
+        isinstance(ng.packing_budget, int) and 0 < ng.packing_budget <= 4096,
+        "packing_budget must be in (0, 4096]",
     )
     check(
         _valid_aws_lifecycle(ng.aws.lifecycle),

@@ -74,6 +74,15 @@ class GroupConfig:
     #: ties oldest-first — the selection method the reference's
     #: node-termination doc names as future work and never shipped)
     scale_down_selection: str = "oldest_first"
+    #: replace the average-based scale-up delta with a first-fit-decreasing
+    #: packing count: "do these pods actually FIT, and how many template nodes
+    #: does the overflow need". Lifts the whole-group-average /
+    #: single-instance-type assumption the reference documents
+    #: (docs/calculations.md:8, docs/best-practices-issues-gotchas.md:36-38)
+    packing_aware: bool = False
+    #: max virtual new nodes the packing pass may propose per tick (static
+    #: kernel shape; the executor's max_nodes clamp still applies after)
+    packing_budget: int = 128
 
 
 @dataclass
@@ -310,7 +319,82 @@ def evaluate_node_group(
         except ValueError:
             return Decision(DecisionStatus.ERR_NEG_DELTA, **base)
 
+    if config.packing_aware and nodes_delta >= 0:
+        # Packing-aware groups replace the average-based delta whenever the
+        # switch did not choose scale-DOWN: FFD-repack all pods into the
+        # untainted nodes' capacity and count the template-node overflow.
+        # Catches both averaging failure modes — headroom-triggered scale-ups
+        # whose pods actually fit (delta shrinks to 0), and under-threshold
+        # fragmentation where a pod fits nowhere (delta grows from 0).
+        nodes_delta = packing_scale_up_delta(pods, untainted, config, state)
+
     return Decision(DecisionStatus.OK, nodes_delta=nodes_delta, **base)
+
+
+def ffd_pack_pure(pods, bins, template, new_bin_budget: int):
+    """First-fit-decreasing with deterministic tie-breaking — the golden model
+    for ``ops.binpack.ffd_pack`` (the device kernel is parity-tested against
+    this). pods: [(cpu, mem)]; bins: [(cpu, mem)] free capacity; template:
+    (cpu, mem) capacity of a prospective new node. Returns (assignment,
+    new_bins_used, unplaced). Pure Python, no array deps: usable by the
+    dependency-free golden backend."""
+    ref_cpu = template[0] or 1
+    ref_mem = template[1] or 1
+    order = sorted(
+        range(len(pods)),
+        key=lambda i: (-max(pods[i][0] / ref_cpu, pods[i][1] / ref_mem), i),
+    )
+    capacity = [list(b) for b in bins] + [
+        [template[0], template[1]] for _ in range(new_bin_budget)
+    ]
+    assignment = [-1] * len(pods)
+    for i in order:
+        cpu, mem = pods[i]
+        for bi, (bc, bm) in enumerate(capacity):
+            if bc >= cpu and bm >= mem:
+                capacity[bi][0] -= cpu
+                capacity[bi][1] -= mem
+                assignment[i] = bi
+                break
+    used_virtual = sum(
+        1
+        for bi in range(len(bins), len(capacity))
+        if capacity[bi][0] < template[0] or capacity[bi][1] < template[1]
+    )
+    unplaced = sum(1 for a in assignment if a < 0)
+    return assignment, used_virtual, unplaced
+
+
+def packing_scale_up_delta(
+    pods: Sequence[k8s.Pod],
+    untainted: Sequence[k8s.Node],
+    config: GroupConfig,
+    state: GroupState,
+) -> int:
+    """The packing-aware delta: FFD-place every pod of the group into the
+    untainted nodes' allocatable capacity plus up to ``packing_budget`` virtual
+    nodes of the cached template capacity; the delta is virtual-nodes-used plus
+    one per pod that fits nowhere (a pod larger than the template conservatively
+    claims a node — adding more identical nodes cannot help it, mirroring the
+    reference's +1 no-cache convention, util.go:26-28)."""
+    if not pods:
+        return 0
+    template = (state.cached_cpu_milli, state.cached_mem_bytes)
+    if template[0] == 0 or template[1] == 0:
+        # no cached capacity to size virtual nodes: reference convention is
+        # "request one and find out" (calcScaleUpDelta's no-cache branch)
+        return 1
+    reqs = []
+    for p in pods:
+        r = k8s.compute_pod_resource_request(p)
+        reqs.append((r.cpu_milli, r.mem_bytes))
+    bins = [
+        (n.cpu_allocatable_milli, n.mem_allocatable_bytes) for n in untainted
+    ]
+    _, used_virtual, unplaced = ffd_pack_pure(
+        reqs, bins, template, config.packing_budget
+    )
+    return used_virtual + unplaced
 
 
 # ---------------------------------------------------------------------------

@@ -19,3 +19,56 @@ def ensure_x64() -> None:
 
     jax.config.update("jax_enable_x64", True)
     _configured = True
+
+
+_probe_result = None
+
+
+def ensure_responsive_accelerator(timeout_sec: float = 90.0) -> bool:
+    """Probe the default JAX platform in a SUBPROCESS and pin the CPU backend
+    if it does not answer. Some accelerator transports (the TPU tunnel this
+    repo targets) can wedge indefinitely at the first dispatch; a long-lived
+    controller must degrade to XLA-CPU (the same traced program — decisions
+    stay bit-identical) rather than hang its control loop forever. In-process
+    timeouts cannot interrupt a wedged dispatch, hence the subprocess; the
+    platform pin must go through jax.config because environments may pin
+    platforms in sitecustomize, ignoring JAX_PLATFORMS.
+
+    Returns True when the accelerator is healthy. Result is cached (one probe
+    per process)."""
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    import subprocess
+    import sys
+
+    code = "import jax; jax.block_until_ready(jax.numpy.ones(8))"
+    try:
+        alive = (
+            subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=timeout_sec,
+                capture_output=True,
+            ).returncode
+            == 0
+        )
+    except Exception:
+        alive = False
+    if not alive:
+        import logging
+
+        try:
+            import jax
+        except ImportError:
+            # jax-less install: nothing to pin; callers fall back to the
+            # dependency-free golden backend (make_backend("auto"))
+            _probe_result = False
+            return False
+        logging.getLogger("escalator_tpu").warning(
+            "accelerator did not answer a probe within %.0fs; pinning the CPU"
+            " backend (same traced kernels, bit-identical decisions)",
+            timeout_sec,
+        )
+        jax.config.update("jax_platforms", "cpu")
+    _probe_result = alive
+    return alive

@@ -36,6 +36,12 @@ class KubernetesClient(Protocol):
     def delete_node(self, name: str) -> None:
         ...
 
+    def create_event(self, event: k8s.Event) -> None:
+        """Broadcast an Event (reference analog: the election broadcaster's
+        recorder, cmd/main.go:166-170). Best-effort: implementations must not
+        raise into the control loop."""
+        ...
+
 
 class InMemoryKubernetesClient:
     """In-process cluster store. Update/delete observers let tests assert on write
@@ -48,6 +54,9 @@ class InMemoryKubernetesClient:
         self._pods: Dict[str, k8s.Pod] = {}
         self.on_node_update: List[Callable[[k8s.Node], None]] = []
         self.on_node_delete: List[Callable[[str], None]] = []
+        #: recorded Events, observable by tests the way the reference's fake
+        #: broadcaster sink is (real adapters POST these to the apiserver)
+        self.events: List[k8s.Event] = []
         for n in nodes or []:
             self._nodes[n.name] = n
         for p in pods or []:
@@ -92,6 +101,22 @@ class InMemoryKubernetesClient:
             del self._nodes[name]
         for cb in self.on_node_delete:
             cb(name)
+
+    def create_event(self, event: k8s.Event) -> None:
+        with self._lock:
+            # compact repeats the way the apiserver's event series do: same
+            # (reason, object) within the retention window bumps count
+            for e in reversed(self.events[-16:]):
+                if (
+                    e.reason == event.reason
+                    and e.involved_kind == event.involved_kind
+                    and e.involved_name == event.involved_name
+                    and e.message == event.message
+                ):
+                    e.count += 1
+                    e.timestamp_sec = event.timestamp_sec
+                    return
+            self.events.append(event)
 
     # -- simulation helpers ---------------------------------------------------
     def add_node(self, node: k8s.Node) -> None:

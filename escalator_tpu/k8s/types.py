@@ -125,6 +125,24 @@ class Node:
         return n
 
 
+@dataclass
+class Event:
+    """A k8s Event the autoscaler broadcasts on its actions — the analog of the
+    reference's event broadcaster (/root/reference/cmd/main.go:166-170, which
+    records election and scaling activity into the cluster's event stream).
+    Field names follow core/v1 Event."""
+
+    reason: str                 # machine-readable, e.g. "ScaleUpCloudProvider"
+    message: str
+    type: str = "Normal"        # "Normal" | "Warning"
+    involved_kind: str = "NodeGroup"
+    involved_name: str = ""
+    namespace: str = "default"
+    source: str = "escalator-tpu"
+    timestamp_sec: int = 0      # event time, unix seconds
+    count: int = 1
+
+
 # ---------------------------------------------------------------------------
 # Pod classification (reference: pkg/k8s/util.go:11-24)
 # ---------------------------------------------------------------------------

@@ -144,6 +144,12 @@ solver_pack_latency = Histogram(
     ["backend"], namespace="escalator_tpu", registry=registry,
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
 )
+solver_packing_latency = Histogram(
+    "solver_packing_latency_seconds",
+    "latency of the packing-aware FFD delta pass (packing_aware groups only)",
+    namespace="escalator_tpu", registry=registry,
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
 
 
 def start(address: str = "0.0.0.0:8080") -> WSGIServer:

@@ -150,29 +150,9 @@ def ffd_pack(
 
 def ffd_pack_reference(pods, bins, template, new_bin_budget):
     """Pure-Python FFD with identical tie-breaking — the golden model for tests.
-    pods: list[(cpu, mem)]; bins: list[(cpu, mem)]; template: (cpu, mem)."""
-    ref_cpu = template[0] or 1
-    ref_mem = template[1] or 1
-    order = sorted(
-        range(len(pods)),
-        key=lambda i: (-max(pods[i][0] / ref_cpu, pods[i][1] / ref_mem), i),
-    )
-    capacity = [list(b) for b in bins] + [
-        [template[0], template[1]] for _ in range(new_bin_budget)
-    ]
-    assignment = [-1] * len(pods)
-    for i in order:
-        cpu, mem = pods[i]
-        for bi, (bc, bm) in enumerate(capacity):
-            if bc >= cpu and bm >= mem:
-                capacity[bi][0] -= cpu
-                capacity[bi][1] -= mem
-                assignment[i] = bi
-                break
-    used_virtual = sum(
-        1
-        for bi in range(len(bins), len(capacity))
-        if capacity[bi][0] < template[0] or capacity[bi][1] < template[1]
-    )
-    unplaced = sum(1 for a in assignment if a < 0)
-    return assignment, used_virtual, unplaced
+    pods: list[(cpu, mem)]; bins: list[(cpu, mem)]; template: (cpu, mem).
+    Single source of truth lives in core.semantics (the golden backend's
+    packing-aware delta uses it without any array deps)."""
+    from escalator_tpu.core.semantics import ffd_pack_pure
+
+    return ffd_pack_pure(pods, bins, template, new_bin_budget)

@@ -18,6 +18,7 @@ import numpy as np
 from escalator_tpu.controller.backend import (
     ComputeBackend,
     GoldenBackend,
+    PackingPostPass,
     PaddedPacker,
     _unpack,
 )
@@ -75,6 +76,7 @@ class GrpcBackend(ComputeBackend):
         self.client = ComputeClient(address, timeout_sec)
         self.fallback = fallback or GoldenBackend()
         self._packer = PaddedPacker()
+        self._packing = PackingPostPass()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None,
                taint_trackers=None):
@@ -89,4 +91,11 @@ class GrpcBackend(ComputeBackend):
             return self.fallback.decide(
                 group_inputs, now_sec, dry_mode_flags, taint_trackers
             )
-        return _unpack(out, group_inputs)
+        results = _unpack(out, group_inputs)
+        # packing-aware override runs client-side: it needs only the object
+        # inputs already in hand, keeping the wire format untouched. On a
+        # jax-less client it degrades to the pure-Python FFD (same math);
+        # packing_aware groups therefore do NOT offload this step to the
+        # plugin — a deliberate trade against a wire-format revision.
+        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
+        return results

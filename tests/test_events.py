@@ -1,0 +1,92 @@
+"""k8s Events on scaling actions — the analog of the reference's event
+broadcaster (/root/reference/cmd/main.go:166-170). The in-memory client records
+them (real adapters forward to the apiserver); dry mode must leave no trace."""
+
+from escalator_tpu.controller.backend import GoldenBackend
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_nodes,
+    build_test_pods,
+)
+
+from tests.test_controller import LABEL_KEY, LABEL_VALUE, World, make_opts
+
+
+def _reasons(w):
+    return [e.reason for e in w.client.events]
+
+
+def _scale_up_world(dry_mode=False):
+    pods = build_test_pods(10, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    return World(make_opts(), nodes=nodes, pods=pods, backend=GoldenBackend(),
+                 dry_mode=dry_mode)
+
+
+def test_scale_up_records_event():
+    w = _scale_up_world()
+    w.tick()
+    assert "ScaleUpCloudProvider" in _reasons(w)
+    (ev,) = [e for e in w.client.events if e.reason == "ScaleUpCloudProvider"]
+    assert ev.involved_kind == "NodeGroup"
+    assert ev.involved_name == "buildeng"
+    assert "by 6" in ev.message
+    assert ev.type == "Normal"
+
+
+def test_scale_down_taint_records_event():
+    pods = build_test_pods(1, PodOpts(
+        cpu=[100], mem=[10**8],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    nodes = build_test_nodes(10, NodeOpts(cpu=4000, mem=16 * 10**9))
+    w = World(make_opts(), nodes=nodes, pods=pods, backend=GoldenBackend())
+    w.tick()
+    assert "ScaleDownTaint" in _reasons(w)
+
+
+def test_reaper_records_delete_event():
+    pods = []
+    nodes = build_test_nodes(4, NodeOpts(cpu=4000, mem=16 * 10**9))
+    # two nodes long-tainted and empty -> reap-eligible; min_nodes=1 keeps others
+    w = World(make_opts(min_nodes=0), nodes=nodes, pods=pods,
+              backend=GoldenBackend())
+    for n in w.client.list_nodes()[:2]:
+        n.taints.append(k8s.Taint(
+            key=k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY,
+            value=str(int(w.clock.now()) - 10_000),
+        ))
+        w.client.update_node(n)
+    w.tick()
+    assert "DeleteNodes" in _reasons(w)
+    (ev,) = [e for e in w.client.events if e.reason == "DeleteNodes"]
+    assert "2 expired" in ev.message
+
+
+def test_dry_mode_records_nothing():
+    w = _scale_up_world(dry_mode=True)
+    w.tick()
+    assert w.client.events == []
+
+
+def test_repeat_events_compact_to_count():
+    """Identical (reason, object, message) repeats bump count instead of
+    growing the event list unboundedly — apiserver event-series semantics."""
+    from escalator_tpu.k8s.client import InMemoryKubernetesClient
+
+    c = InMemoryKubernetesClient()
+    for ts in (100, 160):
+        c.create_event(k8s.Event(
+            reason="ScaleUpCloudProvider", message="increased by 3",
+            involved_name="buildeng", timestamp_sec=ts,
+        ))
+    c.create_event(k8s.Event(
+        reason="ScaleUpCloudProvider", message="increased by 5",
+        involved_name="buildeng", timestamp_sec=200,
+    ))
+    assert len(c.events) == 2
+    assert c.events[0].count == 2 and c.events[0].timestamp_sec == 160
+    assert c.events[1].count == 1

@@ -1,0 +1,205 @@
+"""packing_aware: the FFD-overflow delta replacing the whole-group average.
+
+The reference documents that its delta math assumes one instance type and can
+be wrong on heterogeneous nodes (/root/reference/docs/calculations.md:8,
+docs/best-practices-issues-gotchas.md:36-38). These tests pin the two failure
+modes the packing-aware option fixes — averaging over-asks when the pods
+actually fit, and under-asks (zero) when a pod fits nowhere — plus cross-
+backend parity of the override and config plumbing."""
+
+import numpy as np
+import pytest
+
+from escalator_tpu.controller import controller as ctl
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.controller.backend import (
+    GoldenBackend,
+    JaxBackend,
+    PodAxisJaxBackend,
+)
+from escalator_tpu.controller.native_backend import make_native_backend
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_node,
+    build_test_pod,
+    build_test_pods,
+)
+
+from tests.test_controller import BACKENDS, LABEL_KEY, LABEL_VALUE, World, make_opts
+
+
+def _cfg(**kw):
+    base = dict(
+        min_nodes=0, max_nodes=100,
+        taint_lower_percent=30, taint_upper_percent=45, scale_up_percent=70,
+        slow_removal_rate=1, fast_removal_rate=2,
+        soft_delete_grace_sec=300, hard_delete_grace_sec=900,
+        packing_aware=True,
+    )
+    base.update(kw)
+    return sem.GroupConfig(**base)
+
+
+def _node(cpu, mem=16 * 10**9):
+    return build_test_node(NodeOpts(
+        cpu=cpu, mem=mem, label_key=LABEL_KEY, label_value=LABEL_VALUE))
+
+
+def _pod(cpu, mem=10**9):
+    return build_test_pod(PodOpts(
+        cpu=[cpu], mem=[mem],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+
+
+def test_average_over_asks_but_pods_fit():
+    """Utilisation 75% > threshold 70% -> average delta 1; but one 750m pod per
+    1000m node FITS, so the packed delta is 0 (no scale-up needed)."""
+    nodes = [_node(1000), _node(1000)]
+    pods = [_pod(750), _pod(750)]
+    state = sem.GroupState()
+    avg = sem.evaluate_node_group(pods, nodes, _cfg(packing_aware=False), state)
+    packed = sem.evaluate_node_group(pods, nodes, _cfg(), sem.GroupState())
+    assert avg.status == sem.DecisionStatus.OK and avg.nodes_delta == 1
+    assert packed.status == sem.DecisionStatus.OK and packed.nodes_delta == 0
+
+
+def test_average_misses_unplaceable_pod():
+    """Utilisation 62.5% -> average says do nothing; but a 2500m pod fits NO
+    2000m node (and never will) — packing claims one node for it instead of
+    leaving it pending forever."""
+    nodes = [_node(2000), _node(2000)]
+    pods = [_pod(2500)]
+    avg = sem.evaluate_node_group(
+        pods, nodes, _cfg(packing_aware=False), sem.GroupState()
+    )
+    packed = sem.evaluate_node_group(pods, nodes, _cfg(), sem.GroupState())
+    assert avg.status == sem.DecisionStatus.OK and avg.nodes_delta == 0
+    assert packed.nodes_delta == 1
+
+
+def test_heterogeneous_overflow_counts_template_nodes():
+    """135% utilisation: the average asks for 2 nodes, but the six 450m pods
+    pack two-per-1000m-node — one new template node suffices."""
+    nodes = [_node(1000), _node(1000)]
+    pods = [_pod(450) for _ in range(6)]
+    avg = sem.evaluate_node_group(
+        pods, nodes, _cfg(packing_aware=False), sem.GroupState()
+    )
+    packed = sem.evaluate_node_group(pods, nodes, _cfg(), sem.GroupState())
+    assert avg.nodes_delta == 2
+    assert packed.nodes_delta == 1
+
+
+def test_scale_down_zone_is_untouched():
+    """Packing replaces only non-negative deltas: the taint zones still use
+    the reference's removal rates."""
+    nodes = [_node(1000), _node(1000)]
+    pods = [_pod(100)]  # 5% -> fast removal zone
+    packed = sem.evaluate_node_group(pods, nodes, _cfg(), sem.GroupState())
+    assert packed.nodes_delta == -2
+
+
+def test_no_cached_capacity_requests_one_node():
+    """Scale-from-zero with no template: mirror the reference's +1 convention."""
+    packed = sem.evaluate_node_group(
+        [_pod(500)], [], _cfg(min_nodes=0), sem.GroupState()
+    )
+    # zero capacity + zero untainted -> scale-from-zero sentinel path; packing
+    # then sees no cached capacity and asks for one node to find out
+    assert packed.nodes_delta == 1
+
+
+def test_packing_budget_caps_the_delta():
+    """Overflow beyond the budget: each unplaced pod still claims one node, so
+    budget 2 with 5 one-per-node pods yields 2 + 3."""
+    nodes = [_node(1000)]
+    pods = [_pod(900) for _ in range(6)]
+    packed = sem.evaluate_node_group(
+        pods, nodes, _cfg(packing_budget=2), sem.GroupState()
+    )
+    assert packed.nodes_delta == 2 + 3
+
+
+@pytest.fixture(params=list(BACKENDS), ids=list(BACKENDS))
+def backend(request):
+    return BACKENDS[request.param]()
+
+
+def test_backend_parity_on_packing_groups(backend):
+    """Every backend's packing-aware delta matches the golden model on a
+    heterogeneous mix (distinct pod sizes keep FFD order-independent)."""
+    opts = make_opts(packing_aware=True)
+    nodes = [_node(4000), _node(2000), _node(1000)]
+    # 4975m of requests on 7000m capacity = 71.07% > 70 -> scale-up zone
+    pods = [_pod(c) for c in (1800, 1300, 900, 575, 400)]
+    w = World(opts, nodes=nodes, pods=pods, backend=backend)
+    w.tick()
+    golden = sem.evaluate_node_group(
+        w.state.pod_lister.list(), w.state.node_lister.list(),
+        opts.to_group_config(), sem.GroupState(),
+    )
+    assert w.state.scale_delta == golden.nodes_delta
+
+
+def test_controller_acts_on_packed_delta():
+    """End-to-end: averaging would scale up, packing proves the pods fit, the
+    provider is left alone."""
+    opts = make_opts(packing_aware=True)
+    nodes = [_node(1000), _node(1000)]
+    pods = [_pod(750), _pod(750)]  # 75% utilisation, but one per node fits
+    w = World(opts, nodes=nodes, pods=pods, backend=GoldenBackend())
+    w.tick()
+    assert w.state.scale_delta == 0
+    assert w.group.increase_calls == []
+
+    opts2 = make_opts(packing_aware=False)
+    w2 = World(opts2, nodes=[_node(1000), _node(1000)],
+               pods=[_pod(750), _pod(750)], backend=GoldenBackend())
+    w2.tick()
+    assert w2.state.scale_delta == 1
+    assert w2.group.increase_calls == [1]
+
+
+def test_budget_cap_through_device_kernel():
+    """The device post-pass packs at the EXACT configured budget (padding the
+    virtual-bin axis would let FFD spill past it and diverge from golden)."""
+    opts = make_opts(packing_aware=True, packing_budget=2)
+    nodes = [_node(1000)]
+    pods = [_pod(900) for _ in range(6)]
+    w = World(opts, nodes=nodes, pods=pods, backend=JaxBackend())
+    w.tick()
+    # 1 existing node holds one pod; budget 2 holds two; 3 unplaced claim one each
+    assert w.state.scale_delta == 2 + 3
+
+
+def test_yaml_config_and_validation():
+    yaml_doc = """
+node_groups:
+  - name: pack
+    label_key: customer
+    label_value: pack
+    cloud_provider_group_name: pack-asg
+    min_nodes: 1
+    max_nodes: 10
+    taint_upper_capacity_threshold_percent: 45
+    taint_lower_capacity_threshold_percent: 30
+    scale_up_threshold_percent: 70
+    slow_node_removal_rate: 1
+    fast_node_removal_rate: 2
+    soft_delete_grace_period: 5m
+    hard_delete_grace_period: 15m
+    scale_up_cool_down_period: 10m
+    packing_aware: true
+    packing_budget: 64
+"""
+    (opts,) = ngmod.unmarshal_node_group_options(yaml_doc)
+    assert opts.packing_aware is True and opts.packing_budget == 64
+    assert ngmod.validate_node_group(opts) == []
+    cfg = opts.to_group_config()
+    assert cfg.packing_aware is True and cfg.packing_budget == 64
+
+    opts.packing_budget = 0
+    assert any("packing_budget" in p for p in ngmod.validate_node_group(opts))
