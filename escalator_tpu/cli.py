@@ -43,7 +43,11 @@ from escalator_tpu.controller import controller as ctl
 from escalator_tpu.controller import node_group as ngmod
 from escalator_tpu.controller.backend import make_backend
 from escalator_tpu.k8s import types as k8s
-from escalator_tpu.k8s.client import InMemoryKubernetesClient, load_incluster
+from escalator_tpu.k8s.client import (
+    InMemoryKubernetesClient,
+    load_incluster,
+    load_kubeconfig,
+)
 from escalator_tpu.k8s.election import (
     FileResourceLock,
     LeaderElectionConfig,
@@ -83,6 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cloud provider backend")
     p.add_argument("--kubeconfig", default="",
                    help="kubeconfig path (out-of-cluster mode)")
+    p.add_argument("--incluster", action="store_true",
+                   help="connect to the apiserver from inside the cluster"
+                        " (serviceaccount token; reference: cmd/main.go:62-66)")
     p.add_argument("--sim-state", default="",
                    help="YAML cluster state for in-memory simulation mode")
     p.add_argument("--backend", default="auto",
@@ -104,7 +111,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profiler-port", type=int, default=0,
                    help="start the live jax profiler server on this port")
     p.add_argument("--leader-elect", action="store_true")
-    p.add_argument("--leader-elect-lock-file", default="/tmp/escalator-tpu.lease")
+    p.add_argument("--leader-elect-lock-file", default="/tmp/escalator-tpu.lease",
+                   help="lease file for sim/file election (apiserver-backed"
+                        " clients elect over a k8s Lease instead)")
+    p.add_argument("--leader-elect-lease-namespace", default="kube-system",
+                   help="namespace of the election Lease object")
+    p.add_argument("--leader-elect-lease-name", default="escalator-tpu",
+                   help="name of the election Lease object")
     p.add_argument("--leader-elect-lease-duration", default="15s")
     p.add_argument("--leader-elect-renew-deadline", default="10s")
     p.add_argument("--leader-elect-retry-period", default="2s")
@@ -228,12 +241,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.sim_state:
         client = load_sim_state(args.sim_state)
-    elif args.kubeconfig or args.cloud_provider == "aws":
-        client = load_incluster()  # raises with a clear message (no k8s package)
+    elif args.kubeconfig:
+        client = load_kubeconfig(args.kubeconfig)
+        log.info("connected to apiserver via kubeconfig; informer caches synced")
+    elif args.incluster or args.cloud_provider == "aws":
+        client = load_incluster()
+        log.info("connected to in-cluster apiserver; informer caches synced")
     else:
         raise SystemExit(
-            "no cluster source: pass --sim-state for simulation mode or"
-            " --kubeconfig for a real cluster"
+            "no cluster source: pass --sim-state for simulation mode,"
+            " --kubeconfig for out-of-cluster, or --incluster"
         )
 
     builder = setup_cloud_provider(args, node_groups, client)
@@ -258,8 +275,22 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.leader_elect:
         deposed = threading.Event()
+        # apiserver-backed clients elect over a real k8s Lease
+        # (reference: pkg/k8s/election.go:57-76); sim mode uses the file lock
+        from escalator_tpu.k8s.restclient import ApiserverClient, LeaseResourceLock
+
+        if isinstance(client, ApiserverClient):
+            resource_lock = LeaseResourceLock(
+                client.transport,
+                namespace=args.leader_elect_lease_namespace,
+                name=args.leader_elect_lease_name,
+                lease_duration_sec=ngmod.parse_duration(
+                    args.leader_elect_lease_duration),
+            )
+        else:
+            resource_lock = FileResourceLock(args.leader_elect_lock_file)
         elector = LeaderElector(
-            FileResourceLock(args.leader_elect_lock_file),
+            resource_lock,
             LeaderElectionConfig(
                 lease_duration_sec=ngmod.parse_duration(
                     args.leader_elect_lease_duration),
@@ -280,7 +311,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             try:
                 create(k8s.Event(
                     reason=reason, message=message,
-                    involved_kind="Lease", involved_name="escalator-tpu",
+                    involved_kind="Lease",
+                    involved_name=args.leader_elect_lease_name,
+                    namespace=args.leader_elect_lease_namespace,
                     timestamp_sec=int(time.time()),
                 ))
             except Exception as e:
@@ -360,6 +393,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         tracer.close()
         if server is not None:
             server.shutdown()
+        stop_client = getattr(client, "stop", None)
+        if callable(stop_client):
+            stop_client()  # stop informer list+watch threads
     return 0
 
 

@@ -133,16 +133,20 @@ class InMemoryKubernetesClient:
 
 
 def load_incluster() -> KubernetesClient:
-    """Build a client against a real apiserver. Requires the ``kubernetes`` package,
-    which is not part of this image — gated import with a clear error (reference
-    equivalent: pkg/k8s/client.go:12-26)."""
-    try:
-        import kubernetes  # noqa: F401
-    except ImportError as e:  # pragma: no cover
-        raise RuntimeError(
-            "real-cluster mode needs the `kubernetes` package; this environment "
-            "provides only in-memory/simulation clients"
-        ) from e
-    raise NotImplementedError(
-        "apiserver-backed client adapter not yet implemented"
-    )  # pragma: no cover
+    """Client against the cluster this process runs in: serviceaccount token +
+    KUBERNETES_SERVICE_HOST, the rest.InClusterConfig flow (reference:
+    pkg/k8s/client.go:28-40). Speaks the REST list+watch wire protocol directly
+    (restclient.py) — no ``kubernetes`` package needed. Blocks until the
+    informer caches sync, like the reference's WaitForSync gate
+    (cmd/main.go:130-137)."""
+    from escalator_tpu.k8s import restclient
+
+    return restclient.connect(restclient.incluster_config())
+
+
+def load_kubeconfig(path: str, context: str = "") -> KubernetesClient:
+    """Out-of-cluster client from a kubeconfig file (reference:
+    pkg/k8s/client.go:12-26, clientcmd.BuildConfigFromFlags)."""
+    from escalator_tpu.k8s import restclient
+
+    return restclient.connect(restclient.kubeconfig_config(path, context))

@@ -186,7 +186,19 @@ class LeaderElector:
             else None
         )
         while not self._stop.is_set():
-            if self._try_acquire():
+            try:
+                acquired = self._try_acquire()
+            except Exception as e:
+                # transient lock-backend failure (e.g. apiserver blip during a
+                # rolling restart) must not crash a standby — treat as
+                # not-acquired and retry next period
+                import logging
+
+                logging.getLogger("escalator_tpu.k8s.election").warning(
+                    "lease acquisition attempt failed transiently: %s", e
+                )
+                acquired = False
+            if acquired:
                 self.is_leader = True
                 if self.on_started_leading is not None:
                     self.on_started_leading()

@@ -1,0 +1,489 @@
+"""In-repo fake kube-apiserver: real wire semantics over stdlib http.server.
+
+The restclient module speaks the k8s REST list+watch protocol; this server is
+its test double — the analog of the reference testing its client paths against
+the fake clientset's reactors (pkg/test/builder.go), except here the fake sits
+on the OTHER side of real HTTP so the transport, chunked watch streaming,
+resourceVersion bookkeeping, 409 conflicts and 410 relists are all exercised.
+
+Implemented surface (what the controller + elector touch):
+
+- ``GET /api/v1/{pods,nodes}`` — list (with fieldSelector) and chunked watch
+  (``?watch=true&resourceVersion=N&timeoutSeconds=T``). A MODIFIED object that
+  leaves a field-selector's match set is delivered as DELETED to that watcher,
+  matching apiserver behavior for ``status.phase!=Succeeded`` informers.
+- ``GET/PUT/DELETE /api/v1/nodes/{name}`` (and namespaced pods) — PUT enforces
+  optimistic concurrency: a stale ``metadata.resourceVersion`` is 409.
+- ``POST /api/v1/namespaces/{ns}/events`` — append to :attr:`events`.
+- ``GET/POST/PUT .../coordination.k8s.io/v1/.../leases`` — Lease CRUD with the
+  same resourceVersion CAS; POST of an existing lease is 409 AlreadyExists.
+- Watches older than the retained history window get a 410 ERROR event
+  (drives the client's relist path deterministically via ``compact_history``).
+- Optional bearer-token auth (401 on mismatch) to exercise auth plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Deque, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+
+def _match_field_selector(selector: str, obj: dict) -> bool:
+    """Supports the conjunctive =/!= grammar the reference informers use
+    (pkg/k8s/cache.go:17: status.phase!=Succeeded,status.phase!=Failed)."""
+    if not selector:
+        return True
+    for clause in selector.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "!=" in clause:
+            path, want = clause.split("!=", 1)
+            negate = True
+        else:
+            path, want = clause.split("=", 1)
+            negate = False
+        cur = obj
+        for part in path.strip().split("."):
+            cur = (cur or {}).get(part) if isinstance(cur, dict) else None
+        value = "" if cur is None else str(cur)
+        if negate and value == want:
+            return False
+        if not negate and value != want:
+            return False
+    return True
+
+
+class _State:
+    """Cluster state + watch history, guarded by one lock/condition."""
+
+    def __init__(self, history_window: int = 4096):
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.rv = 0
+        #: collection path -> {key -> obj}; keys are "ns/name" or "name"
+        self.collections: Dict[str, Dict[str, dict]] = {
+            "/api/v1/pods": {},
+            "/api/v1/nodes": {},
+        }
+        self.leases: Dict[str, dict] = {}  # "ns/name" -> lease obj
+        self.events: List[dict] = []
+        #: (rv, collection, type, obj, prev_obj) — prev_obj drives selector
+        #: transition logic for filtered watchers
+        self.history: Deque[Tuple[int, str, str, dict, Optional[dict]]] = deque(
+            maxlen=history_window
+        )
+        self.oldest_rv = 0  # watches at rv < oldest_rv get 410
+
+    def next_rv(self) -> int:
+        self.rv += 1
+        return self.rv
+
+    def apply(self, collection: str, etype: str, key: str, obj: dict,
+              prev: Optional[dict]) -> dict:
+        """Record a write under the lock; stamps resourceVersion, appends to
+        watch history, wakes watchers."""
+        rv = self.next_rv()
+        obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
+        if etype == "DELETED":
+            self.collections[collection].pop(key, None)
+        else:
+            self.collections[collection][key] = obj
+        self.history.append((rv, collection, etype, obj, prev))
+        self.cond.notify_all()
+        return obj
+
+
+class FakeApiserver:
+    def __init__(self, token: str = "", history_window: int = 4096):
+        self.state = _State(history_window=history_window)
+        self.token = token
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="fake-apiserver")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FakeApiserver":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> "FakeApiserver":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- python-side cluster manipulation (goes through the same write path
+    # as HTTP, so watches fire) ---------------------------------------------
+    @staticmethod
+    def _key(obj: dict) -> str:
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace")
+        return f"{ns}/{meta['name']}" if ns else meta["name"]
+
+    def put_object(self, collection: str, obj: dict) -> dict:
+        obj = json.loads(json.dumps(obj))
+        with self.state.lock:
+            key = self._key(obj)
+            prev = self.state.collections[collection].get(key)
+            etype = "MODIFIED" if prev is not None else "ADDED"
+            return self.state.apply(collection, etype, key, obj, prev)
+
+    def delete_object(self, collection: str, key: str) -> bool:
+        with self.state.lock:
+            prev = self.state.collections[collection].get(key)
+            if prev is None:
+                return False
+            self.state.apply(collection, "DELETED", key, dict(prev), prev)
+            return True
+
+    def add_node(self, obj: dict) -> dict:
+        return self.put_object("/api/v1/nodes", obj)
+
+    def add_pod(self, obj: dict) -> dict:
+        return self.put_object("/api/v1/pods", obj)
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str) -> None:
+        with self.state.lock:
+            key = f"{namespace}/{name}"
+            prev = self.state.collections["/api/v1/pods"].get(key)
+            if prev is None:
+                raise KeyError(key)
+            obj = json.loads(json.dumps(prev))
+            obj.setdefault("status", {})["phase"] = phase
+            self.state.apply("/api/v1/pods", "MODIFIED", key, obj, prev)
+
+    def compact_history(self) -> None:
+        """Forget all watch history: any watch from an old resourceVersion now
+        gets 410 Gone (deterministic trigger for the client's relist path)."""
+        with self.state.lock:
+            self.state.history.clear()
+            self.state.oldest_rv = self.state.rv + 1
+
+    @property
+    def events(self) -> List[dict]:
+        with self.state.lock:
+            return list(self.state.events)
+
+    def lease(self, namespace: str, name: str) -> Optional[dict]:
+        with self.state.lock:
+            obj = self.state.leases.get(f"{namespace}/{name}")
+            return json.loads(json.dumps(obj)) if obj else None
+
+
+def _make_handler(server: FakeApiserver):
+    state = server.state
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        # -- plumbing ------------------------------------------------------
+        def _send_json(self, code: int, obj: dict) -> None:
+            payload = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _status(self, code: int, reason: str, message: str) -> None:
+            self._send_json(code, {
+                "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                "reason": reason, "message": message, "code": code,
+            })
+
+        def _read_body(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            return json.loads(raw) if raw else {}
+
+        def _valid_lease(self, body: dict) -> bool:
+            """coordination/v1 ValidateLeaseSpec: leaseDurationSeconds, if set,
+            must be > 0 — a real apiserver 422s otherwise, so the fake must too
+            (a 0 here once slipped through and would have livelocked election
+            against a real cluster)."""
+            spec = body.get("spec") or {}
+            dur = spec.get("leaseDurationSeconds")
+            if dur is not None and (not isinstance(dur, int) or dur <= 0):
+                self._status(422, "Invalid",
+                             "spec.leaseDurationSeconds must be greater than 0")
+                return False
+            return True
+
+        def _authed(self) -> bool:
+            if not server.token:
+                return True
+            got = self.headers.get("Authorization", "")
+            if got == f"Bearer {server.token}":
+                return True
+            self._status(401, "Unauthorized", "bad bearer token")
+            return False
+
+        # -- routing -------------------------------------------------------
+        def _route(self) -> Tuple[str, Optional[str], Optional[str], Dict[str, str]]:
+            """Returns (collection, namespace, name, params). collection is the
+            cluster-scoped canonical path ('/api/v1/pods', '/api/v1/nodes',
+            'leases', 'events', or '')."""
+            parts = urlsplit(self.path)
+            params = {k: v[0] for k, v in parse_qs(parts.query).items()}
+            seg = [s for s in parts.path.split("/") if s]
+            # /api/v1/...
+            if seg[:2] == ["api", "v1"]:
+                rest = seg[2:]
+                if rest[:1] == ["namespaces"] and len(rest) >= 3:
+                    ns, kind = rest[1], rest[2]
+                    name = rest[3] if len(rest) > 3 else None
+                    if kind == "events":
+                        return "events", ns, name, params
+                    if kind == "pods":
+                        return "/api/v1/pods", ns, name, params
+                    return "", ns, name, params
+                if rest[:1] == ["pods"]:
+                    return "/api/v1/pods", None, rest[1] if len(rest) > 1 else None, params
+                if rest[:1] == ["nodes"]:
+                    return "/api/v1/nodes", None, rest[1] if len(rest) > 1 else None, params
+            if seg[:2] == ["apis", "coordination.k8s.io"] and "leases" in seg:
+                ns = seg[seg.index("namespaces") + 1] if "namespaces" in seg else "default"
+                li = seg.index("leases")
+                name = seg[li + 1] if len(seg) > li + 1 else None
+                return "leases", ns, name, params
+            return "", None, None, params
+
+        # -- GET: single / list / watch ------------------------------------
+        def do_GET(self) -> None:
+            if not self._authed():
+                return
+            collection, ns, name, params = self._route()
+            if collection == "leases":
+                with state.lock:
+                    obj = state.leases.get(f"{ns}/{name}")
+                if obj is None:
+                    self._status(404, "NotFound", f"lease {ns}/{name} not found")
+                else:
+                    self._send_json(200, obj)
+                return
+            if collection not in state.collections:
+                self._status(404, "NotFound", f"no route {self.path}")
+                return
+            if name is not None:
+                key = f"{ns}/{name}" if ns else name
+                with state.lock:
+                    obj = state.collections[collection].get(key)
+                if obj is None:
+                    self._status(404, "NotFound", f"{key} not found")
+                else:
+                    self._send_json(200, obj)
+                return
+            if params.get("watch") in ("true", "1"):
+                self._watch(collection, ns, params)
+                return
+            selector = params.get("fieldSelector", "")
+            with state.lock:
+                items = [
+                    o for k, o in sorted(state.collections[collection].items())
+                    if _match_field_selector(selector, o)
+                    and (ns is None or (o.get("metadata") or {}).get("namespace") == ns)
+                ]
+                rv = state.rv
+            kind = "PodList" if collection.endswith("pods") else "NodeList"
+            self._send_json(200, {
+                "kind": kind, "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(rv)},
+                "items": items,
+            })
+
+        def _watch(self, collection: str, ns: Optional[str],
+                   params: Dict[str, str]) -> None:
+            selector = params.get("fieldSelector", "")
+            since = int(params.get("resourceVersion") or 0)
+            timeout = float(params.get("timeoutSeconds") or 30)
+            deadline = time.monotonic() + min(timeout, 120.0)
+
+            def _matches(obj: Optional[dict]) -> bool:
+                if obj is None:
+                    return False
+                if ns is not None and (obj.get("metadata") or {}).get("namespace") != ns:
+                    return False
+                return _match_field_selector(selector, obj)
+
+            def _translate(etype: str, obj: dict, prev: Optional[dict]):
+                """Field-selector transition semantics: entering the match set
+                is ADDED, leaving it is DELETED (how the apiserver serves
+                phase!=Succeeded watches)."""
+                now_in, was_in = _matches(obj), _matches(prev)
+                if etype == "DELETED":
+                    return ("DELETED", obj) if was_in or now_in else None
+                if etype == "ADDED":
+                    return ("ADDED", obj) if now_in else None
+                if now_in and was_in:
+                    return ("MODIFIED", obj)
+                if now_in:
+                    return ("ADDED", obj)
+                if was_in:
+                    return ("DELETED", obj)
+                return None
+
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def _write_event(etype: str, obj: dict) -> None:
+                line = json.dumps({"type": etype, "object": obj}).encode() + b"\n"
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                with state.lock:
+                    if since and since < state.oldest_rv:
+                        _write_event("ERROR", {
+                            "kind": "Status", "code": 410, "reason": "Expired",
+                            "message": f"resourceVersion {since} is too old",
+                        })
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
+                    cursor = since
+                    while True:
+                        pending = [
+                            h for h in state.history
+                            if h[0] > cursor and h[1] == collection
+                        ]
+                        for rv, _, etype, obj, prev in pending:
+                            out = _translate(etype, obj, prev)
+                            cursor = rv
+                            if out is not None:
+                                state.lock.release()
+                                try:
+                                    _write_event(*out)
+                                finally:
+                                    state.lock.acquire()
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        state.cond.wait(min(remaining, 1.0))
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        # -- writes --------------------------------------------------------
+        def do_PUT(self) -> None:
+            if not self._authed():
+                return
+            collection, ns, name, _ = self._route()
+            body = self._read_body()
+            if collection == "leases":
+                if not self._valid_lease(body):
+                    return
+                key = f"{ns}/{name}"
+                with state.lock:
+                    current = state.leases.get(key)
+                    if current is None:
+                        self._status(404, "NotFound", f"lease {key} not found")
+                        return
+                    want_rv = (body.get("metadata") or {}).get("resourceVersion")
+                    have_rv = (current.get("metadata") or {}).get("resourceVersion")
+                    if want_rv is not None and str(want_rv) != str(have_rv):
+                        self._status(409, "Conflict",
+                                     f"resourceVersion {want_rv} != {have_rv}")
+                        return
+                    body.setdefault("metadata", {})["resourceVersion"] = str(
+                        state.next_rv())
+                    state.leases[key] = body
+                self._send_json(200, body)
+                return
+            if collection not in state.collections or name is None:
+                self._status(404, "NotFound", f"no route {self.path}")
+                return
+            key = f"{ns}/{name}" if ns else name
+            with state.lock:
+                current = state.collections[collection].get(key)
+                if current is None:
+                    self._status(404, "NotFound", f"{key} not found")
+                    return
+                want_rv = (body.get("metadata") or {}).get("resourceVersion")
+                have_rv = (current.get("metadata") or {}).get("resourceVersion")
+                if want_rv is not None and str(want_rv) != str(have_rv):
+                    self._status(409, "Conflict",
+                                 f"resourceVersion {want_rv} != {have_rv} for {key}")
+                    return
+                out = state.apply(collection, "MODIFIED", key, body, current)
+            self._send_json(200, out)
+
+        def do_POST(self) -> None:
+            if not self._authed():
+                return
+            collection, ns, name, _ = self._route()
+            body = self._read_body()
+            if collection == "events":
+                with state.lock:
+                    body.setdefault("metadata", {})["resourceVersion"] = str(
+                        state.next_rv())
+                    state.events.append(body)
+                self._send_json(201, body)
+                return
+            if collection == "leases":
+                if not self._valid_lease(body):
+                    return
+                lease_name = (body.get("metadata") or {}).get("name", name)
+                key = f"{ns}/{lease_name}"
+                with state.lock:
+                    if key in state.leases:
+                        self._status(409, "AlreadyExists",
+                                     f"lease {key} already exists")
+                        return
+                    body.setdefault("metadata", {})["resourceVersion"] = str(
+                        state.next_rv())
+                    state.leases[key] = body
+                self._send_json(201, body)
+                return
+            if collection in state.collections:
+                with state.lock:
+                    meta = body.setdefault("metadata", {})
+                    if ns:
+                        meta.setdefault("namespace", ns)
+                    key = (f"{meta.get('namespace')}/{meta['name']}"
+                           if meta.get("namespace") else meta["name"])
+                    if key in state.collections[collection]:
+                        self._status(409, "AlreadyExists", f"{key} exists")
+                        return
+                    out = state.apply(collection, "ADDED", key, body, None)
+                self._send_json(201, out)
+                return
+            self._status(404, "NotFound", f"no route {self.path}")
+
+        def do_DELETE(self) -> None:
+            if not self._authed():
+                return
+            collection, ns, name, _ = self._route()
+            if collection not in state.collections or name is None:
+                self._status(404, "NotFound", f"no route {self.path}")
+                return
+            key = f"{ns}/{name}" if ns else name
+            with state.lock:
+                prev = state.collections[collection].get(key)
+                if prev is None:
+                    self._status(404, "NotFound", f"{key} not found")
+                    return
+                state.apply(collection, "DELETED", key, dict(prev), prev)
+            self._send_json(200, {"kind": "Status", "status": "Success"})
+
+    return Handler

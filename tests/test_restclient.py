@@ -1,0 +1,572 @@
+"""REST apiserver adapter tests: wire protocol, informers, Lease election, and
+full controller ticks over HTTP against the in-repo fake apiserver.
+
+Reference analogs: client construction pkg/k8s/client.go:12-40, informer caches
+pkg/k8s/cache.go:16-66, Lease election pkg/k8s/election.go:25-76, taint
+GET-then-UPDATE pkg/k8s/taint.go:36-76."""
+
+import time
+from fractions import Fraction
+
+import pytest
+import yaml
+
+from escalator_tpu.controller import controller as ctl
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.controller.backend import GoldenBackend
+from escalator_tpu.k8s import taint as tainting
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.election import LeaderElectionConfig, LeaderElector
+from escalator_tpu.k8s.restclient import (
+    ApiError,
+    ApiserverClient,
+    ApiserverConfig,
+    ConflictError,
+    LeaseResourceLock,
+    Transport,
+    kubeconfig_config,
+    node_from_json,
+    node_to_json,
+    parse_quantity,
+    pod_from_json,
+    pod_to_json,
+    quantity_bytes,
+    quantity_milli,
+)
+from escalator_tpu.testsupport.builders import NodeOpts, PodOpts, build_test_node, build_test_pod
+from escalator_tpu.testsupport.cloud_provider import (
+    MockBuilder,
+    MockCloudProvider,
+    MockNodeGroup,
+)
+from escalator_tpu.testsupport.fakeapiserver import FakeApiserver
+
+TOKEN = "sekrit-token"
+
+
+def _poll(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture()
+def server():
+    with FakeApiserver(token=TOKEN) as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    c = ApiserverClient(
+        ApiserverConfig(server.url, token=TOKEN), watch_timeout_sec=2)
+    c.start(sync_timeout=10)
+    yield c
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# quantity grammar (resource.Quantity semantics)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_quantity_table():
+    assert parse_quantity("500m") == Fraction(1, 2)
+    assert quantity_milli("500m") == 500
+    assert quantity_milli("2") == 2000
+    assert quantity_milli("0.1") == 100
+    assert quantity_milli("2.5") == 2500
+    assert quantity_milli("1n") == 1  # MilliValue rounds UP
+    assert quantity_bytes("1Gi") == 2**30
+    assert quantity_bytes("128Mi") == 128 * 2**20
+    assert quantity_bytes("1M") == 10**6
+    assert quantity_bytes("1e3") == 1000
+    assert quantity_bytes("1500") == 1500
+    assert quantity_bytes("1.5Gi") == 3 * 2**29
+    assert quantity_bytes("") == 0
+
+
+def test_pod_json_mapping_roundtrip():
+    pod = build_test_pod(PodOpts(
+        name="web-1", cpu=[500, 250], mem=[10**9, 5 * 10**8],
+        init_containers_cpu=[2000], init_containers_mem=[10**8],
+        cpu_overhead=100, mem_overhead=10**7,
+        node_selector_key="customer", node_selector_value="buildeng",
+        node_affinity_key="tier", node_affinity_value="batch",
+        owner="ReplicaSet", node_name="n1",
+    ))
+    back = pod_from_json(pod_to_json(pod))
+    assert k8s.compute_pod_resource_request(back) == \
+        k8s.compute_pod_resource_request(pod)
+    assert back.node_selector == pod.node_selector
+    assert back.node_name == "n1"
+    assert back.owner_kind == "ReplicaSet"
+    assert back.affinity.has_node_affinity
+    term = back.affinity.node_affinity_required_terms[0]
+    assert term.match_expressions[0].key == "tier"
+    assert term.match_expressions[0].values == ("batch",)
+
+
+def test_node_json_mapping_roundtrip():
+    node = build_test_node(NodeOpts(
+        name="n1", cpu=4000, mem=16 * 10**9, tainted=True,
+        taint_time_sec=1_700_000_000, cordoned=True, no_delete=True,
+        creation_time_ns=1_600_000_000 * 10**9,
+    ))
+    back = node_from_json(node_to_json(node))
+    assert back.cpu_allocatable_milli == 4000
+    assert back.mem_allocatable_bytes == 16 * 10**9
+    assert back.unschedulable
+    assert k8s.get_to_be_removed_time(back) == 1_700_000_000
+    assert back.annotations[k8s.NODE_ESCALATOR_IGNORE_ANNOTATION]
+    assert back.creation_time_ns == 1_600_000_000 * 10**9
+    assert back.labels == node.labels
+
+
+def test_node_json_parses_real_shapes():
+    """Quantities as kubelet reports them: cpu in cores, memory in Ki."""
+    node = node_from_json({
+        "metadata": {"name": "ip-10-0-0-1",
+                     "creationTimestamp": "2026-07-29T12:00:00Z",
+                     "labels": {"customer": "shared"}},
+        "spec": {"providerID": "aws:///us-east-1a/i-abc"},
+        "status": {"allocatable": {"cpu": "3920m", "memory": "15246516Ki"}},
+    })
+    assert node.cpu_allocatable_milli == 3920
+    assert node.mem_allocatable_bytes == 15246516 * 1024
+    assert node.provider_id.endswith("i-abc")
+
+
+# ---------------------------------------------------------------------------
+# transport / auth
+# ---------------------------------------------------------------------------
+
+
+def test_bad_token_is_401(server):
+    t = Transport(ApiserverConfig(server.url, token="wrong"))
+    with pytest.raises(ApiError) as exc:
+        t.request("GET", "/api/v1/nodes")
+    assert exc.value.status == 401
+
+
+# ---------------------------------------------------------------------------
+# informers: list+watch, field selectors, relist
+# ---------------------------------------------------------------------------
+
+
+def test_informer_list_then_watch(server, client):
+    assert client.list_nodes() == [] and client.list_pods() == []
+    server.add_node(node_to_json(build_test_node(
+        NodeOpts(name="n1", cpu=4000, mem=16 * 10**9))))
+    server.add_pod(pod_to_json(build_test_pod(
+        PodOpts(name="p1", cpu=[500], mem=[10**9]))))
+    assert _poll(lambda: [n.name for n in client.list_nodes()] == ["n1"])
+    assert _poll(lambda: [p.name for p in client.list_pods()] == ["p1"])
+    # modification propagates
+    server.add_node(node_to_json(build_test_node(
+        NodeOpts(name="n1", cpu=8000, mem=16 * 10**9))))
+    assert _poll(
+        lambda: client.list_nodes()[0].cpu_allocatable_milli == 8000)
+    # deletion propagates
+    server.delete_object("/api/v1/nodes", "n1")
+    assert _poll(lambda: client.list_nodes() == [])
+
+
+def test_completed_pods_leave_the_cache(server, client):
+    """status.phase!=Succeeded,!=Failed field selector: a pod completing is a
+    DELETED event to the informer (pkg/k8s/cache.go:17)."""
+    server.add_pod(pod_to_json(build_test_pod(
+        PodOpts(name="job-1", namespace="default", cpu=[100], mem=[10**8]))))
+    assert _poll(lambda: len(client.list_pods()) == 1)
+    server.set_pod_phase("default", "job-1", "Succeeded")
+    assert _poll(lambda: client.list_pods() == [])
+    # and a Succeeded pod added later never shows up
+    done = pod_to_json(build_test_pod(PodOpts(name="job-2", cpu=[1], mem=[1])))
+    done["status"]["phase"] = "Failed"
+    server.add_pod(done)
+    server.add_pod(pod_to_json(build_test_pod(
+        PodOpts(name="live", cpu=[1], mem=[1]))))
+    assert _poll(lambda: [p.name for p in client.list_pods()] == ["live"])
+
+
+def test_watch_expiry_triggers_relist(server, client):
+    server.add_node(node_to_json(build_test_node(
+        NodeOpts(name="n1", cpu=4000, mem=16 * 10**9))))
+    assert _poll(lambda: len(client.list_nodes()) == 1)
+    server.compact_history()  # next watch from the old rv gets 410
+    time.sleep(2.2)  # let the in-flight short watch (2s) end and reconnect
+    server.add_node(node_to_json(build_test_node(
+        NodeOpts(name="n2", cpu=4000, mem=16 * 10**9))))
+    assert _poll(lambda: len(client.list_nodes()) == 2, timeout=15)
+    assert client._nodes.relists >= 1
+
+
+def test_subscribe_replays_then_streams(server, client):
+    server.add_node(node_to_json(build_test_node(
+        NodeOpts(name="n1", cpu=4000, mem=16 * 10**9))))
+    assert _poll(lambda: len(client.list_nodes()) == 1)
+    seen = []
+    client.subscribe(lambda e: seen.append((e.kind, e.type, getattr(e.obj, "name", ""))))
+    assert ("node", "added", "n1") in seen  # replay
+    server.add_pod(pod_to_json(build_test_pod(
+        PodOpts(name="p1", cpu=[500], mem=[10**9]))))
+    assert _poll(lambda: ("pod", "added", "p1") in seen)
+
+
+# ---------------------------------------------------------------------------
+# writes: GET-then-PUT, conflicts, events
+# ---------------------------------------------------------------------------
+
+
+def test_taint_flow_preserves_unknown_fields(server, client):
+    raw = node_to_json(build_test_node(NodeOpts(name="n1", cpu=4000, mem=16 * 10**9)))
+    raw["status"]["nodeInfo"] = {"kubeletVersion": "v1.29.0"}
+    raw["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+    server.add_node(raw)
+    assert _poll(lambda: len(client.list_nodes()) == 1)
+
+    node = client.get_node("n1")
+    updated = tainting.add_to_be_removed_taint(node, client)
+    assert k8s.get_to_be_removed_taint(updated) is not None
+
+    stored = server.state.collections["/api/v1/nodes"]["n1"]
+    assert stored["status"]["nodeInfo"]["kubeletVersion"] == "v1.29.0"
+    assert stored["status"]["conditions"][0]["type"] == "Ready"
+    assert any(t["key"] == k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY
+               for t in stored["spec"]["taints"])
+
+    # and removal round-trips too
+    untainted = tainting.delete_to_be_removed_taint(updated, client)
+    assert k8s.get_to_be_removed_taint(untainted) is None
+    stored = server.state.collections["/api/v1/nodes"]["n1"]
+    assert stored["spec"]["taints"] == []
+    assert stored["status"]["nodeInfo"]["kubeletVersion"] == "v1.29.0"
+
+
+def test_stale_resource_version_is_conflict(server, client):
+    server.add_node(node_to_json(build_test_node(
+        NodeOpts(name="n1", cpu=4000, mem=16 * 10**9))))
+    assert _poll(lambda: len(client.list_nodes()) == 1)
+    stale = dict(server.state.collections["/api/v1/nodes"]["n1"])
+    stale["metadata"] = dict(stale["metadata"], resourceVersion="1")
+    server.add_node(node_to_json(build_test_node(
+        NodeOpts(name="n1", cpu=8000, mem=16 * 10**9))))  # bump rv
+    with pytest.raises(ConflictError):
+        client.transport.request("PUT", "/api/v1/nodes/n1", body=stale)
+
+
+def test_delete_node_over_the_wire(server, client):
+    server.add_node(node_to_json(build_test_node(
+        NodeOpts(name="n1", cpu=4000, mem=16 * 10**9))))
+    assert _poll(lambda: len(client.list_nodes()) == 1)
+    client.delete_node("n1")
+    assert server.state.collections["/api/v1/nodes"] == {}
+    assert _poll(lambda: client.list_nodes() == [])
+
+
+def test_events_posted(server, client):
+    client.create_event(k8s.Event(
+        reason="ScaleUpCloudProvider", message="increased by 3",
+        involved_name="buildeng", timestamp_sec=1_700_000_000))
+    evs = server.events
+    assert len(evs) == 1
+    assert evs[0]["reason"] == "ScaleUpCloudProvider"
+    assert evs[0]["involvedObject"]["name"] == "buildeng"
+
+
+# ---------------------------------------------------------------------------
+# Lease election
+# ---------------------------------------------------------------------------
+
+
+def _elector(server, ident, **cfg):
+    lock = LeaseResourceLock(
+        Transport(ApiserverConfig(server.url, token=TOKEN)),
+        namespace="kube-system", name="escalator-tpu")
+    config = LeaderElectionConfig(
+        lease_duration_sec=cfg.get("lease", 0.6),
+        renew_deadline_sec=cfg.get("renew", 0.4),
+        retry_period_sec=cfg.get("retry", 0.05),
+    )
+    return LeaderElector(lock, config, identity=ident)
+
+
+def test_lease_election_single_winner_and_takeover(server):
+    a = _elector(server, "holder-a")
+    b = _elector(server, "holder-b")
+    assert a.run(blocking_acquire_timeout=5)
+    assert a.is_leader
+    lease = server.lease("kube-system", "escalator-tpu")
+    assert lease["spec"]["holderIdentity"] == "holder-a"
+
+    # b cannot take a held, renewing lease
+    assert not b.run(blocking_acquire_timeout=0.4)
+
+    # a stops renewing; after expiry b takes over via CAS on the stale holder
+    a.stop()
+    assert b.run(blocking_acquire_timeout=10)
+    lease = server.lease("kube-system", "escalator-tpu")
+    assert lease["spec"]["holderIdentity"] == "holder-b"
+    b.stop()
+
+
+def test_lease_duration_is_positive_and_validated(server):
+    """A real apiserver 422s leaseDurationSeconds <= 0 (ValidateLeaseSpec); the
+    fake enforces the same, and the lock always writes a positive duration."""
+    from escalator_tpu.k8s.election import LeaderRecord
+
+    t = Transport(ApiserverConfig(server.url, token=TOKEN))
+    lock = LeaseResourceLock(t, lease_duration_sec=15.0)
+    now = time.time()
+    assert lock.create_or_update(LeaderRecord("x", now, now), None)
+    lease = server.lease("kube-system", "escalator-tpu")
+    assert lease["spec"]["leaseDurationSeconds"] == 15
+    # direct write of an invalid duration is rejected like a real apiserver
+    bad = dict(lease)
+    bad["spec"] = dict(lease["spec"], leaseDurationSeconds=0)
+    with pytest.raises(ApiError) as exc:
+        t.request("PUT",
+                  "/apis/coordination.k8s.io/v1/namespaces/kube-system"
+                  "/leases/escalator-tpu", body=bad)
+    assert exc.value.status == 422
+
+
+def test_lease_lock_survives_apiserver_outage(server):
+    """Transient connection failure during acquisition = not-acquired, not a
+    crash (an apiserver rolling restart must not kill HA standbys)."""
+    t = Transport(ApiserverConfig("http://127.0.0.1:1", token=TOKEN))  # refused
+    lock = LeaseResourceLock(t)
+    from escalator_tpu.k8s.election import LeaderRecord
+
+    now = time.time()
+    assert lock.create_or_update(LeaderRecord("x", now, now), "x") is False
+    elector = LeaderElector(lock, LeaderElectionConfig(
+        lease_duration_sec=0.5, renew_deadline_sec=0.3, retry_period_sec=0.05))
+    assert elector.run(blocking_acquire_timeout=0.3) is False  # no crash
+
+
+def test_token_file_rotation(server, tmp_path):
+    """Bound serviceaccount tokens rotate on disk; the transport must pick up
+    the new token (client-go reloads; a cached startup token => 401 forever)."""
+    tok = tmp_path / "token"
+    tok.write_text("wrong")
+    cfg = ApiserverConfig(server.url, token_file=str(tok))
+    t = Transport(cfg)
+    with pytest.raises(ApiError) as exc:
+        t.request("GET", "/api/v1/nodes")
+    assert exc.value.status == 401
+    import os as _os
+
+    tok.write_text(TOKEN)
+    _os.utime(tok, (time.time() + 5, time.time() + 5))  # ensure mtime changes
+    assert t.request("GET", "/api/v1/nodes")["kind"] == "NodeList"
+
+
+def test_preexisting_empty_lease_is_claimable(server):
+    """A Lease with no holderIdentity (released client-go-style or pre-created
+    by a manifest) must be claimable via CAS PUT — a POST-only create path
+    would 409-livelock forever."""
+    from escalator_tpu.k8s.election import LeaderRecord
+
+    t = Transport(ApiserverConfig(server.url, token=TOKEN))
+    t.request("POST", "/apis/coordination.k8s.io/v1/namespaces/kube-system/leases",
+              body={"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                    "metadata": {"name": "escalator-tpu",
+                                 "namespace": "kube-system"},
+                    "spec": {}})
+    lock = LeaseResourceLock(t)
+    assert lock.get() is None  # holder-less reads as no record
+    now = time.time()
+    assert lock.create_or_update(LeaderRecord("claimer", now, now), None)
+    lease = server.lease("kube-system", "escalator-tpu")
+    assert lease["spec"]["holderIdentity"] == "claimer"
+
+
+def test_micro_time_fraction_rollover():
+    from escalator_tpu.k8s.restclient import _micro_time, _parse_micro_time
+
+    t = 1_700_000_000.9999996  # naive per-field rounding emits ".1000000"
+    assert abs(_parse_micro_time(_micro_time(t)) - (t + 0.0000004)) < 1e-5
+    assert ".1000000" not in _micro_time(t)
+
+
+def test_lease_cas_loses_race(server):
+    """Two raw locks CAS-ing concurrently: exactly one create succeeds."""
+    from escalator_tpu.k8s.election import LeaderRecord
+
+    l1 = LeaseResourceLock(Transport(ApiserverConfig(server.url, token=TOKEN)))
+    l2 = LeaseResourceLock(Transport(ApiserverConfig(server.url, token=TOKEN)))
+    now = time.time()
+    r1 = l1.create_or_update(LeaderRecord("x", now, now), None)
+    r2 = l2.create_or_update(LeaderRecord("y", now, now), None)
+    assert r1 and not r2
+    # update with the wrong expected holder fails, right one succeeds
+    assert not l2.create_or_update(LeaderRecord("y", now, now), "y")
+    assert l1.create_or_update(LeaderRecord("x", now, now + 1), "x")
+
+
+# ---------------------------------------------------------------------------
+# kubeconfig
+# ---------------------------------------------------------------------------
+
+
+def test_kubeconfig_parsing(tmp_path, server):
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump({
+        "current-context": "fake",
+        "contexts": [{"name": "fake",
+                      "context": {"cluster": "c", "user": "u",
+                                  "namespace": "infra"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server.url}}],
+        "users": [{"name": "u", "user": {"token": TOKEN}}],
+    }))
+    cfg = kubeconfig_config(str(path))
+    assert cfg.base_url == server.url
+    assert cfg.token == TOKEN
+    assert cfg.namespace == "infra"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: controller ticks over HTTP
+# ---------------------------------------------------------------------------
+
+LABEL_KEY, LABEL_VALUE = "customer", "buildeng"
+
+
+def _ng_opts(**kw):
+    base = dict(
+        name="buildeng", label_key=LABEL_KEY, label_value=LABEL_VALUE,
+        cloud_provider_group_name="buildeng-asg",
+        min_nodes=1, max_nodes=100,
+        taint_upper_capacity_threshold_percent=45,
+        taint_lower_capacity_threshold_percent=30,
+        scale_up_threshold_percent=70,
+        slow_node_removal_rate=1, fast_node_removal_rate=2,
+        soft_delete_grace_period="5m", hard_delete_grace_period="15m",
+        scale_up_cool_down_period="10m",
+    )
+    base.update(kw)
+    return ngmod.NodeGroupOptions(**base)
+
+
+def _seed_cluster(server, n_nodes, n_pods, pod_cpu=1500, pod_mem=6 * 10**9):
+    for i in range(n_nodes):
+        server.add_node(node_to_json(build_test_node(NodeOpts(
+            name=f"n{i}", cpu=2000, mem=8 * 10**9,
+            creation_time_ns=(i + 1) * 10**9))))
+    for i in range(n_pods):
+        server.add_pod(pod_to_json(build_test_pod(PodOpts(
+            name=f"p{i}", cpu=[pod_cpu], mem=[pod_mem],
+            node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))))
+
+
+def _controller_over(client, opts, target_size):
+    provider = MockCloudProvider()
+    group = MockNodeGroup("buildeng-asg", "buildeng", min_size=opts.min_nodes,
+                          max_size=opts.max_nodes, target_size=target_size)
+    provider.register_node_group(group)
+    controller = ctl.Controller(ctl.Opts(
+        client=client, node_groups=[opts],
+        cloud_provider_builder=MockBuilder(provider),
+        scan_interval_sec=60, backend=GoldenBackend(),
+    ))
+    return controller, group
+
+
+def test_controller_scales_up_over_http(server, client):
+    _seed_cluster(server, n_nodes=2, n_pods=8)  # way over capacity
+    assert _poll(lambda: len(client.list_nodes()) == 2
+                 and len(client.list_pods()) == 8)
+    controller, group = _controller_over(client, _ng_opts(), target_size=2)
+    controller.run_once()
+    assert group.target_size() > 2
+
+
+def test_controller_taints_over_http(server, client):
+    # 6 idle nodes, one tiny pod: utilisation far below the taint threshold
+    _seed_cluster(server, n_nodes=6, n_pods=1, pod_cpu=50, pod_mem=10**8)
+    assert _poll(lambda: len(client.list_nodes()) == 6
+                 and len(client.list_pods()) == 1)
+    controller, _ = _controller_over(client, _ng_opts(), target_size=6)
+    controller.run_once()
+    stored = server.state.collections["/api/v1/nodes"]
+    tainted = [
+        name for name, obj in stored.items()
+        if any(t["key"] == k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY
+               for t in (obj.get("spec", {}).get("taints") or []))
+    ]
+    assert len(tainted) >= 1
+    # oldest-first: n0 has the earliest creationTimestamp
+    assert "n0" in tainted
+
+
+def test_native_backend_over_http(server, client):
+    """The full event path: apiserver watch -> informer -> WatchBridge ->
+    native store -> kernel decision."""
+    from escalator_tpu.controller.native_backend import make_native_backend
+
+    _seed_cluster(server, n_nodes=2, n_pods=8)
+    assert _poll(lambda: len(client.list_nodes()) == 2
+                 and len(client.list_pods()) == 8)
+    opts = _ng_opts()
+    backend = make_native_backend(client, [opts])
+    provider = MockCloudProvider()
+    group = MockNodeGroup("buildeng-asg", "buildeng", min_size=1,
+                          max_size=100, target_size=2)
+    provider.register_node_group(group)
+    controller = ctl.Controller(ctl.Opts(
+        client=client, node_groups=[opts],
+        cloud_provider_builder=MockBuilder(provider),
+        scan_interval_sec=60, backend=backend,
+    ))
+    controller.run_once()
+    assert group.target_size() > 2
+
+
+def test_cli_once_against_fake_apiserver(server, tmp_path, capsys):
+    """cli.main --kubeconfig --once --leader-elect drives config discovery,
+    informer sync, Lease election and a full tick over the wire."""
+    import json as jsonmod
+
+    from escalator_tpu import cli
+
+    _seed_cluster(server, n_nodes=2, n_pods=8)
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(yaml.safe_dump({
+        "current-context": "fake",
+        "contexts": [{"name": "fake", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server.url}}],
+        "users": [{"name": "u", "user": {"token": TOKEN}}],
+    }))
+    ngfile = tmp_path / "nodegroups.yaml"
+    ngfile.write_text(yaml.safe_dump({"node_groups": [{
+        "name": "buildeng",
+        "label_key": LABEL_KEY, "label_value": LABEL_VALUE,
+        "cloud_provider_group_name": "buildeng-asg",
+        "min_nodes": 1, "max_nodes": 100,
+        "taint_upper_capacity_threshold_percent": 45,
+        "taint_lower_capacity_threshold_percent": 30,
+        "scale_up_threshold_percent": 70,
+        "slow_node_removal_rate": 1, "fast_node_removal_rate": 2,
+        "soft_delete_grace_period": "5m", "hard_delete_grace_period": "15m",
+        "scale_up_cool_down_period": "10m",
+    }]}))
+    rc = cli.main([
+        "--nodegroups", str(ngfile),
+        "--kubeconfig", str(kubeconfig),
+        "--backend", "golden",
+        "--leader-elect",
+        "--leader-elect-lease-namespace", "default",
+        "--once",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    doc = jsonmod.loads(out)
+    assert doc["deltas"]["buildeng"] > 0
+    # the election left a Lease behind and recorded the event
+    lease = server.lease("default", "escalator-tpu")
+    assert lease is not None and lease["spec"]["holderIdentity"]
+    assert any(e["reason"] == "LeaderElected" for e in server.events)
