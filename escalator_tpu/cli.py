@@ -338,9 +338,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         threading.Thread(target=watch_deposed, daemon=True).start()
 
-    if args.backend != "golden":
+    if args.backend not in ("golden", "grpc"):
         # a wedged accelerator transport must degrade to XLA-CPU, not hang the
-        # control loop at the first dispatch (same kernels, same decisions)
+        # control loop at the first dispatch (same kernels, same decisions).
+        # grpc is exempt: its heavy compute is remote, and the only local jax
+        # use (the packing post-pass) runs fine on whatever answers later —
+        # an up-to-90s startup stall buys nothing there.
         from escalator_tpu.jaxconfig import ensure_responsive_accelerator
 
         ensure_responsive_accelerator()

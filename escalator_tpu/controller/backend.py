@@ -292,7 +292,7 @@ class PackingPostPass:
             binpack = None
 
         # groups the kernel cannot size virtual nodes for take the reference's
-        # +1 no-cache convention (util.go:26-28) without a device call
+        # +1 no-cache convention (pkg/controller/util.go:20-24) without a device call
         device_rows = []
         for row in sel_data:
             gi, pod_cpu, _m, _bc, _bm, template, _b = row
@@ -448,9 +448,14 @@ class ShardedJaxBackend(ComputeBackend):
             shard_results = _unpack(shard_out, shard_inputs)
             for local, gi in enumerate(shard_groups):
                 results[gi] = shard_results[local]
-        final = [r for r in results if r is not None]
-        self._packing.apply(final, group_inputs, dry_mode_flags, taint_trackers)
-        return final
+        # PackingPostPass.select indexes results[gi] by group_inputs position,
+        # so it must see the UNfiltered list — a partial assignment filtered
+        # first would silently repack the wrong groups' deltas
+        assert all(r is not None for r in results), (
+            "assign_shards must cover every group"
+        )
+        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
+        return results
 
 
 class PodAxisJaxBackend(ComputeBackend):

@@ -376,7 +376,7 @@ def packing_scale_up_delta(
     nodes of the cached template capacity; the delta is virtual-nodes-used plus
     one per pod that fits nowhere (a pod larger than the template conservatively
     claims a node — adding more identical nodes cannot help it, mirroring the
-    reference's +1 no-cache convention, util.go:26-28)."""
+    reference's +1 no-cache convention, pkg/controller/util.go:20-24)."""
     if not pods:
         return 0
     template = (state.cached_cpu_milli, state.cached_mem_bytes)

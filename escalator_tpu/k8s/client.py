@@ -102,21 +102,28 @@ class InMemoryKubernetesClient:
         for cb in self.on_node_delete:
             cb(name)
 
+    #: retained Events cap — long sim runs must not grow the list unboundedly
+    MAX_EVENTS = 4096
+
     def create_event(self, event: k8s.Event) -> None:
         with self._lock:
             # compact repeats the way the apiserver's event series do: same
-            # (reason, object) within the retention window bumps count
+            # (reason, object) within the retention window bumps count. The
+            # message is NOT part of the key — emitted messages embed counts
+            # ("increased ... by 6"), so near-duplicates would never compact
             for e in reversed(self.events[-16:]):
                 if (
                     e.reason == event.reason
                     and e.involved_kind == event.involved_kind
                     and e.involved_name == event.involved_name
-                    and e.message == event.message
                 ):
                     e.count += 1
+                    e.message = event.message  # keep the freshest text
                     e.timestamp_sec = event.timestamp_sec
                     return
             self.events.append(event)
+            if len(self.events) > self.MAX_EVENTS:
+                del self.events[: len(self.events) - self.MAX_EVENTS]
 
     # -- simulation helpers ---------------------------------------------------
     def add_node(self, node: k8s.Node) -> None:

@@ -293,15 +293,16 @@ def fused_segment_sums(
         ids_s = ids_p[perm]
         valid_s = valid_p[perm]
         spread_sorted, ids_clean_s, bases_s = layout(ids_s, valid_s)
-        cols_s = build_cols()[:, perm]
+
         # a sorted tile can still span > MAX_SPREAD groups when groups average
-        # under ~1 lane each — only then is scatter the right tool
-        return lax.cond(
-            spread_sorted,
-            lambda __: run_pallas(ids_clean_s, bases_s, cols_s),
-            xla_path,
-            None,
-        )
+        # under ~1 lane each — only then is scatter the right tool. The
+        # [MAX_COLS, P_pad] column gather stays INSIDE the true branch so that
+        # pathology doesn't pay for a gather it then discards.
+        def sorted_path(__):
+            cols_s = build_cols()[:, perm]
+            return run_pallas(ids_clean_s, bases_s, cols_s)
+
+        return lax.cond(spread_sorted, sorted_path, xla_path, None)
 
     results = lax.cond(
         in_range,

@@ -73,8 +73,10 @@ def test_dry_mode_records_nothing():
 
 
 def test_repeat_events_compact_to_count():
-    """Identical (reason, object, message) repeats bump count instead of
-    growing the event list unboundedly — apiserver event-series semantics."""
+    """(reason, object) repeats bump count instead of growing the event list
+    unboundedly — apiserver event-series semantics. The message is NOT part of
+    the key (emitted messages embed counts like 'increased by 3', so keying on
+    text would never compact); the freshest text wins."""
     from escalator_tpu.k8s.client import InMemoryKubernetesClient
 
     c = InMemoryKubernetesClient()
@@ -87,6 +89,26 @@ def test_repeat_events_compact_to_count():
         reason="ScaleUpCloudProvider", message="increased by 5",
         involved_name="buildeng", timestamp_sec=200,
     ))
+    assert len(c.events) == 1
+    assert c.events[0].count == 3
+    assert c.events[0].timestamp_sec == 200
+    assert c.events[0].message == "increased by 5"
+    # a different object does NOT compact
+    c.create_event(k8s.Event(
+        reason="ScaleUpCloudProvider", message="increased by 1",
+        involved_name="other-group", timestamp_sec=210,
+    ))
     assert len(c.events) == 2
-    assert c.events[0].count == 2 and c.events[0].timestamp_sec == 160
-    assert c.events[1].count == 1
+
+
+def test_event_list_is_capped():
+    from escalator_tpu.k8s.client import InMemoryKubernetesClient
+
+    c = InMemoryKubernetesClient()
+    for i in range(c.MAX_EVENTS + 50):
+        c.create_event(k8s.Event(
+            reason="R", message=f"m{i}", involved_name=f"g{i}",
+            timestamp_sec=i,
+        ))
+    assert len(c.events) == c.MAX_EVENTS
+    assert c.events[-1].involved_name == f"g{c.MAX_EVENTS + 49}"
