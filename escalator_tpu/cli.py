@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import signal
 import sys
 import threading
@@ -299,6 +300,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 retry_period_sec=ngmod.parse_duration(
                     args.leader_elect_retry_period),
             ),
+            # the Deployment sets POD_NAME via the downward API so the Lease
+            # holder is readable as "which replica leads" (the reference uses
+            # the pod hostname the same way, cmd/main.go:163); fall back to
+            # the pid-uuid identity outside k8s
+            identity=os.environ.get("POD_NAME") or None,
             on_deposed=deposed.set,
         )
         def _election_event(reason: str, message: str) -> None:

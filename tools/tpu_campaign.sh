@@ -21,7 +21,7 @@ EOF
   then
     echo "$TS probe OK: $(tail -1 /tmp/tpu_probe_out)" >> "$LOG"
     CAP="TPU_BENCH_$(date -u +%Y%m%dT%H%M%SZ).json"
-    if timeout 2400 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
+    if timeout 4800 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
       if grep -q "CPU fallback" "$CAP"; then
         echo "$TS bench ran but degraded mid-run (kept $CAP)" >> "$LOG"
       else
