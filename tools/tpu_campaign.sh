@@ -6,7 +6,7 @@
 # timestamped file (the tunnel may wedge again before end-of-round).
 cd "$(dirname "$0")/.." || exit 1
 LOG=TPU_ATTEMPTS.log
-INTERVAL="${TPU_CAMPAIGN_INTERVAL:-600}"
+INTERVAL="${TPU_CAMPAIGN_INTERVAL:-300}"
 while true; do
   TS=$(date -u +%FT%TZ)
   # probe in a fresh subprocess: a wedged tunnel hangs even jnp.ones(8), and no
@@ -21,7 +21,10 @@ EOF
   then
     echo "$TS probe OK: $(tail -1 /tmp/tpu_probe_out)" >> "$LOG"
     CAP="TPU_BENCH_$(date -u +%Y%m%dT%H%M%SZ).json"
-    if timeout 4800 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
+    # campaign captures race a short tunnel window: fewer iters, skip the
+    # CPU-only sharded subprocess (the end-of-round driver run does it all)
+    if ESCALATOR_TPU_BENCH_ITERS=12 ESCALATOR_TPU_BENCH_SKIP_SHARDED=1 \
+       timeout 1800 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
       if grep -q "CPU fallback" "$CAP"; then
         echo "$TS bench ran but degraded mid-run (kept $CAP)" >> "$LOG"
       else
