@@ -308,14 +308,16 @@ def _cfg9_pallas_matrix(detail, headline_cluster, host_headline,
         try:
             r["pallas_ms"] = round(
                 _time_decide(cluster, now, impl="pallas"), 3)
+        except Exception as e:  # pragma: no cover
+            r["pallas_error"] = str(e)
+        try:
             r["path"] = pk.path_report(
                 np.where(host_valid, host_group, 0), host_valid,
                 {"cpu": host_cpu},
             )["path"]
         except Exception as e:  # pragma: no cover
-            r["pallas_error"] = str(e)
-        if ("xla_ms" in r and "pallas_ms" in r and r["xla_ms"]
-                and "pallas_error" not in r):
+            r["path_error"] = str(e)
+        if "xla_ms" in r and "pallas_ms" in r and r["xla_ms"]:
             r["pallas_over_xla"] = round(r["pallas_ms"] / r["xla_ms"], 3)
         rows[label] = r
 
@@ -516,6 +518,7 @@ def run_sharded() -> None:
     # ---- cfg8: pod-axis, ONE giant group with 1M pods ----------------------
     giant = _rng_cluster_arrays(rng, 1, 1_000_000, 50_000, mixed=True)
     curve8 = {}
+    mesh8 = placed8_on_mesh8 = None  # bound explicitly at S=8, not loop-exit state
     for S in (2, 8):
         mesh = meshlib.make_mesh(devices[:S])
         placed8 = podaxis.place(podaxis.pad_pods_for_mesh(giant, mesh), mesh)
@@ -523,15 +526,16 @@ def run_sharded() -> None:
         med8, _ = _timeit(
             lambda: jax.block_until_ready(decider8(placed8, now)), iters=iters)
         curve8[str(S)] = round(med8, 3)
+        if S == 8:
+            mesh8, placed8_on_mesh8 = mesh, placed8
     out["cfg8_curve_ms_by_devices"] = curve8
     out["cfg8_podaxis_8dev_1Mpods_ms"] = curve8["8"]
 
-    # phase split on the 8-dev mesh (reusing the loop's final S=8 mesh and
-    # placement): the sharded pod sweep (scales with devices on real chips)
-    # vs the replicated tail (constant-time on real chips, S-fold serialized
-    # on this rig) — the crossover model's two terms
+    # phase split on the 8-dev mesh: the sharded pod sweep (scales with
+    # devices on real chips) vs the replicated tail (constant-time on real
+    # chips, S-fold serialized on this rig) — the crossover model's two terms
     sweep_ms = podaxis.time_pod_sweep(
-        mesh, placed8, _timeit=lambda f: _timeit(f, iters=iters))
+        mesh8, placed8_on_mesh8, _timeit=lambda f: _timeit(f, iters=iters))
     out["cfg8_sweep_only_8dev_ms"] = round(sweep_ms, 3)
     out["cfg8_replicated_tail_ms"] = round(curve8["8"] - sweep_ms, 3)
 
@@ -659,7 +663,8 @@ def main() -> None:
     # an 8-way mesh either way). Campaign captures racing a short tunnel
     # window skip this CPU-only section (ESCALATOR_TPU_BENCH_SKIP_SHARDED) —
     # the TPU-relevant configs above are the capture's point.
-    if os.environ.get("ESCALATOR_TPU_BENCH_SKIP_SHARDED"):
+    if os.environ.get("ESCALATOR_TPU_BENCH_SKIP_SHARDED", "").lower() not in (
+            "", "0", "false"):
         skip_note = "sharded section skipped by design (campaign capture)"
         detail["cfg7_skipped"] = detail["cfg8_skipped"] = skip_note
     else:

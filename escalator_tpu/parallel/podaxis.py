@@ -32,16 +32,18 @@ concurrently). So on real hardware the best case is
 ``total(inf) -> tail(N)``: pod-axis sharding pays off only while the pod
 sweep DOMINATES the node tail, i.e. **P >> N** (giant default group, few
 nodes). At the bench shape (1M pods / 50k nodes, CPU) the split is
-sweep ~20 ms vs tail ~50 ms — sharding can cut at most the 20, never the 50;
-shapes with fewer nodes or more pods shift the ceiling up.
+sweep ~20 ms vs tail ~30 ms (tail measured after the one-pass multi-key
+``lax.sort`` fusion in ops.kernel) — sharding can cut at most the 20, never
+the 30; shapes with fewer nodes or more pods shift the ceiling up.
 
 On this repo's 1-physical-core bench rig the virtual devices timeshare one
 core, so the replicated tail SERIALIZES S-fold instead of running
-concurrently: measured cfg8 8-dev total = 412 ms vs 70 ms single-device
-(sweep-only 19 ms, tail 393 ms — the S-fold serialization, exactly). That
-0.17x "speedup" is the rig artifact the cost model predicts, not a property
-of the design; the sharded sweep itself (19 ms for 1M lanes over 8 shards)
-is the term that rides ICI on real chips. The bench reports the curve, the
+concurrently: measured cfg8 8-dev total = 261 ms vs 61 ms single-device
+(sweep-only 19 ms, tail 242 ms ~= 8 x the single-device tail — the S-fold
+serialization, exactly). That 0.23x "speedup" is the rig artifact the cost
+model predicts, not a property of the design; the sharded sweep itself
+(19 ms for 1M lanes over 8 shards) is the term that rides ICI on real
+chips. The bench reports the curve, the
 phase split, and the confound note side by side so neither reading is
 possible by accident.
 

@@ -206,10 +206,15 @@ def test_wire_soak_churn_relists_and_lease_contention():
             for t in threads:
                 t.start()
 
+            # compact on an explicit, evenly spread set of ticks so RELISTS
+            # actually controls the compaction count
+            compact_ticks = {
+                (i + 1) * TICKS // (RELISTS + 1) for i in range(RELISTS)
+            }
             try:
                 for tick in range(TICKS):
                     controller.run_once()
-                    if tick % (TICKS // RELISTS) == 1:
+                    if tick in compact_ticks:
                         # compact the watch history: the informers' next
                         # reconnect gets 410 Gone and must relist cleanly
                         server.compact_history()
