@@ -1,0 +1,250 @@
+"""Render docs/metrics-dashboard.svg — a static preview of the Grafana
+dashboard (docs/grafana-dashboard.json) over one synthetic scale cycle.
+
+The reference ships a screenshot of its live dashboard (docs/metrics.md links
+docs/metrics-dashboard.png); this repo has no live Grafana to screenshot, so
+the preview is rendered deterministically from a simulated six-hour
+scale-up/scale-down cycle instead — same panels, same metric names, plausible
+shapes. Regenerate with: python tools/render_dashboard_preview.py
+
+Styling follows a fixed mark spec: 2px round-capped lines, hairline solid
+gridlines one step off the surface, text in ink tokens (never series colors),
+legend for every multi-series panel, sparing direct end-labels. Series hues
+are a validated colorblind-safe categorical palette in fixed slot order.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+W, H = 1180, 1510
+PANEL_W, PANEL_H = 560, 270
+PAD = 20
+PLOT_L, PLOT_T, PLOT_R, PLOT_B = 46, 34, 10, 52
+
+SURFACE = "#fcfcfb"
+GRID = "#e8e7e4"
+INK = "#0b0b0b"
+INK2 = "#52514e"
+# categorical slots, fixed order (validated palette; see dataviz notes)
+S1, S2, S3, S4 = "#2a78d6", "#eb6834", "#1baf7a", "#eda100"
+
+T = 72  # samples over 6h (5-min scrape)
+
+
+def cycle():
+    """One synthetic scale cycle: pending spike -> scale-up -> drain ->
+    taint -> reap. Returns dict of named series, each length T."""
+    s = {k: [] for k in (
+        "nodes", "untainted", "tainted", "cordoned", "cpu", "mem", "delta",
+        "pods", "evicted", "target", "actual", "maxsize", "lock", "lockrate",
+        "lag", "decide", "pack", "run_a", "run_b", "pend_a",
+        "ph_run", "ph_pend", "ph_succ", "ph_fail")}
+    nodes, tainted = 14.0, 2.0
+    for i in range(T):
+        x = i / (T - 1)
+        # demand wave: quiet -> burst at x~0.25 -> drain after x~0.6
+        burst = math.exp(-((x - 0.35) / 0.16) ** 2)
+        pods = 40 + 260 * burst + 6 * math.sin(i * 1.7)
+        cpu = min(97.0, 22 + 68 * burst + 3 * math.sin(i * 2.3))
+        mem = min(92.0, 18 + 55 * burst + 3 * math.cos(i * 1.9))
+        delta = 0
+        if cpu > 70 and nodes < 26:
+            delta = min(4, int((cpu - 70) / 6) + 1)
+            nodes += delta
+            tainted = max(0.0, tainted - 1)
+        elif cpu < 30 and nodes > 12:
+            delta = -1
+            tainted = min(nodes - 10, tainted + 1)
+            if tainted > 3:
+                nodes -= 1
+                tainted -= 1
+        s["nodes"].append(nodes)
+        s["untainted"].append(nodes - tainted - 1)
+        s["tainted"].append(tainted)
+        s["cordoned"].append(1)
+        s["cpu"].append(cpu)
+        s["mem"].append(mem)
+        s["delta"].append(delta)
+        s["pods"].append(pods)
+        s["evicted"].append(max(0.0, 0.4 * (tainted - 1) + 0.1 * math.sin(i)))
+        s["target"].append(nodes)
+        s["actual"].append(s["nodes"][max(0, i - 2)])  # provider lags 2 ticks
+        s["maxsize"].append(30)
+        locked = 1.0 if (0 < delta and cpu > 70) else 0.0
+        s["lock"].append(locked)
+        s["lockrate"].append(0.2 + 1.4 * locked)
+        s["lag"].append(95 + 40 * burst + 8 * math.sin(i * 1.3))
+        s["decide"].append(0.0016 + 0.0006 * burst)
+        s["pack"].append(0.0031 + 0.0009 * burst)
+        s["run_a"].append(30 + 180 * burst)
+        s["run_b"].append(25 + 20 * math.sin(i * 0.6) ** 2)
+        s["pend_a"].append(max(0.0, 90 * burst - 20))
+        s["ph_run"].append(55 + 195 * burst)
+        s["ph_pend"].append(max(0.0, 95 * burst - 15))
+        s["ph_succ"].append(8 + 0.9 * i)
+        s["ph_fail"].append(2 + 0.03 * i)
+    return s
+
+
+def nice_ticks(lo, hi, n=4):
+    if hi <= lo:
+        hi = lo + 1
+    raw = (hi - lo) / n
+    mag = 10 ** math.floor(math.log10(raw))
+    step = min(x for x in (1, 2, 5, 10) if x * mag >= raw) * mag
+    t0 = math.floor(lo / step) * step
+    out = []
+    t = t0
+    while t <= hi + 1e-9:
+        if t >= lo - 1e-9:
+            out.append(t)
+        t += step
+    return out
+
+
+def fmt(v):
+    if v >= 1000:
+        return f"{v:,.0f}"
+    if v == int(v):
+        return f"{int(v)}"
+    return f"{v:g}"
+
+
+class Panel:
+    def __init__(self, x, y, title):
+        self.x, self.y, self.title = x, y, title
+        self.parts = [
+            f'<g transform="translate({x},{y})">',
+            f'<rect width="{PANEL_W}" height="{PANEL_H}" fill="{SURFACE}" '
+            f'stroke="{GRID}" rx="4"/>',
+            f'<text x="14" y="22" fill="{INK}" font-size="13" '
+            f'font-weight="600">{title}</text>',
+        ]
+        self.pw = PANEL_W - PLOT_L - PLOT_R
+        self.ph = PANEL_H - PLOT_T - PLOT_B
+
+    def px(self, i):
+        return PLOT_L + self.pw * i / (T - 1)
+
+    def py(self, v, lo, hi):
+        return PLOT_T + self.ph * (1 - (v - lo) / (hi - lo))
+
+    def axes(self, lo, hi, unit=""):
+        for tv in nice_ticks(lo, hi):
+            y = self.py(tv, lo, hi)
+            self.parts.append(
+                f'<line x1="{PLOT_L}" y1="{y:.1f}" x2="{PLOT_L + self.pw}" '
+                f'y2="{y:.1f}" stroke="{GRID}" stroke-width="1"/>')
+            self.parts.append(
+                f'<text x="{PLOT_L - 6}" y="{y + 4:.1f}" fill="{INK2}" '
+                f'font-size="10" text-anchor="end">{fmt(tv)}{unit}</text>')
+        # time labels sit just under the plot, clear of the legend row below
+        for frac, lab in ((0, "12:00"), (0.5, "15:00"), (1, "18:00")):
+            x = PLOT_L + self.pw * frac
+            self.parts.append(
+                f'<text x="{x:.1f}" y="{PLOT_T + self.ph + 14}" fill="{INK2}" '
+                f'font-size="10" text-anchor="middle">{lab}</text>')
+
+    def line(self, series, color, lo, hi):
+        pts = " ".join(
+            f"{self.px(i):.1f},{self.py(v, lo, hi):.1f}"
+            for i, v in enumerate(series))
+        self.parts.append(
+            f'<polyline points="{pts}" fill="none" stroke="{color}" '
+            f'stroke-width="2" stroke-linejoin="round" stroke-linecap="round"/>')
+
+    def end_label(self, series, label, lo, hi):
+        """Sparing direct label at the line's endpoint, in ink (text never
+        wears the series color)."""
+        y = self.py(series[-1], lo, hi)
+        self.parts.append(
+            f'<text x="{PLOT_L + self.pw - 4:.1f}" y="{y - 6:.1f}" '
+            f'fill="{INK2}" font-size="10" text-anchor="end">{label}</text>')
+
+    def legend(self, entries):
+        x = PLOT_L
+        for color, label in entries:
+            self.parts.append(
+                f'<rect x="{x}" y="{PANEL_H - 22}" width="10" height="10" '
+                f'rx="2" fill="{color}"/>')
+            self.parts.append(
+                f'<text x="{x + 14}" y="{PANEL_H - 13}" fill="{INK2}" '
+                f'font-size="10">{label}</text>')
+            x += 14 + 7 * len(label) + 16
+
+    def done(self):
+        self.parts.append("</g>")
+        return "\n".join(self.parts)
+
+
+def timeseries_panel(x, y, title, series, unit="", labels=()):
+    """series: list of (values, color, legend_label)."""
+    p = Panel(x, y, title)
+    lo = min(0.0, min(min(vals) for vals, _, _ in series) * 1.15)
+    hi = max(max(vals) for vals, _, _ in series) * 1.15 or 1.0
+    p.axes(lo, hi, unit)
+    for vals, color, _ in series:
+        p.line(vals, color, lo, hi)
+    if len(series) > 1:
+        p.legend([(c, l) for _, c, l in series])
+    for vals, _, lab in (series[i] for i in labels):
+        p.end_label(vals, lab, lo, hi)
+    return p.done()
+
+
+def main():
+    s = cycle()
+    panels, grid = [], [
+        ("Node counts by state",
+         [(s["nodes"], S1, "total"), (s["untainted"], S2, "untainted"),
+          (s["tainted"], S3, "tainted"), (s["cordoned"], S4, "cordoned")],
+         "", (0,)),
+        ("Utilisation (%)",
+         [(s["cpu"], S1, "cpu"), (s["mem"], S2, "mem")], "%", (0,)),
+        ("Scale delta", [(s["delta"], S1, "delta")], "", ()),
+        ("Pods",
+         [(s["pods"], S1, "considered"), (s["evicted"], S2, "evicted/s")],
+         "", (0,)),
+        ("Provider sizes",
+         [(s["target"], S1, "target"), (s["actual"], S2, "actual"),
+          (s["maxsize"], S3, "max")], "", (2,)),
+        ("Scale lock",
+         [(s["lock"], S1, "locked"), (s["lockrate"], S2, "locked checks/s")],
+         "", ()),
+        ("Node registration lag (p90)", [(s["lag"], S1, "p90")], "s", ()),
+        ("Solver latency (p99)",
+         [(s["decide"], S1, "decide"), (s["pack"], S2, "pack")], "s", ()),
+        ("Running Pods (by namespace)",
+         [(s["run_a"], S1, "buildeng running"), (s["run_b"], S2,
+           "shared running"), (s["pend_a"], S3, "buildeng pending")], "", ()),
+        ("Pod Phase",
+         [(s["ph_run"], S1, "Running"), (s["ph_pend"], S2, "Pending"),
+          (s["ph_succ"], S3, "Succeeded"), (s["ph_fail"], S4, "Failed")],
+         "", (0,)),
+    ]
+    for i, (title, series, unit, labels) in enumerate(grid):
+        x = PAD + (i % 2) * (PANEL_W + PAD)
+        y = 46 + (i // 2) * (PANEL_H + PAD)
+        panels.append(timeseries_panel(x, y, title, series, unit, labels))
+
+    svg = "\n".join([
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" height="{H}" '
+        f'viewBox="0 0 {W} {H}" font-family="system-ui, sans-serif">',
+        f'<rect width="{W}" height="{H}" fill="#f5f4f2"/>',
+        f'<text x="{PAD}" y="30" fill="{INK}" font-size="17" '
+        'font-weight="700">escalator-tpu dashboard preview '
+        '(synthetic scale cycle)</text>',
+        *panels,
+        "</svg>",
+    ])
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "..", "docs", "metrics-dashboard.svg")
+    with open(out, "w") as f:
+        f.write(svg)
+    print(f"wrote {os.path.normpath(out)} ({len(svg)} bytes)")
+
+
+if __name__ == "__main__":
+    main()
