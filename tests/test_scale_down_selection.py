@@ -145,3 +145,76 @@ node_groups:
         )
         problems = ngmod.validate_node_group(opts)
         assert any("scale_down_selection" in p for p in problems), problems
+
+
+class TestTieBreaking:
+    """Exact-tie creation timestamps (and tied pod counts for emptiest_first)
+    must order by input index — the deterministic tie-break CHANGELOG
+    documents. Locks the multi-key lax.sort's iota key in ops.kernel
+    (_grouped_order): a regression to an unstable or differently-keyed sort
+    flips these orders silently."""
+
+    def _orders(self, group):
+        cluster = pack_cluster([group])
+        out = kernel.decide_jit(cluster, NOW)
+        u_off = np.asarray(out.untainted_offsets)
+        t_off = np.asarray(out.tainted_offsets)
+        down = list(np.asarray(out.scale_down_order)[u_off[0]:u_off[1]])
+        up = list(np.asarray(out.untaint_order)[t_off[0]:t_off[1]])
+        return down, up
+
+    def test_all_creation_times_equal(self):
+        nodes = [
+            build_test_node(NodeOpts(name=f"tie-n{i}", cpu=4000,
+                                     mem=16 * 10**9, creation_time_ns=10**9))
+            for i in range(5)
+        ]
+        group = ([], nodes, _cfg("oldest_first"), sem.GroupState())
+        down, _ = self._orders(group)
+        assert down == [0, 1, 2, 3, 4]  # input order, exactly
+        assert sem.nodes_oldest_first(nodes) == down
+
+    def test_tied_pairs_keep_input_order_among_equals(self):
+        ts = [3, 1, 3, 1, 2]  # pairs tie; golden sorts (ts, index)
+        nodes = [
+            build_test_node(NodeOpts(name=f"pair-n{i}", cpu=4000,
+                                     mem=16 * 10**9,
+                                     creation_time_ns=t * 10**9))
+            for i, t in enumerate(ts)
+        ]
+        group = ([], nodes, _cfg("oldest_first"), sem.GroupState())
+        down, _ = self._orders(group)
+        assert down == sem.nodes_oldest_first(nodes) == [1, 3, 4, 0, 2]
+
+    def test_untaint_ties_also_input_order(self):
+        # young pair LAST in input: expected [2,3,0,1] differs from input
+        # order, so a dropped/major-only sort cannot sneak past this
+        ts = [1, 1, 2, 2]
+        nodes = [
+            build_test_node(NodeOpts(name=f"unt-n{i}", cpu=4000,
+                                     mem=16 * 10**9, tainted=True,
+                                     taint_time_sec=int(NOW) - 10,
+                                     creation_time_ns=t * 10**9))
+            for i, t in enumerate(ts)
+        ]
+        group = ([], nodes, _cfg("oldest_first"), sem.GroupState())
+        _, up = self._orders(group)
+        # newest first; among equal timestamps, input order
+        assert up == sem.nodes_newest_first(nodes) == [2, 3, 0, 1]
+
+    def test_emptiest_first_tied_counts_fall_back_to_age_then_index(self):
+        # same pod count everywhere; two nodes also tie on age
+        nodes = [
+            build_test_node(NodeOpts(name=f"emp-n{i}", cpu=4000,
+                                     mem=16 * 10**9,
+                                     creation_time_ns=t * 10**9))
+            for i, t in enumerate([2, 1, 2])
+        ]
+        pods = [
+            build_test_pod(PodOpts(name=f"emp-p{i}", cpu=[100], mem=[10**8],
+                                   node_name=n.name))
+            for i, n in enumerate(nodes)
+        ]
+        group = (pods, nodes, _cfg("emptiest_first"), sem.GroupState())
+        down, _ = self._orders(group)
+        assert down == sem.nodes_emptiest_first(nodes, [1, 1, 1]) == [1, 0, 2]
