@@ -111,6 +111,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of ticks to include in the profiler trace")
     p.add_argument("--profiler-port", type=int, default=0,
                    help="start the live jax profiler server on this port")
+    p.add_argument("--tick-watchdog", dest="tick_watchdog",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="exit when ticks stall far past the scan interval "
+                        "(a wedged leader must crash-to-restart so its "
+                        "Lease lapses and a standby promotes; readiness "
+                        "alone cannot fail over a controller)")
     p.add_argument("--leader-elect", action="store_true")
     p.add_argument("--leader-elect-lock-file", default="/tmp/escalator-tpu.lease",
                    help="lease file for sim/file election (apiserver-backed"
@@ -257,9 +263,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     builder = setup_cloud_provider(args, node_groups, client)
 
     server = None
+    controller_ref: dict = {}
     if not args.once:
         host, _, port = args.address.rpartition(":")
-        server = metrics.start(f"{host or '0.0.0.0'}:{port}")
+
+        def _stale_limit(c):
+            """Single source of the tick-staleness policy: readiness fails
+            at this age; the watchdog exits at twice it."""
+            return 3 * c.opts.scan_interval_sec + 60
+
+        def _readiness():
+            """k8s readiness: not-ready while awaiting leadership (the
+            controller isn't constructed yet on standbys) and when ticks go
+            stale — a wedged device dispatch or stuck provider call stops
+            run_once from completing, which is exactly what should pull a
+            replica out of rotation. Liveness (/healthz) stays green either
+            way: standbys and wedged-but-recovering leaders must not be
+            restarted by the kubelet."""
+            c = controller_ref.get("controller")
+            if c is None:
+                return False, "awaiting leadership / controller not started"
+            if c.last_tick_completed_sec is None:
+                return False, "no tick completed yet"
+            age = c.clock.now() - c.last_tick_completed_sec
+            limit = _stale_limit(c)
+            if age > limit:
+                return False, f"last tick {age:.0f}s ago (limit {limit:.0f}s)"
+            return True, f"ok (last tick {age:.0f}s ago)"
+
+        server = metrics.start(f"{host or '0.0.0.0'}:{port}",
+                               readiness=_readiness)
         log.info("metrics listening on %s", args.address)
 
     stop_event = threading.Event()
@@ -274,6 +307,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError:
         pass  # not the main thread (tests)
 
+    elector = None
     if args.leader_elect:
         deposed = threading.Event()
         # apiserver-backed clients elect over a real k8s Lease
@@ -381,6 +415,46 @@ def main(argv: Optional[List[str]] = None) -> int:
         ),
         stop_event=stop_event,
     )
+    controller_ref["controller"] = controller
+
+    if not args.once and args.tick_watchdog:
+        # A wedged tick (hung provider call, wedged device dispatch) leaves
+        # lease renewal healthy on its own thread: standbys never promote and
+        # /readyz 503 has no operational effect on a controller that serves
+        # no traffic. Crash-to-restart is the remediation, same as the
+        # deposed path (reference: cmd/main.go:147-154) — the restart clears
+        # the wedge or hands leadership to a standby.
+        # env override is for tests/ops tuning; the default keeps the limit
+        # far above any healthy inter-tick gap (2x the /readyz staleness
+        # limit, so readiness always fires first)
+        exit_limit = (float(os.environ.get(
+            "ESCALATOR_TPU_WATCHDOG_LIMIT_SEC", 0))
+            or 2 * _stale_limit(controller))
+        watchdog_start = time.time()
+
+        def tick_watchdog():
+            while not stop_event.wait(min(30.0, exit_limit / 4)):
+                last = controller.last_tick_completed_sec
+                age = time.time() - (last if last is not None
+                                     else watchdog_start)
+                if age > exit_limit:
+                    log.critical(
+                        "no tick completed for %.0fs (limit %.0fs); exiting "
+                        "so a standby can take over", age, exit_limit)
+                    try:
+                        if elector is not None:
+                            elector.stop()  # stop renewing; Lease lapses
+                    finally:
+                        os._exit(70)
+            # stop requested: a WEDGED tick still never returns, and outside
+            # k8s nothing sends SIGKILL — escalate instead of disarming. A
+            # clean shutdown exits the interpreter (killing this daemon
+            # thread) long before the grace elapses.
+            time.sleep(60)
+            log.critical("shutdown did not complete within 60s; forcing exit")
+            os._exit(70)
+
+        threading.Thread(target=tick_watchdog, daemon=True).start()
 
     if args.once:
         controller.run_once()

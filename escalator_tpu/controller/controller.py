@@ -97,6 +97,8 @@ class Controller:
         self.client = opts.client
         self.clock = opts.clock
         self.stop_event = stop_event or threading.Event()
+        #: clock time of the last completed tick, for /readyz freshness
+        self.last_tick_completed_sec: Optional[float] = None
         self.backend = opts.backend or make_backend("auto")
         self.cloud_provider = opts.cloud_provider_builder.build()
 
@@ -250,6 +252,7 @@ class Controller:
             state.scale_delta = delta
 
         metrics.run_count.inc()
+        self.last_tick_completed_sec = self.clock.now()
         log.debug("scaling took a total of %.3fs", self.clock.now() - start)
 
     def run_forever(self, run_immediately: bool = False) -> None:

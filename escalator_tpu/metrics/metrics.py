@@ -6,7 +6,15 @@ shipped Grafana board, docs/grafana-dashboard.json) keep working, plus
 from __future__ import annotations
 
 import threading
+import socketserver
 from wsgiref.simple_server import WSGIServer, make_server
+
+
+class _ThreadingWSGIServer(socketserver.ThreadingMixIn, WSGIServer):
+    """One thread per connection: a stalled metrics scrape must never block
+    the /healthz the kubelet's liveness probe depends on."""
+
+    daemon_threads = True
 
 from prometheus_client import (
     CollectorRegistry,
@@ -152,19 +160,39 @@ solver_packing_latency = Histogram(
 )
 
 
-def start(address: str = "0.0.0.0:8080") -> WSGIServer:
-    """Serve /metrics on a background thread (reference: metrics.go:260-268).
-    Returns the server (call .shutdown() to stop)."""
+def start(address: str = "0.0.0.0:8080", readiness=None) -> WSGIServer:
+    """Serve /metrics on a background thread (reference: metrics.go:260-268),
+    plus /healthz (process liveness: 200 whenever the server answers) and
+    /readyz (200 only when the optional ``readiness`` callable returns
+    ``(True, detail)``, else 503 with the detail — the reference's bare mux
+    has neither, so its Deployment can't distinguish a live standby from a
+    wedged leader). Returns the server (call .shutdown() to stop)."""
     host, _, port = address.rpartition(":")
     app = make_wsgi_app(registry)
 
-    def metrics_only(environ, start_response):
-        if environ.get("PATH_INFO") != "/metrics":
-            start_response("404 Not Found", [("Content-Type", "text/plain")])
-            return [b"not found"]
-        return app(environ, start_response)
+    def route(environ, start_response):
+        path = environ.get("PATH_INFO")
+        if path == "/metrics":
+            return app(environ, start_response)
+        if path == "/healthz":
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return [b"ok"]
+        if path == "/readyz":
+            if readiness is None:
+                ok, detail = True, "ok"
+            else:
+                try:
+                    ok, detail = readiness()
+                except Exception as e:  # a crashing check is "not ready"
+                    ok, detail = False, f"readiness check failed: {e}"
+            start_response("200 OK" if ok else "503 Service Unavailable",
+                           [("Content-Type", "text/plain")])
+            return [detail.encode()]
+        start_response("404 Not Found", [("Content-Type", "text/plain")])
+        return [b"not found"]
 
-    server = make_server(host or "0.0.0.0", int(port), metrics_only)
+    server = make_server(host or "0.0.0.0", int(port), route,
+                         server_class=_ThreadingWSGIServer)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server

@@ -219,3 +219,106 @@ class FakeStopOnce:
 
     def is_set(self):
         return self.rounds <= 0
+
+
+class TestHealthEndpoints:
+    """/healthz (liveness) and /readyz (readiness) on the metrics server —
+    no reference analog (its mux serves /metrics only, metrics.go:260-268);
+    the Deployment manifests' probes point here."""
+
+    def _get(self, port, path):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_healthz_readyz_states(self):
+        from escalator_tpu.metrics import metrics as m
+
+        state = {"ready": (False, "warming up")}
+        server = m.start("127.0.0.1:0", readiness=lambda: state["ready"])
+        try:
+            port = server.server_address[1]
+            assert self._get(port, "/healthz") == (200, "ok")
+            code, body = self._get(port, "/readyz")
+            assert code == 503 and "warming up" in body
+            state["ready"] = (True, "ok (last tick 1s ago)")
+            code, body = self._get(port, "/readyz")
+            assert code == 200 and "last tick" in body
+            # a crashing readiness callable reads as not-ready, not a 500
+            state["ready"] = None  # unpackable -> TypeError inside route
+            code, body = self._get(port, "/readyz")
+            assert code == 503 and "readiness check failed" in body
+            assert self._get(port, "/metrics")[0] == 200
+            assert self._get(port, "/nope")[0] == 404
+        finally:
+            server.shutdown()
+
+    def test_no_readiness_callable_is_ready(self):
+        from escalator_tpu.metrics import metrics as m
+
+        server = m.start("127.0.0.1:0")
+        try:
+            port = server.server_address[1]
+            assert self._get(port, "/readyz") == (200, "ok")
+        finally:
+            server.shutdown()
+
+
+class TestTickWatchdog:
+    """A leader whose ticks stall must crash-to-restart (exit 70) so its
+    Lease lapses and a standby promotes — readiness alone cannot fail over a
+    controller that serves no traffic. No reference analog (its only
+    self-termination paths are leader deposition and the fleet breaker)."""
+
+    def test_stalled_ticks_exit_70(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        # limit deliberately below the scan interval: the first tick
+        # completes immediately, then the idle gap trips the watchdog —
+        # exercising the exit path without simulating a real wedge
+        env["ESCALATOR_TPU_WATCHDOG_LIMIT_SEC"] = "3"
+        proc = subprocess.run(
+            [sys.executable, "-m", "escalator_tpu",
+             "--nodegroups", "examples/nodegroups.yaml",
+             "--sim-state", "examples/cluster-state.yaml",
+             "--backend", "golden", "--scaninterval", "60s",
+             "--address", "127.0.0.1:0"],
+            env=env, capture_output=True, text=True, timeout=60,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 70, (proc.returncode, proc.stderr[-500:])
+        assert "no tick completed" in proc.stderr
+
+    def test_healthy_ticks_do_not_exit(self, tmp_path):
+        import os
+        import signal as sig
+        import subprocess
+        import sys
+        import time as t
+
+        env = dict(os.environ)
+        env["ESCALATOR_TPU_WATCHDOG_LIMIT_SEC"] = "30"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "escalator_tpu",
+             "--nodegroups", "examples/nodegroups.yaml",
+             "--sim-state", "examples/cluster-state.yaml",
+             "--backend", "golden", "--scaninterval", "1s",
+             "--address", "127.0.0.1:0"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        try:
+            t.sleep(10)  # several ticks; watchdog checks at limit/4 = 7.5s
+            assert proc.poll() is None, proc.stderr.read().decode()[-500:]
+        finally:
+            proc.send_signal(sig.SIGTERM)
+            proc.wait(timeout=30)

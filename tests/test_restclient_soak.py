@@ -226,8 +226,24 @@ def test_wire_soak_churn_relists_and_lease_contention():
             assert not errors, f"soak thread crashed: {errors[0]!r}"
             assert all(not t.is_alive() for t in threads)
 
-            # the churn must actually have exercised the relist path
-            assert client._pods.relists + client._nodes.relists >= 1
+            # the churn must actually have exercised the relist path. A 410
+            # is only observed when a watch RECONNECTS (~1s chunk boundary)
+            # after a compaction that outran its resourceVersion — with a
+            # warm jit cache the tick loop can finish inside one chunk, so
+            # force the gap and wait for an informer to see it instead of
+            # racing the chunk clock.
+            def force_relist():
+                if client._pods.relists + client._nodes.relists >= 1:
+                    return True
+                server.add_pod(pod_to_json(build_test_pod(PodOpts(
+                    name=f"relist-bait-{time.monotonic_ns()}",
+                    cpu=[1], mem=[1], node_selector_key=LABEL_KEY,
+                    node_selector_value=LABEL_VALUE))))
+                server.compact_history()
+                return False
+
+            assert _poll(force_relist, timeout=30, interval=0.4), \
+                "no informer ever relisted after history compaction"
 
             # mutual exclusion, not never-acquired: on a loaded 1-core rig
             # the holder CAN legitimately miss 3s of renewals (a long XLA
