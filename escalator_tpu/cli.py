@@ -272,6 +272,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             at this age; the watchdog exits at twice it."""
             return 3 * c.opts.scan_interval_sec + 60
 
+        def _tick_age():
+            """Seconds since the last completed tick; -1 before the first
+            (or while awaiting leadership). The single freshness source for
+            both /readyz and the exported gauge."""
+            c = controller_ref.get("controller")
+            if c is None or c.last_tick_completed_sec is None:
+                return -1.0
+            return c.clock.now() - c.last_tick_completed_sec
+
         def _readiness():
             """k8s readiness: not-ready while awaiting leadership (the
             controller isn't constructed yet on standbys) and when ticks go
@@ -280,17 +289,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             replica out of rotation. Liveness (/healthz) stays green either
             way: standbys and wedged-but-recovering leaders must not be
             restarted by the kubelet."""
-            c = controller_ref.get("controller")
-            if c is None:
-                return False, "awaiting leadership / controller not started"
-            if c.last_tick_completed_sec is None:
-                return False, "no tick completed yet"
-            age = c.clock.now() - c.last_tick_completed_sec
-            limit = _stale_limit(c)
+            age = _tick_age()
+            if age < 0:
+                c = controller_ref.get("controller")
+                return False, ("no tick completed yet" if c is not None
+                               else "awaiting leadership / controller not started")
+            limit = _stale_limit(controller_ref["controller"])
             if age > limit:
                 return False, f"last tick {age:.0f}s ago (limit {limit:.0f}s)"
             return True, f"ok (last tick {age:.0f}s ago)"
 
+        metrics.last_tick_age_seconds.set_function(_tick_age)
         server = metrics.start(f"{host or '0.0.0.0'}:{port}",
                                readiness=_readiness)
         log.info("metrics listening on %s", args.address)

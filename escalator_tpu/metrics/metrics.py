@@ -37,6 +37,13 @@ run_count = Counter(
     "run_count", "Number of times the controller has checked for cluster state",
     namespace=NAMESPACE, registry=registry,
 )
+last_tick_age_seconds = Gauge(
+    "last_tick_age_seconds",
+    "Seconds since the last completed controller tick (-1 before the first; "
+    "the same freshness signal /readyz gates on)",
+    namespace="escalator_tpu", registry=registry,
+)
+last_tick_age_seconds.set(-1)
 node_group_nodes_untainted = Gauge(
     "node_group_untainted_nodes",
     "nodes considered by specific node groups that are untainted",
