@@ -1,0 +1,38 @@
+#!/bin/bash
+# Capture a jax.profiler trace of the batched decision on the real TPU —
+# evidence of what the device actually executes (MXU/fusion layout). Run when
+# the tunnel answers (check: tail TPU_ATTEMPTS.log). Output: a timestamped
+# trace dir + a one-line summary JSON for the audit trail.
+set -e
+cd "$(dirname "$0")/.."
+OUT="tpu_traces/trace_$(date -u +%Y%m%dT%H%M%SZ)"
+mkdir -p "$OUT"
+timeout 600 python - "$OUT" <<'EOF'
+import json
+import sys
+
+import numpy as np
+
+out_dir = sys.argv[1]
+import jax
+
+import bench as B
+from escalator_tpu.ops.kernel import decide_jit
+
+device = jax.devices()[0]
+assert device.platform not in ("cpu",), f"not a TPU: {device}"
+rng = np.random.default_rng(0)
+now = np.int64(1_700_000_000)
+cluster = jax.device_put(
+    B._rng_cluster_arrays(rng, 2048, 100_000, 50_000, mixed=True,
+                          heterogeneous=True, tainted_frac=0.1,
+                          cordoned_frac=0.02),
+    device,
+)
+jax.block_until_ready(decide_jit(cluster, now))  # compile outside the trace
+with jax.profiler.trace(out_dir):
+    for _ in range(10):
+        jax.block_until_ready(decide_jit(cluster, now))
+print(json.dumps({"trace_dir": out_dir, "device": str(device),
+                  "shape": "2048g/100kpods/50knodes", "iters": 10}))
+EOF
