@@ -18,7 +18,10 @@ Configs:
   cfg4_e2e    full-upload end-to-end tick (device_put + decide per iteration)
   cfg6        native incremental tick (C++ store, 1% churn) with a phase
               breakdown (upsert/drain/scatter/decide), a churn sweep
-              (0.1/1/10%) and the full-reupload comparison it replaces
+              (0.1/1/10%) and the full-reupload comparison it replaces.
+              Its store holds no tainted nodes, so this is the healthy-tick
+              fast path (the empty-selection cond skips the untaint sort);
+              cfg4 (10% tainted) prices the full-sort path
   cfg7        mesh-sharded decider, 8192 groups / 1M pods: device-count
               scaling curve 1->2->4->8 (subprocess on a virtual CPU mesh when
               the main run has a single device; see the printed confound note)

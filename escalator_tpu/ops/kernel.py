@@ -15,9 +15,11 @@ loops (pkg/k8s/util.go:27-51) with:
 - the reaper eligibility mask (pkg/controller/scale_down.go:51-99) via a per-node
   pod-count segment sum.
 
-Everything is fixed-shape and branch-free (jnp.where/select), so XLA compiles a single
-fused program; jit caches on the padded shapes chosen by the packer
-(`escalator_tpu.core.arrays.pack_cluster`).
+Everything is fixed-shape and branch-free (jnp.where/select) except one deliberate
+data-dependent branch: each ordering sort sits behind a ``lax.cond`` that skips the
+full node-axis sort when its selection is empty (healthy clusters have no tainted
+nodes most ticks). XLA compiles a single fused program per branch; jit caches on the
+padded shapes chosen by the packer (`escalator_tpu.core.arrays.pack_cluster`).
 
 Status codes mirror `escalator_tpu.core.semantics.DecisionStatus`, the golden model
 this kernel is parity-tested against.
@@ -388,14 +390,34 @@ def decide(
 
     # ---- selections (pkg/controller/sort.go; scale_up.go:118; scale_down.go:171) ----
     # emptiest_first groups rank victims by pod count before age; elsewhere the
-    # primary key is 0, reducing to the reference's oldest-first order exactly
+    # primary key is 0, reducing to the reference's oldest-first order exactly.
+    # Each ordering is consumed only through its offsets window, so when a
+    # selection is EMPTY (no tainted nodes on a healthy cluster; no untainted
+    # during a full drain) the sort's result is never read — lax.cond skips
+    # the full node-axis sort at runtime in those cases. Under vmap (the
+    # sharded decider) cond lowers to select and both branches run; the
+    # trivial branch is an iota, so that costs nothing.
     victim_primary = jnp.where(
         g.emptiest[ngroup], node_pods_remaining64, jnp.int64(0)
     )
-    scale_down_order = _grouped_order(
-        n.creation_ns, untainted_sel, ngroup, G, primary=victim_primary
+    # the +0*ngroup ties the constant iota to the inputs' sharding variance:
+    # under shard_map the sorted branch is device-varying and cond requires
+    # both branches to match (XLA folds the zero away)
+    trivial_order = jnp.arange(N, dtype=_I32) + ngroup.astype(_I32) * 0
+    scale_down_order = jax.lax.cond(
+        jnp.any(untainted_sel),
+        lambda _: _grouped_order(
+            n.creation_ns, untainted_sel, ngroup, G, primary=victim_primary
+        ),
+        lambda _: trivial_order,
+        None,
     )
-    untaint_order = _grouped_order(-n.creation_ns, tainted_sel, ngroup, G)
+    untaint_order = jax.lax.cond(
+        jnp.any(tainted_sel),
+        lambda _: _grouped_order(-n.creation_ns, tainted_sel, ngroup, G),
+        lambda _: trivial_order,
+        None,
+    )
 
     def offsets(sel):
         counts = _segsum(sel.astype(_I64), ngroup, G)
