@@ -16,4 +16,7 @@ RUN pip install --no-cache-dir -e . \
     && python -c "from escalator_tpu.native import statestore; assert statestore.available()"
 
 EXPOSE 8080
+# for non-k8s runtimes (docker/compose); k8s manifests use the probe endpoints
+HEALTHCHECK --interval=30s --timeout=5s --start-period=120s \
+  CMD python -c "import urllib.request; urllib.request.urlopen('http://127.0.0.1:8080/healthz', timeout=3)" || exit 1
 ENTRYPOINT ["python", "-m", "escalator_tpu"]
