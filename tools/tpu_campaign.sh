@@ -30,6 +30,15 @@ EOF
       else
         echo "$TS bench CAPTURED on live device -> $CAP" >> "$LOG"
         cp "$CAP" TPU_BENCH_CAPTURE.json
+        # one device trace per campaign while the window holds (cheap next to
+        # the bench; evidence of what the TPU actually executes)
+        if [ ! -d tpu_traces ] || [ -z "$(ls -A tpu_traces 2>/dev/null)" ]; then
+          if bash tools/capture_tpu_profile.sh >> "$LOG" 2>&1; then
+            echo "$TS profiler trace captured" >> "$LOG"
+          else
+            echo "$TS profiler trace FAILED" >> "$LOG"
+          fi
+        fi
       fi
     else
       echo "$TS bench run failed/timed out (see ${CAP%.json}.stderr.log)" >> "$LOG"
