@@ -218,3 +218,41 @@ class TestTieBreaking:
         group = (pods, nodes, _cfg("emptiest_first"), sem.GroupState())
         down, _ = self._orders(group)
         assert down == sem.nodes_emptiest_first(nodes, [1, 1, 1]) == [1, 0, 2]
+
+
+class TestEmptySelectionWindows:
+    """The empty-selection fast path (ops.kernel skips a sort via lax.cond
+    when nothing is selected) is safe only because consumers read orderings
+    exclusively through their per-group offset windows — which are empty
+    exactly when the selection is. Lock that invariant: if it breaks, the
+    skipped sort's placeholder content becomes observable."""
+
+    def test_no_tainted_nodes_means_empty_untaint_windows(self):
+        nodes = [
+            build_test_node(NodeOpts(name=f"h-n{i}", cpu=4000, mem=16 * 10**9,
+                                     creation_time_ns=(i + 1) * 10**9))
+            for i in range(6)
+        ]
+        out = kernel.decide_jit(
+            pack_cluster([([], nodes, _cfg(), sem.GroupState())]), NOW)
+        t_off = np.asarray(out.tainted_offsets)
+        assert (t_off == 0).all()  # every untaint window empty
+        # and the scale-down windows still carry the real sorted order
+        u_off = np.asarray(out.untainted_offsets)
+        down = list(np.asarray(out.scale_down_order)[u_off[0]:u_off[1]])
+        assert down == sem.nodes_oldest_first(nodes)
+
+    def test_all_tainted_means_empty_scaledown_windows(self):
+        nodes = [
+            build_test_node(NodeOpts(name=f"d-n{i}", cpu=4000, mem=16 * 10**9,
+                                     tainted=True, taint_time_sec=int(NOW) - 5,
+                                     creation_time_ns=(i + 1) * 10**9))
+            for i in range(6)
+        ]
+        out = kernel.decide_jit(
+            pack_cluster([([], nodes, _cfg(), sem.GroupState())]), NOW)
+        u_off = np.asarray(out.untainted_offsets)
+        assert (u_off == 0).all()  # every scale-down window empty
+        t_off = np.asarray(out.tainted_offsets)
+        up = list(np.asarray(out.untaint_order)[t_off[0]:t_off[1]])
+        assert up == sem.nodes_newest_first(nodes)
