@@ -594,13 +594,11 @@ def main() -> None:
         tainted_frac=0.1, cordoned_frac=0.02,
     )
     headline_cluster = put(host_headline)
-    import jax as _jax
+    from escalator_tpu.ops.kernel import decide_jit
 
-    from escalator_tpu.ops.kernel import decide_jit as _dj
-
-    _jax.block_until_ready(_dj(headline_cluster, now))
+    jax.block_until_ready(decide_jit(headline_cluster, now))
     med, mn = _timeit(
-        lambda: _jax.block_until_ready(_dj(headline_cluster, now)))
+        lambda: jax.block_until_ready(decide_jit(headline_cluster, now)))
     detail["cfg4_kernel_only_ms"] = round(med, 3)
     detail["cfg4_kernel_only_min_ms"] = round(mn, 3)
     detail["cfg4_phases"] = _phase_breakdown(
@@ -609,8 +607,8 @@ def main() -> None:
     # full-upload end-to-end tick: transfer the whole cluster + decide, per
     # iteration — the fallback headline when the native store is unavailable
     def full_tick():
-        dev = _jax.device_put(host_headline, device)
-        _jax.block_until_ready(_dj(dev, now))
+        dev = jax.device_put(host_headline, device)
+        jax.block_until_ready(decide_jit(dev, now))
 
     e2e_med, e2e_min = _timeit(full_tick, iters=max(10, ITERS // 3))
     detail["cfg4_e2e_full_upload_ms"] = round(e2e_med, 3)
@@ -655,7 +653,7 @@ def main() -> None:
         from escalator_tpu.ops.simulate import sweep_deltas_jit
 
         swp_med, _ = _timeit(
-            lambda: _jax.block_until_ready(
+            lambda: jax.block_until_ready(
                 sweep_deltas_jit(headline_cluster, num_candidates=32)))
         detail["cfg11_whatif_sweep_2048g_32cand_ms"] = round(swp_med, 3)
     except Exception as e:  # pragma: no cover

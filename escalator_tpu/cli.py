@@ -289,12 +289,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             replica out of rotation. Liveness (/healthz) stays green either
             way: standbys and wedged-but-recovering leaders must not be
             restarted by the kubelet."""
+            c = controller_ref.get("controller")
             age = _tick_age()
             if age < 0:
-                c = controller_ref.get("controller")
                 return False, ("no tick completed yet" if c is not None
                                else "awaiting leadership / controller not started")
-            limit = _stale_limit(controller_ref["controller"])
+            limit = _stale_limit(c)
             if age > limit:
                 return False, f"last tick {age:.0f}s ago (limit {limit:.0f}s)"
             return True, f"ok (last tick {age:.0f}s ago)"
