@@ -419,6 +419,11 @@ def _summarize_tpu_captures() -> list:
                 # "parsed"; a fully wedged round has none — not a capture
                 data = data.get("parsed")
                 if not isinstance(data, dict) or "metric" not in data:
+                    if not os.path.basename(path).startswith("BENCH_r"):
+                        # a campaign capture that died mid-run still names a
+                        # TPU session — surface it, don't erase the evidence
+                        rows.append({"file": os.path.basename(path),
+                                     "error": "no bench record in capture"})
                     continue
             # split device into name + degraded flag: embedding the raw
             # "... CPU fallback" marker here would poison the campaign's

@@ -1,0 +1,104 @@
+"""The shipped Grafana dashboard must stay resolvable against the metrics this
+process actually exports (reference ships the same pairing:
+/root/reference/docs/grafana-dashboard.json over /root/reference/pkg/metrics/
+metrics.go:12-230). A renamed collector or a typo'd panel query silently breaks
+the dashboard in production — this locks the two files together in CI."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DASHBOARD = REPO / "docs" / "grafana-dashboard.json"
+
+#: Metrics the dashboard uses that are exported by OTHER cluster components
+#: (kube-state-metrics), not by this process — same split as the reference's
+#: Pod Phase panel, which queries kube-state-metrics too.
+EXTERNAL_METRICS = {"kube_pod_status_phase"}
+
+
+def _dashboard_exprs() -> list:
+    data = json.loads(DASHBOARD.read_text())
+    exprs = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            if isinstance(obj.get("expr"), str):
+                exprs.append(obj["expr"])
+            for v in obj.values():
+                walk(v)
+        elif isinstance(obj, list):
+            for v in obj:
+                walk(v)
+
+    walk(data)
+    return exprs
+
+
+def _metric_tokens(expr: str) -> set:
+    """Identifiers in a PromQL expression that look like our metric names.
+
+    Restricting to the escalator prefixes keeps PromQL functions, label names
+    and template variables out of the comparison.
+    """
+    toks = re.findall(r"[a-zA-Z_:][a-zA-Z0-9_:]*", expr)
+    return {
+        t for t in toks
+        if t.startswith(("escalator_", "kube_"))
+    }
+
+
+def _exported_sample_names() -> set:
+    from escalator_tpu.metrics import metrics
+
+    names = set()
+    for family in metrics.registry.collect():
+        for sample in family.samples:
+            names.add(sample.name)
+        # histograms/counters may have no samples yet for some suffixes;
+        # derive the canonical suffixed names from the family type too
+        if family.type == "histogram":
+            names.update(
+                {family.name + s for s in ("_bucket", "_sum", "_count")}
+            )
+        elif family.type == "counter":
+            names.add(family.name + "_total")
+        else:
+            names.add(family.name)
+    return names
+
+
+def test_every_dashboard_query_resolves():
+    exprs = _dashboard_exprs()
+    assert exprs, "dashboard has no queries — wrong file?"
+    exported = _exported_sample_names()
+    used = set().union(*(_metric_tokens(e) for e in exprs))
+    unresolved = used - exported - EXTERNAL_METRICS
+    assert not unresolved, (
+        f"dashboard queries reference metrics this process does not export: "
+        f"{sorted(unresolved)}"
+    )
+
+
+def test_dashboard_covers_reference_panel_set():
+    """The panels the verdicts tracked as parity gaps stay present: scale lock,
+    registration lag, Pod Phase, and the per-namespace running-pods panel."""
+    text = DASHBOARD.read_text()
+    data = json.loads(text)
+    titles = [p.get("title", "") for p in data.get("panels", [])]
+    for needle in ("Scale Lock", "Registration Lag", "Pod Phase"):
+        assert any(needle.lower() in t.lower() for t in titles), (
+            f"missing dashboard panel: {needle}; have {titles}"
+        )
+    assert "$namespace" in text, "per-namespace templated panel missing"
+
+
+def test_histogram_queries_use_suffixed_series():
+    """histogram_quantile() panels must query the *_bucket series — querying
+    the bare family name returns nothing in Prometheus."""
+    for expr in _dashboard_exprs():
+        if "histogram_quantile" in expr:
+            assert "_bucket" in expr, expr

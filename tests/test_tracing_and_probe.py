@@ -1,0 +1,142 @@
+"""Tracing hooks (utils/tracing.py) and the accelerator probe (jaxconfig.py).
+
+The reference has neither subsystem (its only tracing is a wall-time debug log,
+/root/reference/pkg/controller/controller.go:448-449); both are TPU-build
+additions, so their contracts are locked here rather than by a parity table:
+the tracer must actually produce a TensorBoard-loadable trace and stop after
+``max_ticks``, and the probe must degrade (not hang), write its audit line,
+and cache its verdict.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from escalator_tpu.utils.tracing import TickTracer
+
+
+def test_tick_tracer_writes_trace_and_stops(tmp_path):
+    tracer = TickTracer(trace_dir=str(tmp_path), max_ticks=2)
+    for _ in range(4):  # two ticks past the budget: must be plain no-ops
+        with tracer.tick():
+            jax.block_until_ready(jnp.ones(8) + 1)
+    assert tracer._remaining == 0 and not tracer._active
+    written = [
+        os.path.join(dirpath, f)
+        for dirpath, _, files in os.walk(tmp_path)
+        for f in files
+    ]
+    assert written, "profiler trace produced no files"
+
+
+def test_tick_tracer_disabled_without_dir():
+    tracer = TickTracer(trace_dir=None, max_ticks=5)
+    with tracer.tick():
+        pass
+    assert not tracer._active
+    tracer.close()  # idempotent no-op
+
+
+def test_tick_tracer_close_flushes_partial_trace(tmp_path):
+    tracer = TickTracer(trace_dir=str(tmp_path), max_ticks=100)
+    with tracer.tick():
+        jax.block_until_ready(jnp.ones(8) * 2)
+    assert tracer._active  # budget not exhausted: trace still open
+    tracer.close()  # the CLI shutdown path
+    assert not tracer._active and tracer._remaining == 0
+
+
+def _fresh_probe(monkeypatch):
+    from escalator_tpu import jaxconfig
+
+    monkeypatch.setattr(jaxconfig, "_probe_result", None)
+    return jaxconfig
+
+
+def test_probe_timeout_degrades_and_logs(tmp_path, monkeypatch):
+    jaxconfig = _fresh_probe(monkeypatch)
+
+    def hang(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=k.get("timeout", 0))
+
+    monkeypatch.setattr(subprocess, "run", hang)
+    logf = tmp_path / "attempts.log"
+    # un-pin the platform first (conftest pins cpu for every test), so the
+    # assertion below actually exercises the probe's degrade path rather than
+    # passing vacuously; restored right after.
+    jax.config.update("jax_platforms", None)
+    try:
+        ok = jaxconfig.ensure_responsive_accelerator(
+            timeout_sec=1.0, attempts=2, retry_wait_sec=0.0,
+            attempt_log=str(logf),
+        )
+        # platform must be pinned to CPU so a wedged tunnel cannot hang callers
+        assert jax.config.jax_platforms == "cpu"
+    finally:
+        jax.config.update("jax_platforms", "cpu")
+    assert ok is False
+    lines = logf.read_text().strip().splitlines()
+    assert len(lines) == 2  # one audit line per attempt
+    assert all("no answer" in line for line in lines)
+
+
+def test_probe_success_short_circuits_retries(monkeypatch, tmp_path):
+    jaxconfig = _fresh_probe(monkeypatch)
+    calls = []
+
+    def ok_run(*a, **k):
+        calls.append(a)
+        return subprocess.CompletedProcess(a, returncode=0)
+
+    monkeypatch.setattr(subprocess, "run", ok_run)
+    logf = tmp_path / "attempts.log"
+    assert jaxconfig.ensure_responsive_accelerator(
+        attempts=3, retry_wait_sec=0.0, attempt_log=str(logf)
+    ) is True
+    assert len(calls) == 1  # no pointless retries after a healthy answer
+    assert "OK" in logf.read_text()
+
+
+def test_probe_result_is_cached(monkeypatch):
+    jaxconfig = _fresh_probe(monkeypatch)
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **k: subprocess.CompletedProcess(a, returncode=0),
+    )
+    assert jaxconfig.ensure_responsive_accelerator() is True
+
+    def boom(*a, **k):  # a second probe campaign must never start
+        raise AssertionError("probe re-ran despite cached result")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert jaxconfig.ensure_responsive_accelerator() is True
+
+
+def test_probe_unwritable_log_is_not_fatal(monkeypatch):
+    jaxconfig = _fresh_probe(monkeypatch)
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **k: subprocess.CompletedProcess(a, returncode=0),
+    )
+    assert jaxconfig.ensure_responsive_accelerator(
+        attempt_log="/nonexistent-dir/attempts.log"
+    ) is True
+
+
+def test_profiler_server_failure_is_nonfatal(monkeypatch):
+    from escalator_tpu.utils import tracing
+
+    called = {}
+
+    def fail(port):
+        called["port"] = port
+        raise RuntimeError("already started")
+
+    monkeypatch.setattr(jax.profiler, "start_server", fail)
+    tracing.start_profiler_server(9999)  # must not raise
+    assert called["port"] == 9999
