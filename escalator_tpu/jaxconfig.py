@@ -105,3 +105,10 @@ def ensure_responsive_accelerator(
         jax.config.update("jax_platforms", "cpu")
     _probe_result = alive
     return alive
+
+
+#: Platforms where COMPILED Pallas kernels exist ("axon" is the TPU tunnel's
+#: platform name). Single source for pallas_kernel._use_interpret (interpret
+#: off these platforms) and ops.kernel.native_tick_impl (never default the
+#: production hot path onto interpreter-mode Pallas).
+PALLAS_COMPILED_PLATFORMS = ("tpu", "axon")

@@ -171,6 +171,12 @@ class DeviceClusterCache:
     def cluster(self) -> ClusterArrays:
         return self._cluster
 
+    @property
+    def device(self):
+        """The device the cluster is resident on (impl selection keys off its
+        platform — see ops.kernel.native_tick_impl)."""
+        return self._device
+
     def set_host(self, pods: PodArrays, nodes: NodeArrays) -> None:
         """Rebind the host-side views gathers read from. Needed when the store
         re-views its buffers (growth) or a per-tick corrected view (dry mode)

@@ -240,3 +240,30 @@ def test_scale_up_delta_float_order_parity():
     cluster = pack_cluster([(pods, nodes, cfg, sem.GroupState())])
     out = kernel.decide_jit(cluster, np.int64(NOW))
     assert int(out.nodes_delta[0]) == want.nodes_delta
+
+
+def test_native_tick_impl_selection(monkeypatch):
+    """The native tick defaults to the Pallas sweep on an accelerator (its
+    slot-reused layout is the sorted path's measured win) and to XLA scatter
+    on CPU; ESCALATOR_TPU_KERNEL_IMPL overrides both ways."""
+    monkeypatch.delenv("ESCALATOR_TPU_KERNEL_IMPL", raising=False)
+    assert kernel.native_tick_impl("tpu") == "pallas"
+    assert kernel.native_tick_impl("axon") == "pallas"  # tunnel platform name
+    assert kernel.native_tick_impl("cpu") == "xla"
+    # compiled Pallas is TPU-only: a gpu platform must NOT be handed
+    # interpreter-mode Pallas on the hot path
+    assert kernel.native_tick_impl("gpu") == "xla"
+    # the whitelist is shared with pallas_kernel._use_interpret — pin the
+    # single source so the two selectors cannot drift
+    from escalator_tpu.jaxconfig import PALLAS_COMPILED_PLATFORMS
+
+    for p in PALLAS_COMPILED_PLATFORMS:
+        assert kernel.native_tick_impl(p) == "pallas"
+    # SET-but-empty env propagates (decide() fails fast on it), matching
+    # default_impl's behavior for the repack backends
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "")
+    assert kernel.native_tick_impl("tpu") == ""
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "xla")
+    assert kernel.native_tick_impl("tpu") == "xla"
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
+    assert kernel.native_tick_impl("cpu") == "pallas"
