@@ -29,9 +29,14 @@ Configs:
               sweep/tail phase split (see podaxis.py for the crossover model)
   cfg9        pallas-vs-xla aggregation matrix on >=3 shapes (TPU only):
               contiguous 100k lanes, churned/interleaved store layout,
-              1M-lane single group — with a computed conclusion string
+              1M-lane single group — with a computed conclusion string,
+              per-row xla re-times and a cfg4 control re-time (tunnel
+              sessions showed a steady-state per-program penalty on
+              late-loaded programs; the diagnostics make it identifiable)
   cfg10       FFD bin-packing (ops.binpack) at 2048 groups
   cfg11       what-if delta sweep (ops.simulate) at the headline shape
+  cfg12       gRPC compute-plugin round-trip at the headline shape (codec +
+              localhost transport + decide, the non-Python-shell price)
 
 Timing notes: values are medians over N iters (min alongside) — CPU numbers on
 a shared VM drift several percent between runs, which round 2 mislabelled as a
@@ -282,6 +287,16 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
     detail["cfg6_host_ms_1pct"] = round(
         sweep["1pct"]["upsert"] + sweep["1pct"]["drain"], 3)
 
+    # the fused single-dispatch alternative (scatter+decide in ONE device
+    # program, DeviceClusterCache.apply_dirty_and_decide): the native backend
+    # defaults to the two-call path on a claim of "measured faster" — keep
+    # that claim measured, per capture, in the artifact
+    try:
+        detail["cfg6_fused_tick_1pct_ms"] = _time_fused_tick(
+            store, cache, impl, rng, now)
+    except Exception as e:  # pragma: no cover
+        detail["cfg6_fused_tick_error"] = str(e)
+
     # the alternative the incremental path replaces: re-upload the whole
     # cluster every tick (the reference's O(cluster) re-walk analog)
     host_cluster = ClusterArrays(groups=base.groups, pods=pods_v, nodes=nodes_v)
@@ -293,6 +308,34 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
     full_med, _ = _timeit(full_reupload, iters=10)
     detail["cfg6_full_reupload_ms"] = round(full_med, 3)
     return cache.cluster
+
+
+def _time_fused_tick(store, cache, impl, rng, now, n_churn=1000,
+                     iters=10) -> float:
+    """Median ms of the fused scatter+decide tick (ONE device dispatch via
+    DeviceClusterCache.apply_dirty_and_decide) under the same churn the
+    two-call phase loop measures. Upserts wrap within the store's current
+    pod count so capacity never grows mid-timing."""
+    import jax
+
+    num_pods = int(np.asarray(cache.cluster.pods.valid).sum())
+    groups_n = int(cache.cluster.groups.valid.shape[0])
+    # (no explicit warm-up needed: _timeit's warm call compiles the fused
+    # program for this bucket size before timing starts)
+
+    def fused_tick(t=[0]):
+        t[0] += 1
+        uids = [f"p{(t[0] * n_churn + i) % num_pods}" for i in range(n_churn)]
+        store.upsert_pods_batch(
+            uids, rng.integers(0, groups_n, n_churn),
+            np.full(n_churn, 250), np.full(n_churn, 10**9))
+        pod_dirty, node_dirty = store.drain_dirty()
+        out = cache.apply_dirty_and_decide(
+            pod_dirty, node_dirty, now, impl=impl)
+        jax.block_until_ready(out)
+
+    med, _ = _timeit(fused_tick, iters=iters)
+    return round(med, 3)
 
 
 def _cfg9_pallas_matrix(detail, headline_cluster, host_headline,
@@ -386,6 +429,21 @@ def _cfg9_pallas_matrix(detail, headline_cluster, host_headline,
     except Exception:  # pragma: no cover - not every backend reports stats
         pass
 
+    # control: re-time the cfg4 program (compiled at session start, on the
+    # early-uploaded headline cluster) AFTER the heavy rows. Session
+    # 0627 showed the inflated rows are steady-state (xla_retime ~= xla,
+    # so not warming) while the contiguous row stayed sub-ms at the same
+    # point — if this control also stays at its cfg4 value, the penalty is
+    # per-program/per-buffer (a tunnel cache artifact), not a session-wide
+    # slowdown, and the product path (few programs, compiled at startup)
+    # is unaffected.
+    try:
+        ctl_med, ctl_min = _time_decide_med_min(headline_cluster, now)
+        detail["cfg9_control_cfg4_retime_ms"] = round(ctl_med, 3)
+        detail["cfg9_control_cfg4_retime_min_ms"] = round(ctl_min, 3)
+    except Exception as e:  # pragma: no cover
+        detail["cfg9_control_cfg4_retime_error"] = str(e)
+
     measured = [l for l, r in rows.items() if r.get("pallas_over_xla")]
     wins = [l for l in measured if rows[l]["pallas_over_xla"] < 0.95]
     losses = [l for l in measured if rows[l]["pallas_over_xla"] > 1.05]
@@ -431,6 +489,33 @@ def _bench_ffd_pack(rng, device) -> "tuple[float, float]":
         iters=max(10, ITERS // 3),
     )
     return round(med, 3), round(mn, 3)
+
+
+def _bench_plugin_roundtrip(host_cluster, now) -> dict:
+    """cfg12: the gRPC compute-plugin boundary priced at the headline shape —
+    columnar encode -> localhost gRPC -> decode -> decide on the server's
+    device -> encode -> decode. This is what a non-Python controller shell
+    (the reference-style embedding, SURVEY.md §2.7 plugin slot) pays per tick
+    over the bare in-process decide that cfg4 times."""
+    from escalator_tpu.plugin.client import ComputeClient
+    from escalator_tpu.plugin.server import make_server
+
+    server = make_server("127.0.0.1:0", max_workers=2)
+    try:
+        server.start()
+        client = ComputeClient(f"127.0.0.1:{server._escalator_bound_port}",
+                               timeout_sec=120.0)
+        try:
+            med, mn = _timeit(
+                lambda: client.decide_arrays(host_cluster, int(now)),
+                iters=max(5, ITERS // 3),
+            )
+            return {"cfg12_plugin_roundtrip_2048g_100kpods_ms": round(med, 3),
+                    "cfg12_plugin_roundtrip_min_ms": round(mn, 3)}
+        finally:
+            client.close()
+    finally:
+        server.stop(grace=None)
 
 
 def _summarize_tpu_captures() -> list:
@@ -732,6 +817,13 @@ def main() -> None:
         detail["cfg11_whatif_sweep_min_ms"] = round(swp_min, 3)
     except Exception as e:  # pragma: no cover
         detail["cfg11_whatif_sweep_error"] = str(e)
+
+    # 12. the compute-plugin boundary at the headline shape (skipped when
+    # grpc is unavailable; the local fallback path needs no pricing)
+    try:
+        detail.update(_bench_plugin_roundtrip(host_headline, now))
+    except Exception as e:  # pragma: no cover
+        detail["cfg12_plugin_error"] = str(e)
 
     # 7/8. sharded paths (always in a subprocess on the 8-virtual-device CPU
     # mesh: the scaling SHAPE is the evidence; single-chip hardware can't host
