@@ -822,6 +822,8 @@ def main() -> None:
     # grpc is unavailable; the local fallback path needs no pricing)
     try:
         detail.update(_bench_plugin_roundtrip(host_headline, now))
+    except ModuleNotFoundError as e:  # pragma: no cover - grpc-less host
+        detail["cfg12_skipped"] = f"grpc unavailable ({e.name})"
     except Exception as e:  # pragma: no cover
         detail["cfg12_plugin_error"] = str(e)
 

@@ -456,3 +456,36 @@ class TestWatchBridgeRebinding:
         client.add_node(node_b)
         assert store.node_slot("b") == slot_a  # freelist reuse
         assert store.pod_views()["node"][store.pod_slot(uid)] == -1
+
+
+def test_native_backend_pallas_tick_parity(monkeypatch):
+    """The native tick defaults to impl='pallas' on TPU
+    (ops.kernel.native_tick_impl); CI has no TPU, so force the same path via
+    the env override (interpret-mode Pallas on CPU — same program logic) and
+    run the full taint->grace->reap lifecycle, asserting the cluster ends in
+    the same state a golden-backend run produces from an identical world."""
+    def lifecycle(backend):
+        nodes = build_test_nodes(6, NodeOpts(cpu=1000, mem=4 * 10**9))
+        # node names come from a global counter, so compare by position
+        # within this run's node list, not by name
+        idx = {n.name: i for i, n in enumerate(nodes)}
+        pods = build_test_pods(1, PodOpts(
+            cpu=[100], mem=[10**8],
+            node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+        pods[0].node_name = nodes[0].name
+        w = World(make_opts(min_nodes=1), nodes=nodes, pods=pods,
+                  backend=backend)
+        for _ in range(4):
+            w.tick()
+            w.clock.advance(60)
+        tainted_after_4 = sorted(idx[n.name] for n in w.tainted_nodes())
+        w.clock.advance(300)
+        w.tick()
+        live = sorted(idx[n.name] for n in w.client.list_nodes())
+        return tainted_after_4, live, w.group.target_size()
+
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
+    got = lifecycle(make_native_backend)
+    monkeypatch.delenv("ESCALATOR_TPU_KERNEL_IMPL")
+    want = lifecycle(GoldenBackend())
+    assert got == want

@@ -23,25 +23,27 @@ EOF
     CAP="TPU_BENCH_$(date -u +%Y%m%dT%H%M%SZ).json"
     # campaign captures race a short tunnel window: fewer iters, skip the
     # CPU-only sharded subprocess (the end-of-round driver run does it all)
+    # 45 min: r4 added configs (fused-tick compile, plugin round-trips, cfg9
+    # retimes) that pushed a tunnel-weather-slowed session past the old 30
     if ESCALATOR_TPU_BENCH_ITERS=12 ESCALATOR_TPU_BENCH_SKIP_SHARDED=1 \
-       timeout 1800 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
+       timeout 2700 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
       if grep -q "CPU fallback" "$CAP"; then
-        echo "$TS bench ran but degraded mid-run (kept $CAP)" >> "$LOG"
+        echo "$(date -u +%FT%TZ) bench ran but degraded mid-run (kept $CAP)" >> "$LOG"
       else
-        echo "$TS bench CAPTURED on live device -> $CAP" >> "$LOG"
+        echo "$(date -u +%FT%TZ) bench CAPTURED on live device -> $CAP" >> "$LOG"
         cp "$CAP" TPU_BENCH_CAPTURE.json
         # one device trace per campaign while the window holds (cheap next to
         # the bench; evidence of what the TPU actually executes)
         if [ ! -d tpu_traces ] || [ -z "$(ls -A tpu_traces 2>/dev/null)" ]; then
           if bash tools/capture_tpu_profile.sh >> "$LOG" 2>&1; then
-            echo "$TS profiler trace captured" >> "$LOG"
+            echo "$(date -u +%FT%TZ) profiler trace captured" >> "$LOG"
           else
-            echo "$TS profiler trace FAILED" >> "$LOG"
+            echo "$(date -u +%FT%TZ) profiler trace FAILED" >> "$LOG"
           fi
         fi
       fi
     else
-      echo "$TS bench run failed/timed out (see ${CAP%.json}.stderr.log)" >> "$LOG"
+      echo "$(date -u +%FT%TZ) bench run failed/timed out (see ${CAP%.json}.stderr.log)" >> "$LOG"
     fi
   else
     echo "$TS probe FAIL: $(tail -c 200 /tmp/tpu_probe_out | tr '\n' ' ')" >> "$LOG"
