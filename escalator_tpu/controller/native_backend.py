@@ -18,6 +18,7 @@ filterNodes' dry-mode branch (controller.go:126-138) without mutating the store.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -64,6 +65,10 @@ class NativeJaxBackend(ComputeBackend):
         # view — they must be re-scattered (possibly back to raw) this tick
         self._overridden_slots = np.empty(0, np.int64)
         self._packing = PackingPostPass()
+        # sticky impl override after a Pallas failure (see _decide_resilient):
+        # a controller that crash-loops on a kernel lowering bug is worse than
+        # one that degrades to the bit-identical scatter path and says so
+        self._impl_fallback: "str | None" = None
 
     def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
         """First live node per group -> GroupState cached capacity
@@ -181,17 +186,9 @@ class NativeJaxBackend(ComputeBackend):
             self._cache.apply_gathered(gathered, groups)
         self._overridden_slots = overridden
         t1 = time.perf_counter()
-        from escalator_tpu.ops.kernel import native_tick_impl
-
-        # slot reuse churns this store's layout into group-interleaved lanes,
-        # where the Pallas sorted-MXU sweep measured 1.57x faster than XLA
-        # scatter on TPU — so the native tick (alone among the backends)
-        # defaults to pallas on an accelerator (env still overrides)
-        out = self._kernel.decide_jit(
-            self._cache.cluster, np.int64(now_sec),
-            impl=native_tick_impl(self._cache.device.platform),
-        )
-        jax.block_until_ready(out)
+        # blocks on the result itself: an async device failure must surface
+        # inside the resilient wrapper, not here
+        out = self._decide_resilient(np.int64(now_sec))
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
@@ -202,6 +199,45 @@ class NativeJaxBackend(ComputeBackend):
                 results, [row for row in packing_rows if row[0] in sel]
             )
         return results
+
+    def _decide_resilient(self, now_sec):
+        """Run the decide with the native tick's impl selection (pallas on
+        TPU — the churned slot-reused layout is where the sorted MXU sweep
+        measured 1.57x faster than XLA scatter; ops.kernel.native_tick_impl),
+        degrading STICKILY to the XLA scatter path if the Pallas program ever
+        fails to lower/execute. Outputs are bit-identical either way (the
+        parity suite locks that), so degrading changes latency, never
+        decisions — same philosophy as the accelerator probe's CPU pin
+        (jaxconfig.ensure_responsive_accelerator). A crash would instead
+        restart-loop through the same compile failure every time."""
+        import jax
+
+        from escalator_tpu.ops.kernel import native_tick_impl
+
+        impl = self._impl_fallback or native_tick_impl(
+            self._cache.device.platform)
+        # misconfiguration stays fail-fast (same ValueError every backend
+        # raises for a bad ESCALATOR_TPU_KERNEL_IMPL; kernel.py locks this
+        # invariant) — only genuine lowering/device failures degrade
+        if impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown aggregation impl {impl!r}")
+        try:
+            # block HERE: decide_jit dispatches asynchronously, so a device-
+            # side Pallas failure surfaces at block_until_ready, and it must
+            # surface inside this try for the fallback to catch it
+            return jax.block_until_ready(self._kernel.decide_jit(
+                self._cache.cluster, now_sec, impl=impl))
+        except Exception:
+            if impl == "xla":  # nothing further to degrade to
+                raise
+            logging.getLogger("escalator_tpu.native").warning(
+                "impl=%r decide failed; falling back to impl='xla' for the "
+                "rest of this process (decisions are bit-identical)", impl,
+                exc_info=True,
+            )
+            self._impl_fallback = "xla"
+            return jax.block_until_ready(self._kernel.decide_jit(
+                self._cache.cluster, now_sec, impl="xla"))
 
     def _gather_packing_inputs(self, group_inputs, pods, nodes):
         """[(gi, pod_cpu, pod_mem, bin_cpu, bin_mem, template, budget)] for

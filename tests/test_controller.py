@@ -489,3 +489,54 @@ def test_native_backend_pallas_tick_parity(monkeypatch):
     monkeypatch.delenv("ESCALATOR_TPU_KERNEL_IMPL")
     want = lifecycle(GoldenBackend())
     assert got == want
+
+
+def test_native_backend_pallas_failure_degrades_sticky(monkeypatch, caplog):
+    """A Pallas program that fails to lower/execute must degrade the native
+    tick to the XLA path — once, stickily, with a warning — not crash-loop
+    the controller (decisions are bit-identical across impls, so degrading
+    changes latency, never behavior)."""
+    from escalator_tpu.ops import kernel as kmod
+
+    real_decide_jit = kmod.decide_jit
+    calls = []
+
+    def flaky_decide_jit(cluster, now, impl="xla"):
+        calls.append(impl)
+        if impl == "pallas":
+            raise RuntimeError("mosaic lowering exploded")
+        return real_decide_jit(cluster, now, impl=impl)
+
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
+    nodes = build_test_nodes(3, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(2, PodOpts(
+        cpu=[100], mem=[10**8],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=1), nodes=nodes, pods=pods,
+              backend=make_native_backend)
+    w.controller.backend._kernel = type(
+        "K", (), {"decide_jit": staticmethod(flaky_decide_jit)})
+
+    with caplog.at_level(logging.WARNING, logger="escalator_tpu.native"):
+        w.tick()  # pallas fails -> falls back to xla within the same tick
+    assert calls == ["pallas", "xla"]
+    assert any("falling back" in r.message for r in caplog.records)
+
+    w.tick()  # sticky: no second pallas attempt
+    assert calls == ["pallas", "xla", "xla"]
+
+
+def test_native_backend_misconfigured_impl_fails_fast(monkeypatch):
+    """A bad ESCALATOR_TPU_KERNEL_IMPL must raise the same fail-fast
+    ValueError on the native backend as on every other backend — the sticky
+    degrade path is for genuine lowering/device failures only."""
+    from escalator_tpu.core import semantics as sem
+
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "palas")  # typo'd
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(1, PodOpts(cpu=[100], mem=[10**8]))
+    client = EventfulClient(nodes=nodes, pods=pods)
+    backend = make_native_backend(client, [make_opts()])
+    cfg = make_opts().to_group_config()
+    with pytest.raises(ValueError, match="unknown aggregation impl"):
+        backend.decide([(pods, nodes, cfg, sem.GroupState())], now_sec=0)
