@@ -112,3 +112,23 @@ def test_capture_summary_surfaces_dead_capture(bench, tmp_path, monkeypatch):
     rows = bench._summarize_tpu_captures()
     row = next(r for r in rows if r["file"] == dead.name)
     assert row["error"] == "no bench record in capture"
+
+
+def test_archived_e2e_filter(bench):
+    rows = [
+        {"file": "a", "value_ms": 1.4, "headline_scope": "end_to_end_x"},
+        {"file": "b", "value_ms": 9.9, "headline_scope": "end_to_end_x",
+         "degraded": True},
+        {"file": "c", "value_ms": 0.2, "headline_scope": "(pre-r4 kernel-only)"},
+        {"file": "d", "value_ms": 5.0, "headline_scope": "end_to_end_x",
+         "prior_round": True},
+        {"file": "e", "error": "no bench record in capture"},
+        {"file": "f", "value_ms": 2.0, "headline_scope": "end_to_end_y"},
+    ]
+    rows.append({"file": "g", "value_ms": None,  # record written, value lost
+                 "headline_scope": "end_to_end_x"})
+    assert bench._archived_e2e_values(rows) == [1.4, 2.0]
+    # and against the real repo artifacts: structural only (artifact counts
+    # and values churn every capture round)
+    live = bench._archived_e2e_values(bench._summarize_tpu_captures())
+    assert all(isinstance(v, float) and v > 0 for v in live)
