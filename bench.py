@@ -583,8 +583,12 @@ def _summarize_tpu_captures() -> list:
 
 
 def _archived_e2e_values(capture_rows: list) -> list:
-    """End-to-end headline values from THIS round's live-device campaign
-    captures (prior-round, degraded, errored and pre-r4-scope rows excluded)."""
+    """End-to-end headline values from the ARCHIVED live-device campaign
+    captures in the repo (degraded, errored, valueless, pre-r4-scope and
+    BENCH_r* prior-round-wrapper rows excluded). Timestamped filenames in
+    detail.tpu_captures say which session produced each value — captures
+    persist across rounds, so "archived" means exactly that, not "this
+    round's"."""
     return [
         r["value_ms"] for r in capture_rows
         if not r.get("prior_round") and not r.get("degraded")
@@ -853,9 +857,10 @@ def main() -> None:
 
     # cross-capture spread: summarize every TPU campaign capture in the repo
     detail["tpu_captures"] = _summarize_tpu_captures()
-    # best archived on-TPU end-to-end tick this round: kept top-of-detail so
-    # a driver run that lands in a wedged-tunnel window still carries the
-    # round's TPU evidence prominently, clearly labeled as archived
+    # best archived on-TPU end-to-end tick: kept top-of-detail so a driver
+    # run that lands in a wedged-tunnel window still carries the TPU
+    # evidence prominently, clearly labeled as archived (sessions are
+    # identifiable by the timestamped filenames in tpu_captures)
     e2e = _archived_e2e_values(detail["tpu_captures"])
     if e2e:
         detail["tpu_best_archived_e2e_ms"] = min(e2e)

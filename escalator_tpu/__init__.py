@@ -13,4 +13,4 @@ Layer map (mirrors SURVEY.md §1 of the reference, re-architected TPU-first):
 - ``escalator_tpu.testsupport``— fake cluster builders, mock providers
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.1"

@@ -505,17 +505,29 @@ class PodAxisJaxBackend(ComputeBackend):
 def make_backend(kind: str = "auto") -> ComputeBackend:
     """auto: sharded-jax when >1 device, jax when jax imports, else golden.
     podaxis-jax must be chosen explicitly — it pays collectives per tick and
-    only wins when one group holds most of the pods."""
+    only wins when one group holds most of the pods.
+
+    Every jax-dispatching kind probes the accelerator first
+    (jaxconfig.ensure_responsive_accelerator, cached process-wide): a wedged
+    transport must degrade the solver to XLA-CPU, not hang the first
+    dispatch. Centralized HERE so new entry points that construct a backend
+    are safe by construction — sim.py's --sweep-deltas hang against a
+    wedged tunnel came from exactly this guard living only in cli.py.
+    Golden needs no probe (no jax); grpc backends are constructed
+    elsewhere (their compute is remote)."""
     if kind == "golden":
         return GoldenBackend()
+    if kind not in ("jax", "sharded-jax", "podaxis-jax", "auto"):
+        raise ValueError(f"unknown backend {kind!r}")
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
     if kind == "jax":
         return JaxBackend()
     if kind == "sharded-jax":
         return ShardedJaxBackend()
     if kind == "podaxis-jax":
         return PodAxisJaxBackend()
-    if kind != "auto":
-        raise ValueError(f"unknown backend {kind!r}")
     try:
         import jax
 

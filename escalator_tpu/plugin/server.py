@@ -104,6 +104,12 @@ def make_server(
 
 
 def serve(address: str = "127.0.0.1:50551") -> None:  # pragma: no cover - CLI
+    # same guard as the controller CLI: a wedged accelerator transport must
+    # degrade the solver to XLA-CPU (identical decisions), not hang
+    # _ComputeService.__init__ at jax.devices() before the port even binds
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
     server = make_server(address)
     server.start()
     log.info("compute plugin serving on %s", address)

@@ -238,6 +238,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = p.parse_args(argv)
     logging.basicConfig(level=getattr(logging, args.loglevel.upper(), 30))
 
+    if args.sweep_deltas:
+        # --sweep-deltas uses the jax sweep kernel even under the golden
+        # backend, so it needs the wedged-transport probe the jax backends
+        # get inside make_backend (found the hard way: a wedged tunnel hung
+        # `--backend golden --sweep-deltas 8` indefinitely).
+        from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+        ensure_responsive_accelerator()
+
     node_groups = setup_node_groups(args.nodegroups)
     client = load_sim_state(args.sim_state)
     events = []
