@@ -387,17 +387,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         threading.Thread(target=watch_deposed, daemon=True).start()
 
-    if args.backend not in ("golden", "grpc"):
-        # a wedged accelerator transport must degrade to XLA-CPU, not hang the
-        # control loop at the first dispatch (same kernels, same decisions).
-        # grpc is exempt: its heavy compute is remote, and the only local jax
-        # use (the packing post-pass) runs fine on whatever answers later —
-        # an up-to-90s startup stall buys nothing there.
+    if args.backend == "native":
+        # a wedged accelerator transport must degrade to XLA-CPU, not hang
+        # the control loop at the first dispatch (same kernels, same
+        # decisions). The make_backend kinds probe inside make_backend;
+        # native is constructed directly here, so it probes here. grpc needs
+        # no probe: its heavy compute is remote, and the only local jax use
+        # (the packing post-pass) runs fine on whatever answers later.
         from escalator_tpu.jaxconfig import ensure_responsive_accelerator
 
         ensure_responsive_accelerator()
-
-    if args.backend == "native":
         from escalator_tpu.controller.native_backend import make_native_backend
 
         backend = make_native_backend(client, node_groups)

@@ -24,6 +24,32 @@ def ensure_x64() -> None:
 _probe_result = None
 
 
+def _backends_already_initialized() -> bool:
+    """True when this process has live jax backends. Pinning jax_platforms
+    after initialization is a no-op, so probing can neither help nor be
+    trusted — a parent that holds the accelerator exclusively would make the
+    probe SUBPROCESS fail and falsely degrade a healthy device."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # pragma: no cover - private API moved; fall through
+        return False
+
+
+def _pinned_to_cpu() -> bool:
+    """True when jax_platforms is already pinned to cpu (tests, a previous
+    degrade): the CPU backend cannot wedge, and the probe subprocess would
+    probe the DEFAULT platform (a machine sitecustomize may pin the tunnel
+    there), hanging for no reason."""
+    try:
+        import jax
+
+        return jax.config.jax_platforms == "cpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
 def ensure_responsive_accelerator(
     timeout_sec: float = 90.0,
     attempts: int = 1,
@@ -50,6 +76,12 @@ def ensure_responsive_accelerator(
     global _probe_result
     if _probe_result is not None:
         return _probe_result
+    if _backends_already_initialized() or _pinned_to_cpu():
+        # library-embedding fast paths (see the helpers): nothing a probe
+        # could change, so report healthy and leave the process alone. NOT
+        # cached: a caller that later unpins/reinitializes deserves a real
+        # probe campaign.
+        return True
     import subprocess
     import sys
     import time as _time

@@ -69,7 +69,15 @@ def _identity(x: bytes) -> bytes:
 def make_server(
     address: str = "127.0.0.1:50551", max_workers: int = 4
 ) -> grpc.Server:
-    """Build (not start) the plugin server bound to ``address``."""
+    """Build (not start) the plugin server bound to ``address``.
+
+    Probes the accelerator first: _ComputeService.__init__ touches
+    jax.devices(), which hangs indefinitely on a wedged transport. The probe
+    no-ops when this process already has live jax backends or is pinned to
+    cpu (jaxconfig fast paths), so embedders and tests pay nothing."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
     service = _ComputeService()
     handlers = {
         "Decide": grpc.unary_unary_rpc_method_handler(
@@ -104,13 +112,7 @@ def make_server(
 
 
 def serve(address: str = "127.0.0.1:50551") -> None:  # pragma: no cover - CLI
-    # same guard as the controller CLI: a wedged accelerator transport must
-    # degrade the solver to XLA-CPU (identical decisions), not hang
-    # _ComputeService.__init__ at jax.devices() before the port even binds
-    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
-
-    ensure_responsive_accelerator()
-    server = make_server(address)
+    server = make_server(address)  # probes the accelerator (see make_server)
     server.start()
     log.info("compute plugin serving on %s", address)
     server.wait_for_termination()

@@ -200,7 +200,14 @@ def run_simulation(
         # minimal node delta whose post-delta utilisation clears the scale-up
         # threshold (ops/simulate — no reference equivalent)
         from escalator_tpu.core.arrays import pack_cluster
+        from escalator_tpu.jaxconfig import ensure_responsive_accelerator
         from escalator_tpu.ops.simulate import sweep_deltas_jit
+
+        # the sweep dispatches jax even when the tick backend was golden; a
+        # wedged transport must degrade it to XLA-CPU, not hang every caller
+        # of this library function (the guard no-ops for already-initialized
+        # or cpu-pinned processes — jaxconfig fast paths)
+        ensure_responsive_accelerator()
 
         gi, names = [], []
         for ng in node_groups:
@@ -237,15 +244,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--loglevel", default="warn")
     args = p.parse_args(argv)
     logging.basicConfig(level=getattr(logging, args.loglevel.upper(), 30))
-
-    if args.sweep_deltas:
-        # --sweep-deltas uses the jax sweep kernel even under the golden
-        # backend, so it needs the wedged-transport probe the jax backends
-        # get inside make_backend (found the hard way: a wedged tunnel hung
-        # `--backend golden --sweep-deltas 8` indefinitely).
-        from escalator_tpu.jaxconfig import ensure_responsive_accelerator
-
-        ensure_responsive_accelerator()
 
     node_groups = setup_node_groups(args.nodegroups)
     client = load_sim_state(args.sim_state)

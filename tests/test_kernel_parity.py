@@ -267,3 +267,22 @@ def test_native_tick_impl_selection(monkeypatch):
     assert kernel.native_tick_impl("tpu") == "xla"
     monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
     assert kernel.native_tick_impl("cpu") == "pallas"
+
+
+def test_make_backend_probes_accelerator(monkeypatch):
+    """Every jax-dispatching backend kind must run the wedged-transport probe
+    (centralized in make_backend so new entry points are safe by
+    construction); golden must not touch it."""
+    from escalator_tpu import jaxconfig
+    from escalator_tpu.controller import backend as bmod
+
+    probed = []
+    monkeypatch.setattr(jaxconfig, "ensure_responsive_accelerator",
+                        lambda *a, **k: probed.append(True) or True)
+    bmod.make_backend("golden")
+    assert probed == []
+    bmod.make_backend("jax")
+    assert probed == [True]
+    with pytest.raises(ValueError):
+        bmod.make_backend("not-a-backend")
+    assert probed == [True]  # unknown kinds fail fast before probing

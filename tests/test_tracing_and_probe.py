@@ -55,6 +55,11 @@ def _fresh_probe(monkeypatch):
     from escalator_tpu import jaxconfig
 
     monkeypatch.setattr(jaxconfig, "_probe_result", None)
+    # defeat the library-embedding fast paths (this test process HAS live cpu
+    # backends and a cpu pin) so the probe-campaign logic actually runs
+    monkeypatch.setattr(jaxconfig, "_backends_already_initialized",
+                        lambda: False)
+    monkeypatch.setattr(jaxconfig, "_pinned_to_cpu", lambda: False)
     return jaxconfig
 
 
@@ -140,3 +145,25 @@ def test_profiler_server_failure_is_nonfatal(monkeypatch):
     monkeypatch.setattr(jax.profiler, "start_server", fail)
     tracing.start_profiler_server(9999)  # must not raise
     assert called["port"] == 9999
+
+
+def test_probe_fast_paths_skip_subprocess(monkeypatch):
+    """When this process already holds live jax backends (pinning is a no-op
+    and a parent's exclusive device lock would fail the subprocess falsely),
+    or is pinned to cpu (nothing can wedge), the probe must report healthy
+    WITHOUT spawning anything — the library-embedding contract that lets
+    make_backend/make_server probe unconditionally."""
+    from escalator_tpu import jaxconfig
+
+    monkeypatch.setattr(jaxconfig, "_probe_result", None)
+
+    def boom(*a, **k):
+        raise AssertionError("fast path must not spawn a probe subprocess")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    # this test process genuinely has initialized cpu backends AND the pin,
+    # so the real helpers (not stubs) drive the fast path
+    assert jaxconfig._backends_already_initialized() or jaxconfig._pinned_to_cpu()
+    assert jaxconfig.ensure_responsive_accelerator() is True
+    # and the verdict is not cached: a later unpinned process still probes
+    assert jaxconfig._probe_result is None
