@@ -322,3 +322,63 @@ class TestTickWatchdog:
         finally:
             proc.send_signal(sig.SIGTERM)
             proc.wait(timeout=30)
+
+
+class TestCliBackendMatrix:
+    """Every CLI backend must print the SAME deltas for the same world — the
+    cross-backend consistency the verify recipe drives by hand, locked at the
+    CLI wiring layer (backend construction, probe guards, result assembly).
+    All in-process: jax is already initialized on cpu here, so the
+    wedged-transport probe fast-paths to a no-op."""
+
+    def _run(self, configs, backend, extra=()):
+        import io
+        from contextlib import redirect_stdout
+
+        from escalator_tpu.cli import main
+
+        ng, sim = configs
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main([
+                "--nodegroups", str(ng), "--sim-state", str(sim),
+                "--backend", backend, "--once", *extra,
+            ])
+        assert rc == 0
+        return json.loads(buf.getvalue())
+
+    @pytest.fixture
+    def configs(self, tmp_path):
+        ng = tmp_path / "nodegroups.yaml"
+        ng.write_text(NODEGROUPS_YAML)
+        sim = tmp_path / "state.yaml"
+        sim.write_text(SIM_STATE_YAML)
+        return ng, sim
+
+    def test_all_backends_agree(self, configs):
+        want = self._run(configs, "golden")
+        assert want["deltas"] == {"buildeng": 1}
+        for backend in ("jax", "native", "podaxis-jax"):
+            got = self._run(configs, backend)
+            assert got == want, f"{backend} disagrees with golden"
+
+    def test_grpc_backend_agrees(self, configs, caplog):
+        import logging
+
+        from escalator_tpu.plugin.server import make_server
+
+        server = make_server("127.0.0.1:0", max_workers=2)
+        try:
+            server.start()
+            port = server._escalator_bound_port
+            with caplog.at_level(logging.WARNING, logger="escalator_tpu.plugin"):
+                got = self._run(configs, "grpc",
+                                extra=("--plugin-address", f"127.0.0.1:{port}"))
+        finally:
+            server.stop(grace=None)
+        # GrpcBackend silently degrades to the golden backend on RpcError, so
+        # agreement alone would be vacuous — the RPC path must actually have
+        # answered (no fallback warning fired)
+        assert not any("compute plugin unavailable" in r.message
+                       for r in caplog.records), caplog.text
+        assert got == self._run(configs, "golden")
