@@ -44,11 +44,7 @@ from escalator_tpu.controller import controller as ctl
 from escalator_tpu.controller import node_group as ngmod
 from escalator_tpu.controller.backend import make_backend
 from escalator_tpu.k8s import types as k8s
-from escalator_tpu.k8s.client import (
-    InMemoryKubernetesClient,
-    load_incluster,
-    load_kubeconfig,
-)
+from escalator_tpu.k8s.client import load_incluster, load_kubeconfig
 from escalator_tpu.k8s.election import (
     FileResourceLock,
     LeaderElectionConfig,

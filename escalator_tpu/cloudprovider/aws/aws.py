@@ -25,8 +25,7 @@ Capabilities mirrored 1:1:
 from __future__ import annotations
 
 import logging
-import time as _time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 from escalator_tpu.cloudprovider import interface as cp
 from escalator_tpu.cloudprovider.errors import NodeNotInNodeGroupError

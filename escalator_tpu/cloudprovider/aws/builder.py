@@ -5,7 +5,6 @@ available."""
 
 from __future__ import annotations
 
-from typing import List
 
 from escalator_tpu.cloudprovider import interface as cp
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from escalator_tpu.cloudprovider import interface as cp
 from escalator_tpu.cloudprovider.errors import NodeNotInNodeGroupError

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import io
 from dataclasses import dataclass, field
-from typing import Dict, IO, List, Optional, Sequence, Tuple, Union
+from typing import IO, List, Sequence, Union
 
 import yaml
 

@@ -27,7 +27,6 @@ import logging
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-import numpy as np
 
 from escalator_tpu.k8s import types as k8s
 from escalator_tpu.k8s.client import InMemoryKubernetesClient

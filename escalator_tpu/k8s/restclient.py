@@ -42,7 +42,7 @@ import threading
 import time
 import urllib.parse
 from fractions import Fraction
-from typing import Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional
 
 from escalator_tpu.k8s import types as k8s
 from escalator_tpu.k8s.cache import ADDED, DELETED, MODIFIED, WatchEvent

@@ -7,7 +7,6 @@ delete re-GET the node before updating to avoid conflicts, like the reference.""
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
 
 from escalator_tpu.k8s import types as k8s
 from escalator_tpu.k8s.client import KubernetesClient

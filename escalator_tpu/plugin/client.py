@@ -13,7 +13,6 @@ from typing import Optional
 
 import grpc
 import msgpack
-import numpy as np
 
 from escalator_tpu.controller.backend import (
     ComputeBackend,

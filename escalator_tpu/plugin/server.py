@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import logging
 from concurrent import futures
-from typing import Optional
 
 import grpc
 import msgpack

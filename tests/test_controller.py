@@ -5,7 +5,6 @@ convergence. Parametrized over backends so the object shell and the device kerne
 exercised through the same scenarios."""
 
 import logging
-import threading
 
 import pytest
 

@@ -7,25 +7,19 @@ modes the packing-aware option fixes — averaging over-asks when the pods
 actually fit, and under-asks (zero) when a pod fits nowhere — plus cross-
 backend parity of the override and config plumbing."""
 
-import numpy as np
 import pytest
 
-from escalator_tpu.controller import controller as ctl
 from escalator_tpu.controller import node_group as ngmod
 from escalator_tpu.controller.backend import (
     GoldenBackend,
     JaxBackend,
-    PodAxisJaxBackend,
 )
-from escalator_tpu.controller.native_backend import make_native_backend
 from escalator_tpu.core import semantics as sem
-from escalator_tpu.k8s import types as k8s
 from escalator_tpu.testsupport.builders import (
     NodeOpts,
     PodOpts,
     build_test_node,
     build_test_pod,
-    build_test_pods,
 )
 
 from tests.test_controller import BACKENDS, LABEL_KEY, LABEL_VALUE, World, make_opts

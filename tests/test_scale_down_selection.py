@@ -8,7 +8,6 @@ must stay bit-identical to the reference order even in mixed-mode batches.
 """
 
 import numpy as np
-import pytest
 
 from escalator_tpu.core import semantics as sem
 from escalator_tpu.core.arrays import pack_cluster

@@ -1,7 +1,6 @@
 """Golden tests of the pure decision semantics, ported from the reference's tables
 (/root/reference/pkg/controller/util_test.go, pkg/k8s/util_test.go)."""
 
-import math
 
 import pytest
 

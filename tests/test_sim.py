@@ -2,11 +2,9 @@
 
 import json
 
-import pytest
 
 from escalator_tpu import sim
 from escalator_tpu.controller.backend import GoldenBackend
-from escalator_tpu.k8s import types as k8s
 from escalator_tpu.k8s.cache import EventfulClient
 from escalator_tpu.testsupport.builders import NodeOpts, build_test_nodes
 

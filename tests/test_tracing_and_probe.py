@@ -15,7 +15,6 @@ import subprocess
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from escalator_tpu.utils.tracing import TickTracer
 
