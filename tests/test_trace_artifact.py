@@ -25,11 +25,20 @@ import pytest
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
-@functools.lru_cache(maxsize=1)
-def _device_op_names():
-    traces = sorted(REPO.glob("tpu_traces/*/plugins/profile/*/*.trace.json.gz"))
+@functools.lru_cache(maxsize=2)
+def _device_op_names(variant="xla"):
+    """Op-name counts from the newest archived trace of the given variant
+    ("xla" = default decide; "pallas" = trace dirs suffixed -pallas)."""
+    traces = [
+        p for p in sorted(
+            REPO.glob("tpu_traces/*/plugins/profile/*/*.trace.json.gz"))
+        # classify by the trace DIR name, not the whole path (a checkout
+        # path containing "-pallas" must not reclassify every trace)
+        if p.relative_to(REPO / "tpu_traces").parts[0].endswith("-pallas")
+        == (variant == "pallas")
+    ]
     if not traces:
-        pytest.skip("no archived device trace in this checkout")
+        pytest.skip(f"no archived {variant} device trace in this checkout")
     data = json.loads(gzip.open(traces[-1]).read())
     tracks = {
         e["pid"]: e["args"].get("name", "")
@@ -63,3 +72,12 @@ def test_orderings_are_two_sorts_and_two_conditionals():
     # (even with uniform counts) cannot satisfy this
     decide = [n for n in names if n.startswith("jit_decide")]
     assert len({names[n] for n in sorts + conds + decide}) == 1
+
+
+def test_pallas_trace_is_the_decide_program():
+    """When a -pallas trace is archived (tools/capture_tpu_profile.sh with
+    ESCALATOR_TRACE_IMPL=pallas), it must at minimum be the decide program.
+    Tighten this to assert the Mosaic kernel op once the first artifact
+    shows its exact trace name (custom-call naming varies by toolchain)."""
+    names = _device_op_names("pallas")
+    assert any(n.startswith("jit_decide") for n in names), sorted(names)[:10]

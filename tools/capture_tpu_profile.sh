@@ -3,11 +3,20 @@
 # evidence of what the device actually executes (MXU/fusion layout). Run when
 # the tunnel answers (check: tail TPU_ATTEMPTS.log). Output: a timestamped
 # trace dir + a one-line summary JSON for the audit trail.
+# ESCALATOR_TRACE_IMPL=pallas traces the fused MXU sweep instead of the
+# default XLA scatter path (trace dir gets a -pallas suffix).
+# NOTE (docs/performance.md): trace durations are profiler-mode artifacts on
+# this tunnel; the trace documents STRUCTURE (which ops run), not timings.
 set -e
 cd "$(dirname "$0")/.."
-OUT="tpu_traces/trace_$(date -u +%Y%m%dT%H%M%SZ)"
+IMPL="${ESCALATOR_TRACE_IMPL:-xla}"
+SUFFIX=""; [ "$IMPL" != "xla" ] && SUFFIX="-$IMPL"
+OUT="tpu_traces/trace_$(date -u +%Y%m%dT%H%M%SZ)$SUFFIX"
 mkdir -p "$OUT"
-timeout 600 python - "$OUT" <<'EOF'
+# a failed capture must not leave an empty dir that satisfies the campaign's
+# once-per-impl guard forever
+trap 'rm -rf "$OUT"' ERR
+timeout 600 python - "$OUT" "$IMPL" <<'EOF'
 import json
 import sys
 
@@ -29,10 +38,11 @@ cluster = jax.device_put(
                           cordoned_frac=0.02),
     device,
 )
-jax.block_until_ready(decide_jit(cluster, now))  # compile outside the trace
+impl = sys.argv[2]
+jax.block_until_ready(decide_jit(cluster, now, impl=impl))  # compile first
 with jax.profiler.trace(out_dir):
     for _ in range(10):
-        jax.block_until_ready(decide_jit(cluster, now))
-print(json.dumps({"trace_dir": out_dir, "device": str(device),
+        jax.block_until_ready(decide_jit(cluster, now, impl=impl))
+print(json.dumps({"trace_dir": out_dir, "device": str(device), "impl": impl,
                   "shape": "2048g/100kpods/50knodes", "iters": 10}))
 EOF

@@ -32,13 +32,22 @@ EOF
       else
         echo "$(date -u +%FT%TZ) bench CAPTURED on live device -> $CAP" >> "$LOG"
         cp "$CAP" TPU_BENCH_CAPTURE.json
-        # one device trace per campaign while the window holds (cheap next to
-        # the bench; evidence of what the TPU actually executes)
-        if [ ! -d tpu_traces ] || [ -z "$(ls -A tpu_traces 2>/dev/null)" ]; then
+        # one device trace per impl per campaign while the window holds
+        # (cheap next to the bench; evidence of what the TPU actually
+        # executes — structure only, durations are profiler artifacts)
+        if [ -z "$(ls tpu_traces/trace_*/plugins/profile/*/*.trace.json.gz 2>/dev/null | grep -v pallas)" ]; then
           if bash tools/capture_tpu_profile.sh >> "$LOG" 2>&1; then
-            echo "$(date -u +%FT%TZ) profiler trace captured" >> "$LOG"
+            echo "$(date -u +%FT%TZ) profiler trace captured (xla)" >> "$LOG"
           else
-            echo "$(date -u +%FT%TZ) profiler trace FAILED" >> "$LOG"
+            echo "$(date -u +%FT%TZ) profiler trace FAILED (xla)" >> "$LOG"
+          fi
+        fi
+        if [ -z "$(ls tpu_traces/trace_*-pallas/plugins/profile/*/*.trace.json.gz 2>/dev/null)" ]; then
+          if ESCALATOR_TRACE_IMPL=pallas \
+             bash tools/capture_tpu_profile.sh >> "$LOG" 2>&1; then
+            echo "$(date -u +%FT%TZ) profiler trace captured (pallas)" >> "$LOG"
+          else
+            echo "$(date -u +%FT%TZ) profiler trace FAILED (pallas)" >> "$LOG"
           fi
         fi
       fi
