@@ -416,6 +416,20 @@ def decide(
     cpu_pct_out = jnp.where(pct_computed, cpu_pct, 0.0)
     mem_pct_out = jnp.where(pct_computed, mem_pct, 0.0)
 
+    # Request/capacity sums: the reference exits on empty/below-min/above-max
+    # BEFORE aggregating (controller.go:233-255 precede util.go:27-51), so the
+    # golden model reports zeros there; the batched kernel computes sums for
+    # every group unconditionally and must mask them to match. (Counts stay:
+    # they come from the filter pass, which runs before the bounds checks.)
+    # Found by the 10x concurrency soak — the 1x soak never drove a group
+    # past max_nodes, so this path went uncompared for three rounds.
+    pre_agg_exit = invalid | empty | below_min | above_max
+    zero64 = jnp.int64(0)
+    cpu_req = jnp.where(pre_agg_exit, zero64, cpu_req)
+    mem_req = jnp.where(pre_agg_exit, zero64, mem_req)
+    cpu_cap = jnp.where(pre_agg_exit, zero64, cpu_cap)
+    mem_cap = jnp.where(pre_agg_exit, zero64, mem_cap)
+
     # ---- selections (pkg/controller/sort.go; scale_up.go:118; scale_down.go:171) ----
     # emptiest_first groups rank victims by pod count before age; elsewhere the
     # primary key is 0, reducing to the reference's oldest-first order exactly.

@@ -1,0 +1,18 @@
+"""Shared test-harness utilities (SURVEY.md §2.6 analog)."""
+
+def soak_scale() -> int:
+    """Multiplier for the soak tests' event/tick volume, from
+    ESCALATOR_TPU_SOAK_SCALE (default 1 — what CI runs). Thread counts are
+    NOT scaled: intensity should grow linearly and comparably across the
+    soaks. Invalid values fall back to 1 with a warning rather than failing
+    collection for the whole pytest session."""
+    import logging
+    import os
+
+    raw = os.environ.get("ESCALATOR_TPU_SOAK_SCALE", "1")
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        logging.getLogger("escalator_tpu.testsupport").warning(
+            "ignoring malformed ESCALATOR_TPU_SOAK_SCALE=%r", raw)
+        return 1

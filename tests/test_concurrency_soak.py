@@ -35,8 +35,13 @@ from escalator_tpu.utils.clock import MockClock
 LABEL_KEY = "customer"
 LABEL_VALUE = "soak"
 
-TICKS = 12
-EVENTS_PER_THREAD = 150
+# ESCALATOR_TPU_SOAK_SCALE multiplies the soak's event/tick volume for
+# on-demand long runs (CI keeps the 1x defaults; threads are never scaled)
+from escalator_tpu.testsupport import soak_scale as _soak_scale
+
+_SCALE = _soak_scale()
+TICKS = 12 * _SCALE
+EVENTS_PER_THREAD = 150 * _SCALE
 MUTATOR_THREADS = 2
 
 

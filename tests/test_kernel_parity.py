@@ -163,18 +163,40 @@ def test_aggregates_match():
     cluster = pack_cluster(groups)
     out = kernel.decide_jit(cluster, np.int64(NOW))
     for gi, (pods, nodes, config, state) in enumerate(groups):
-        mem_req, cpu_req = k8s.calculate_pods_requests_total(pods)
-        untainted, tainted, cordoned = sem.filter_nodes(nodes)
-        mem_cap, cpu_cap = k8s.calculate_nodes_capacity_total(untainted)
-        assert int(out.cpu_request_milli[gi]) == cpu_req
-        assert int(out.mem_request_bytes[gi]) == mem_req
-        assert int(out.cpu_capacity_milli[gi]) == cpu_cap
-        assert int(out.mem_capacity_bytes[gi]) == mem_cap
-        assert int(out.num_pods[gi]) == len(pods)
-        assert int(out.num_nodes[gi]) == len(nodes)
-        assert int(out.num_untainted[gi]) == len(untainted)
-        assert int(out.num_tainted[gi]) == len(tainted)
-        assert int(out.num_cordoned[gi]) == len(cordoned)
+        # the golden model IS the expectation — including its zero sums on
+        # the pre-aggregation exits (don't re-derive its conditions here)
+        want = sem.evaluate_node_group(pods, nodes, config,
+                                       dataclass_copy(state))
+        for field in ("cpu_request_milli", "mem_request_bytes",
+                      "cpu_capacity_milli", "mem_capacity_bytes",
+                      "num_pods", "num_nodes", "num_untainted",
+                      "num_tainted", "num_cordoned"):
+            assert int(getattr(out, field)[gi]) == getattr(want, field), (
+                f"group {gi} ({want.status.name}): {field}"
+            )
+
+
+def test_above_max_group_reports_zero_sums_like_golden():
+    """Regression for the 10x-soak find: a group past max_nodes must report
+    ZERO request/capacity sums (counts stay) — exactly the golden Decision,
+    whose ERR_ABOVE_MAX return precedes aggregation, reference
+    controller.go:247-255."""
+    cfg = sem.GroupConfig(min_nodes=0, max_nodes=2, taint_lower_percent=30,
+                          taint_upper_percent=45, scale_up_percent=70,
+                          slow_removal_rate=1, fast_removal_rate=2)
+    nodes = [build_test_node(NodeOpts(name=f"n{i}", cpu=4000, mem=16 * 10**9))
+             for i in range(4)]  # 4 > max 2
+    pods = [build_test_pod(PodOpts(name=f"p{i}", cpu=[500], mem=[10**9]))
+            for i in range(3)]
+    want = sem.evaluate_node_group(pods, nodes, cfg, sem.GroupState())
+    assert want.status == sem.DecisionStatus.ERR_ABOVE_MAX
+    out = kernel.decide_jit(
+        pack_cluster([(pods, nodes, cfg, sem.GroupState())]), np.int64(NOW))
+    for field in ("cpu_request_milli", "mem_request_bytes",
+                  "cpu_capacity_milli", "mem_capacity_bytes"):
+        assert int(getattr(out, field)[0]) == getattr(want, field) == 0, field
+    assert int(out.num_nodes[0]) == want.num_nodes == 4
+    assert int(out.num_pods[0]) == want.num_pods == 3
 
 
 def test_padding_lanes_inert():

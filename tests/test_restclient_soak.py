@@ -49,10 +49,15 @@ from escalator_tpu.testsupport.cloud_provider import (
 TOKEN = "sekrit-token"
 LABEL_KEY, LABEL_VALUE = "customer", "soak"
 
-TICKS = 8
-EVENTS_PER_THREAD = 80
+# ESCALATOR_TPU_SOAK_SCALE multiplies the soak's event/tick volume for
+# on-demand long runs (CI keeps the 1x defaults; threads are never scaled)
+from escalator_tpu.testsupport import soak_scale as _soak_scale
+
+_SCALE = _soak_scale()
+TICKS = 8 * _SCALE
+EVENTS_PER_THREAD = 80 * _SCALE
 MUTATOR_THREADS = 2
-RELISTS = 3
+RELISTS = 3 * _SCALE
 
 
 def _poll(predicate, timeout=20.0, interval=0.05):
