@@ -134,6 +134,15 @@ def test_kernel_matches_golden(seed):
         assert delta[gi] == want.nodes_delta, (
             f"group {gi} ({want.status.name}): delta {delta[gi]} != {want.nodes_delta}"
         )
+        # every aggregate field, against the golden Decision — including the
+        # zero sums on pre-aggregation exits (the 10x-soak regression class)
+        for field in ("cpu_request_milli", "mem_request_bytes",
+                      "cpu_capacity_milli", "mem_capacity_bytes", "num_pods",
+                      "num_nodes", "num_untainted", "num_tainted",
+                      "num_cordoned"):
+            assert int(getattr(out, field)[gi]) == int(getattr(want, field)), (
+                f"group {gi} ({want.status.name}): {field}"
+            )
         if want.status not in (
             sem.DecisionStatus.NOOP_EMPTY,
             sem.DecisionStatus.ERR_BELOW_MIN,
