@@ -23,10 +23,13 @@ EOF
     CAP="TPU_BENCH_$(date -u +%Y%m%dT%H%M%SZ).json"
     # campaign captures race a short tunnel window: fewer iters, skip the
     # CPU-only sharded subprocess (the end-of-round driver run does it all)
-    # 45 min: r4 added configs (fused-tick compile, plugin round-trips, cfg9
-    # retimes) that pushed a tunnel-weather-slowed session past the old 30
+    # 55 min: r4 added configs (fused-tick compile, plugin round-trips, cfg9
+    # retimes) that pushed a tunnel-weather-slowed session past the old 30;
+    # r5's cfg13 (1M-pod store build + ~1M-lane decide compile + 8 ticks) and
+    # the cfg9 pallas retimes add more — budget up again so a slow session
+    # still lands its capture instead of timing out at the finish line
     if ESCALATOR_TPU_BENCH_ITERS=12 ESCALATOR_TPU_BENCH_SKIP_SHARDED=1 \
-       timeout 2700 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
+       timeout 3300 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
       if grep -q "CPU fallback" "$CAP"; then
         echo "$(date -u +%FT%TZ) bench ran but degraded mid-run (kept $CAP)" >> "$LOG"
       else
@@ -35,7 +38,17 @@ EOF
         # one device trace per impl per campaign while the window holds
         # (cheap next to the bench; evidence of what the TPU actually
         # executes — structure only, durations are profiler artifacts)
-        if [ -z "$(ls tpu_traces/trace_*/plugins/profile/*/*.trace.json.gz 2>/dev/null | grep -v pallas)" ]; then
+        # classify traces by the trace dir basename only — a checkout path
+        # containing 'pallas' must not make every dir look like a pallas trace
+        HAVE_XLA_TRACE=""
+        for d in tpu_traces/trace_*; do
+          [ -d "$d" ] || continue
+          case "$(basename "$d")" in
+            *-pallas) ;;
+            *) ls "$d"/plugins/profile/*/*.trace.json.gz >/dev/null 2>&1 && HAVE_XLA_TRACE=1 ;;
+          esac
+        done
+        if [ -z "$HAVE_XLA_TRACE" ]; then
           if bash tools/capture_tpu_profile.sh >> "$LOG" 2>&1; then
             echo "$(date -u +%FT%TZ) profiler trace captured (xla)" >> "$LOG"
           else
@@ -55,7 +68,7 @@ EOF
       echo "$(date -u +%FT%TZ) bench run failed/timed out (see ${CAP%.json}.stderr.log)" >> "$LOG"
     fi
   else
-    echo "$TS probe FAIL: $(tail -c 200 /tmp/tpu_probe_out | tr '\n' ' ')" >> "$LOG"
+    echo "$(date -u +%FT%TZ) probe FAIL: $(tail -c 200 /tmp/tpu_probe_out | tr '\n' ' ')" >> "$LOG"
   fi
   sleep "$INTERVAL"
 done
