@@ -854,6 +854,45 @@ def run_sharded() -> None:
     out["cfg8_single_device_ms"] = round(med8s, 3)
     out["cfg8_speedup_8dev"] = (
         round(med8s / curve8["8"], 2) if curve8["8"] > 0 else None)
+
+    # ---- cfg8 grid: 2-D (groups x pods) mesh, few-huge-groups shape --------
+    # The round-4 finding: podaxis' replicated [N] decide tail was 165 of
+    # 182 ms because node arrays ride along whole. The grid shards nodes by
+    # group block, so the tail term shrinks with Sg. Same total load as cfg8
+    # (1M pods / 50k nodes) but as 8 one-group blocks of 125k pods — the
+    # "few huge groups" cluster the 2-D layout exists for. The tail_ms column
+    # across layouts (8x1 -> 1x8) is the design's published curve: at Sg=1
+    # the tail is podaxis' replicated loss, at Sg=8 it is sharded 8-fold.
+    from escalator_tpu.parallel import grid as gridlib
+
+    blocks = [
+        _rng_cluster_arrays(np.random.default_rng(70 + s), 1, 125_000, 6_250,
+                            mixed=True)
+        for s in range(8)
+    ]
+    leaves8 = [c.tree_flatten()[0] for c in blocks]
+    stacked8 = ClusterArrays.tree_unflatten(
+        None, [np.stack(parts) for parts in zip(*leaves8)])
+
+    vdecide = jax.jit(jax.vmap(lambda c, t: decide_jit(c, t), in_axes=(0, None)))
+    stacked_dev = jax.device_put(stacked8, devices[0])
+    gmed1, _ = _timeit(
+        lambda: jax.block_until_ready(vdecide(stacked_dev, now)), iters=iters)
+    out["cfg8_grid_single_device_ms"] = round(gmed1, 3)
+    del stacked_dev
+
+    grid_curve = {}
+    for sg in (8, 4, 2, 1):
+        gmesh = gridlib.make_grid_mesh(devices, num_group_shards=sg)
+        gplaced = gridlib.place_grid(stacked8, gmesh)
+        grid_curve[f"{sg}x{8 // sg}"] = gridlib.time_grid_phases(
+            gmesh, gplaced, _timeit=lambda f: _timeit(f, iters=iters))
+        del gplaced
+    out["cfg8_grid_curve_by_layout"] = grid_curve
+    best = min(grid_curve.values(), key=lambda r: r["total_ms"])
+    out["cfg8_grid_best_total_ms"] = best["total_ms"]
+    out["cfg8_grid_speedup_vs_single"] = (
+        round(gmed1 / best["total_ms"], 2) if best["total_ms"] > 0 else None)
     print(json.dumps(out))
 
 

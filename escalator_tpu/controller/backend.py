@@ -9,6 +9,9 @@ SURVEY.md §2.7 calls the "compute plugin", shaped like a sibling of
 - ``JaxBackend``     — batched device kernel, single program for all groups (TPU when
   present, XLA-CPU otherwise: same traced code, so fallback keeps parity for free)
 - ``ShardedJaxBackend`` — nodegroup axis sharded over a device mesh via shard_map
+- ``GridJaxBackend``    — 2-D (groups x pods) mesh: tail shards with the groups,
+  each block's pod sweep splits further over the mesh columns (parallel.grid)
+- ``PodAxisJaxBackend`` — pod axis sharded, for one dominant giant group
 
 All return the same ``GroupDecision`` objects (decision + object-level selections), so
 the controller shell is backend-agnostic. ``make_backend("auto")`` picks the best
@@ -400,14 +403,24 @@ class ShardedJaxBackend(ComputeBackend):
 
         self._meshlib = meshlib
         self._mesh = mesh if mesh is not None else meshlib.make_mesh()
-        self._impl = impl if impl is not None else _kernel_impl()
+        self._init_common(impl)
         self._decider = meshlib.make_sharded_decider(self._mesh, impl=self._impl)
         self._num_shards = self._mesh.devices.size
+
+    def _init_common(self, impl: Optional[str]) -> None:
+        """State shared with GridJaxBackend (which builds its own mesh and
+        decider but inherits decide() and therefore all of this)."""
+        self._impl = impl if impl is not None else _kernel_impl()
         self._packing = PackingPostPass()
         # high-water-mark per-shard pads: same recompile-avoidance as JaxBackend
         self._pad_pods = 0
         self._pad_nodes = 0
         self._pad_groups = 0
+
+    def _place(self, sharded):
+        """Placement hook: how the stacked [S, ...] cluster lands on the mesh
+        (GridJaxBackend overrides with the 2-D grid layout)."""
+        return self._meshlib.shard_cluster_arrays(sharded, self._mesh)
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
         import jax
@@ -429,7 +442,7 @@ class ShardedJaxBackend(ComputeBackend):
             dry_mode_flags=dry_mode_flags,
             taint_trackers=taint_trackers,
         )
-        placed = self._meshlib.shard_cluster_arrays(sharded, self._mesh)
+        placed = self._place(sharded)
         t1 = time.perf_counter()
         out = self._decider(placed, np.int64(now_sec))
         jax.block_until_ready(out)
@@ -456,6 +469,58 @@ class ShardedJaxBackend(ComputeBackend):
         )
         self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
         return results
+
+
+class GridJaxBackend(ShardedJaxBackend):
+    """2-D grid (groups x pods) mesh backend (parallel.grid): nodegroups
+    shard over the mesh ROWS exactly like ShardedJaxBackend (decisions stay
+    communication-free and the decide tail — percent math + both node
+    orderings — shards with them), while each group block's pod axis
+    additionally splits over the mesh COLUMNS with one psum combining the
+    pod partial sums. Bit-identical decisions (tests/test_grid.py).
+
+    Use when BOTH axes are big: more pods per group block than one chip
+    sweeps comfortably, but still several groups (the few-huge-groups
+    cluster). One giant group degenerates to num_group_shards=1 (pure
+    pod-axis, the PodAxisJaxBackend regime); many small groups want
+    num_group_shards=devices (pure group-axis, ShardedJaxBackend's layout,
+    but priced with an extra trivial psum)."""
+
+    name = "grid-jax"
+
+    def __init__(self, mesh=None, impl: Optional[str] = None,
+                 num_group_shards: Optional[int] = None):
+        from escalator_tpu.parallel import grid as gridlib
+        from escalator_tpu.parallel import mesh as meshlib
+
+        self._meshlib = meshlib
+        self._gridlib = gridlib
+        if mesh is None:
+            import jax
+
+            ndev = len(jax.devices())
+            if num_group_shards is None:
+                # default split: half the devices to each axis when possible —
+                # shapes skewed enough to want an extreme split should pass
+                # num_group_shards explicitly
+                num_group_shards = ndev // 2 if ndev % 2 == 0 else ndev
+            mesh = gridlib.make_grid_mesh(num_group_shards=num_group_shards)
+        elif num_group_shards is not None and (
+            int(mesh.shape[meshlib.GROUP_AXIS]) != num_group_shards
+        ):
+            # an explicit mesh carries its own split; silently dropping the
+            # caller's requested one would hide the misconfiguration
+            raise ValueError(
+                f"num_group_shards={num_group_shards} conflicts with the "
+                f"explicit mesh's groups axis of {mesh.shape[meshlib.GROUP_AXIS]}"
+            )
+        self._mesh = mesh
+        self._init_common(impl)
+        self._decider = gridlib.make_grid_decider(self._mesh, impl=self._impl)
+        self._num_shards = int(self._mesh.shape[meshlib.GROUP_AXIS])
+
+    def _place(self, sharded):
+        return self._gridlib.place_grid(sharded, self._mesh)
 
 
 class PodAxisJaxBackend(ComputeBackend):
@@ -504,8 +569,10 @@ class PodAxisJaxBackend(ComputeBackend):
 
 def make_backend(kind: str = "auto") -> ComputeBackend:
     """auto: sharded-jax when >1 device, jax when jax imports, else golden.
-    podaxis-jax must be chosen explicitly — it pays collectives per tick and
-    only wins when one group holds most of the pods.
+    podaxis-jax and grid-jax must be chosen explicitly — both pay a psum per
+    tick; podaxis-jax wins when ONE group holds most of the pods, grid-jax
+    when a few huge groups do (its 2-D mesh shards the decide tail too —
+    see parallel/grid.py's cost model).
 
     Every jax-dispatching kind probes the accelerator first
     (jaxconfig.ensure_responsive_accelerator, cached process-wide): a wedged
@@ -517,7 +584,7 @@ def make_backend(kind: str = "auto") -> ComputeBackend:
     elsewhere (their compute is remote)."""
     if kind == "golden":
         return GoldenBackend()
-    if kind not in ("jax", "sharded-jax", "podaxis-jax", "auto"):
+    if kind not in ("jax", "sharded-jax", "grid-jax", "podaxis-jax", "auto"):
         raise ValueError(f"unknown backend {kind!r}")
     from escalator_tpu.jaxconfig import ensure_responsive_accelerator
 
@@ -526,6 +593,8 @@ def make_backend(kind: str = "auto") -> ComputeBackend:
         return JaxBackend()
     if kind == "sharded-jax":
         return ShardedJaxBackend()
+    if kind == "grid-jax":
+        return GridJaxBackend()
     if kind == "podaxis-jax":
         return PodAxisJaxBackend()
     try:

@@ -1,0 +1,221 @@
+"""2-D grid sharding: nodegroup axis x pod axis in ONE mesh.
+
+Round-4 measurement showed where each 1-D path stops scaling:
+
+- ``parallel.mesh`` (group axis) shards EVERYTHING per group shard — but a
+  single giant group saturates one device (its whole pod sweep and node sort
+  land on one chip);
+- ``parallel.podaxis`` (pod axis) shards the O(P) pod sweep — but replicates
+  the node arrays, so the O(N log N) decide tail (the two grouped-order
+  ``lax.sort`` passes over ``[N]``) runs whole on every device. Bench cfg8
+  measured that tail at 165 ms of the 182 ms 8-device total: the sharded
+  sweep was 17 ms and everything else was replicated tail.
+
+This module shards BOTH axes at once over a 2-D ``(groups, pods)`` mesh:
+
+- nodegroups are partitioned into ``Sg`` shards exactly as
+  ``mesh.pack_cluster_sharded`` lays them out (leading shard axis);
+- node and group arrays shard over the ``groups`` mesh axis only — each
+  device holds the ``[N/Sg]`` nodes of its group block, so the decide tail
+  (percent math, both grouped-order sorts, offsets, reaper mask) shards
+  Sg-fold instead of replicating;
+- pod arrays shard over BOTH axes ``[Sg, Pb/Sp]`` — each device sweeps
+  ``P/(Sg*Sp)`` pod lanes;
+- ONE ``jax.lax.psum`` over the ``pods`` axis (the stacked ``[3G+N]``
+  single-collective trick from ``parallel.podaxis``) combines the pod
+  partial sums; integer sums commute, so results are **bit-identical** to
+  the single-device kernel on the same stacked cluster.
+
+Cost model per tick, S = Sg*Sp devices (compare podaxis.py's, whose tail
+term does not shard):
+
+    total(Sg, Sp) = sweep(P)/(Sg*Sp) + psum(3*Gb + Nb) + tail(Nb)/1,
+    where Gb = G/Sg, Nb = N/Sg   -> every term now shrinks with Sg.
+
+Choosing the split: ``Sg`` as large as the group count allows (tail and
+psum payload both shrink with Sg; decisions stay communication-free), ``Sp``
+takes the rest when one group block's pod sweep still dominates (a giant
+``default`` group). ``(Sg=S, Sp=1)`` degenerates to ``parallel.mesh``'s
+layout; ``(Sg=1, Sp=S)`` to ``parallel.podaxis``'s.
+
+Reference stakes: the serial O(P) aggregation loop this distributes is
+/root/reference/pkg/k8s/util.go:27-38; the per-group sort the tail shards is
+/root/reference/pkg/controller/sort.go:12-39; the reference runs both on one
+CPU core per cluster with no distribution story at all (SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from escalator_tpu.core.arrays import ClusterArrays, PodArrays
+from escalator_tpu.ops import device_state as _ds  # noqa: F401  (registers SoA pytrees)
+from escalator_tpu.ops import kernel
+from escalator_tpu.parallel.mesh import GROUP_AXIS
+
+POD_AXIS = "pods"
+
+
+def make_grid_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_group_shards: Optional[int] = None,
+) -> Mesh:
+    """2-D ``(groups, pods)`` mesh. ``num_group_shards`` (Sg) defaults to the
+    device count (pure group sharding, Sp=1); pass a divisor of the device
+    count to give the pod axis the remaining factor.
+
+    Multi-host note: keep each ``groups`` row within one host when possible —
+    the per-tick psum then rides ICI; the ``groups`` axis needs no collective
+    traffic at all, so it is the axis that can safely span DCN (the same
+    layout logic as mesh.make_hybrid_mesh, scaling-book recipe)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    sg = n if num_group_shards is None else int(num_group_shards)
+    if sg < 1 or n % sg != 0:
+        raise ValueError(f"num_group_shards={sg} must divide {n} devices")
+    return Mesh(np.array(devs).reshape(sg, n // sg), (GROUP_AXIS, POD_AXIS))
+
+
+def _cluster_specs() -> ClusterArrays:
+    """Spec pytree matching ClusterArrays' flattened leaf structure (the
+    cluster flattens its SoA fields inline, so each leaf needs its own spec):
+    pods over both mesh axes, groups/nodes over the group axis only."""
+    from escalator_tpu.core.arrays import GroupArrays, NodeArrays
+
+    soa = lambda cls, spec: cls(**{f: spec for f in cls.__dataclass_fields__})
+    return ClusterArrays(
+        groups=soa(GroupArrays, P(GROUP_AXIS)),
+        pods=soa(PodArrays, P(GROUP_AXIS, POD_AXIS)),
+        nodes=soa(NodeArrays, P(GROUP_AXIS)),
+    )
+
+
+def pad_stacked_pods_for_grid(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
+    """Pad the per-shard pod axis (dim 1 of the stacked ``[Sg, Pb]`` pod
+    leaves) to a multiple of the ``pods`` mesh axis size; padding lanes are
+    valid=False, masked inside the kernel. No-op when already aligned."""
+    sp = int(mesh.shape[POD_AXIS])
+    p = cluster.pods
+    Pb = int(p.valid.shape[1])
+    pad = (-Pb) % sp
+    if pad == 0:
+        return cluster
+    width = ((0, 0), (0, pad))
+    pods = PodArrays(
+        group=np.pad(np.asarray(p.group), width),
+        cpu_milli=np.pad(np.asarray(p.cpu_milli), width),
+        mem_bytes=np.pad(np.asarray(p.mem_bytes), width),
+        node=np.pad(np.asarray(p.node), width, constant_values=-1),
+        valid=np.pad(np.asarray(p.valid), width, constant_values=False),
+    )
+    return ClusterArrays(groups=cluster.groups, pods=pods, nodes=cluster.nodes)
+
+
+def place_grid(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
+    """Device-put a stacked ``[Sg, ...]`` cluster with the grid layout: pods
+    split over both mesh axes, groups/nodes over the group axis (each group
+    block's nodes live only on its mesh row)."""
+    cluster = pad_stacked_pods_for_grid(cluster, mesh)
+    pod_sh = NamedSharding(mesh, P(GROUP_AXIS, POD_AXIS))
+    row_sh = NamedSharding(mesh, P(GROUP_AXIS))
+    put = lambda soa, sh: type(soa)(
+        **{f: jax.device_put(getattr(soa, f), sh)
+           for f in soa.__dataclass_fields__}
+    )
+    return ClusterArrays(
+        groups=put(cluster.groups, row_sh),
+        pods=put(cluster.pods, pod_sh),
+        nodes=put(cluster.nodes, row_sh),
+    )
+
+
+def make_grid_decider(mesh: Mesh, impl: Optional[str] = None):
+    """jitted ``(stacked_cluster, now_sec) -> DecisionArrays`` over the 2-D
+    grid. Outputs carry the leading shard axis (sharded over ``groups``,
+    replicated over ``pods``) — the same contract as
+    ``mesh.make_sharded_decider``, so backends consume either
+    interchangeably. Bit-identical to ``vmap(kernel.decide)`` on the same
+    stacked cluster (integer pod partials psum exactly; the tail runs
+    locally per group block on its full node set).
+
+    ``impl`` follows ESCALATOR_TPU_KERNEL_IMPL when omitted, as everywhere.
+    The per-shard pod axis must be a multiple of the ``pods`` mesh axis
+    (:func:`pad_stacked_pods_for_grid`)."""
+    if impl is None:
+        impl = kernel.default_impl()
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(_cluster_specs(), P()),
+        out_specs=P(GROUP_AXIS),
+        # pallas_call cannot express varying-mesh-axes metadata yet (same
+        # constraint as mesh.make_sharded_decider / podaxis)
+        check_vma=(impl != "pallas"),
+    )
+    def grid_decide(cluster: ClusterArrays, now_sec) -> kernel.DecisionArrays:
+        def one_block(c: ClusterArrays):
+            G = c.groups.valid.shape[0]
+            N = c.nodes.valid.shape[0]
+            partials = kernel.aggregate_pods(c.pods, c.nodes.group, G, N, impl)
+            # one stacked [3G+N] collective over the pod axis, not one per
+            # field (the podaxis._build_pod_sweep trick); int64 -> exact
+            flat = jnp.concatenate([x.reshape(-1) for x in partials])
+            flat = jax.lax.psum(flat, POD_AXIS)
+            pod_aggs = (flat[:G], flat[G:2 * G], flat[2 * G:3 * G], flat[3 * G:])
+            node_aggs = kernel.aggregate_nodes(c.nodes, G, impl)
+            return kernel.decide(
+                c, now_sec, impl=impl, aggregates=(pod_aggs, node_aggs)
+            )
+
+        return jax.vmap(one_block)(cluster)
+
+    return grid_decide
+
+
+def time_grid_phases(mesh: Mesh, cluster: ClusterArrays, _timeit,
+                     impl: Optional[str] = None) -> dict:
+    """Phase split for the bench (cfg8 grid rows): the sharded pod sweep +
+    psum ALONE vs the full grid decide — the difference is the (now
+    group-sharded) tail. Mirrors podaxis.time_pod_sweep's role."""
+    if impl is None:
+        impl = kernel.default_impl()
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(_cluster_specs(), ),
+        out_specs=P(GROUP_AXIS),
+        check_vma=(impl != "pallas"),
+    )
+    def sweep_only(cluster: ClusterArrays):
+        def one_block(c):
+            G = c.groups.valid.shape[0]
+            N = c.nodes.valid.shape[0]
+            partials = kernel.aggregate_pods(c.pods, c.nodes.group, G, N, impl)
+            flat = jnp.concatenate([x.reshape(-1) for x in partials])
+            return jax.lax.psum(flat, POD_AXIS)
+
+        return jax.vmap(one_block)(cluster)
+
+    sweep_med, _ = _timeit(
+        lambda: jax.block_until_ready(sweep_only(cluster)))
+    decider = make_grid_decider(mesh, impl=impl)
+    total_med, _ = _timeit(
+        lambda: jax.block_until_ready(decider(cluster, jnp.int64(0))))
+    return {"sweep_ms": round(sweep_med, 3),
+            "total_ms": round(total_med, 3),
+            "tail_ms": round(total_med - sweep_med, 3)}

@@ -48,8 +48,14 @@ phase split, and the confound note side by side so neither reading is
 possible by accident.
 
 Composes with the group-axis path: use ``mesh.ShardedJaxBackend`` for many
-groups, this for few-but-huge groups; both produce the same DecisionArrays
-contract.
+groups, this for ONE dominant giant group; both produce the same
+DecisionArrays contract. For the in-between regime — a FEW huge groups —
+``parallel.grid`` shards both axes at once (2-D groups x pods mesh): nodes
+shard by group block so the ``tail(N)`` term above becomes ``tail(N/Sg)``
+instead of replicating, which is exactly the loss this module's cost model
+documents (bench cfg8 measured the replicated tail at 165 of 182 ms; the
+grid's 8x1 layout cut it ~7x on the same rig and went 1.29x FASTER than
+single-device where this module's pure pod-axis split ran 0.28x).
 """
 
 from __future__ import annotations

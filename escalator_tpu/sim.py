@@ -236,8 +236,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--tick-interval", type=float, default=60.0)
     p.add_argument("--node-ready-ticks", type=int, default=2)
     p.add_argument("--backend", default="golden",
-                   choices=["auto", "jax", "sharded-jax", "podaxis-jax",
-                            "golden"])
+                   choices=["auto", "jax", "sharded-jax", "grid-jax",
+                            "podaxis-jax", "golden"])
     p.add_argument("--sweep-deltas", type=int, default=0,
                    help="after the run, report each group's minimal feasible"
                         " scale-up delta over this many candidates")

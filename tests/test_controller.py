@@ -13,6 +13,7 @@ from escalator_tpu.controller import node_group as ngmod
 from escalator_tpu.controller.backend import (
     GoldenBackend,
     JaxBackend,
+    GridJaxBackend,
     PodAxisJaxBackend,
 )
 from escalator_tpu.controller.native_backend import make_native_backend
@@ -110,6 +111,7 @@ BACKENDS = {
     "golden": lambda: GoldenBackend(),
     "jax": lambda: JaxBackend(),
     "podaxis": lambda: PodAxisJaxBackend(),
+    "grid": lambda: GridJaxBackend(),
     # factory taking (client, ng_opts_list); World detects and applies it
     "native": lambda: make_native_backend,
 }

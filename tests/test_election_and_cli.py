@@ -358,7 +358,7 @@ class TestCliBackendMatrix:
     def test_all_backends_agree(self, configs):
         want = self._run(configs, "golden")
         assert want["deltas"] == {"buildeng": 1}
-        for backend in ("jax", "native", "podaxis-jax"):
+        for backend in ("jax", "native", "podaxis-jax", "grid-jax"):
             got = self._run(configs, backend)
             assert got == want, f"{backend} disagrees with golden"
 

@@ -1,0 +1,96 @@
+"""2-D grid sharding (groups x pods mesh): bit-exact vs the unsharded kernel.
+
+The grid decider's pod partials psum over the ``pods`` axis into exactly the
+single-device aggregates (integer addition commutes), and its decide tail
+runs per group block on that block's full node set — so every DecisionArrays
+field must match ``vmap(decide)`` on the same stacked cluster bit-for-bit,
+for every (Sg, Sp) factorization of the 8-device virtual CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from escalator_tpu.core.arrays import ClusterArrays  # noqa: E402
+from escalator_tpu.ops import kernel  # noqa: E402
+from escalator_tpu.parallel import grid  # noqa: E402
+from tests.test_podaxis import ALL_FIELDS, NOW, _random_cluster  # noqa: E402
+
+
+def _stacked_cluster(rng, Sg, G, P, N, giant_group=False):
+    """[Sg, ...]-stacked cluster: Sg independent shard blocks with identical
+    padded shapes, as mesh.pack_cluster_sharded lays them out."""
+    shards = [
+        _random_cluster(rng, G=G, P=P, N=N, giant_group=giant_group)
+        for _ in range(Sg)
+    ]
+    leaves = [c.tree_flatten()[0] for c in shards]
+    stacked = [np.stack(parts) for parts in zip(*leaves)]
+    return ClusterArrays.tree_unflatten(None, stacked)
+
+
+def _vmap_baseline(stacked):
+    return jax.jit(jax.vmap(lambda c: kernel.decide(c, NOW)))(
+        jax.device_put(stacked))
+
+
+def _assert_all_equal(baseline, sharded):
+    for f in ALL_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(baseline, f)), np.asarray(getattr(sharded, f)),
+            err_msg=f,
+        )
+
+
+@pytest.mark.parametrize("Sg", [1, 2, 4, 8])  # Sp = 8 // Sg
+@pytest.mark.parametrize("P", [1000, 1001])  # 1001: exercises grid pod padding
+def test_grid_matches_vmap_decide(Sg, P):
+    rng = np.random.default_rng(100 * Sg + P)
+    stacked = _stacked_cluster(rng, Sg=Sg, G=8, P=P, N=96)
+    baseline = _vmap_baseline(stacked)
+
+    mesh = grid.make_grid_mesh(num_group_shards=Sg)
+    assert mesh.shape == {"groups": Sg, "pods": 8 // Sg}
+    placed = grid.place_grid(stacked, mesh)
+    sharded = grid.make_grid_decider(mesh)(placed, NOW)
+    _assert_all_equal(baseline, sharded)
+
+
+def test_grid_giant_group_blocks():
+    """Each shard block dominated by one giant group — the podaxis regime,
+    now with the tail sharded over the 4 group rows as well."""
+    rng = np.random.default_rng(7)
+    stacked = _stacked_cluster(rng, Sg=4, G=4, P=4096, N=128, giant_group=True)
+    baseline = _vmap_baseline(stacked)
+    mesh = grid.make_grid_mesh(num_group_shards=4)  # (4 groups, 2 pods)
+    sharded = grid.make_grid_decider(mesh)(grid.place_grid(stacked, mesh), NOW)
+    _assert_all_equal(baseline, sharded)
+
+
+def test_grid_pallas_impl_matches():
+    """impl='pallas' inside the grid shard region (interpret on CPU)."""
+    rng = np.random.default_rng(5)
+    stacked = _stacked_cluster(rng, Sg=2, G=8, P=2048, N=64)
+    # group-contiguous pods per shard so the fast path can engage
+    order = np.argsort(np.asarray(stacked.pods.group), axis=1, kind="stable")
+    for f in stacked.pods.__dataclass_fields__:
+        arr = np.asarray(getattr(stacked.pods, f))
+        setattr(stacked.pods, f, np.take_along_axis(arr, order, axis=1))
+    baseline = _vmap_baseline(stacked)
+    mesh = grid.make_grid_mesh(num_group_shards=2)  # (2 groups, 4 pods)
+    sharded = grid.make_grid_decider(mesh, impl="pallas")(
+        grid.place_grid(stacked, mesh), NOW)
+    _assert_all_equal(baseline, sharded)
+
+
+def test_pad_stacked_pods_noop_when_divisible():
+    rng = np.random.default_rng(0)
+    stacked = _stacked_cluster(rng, Sg=2, G=4, P=64, N=16)
+    mesh = grid.make_grid_mesh(num_group_shards=2)
+    assert grid.pad_stacked_pods_for_grid(stacked, mesh) is stacked
+
+
+def test_make_grid_mesh_validates_factorization():
+    with pytest.raises(ValueError):
+        grid.make_grid_mesh(num_group_shards=3)  # does not divide 8
