@@ -1,0 +1,31 @@
+# Developer entrypoints (the reference ships the same one-command workflow:
+# /root/reference/Makefile:13-17 — test = unit+race+cover, vet, lint).
+# The race detector's role here is played by the threaded concurrency soak,
+# which runs as part of the suite (tests/test_concurrency_soak.py).
+
+.PHONY: test lint typecheck build-native bench dryrun clean
+
+test:
+	python -m pytest tests/ -x -q
+
+lint:
+	ruff check escalator_tpu tests bench.py
+
+typecheck:
+	mypy escalator_tpu
+
+# the C++ state store builds lazily on first use; this forces a fresh build
+build-native:
+	g++ -O2 -shared -fPIC -std=c++17 \
+	  -o escalator_tpu/native/libessstate.so escalator_tpu/native/statestore.cpp
+
+bench:
+	python bench.py
+
+# multi-chip sharding validation on 8 virtual devices (no TPU needed)
+dryrun:
+	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	rm -f escalator_tpu/native/libessstate.so
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

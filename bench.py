@@ -855,6 +855,11 @@ def run_sharded() -> None:
     out["cfg8_speedup_8dev"] = (
         round(med8s / curve8["8"], 2) if curve8["8"] > 0 else None)
 
+    # free the podaxis section's 1M-pod buffers before timing the grid rows
+    # (every "device" shares one host's RAM; resident-set pressure skews
+    # timings — same hygiene as the cfg7 dels above)
+    del giant, giant_dev, mesh8, placed8_on_mesh8
+
     # ---- cfg8 grid: 2-D (groups x pods) mesh, few-huge-groups shape --------
     # The round-4 finding: podaxis' replicated [N] decide tail was 165 of
     # 182 ms because node arrays ride along whole. The grid shards nodes by

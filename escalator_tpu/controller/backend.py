@@ -505,15 +505,25 @@ class GridJaxBackend(ShardedJaxBackend):
                 # num_group_shards explicitly
                 num_group_shards = ndev // 2 if ndev % 2 == 0 else ndev
             mesh = gridlib.make_grid_mesh(num_group_shards=num_group_shards)
-        elif num_group_shards is not None and (
-            int(mesh.shape[meshlib.GROUP_AXIS]) != num_group_shards
-        ):
-            # an explicit mesh carries its own split; silently dropping the
-            # caller's requested one would hide the misconfiguration
-            raise ValueError(
-                f"num_group_shards={num_group_shards} conflicts with the "
-                f"explicit mesh's groups axis of {mesh.shape[meshlib.GROUP_AXIS]}"
-            )
+        else:
+            # fail at construction, not deep inside the first decide(): the
+            # grid layout needs exactly these two axes
+            expected = (meshlib.GROUP_AXIS, gridlib.POD_AXIS)
+            if tuple(mesh.axis_names) != expected:
+                raise ValueError(
+                    f"grid mesh must have axes {expected}, got "
+                    f"{tuple(mesh.axis_names)} (use grid.make_grid_mesh)"
+                )
+            if num_group_shards is not None and (
+                int(mesh.shape[meshlib.GROUP_AXIS]) != num_group_shards
+            ):
+                # an explicit mesh carries its own split; silently dropping
+                # the caller's requested one would hide the misconfiguration
+                raise ValueError(
+                    f"num_group_shards={num_group_shards} conflicts with the "
+                    "explicit mesh's groups axis of "
+                    f"{mesh.shape[meshlib.GROUP_AXIS]}"
+                )
         self._mesh = mesh
         self._init_common(impl)
         self._decider = gridlib.make_grid_decider(self._mesh, impl=self._impl)

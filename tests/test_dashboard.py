@@ -94,6 +94,17 @@ def test_dashboard_covers_reference_panel_set():
             f"missing dashboard panel: {needle}; have {titles}"
         )
     assert "$namespace" in text, "per-namespace templated panel missing"
+    # the reference templates on 4 variables (grafana-dashboard.json
+    # templating list: nodegroup, namespace, cloud_provider_group,
+    # cloud_provider); ours adds an explicit datasource on top
+    var_names = {t["name"] for t in data["templating"]["list"]}
+    assert {"datasource", "node_group", "namespace", "cloud_provider",
+            "cloud_provider_group"} <= var_names, var_names
+    # checked on the parsed exprs (the raw file escapes quotes), and with the
+    # closing quote: bare "$cloud_provider" would match $cloud_provider_group
+    assert any('=~"$cloud_provider"' in e for e in _dashboard_exprs()), (
+        "cloud_provider variable is defined but filters no panel query"
+    )
 
 
 def test_histogram_queries_use_suffixed_series():

@@ -94,3 +94,14 @@ def test_pad_stacked_pods_noop_when_divisible():
 def test_make_grid_mesh_validates_factorization():
     with pytest.raises(ValueError):
         grid.make_grid_mesh(num_group_shards=3)  # does not divide 8
+
+
+def test_grid_backend_rejects_bad_mesh():
+    from escalator_tpu.controller.backend import GridJaxBackend
+    from escalator_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="grid mesh must have axes"):
+        GridJaxBackend(mesh=make_mesh())  # 1-D groups-only mesh
+    with pytest.raises(ValueError, match="conflicts"):
+        GridJaxBackend(mesh=grid.make_grid_mesh(num_group_shards=2),
+                       num_group_shards=4)
