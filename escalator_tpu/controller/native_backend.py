@@ -44,6 +44,9 @@ def _copy_soa(soa):
 
 
 class NativeJaxBackend(ComputeBackend):
+    #: ticks on the XLA fallback before the single Pallas retry (see
+    #: _decide_resilient); class-level so tests can shrink the cool-off
+    _PALLAS_RETRY_AFTER = 10
     name = "native-jax"
     needs_objects = False
 
@@ -67,8 +70,14 @@ class NativeJaxBackend(ComputeBackend):
         self._packing = PackingPostPass()
         # sticky impl override after a Pallas failure (see _decide_resilient):
         # a controller that crash-loops on a kernel lowering bug is worse than
-        # one that degrades to the bit-identical scatter path and says so
+        # one that degrades to the bit-identical scatter path and says so.
+        # ONE retry is allowed after _PALLAS_RETRY_AFTER ticks — a transient
+        # non-Pallas failure (host OOM, one-off transfer error) must not
+        # forfeit the measured 1.57x win for the whole process lifetime; a
+        # second failure makes the fallback permanent.
         self._impl_fallback: "str | None" = None
+        self._pallas_failures = 0
+        self._ticks_since_fallback = 0
 
     def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
         """First live node per group -> GroupState cached capacity
@@ -204,8 +213,10 @@ class NativeJaxBackend(ComputeBackend):
         """Run the decide with the native tick's impl selection (pallas on
         TPU — the churned slot-reused layout is where the sorted MXU sweep
         measured 1.57x faster than XLA scatter; ops.kernel.native_tick_impl),
-        degrading STICKILY to the XLA scatter path if the Pallas program ever
-        fails to lower/execute. Outputs are bit-identical either way (the
+        degrading to the XLA scatter path if the Pallas program fails to
+        lower/execute. ONE retry of the native choice happens after
+        _PALLAS_RETRY_AFTER fallback ticks (a transient failure must not
+        forfeit the win forever); a second failure is sticky for the process. Outputs are bit-identical either way (the
         parity suite locks that), so degrading changes latency, never
         decisions — same philosophy as the accelerator probe's CPU pin
         (jaxconfig.ensure_responsive_accelerator). A crash would instead
@@ -214,8 +225,19 @@ class NativeJaxBackend(ComputeBackend):
 
         from escalator_tpu.ops.kernel import native_tick_impl
 
-        impl = self._impl_fallback or native_tick_impl(
-            self._cache.device.platform)
+        native = native_tick_impl(self._cache.device.platform)
+        impl = self._impl_fallback or native
+        if (
+            self._impl_fallback is not None
+            and self._pallas_failures == 1
+            and native == "pallas"
+        ):
+            # degraded by a single failure: retry the native choice once
+            # after a cool-off (the failure may have been transient — host
+            # OOM, one-off transfer error — not the Pallas program itself)
+            self._ticks_since_fallback += 1
+            if self._ticks_since_fallback >= self._PALLAS_RETRY_AFTER:
+                impl = native
         # misconfiguration stays fail-fast (same ValueError every backend
         # raises for a bad ESCALATOR_TPU_KERNEL_IMPL; kernel.py locks this
         # invariant) — only genuine lowering/device failures degrade
@@ -225,14 +247,31 @@ class NativeJaxBackend(ComputeBackend):
             # block HERE: decide_jit dispatches asynchronously, so a device-
             # side Pallas failure surfaces at block_until_ready, and it must
             # surface inside this try for the fallback to catch it
-            return jax.block_until_ready(self._kernel.decide_jit(
+            out = jax.block_until_ready(self._kernel.decide_jit(
                 self._cache.cluster, now_sec, impl=impl))
+            if impl == native and self._impl_fallback is not None:
+                # the retry succeeded: the failure was transient, lift the
+                # fallback. _pallas_failures is a LIFETIME count, deliberately
+                # not reset: a device that fails intermittently would
+                # otherwise oscillate pallas->xla->retry forever, paying a
+                # doubled decide on every failing tick — the next failure
+                # (the second ever) makes the fallback permanent instead.
+                logging.getLogger("escalator_tpu.native").warning(
+                    "impl=%r retry succeeded; lifting the xla fallback", impl)
+                self._impl_fallback = None
+                self._ticks_since_fallback = 0
+            return out
         except Exception:
             if impl == "xla":  # nothing further to degrade to
                 raise
+            self._pallas_failures += 1
+            self._ticks_since_fallback = 0
             logging.getLogger("escalator_tpu.native").warning(
-                "impl=%r decide failed; falling back to impl='xla' for the "
-                "rest of this process (decisions are bit-identical)", impl,
+                "impl=%r decide failed (failure %d); falling back to "
+                "impl='xla' (%s; decisions are bit-identical)", impl,
+                self._pallas_failures,
+                "one retry after cool-off" if self._pallas_failures == 1
+                else "permanently for this process",
                 exc_info=True,
             )
             self._impl_fallback = "xla"

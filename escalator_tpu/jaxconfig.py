@@ -65,8 +65,12 @@ def ensure_responsive_accelerator(
     platform pin must go through jax.config because environments may pin
     platforms in sitecustomize, ignoring JAX_PLATFORMS.
 
-    Returns True when the accelerator is healthy. Result is cached (one probe
-    campaign per process).
+    Returns True when NO DEGRADE IS NEEDED — which means "a probe answered"
+    only when a probe actually ran. The fast paths below return True for a
+    process that is merely cpu-pinned or already initialized (nothing a probe
+    could change); callers must not surface the return value as "live
+    accelerator verified". Result is cached (one probe campaign per process)
+    except on those fast paths.
 
     ``attempts > 1`` retries a failed probe after ``retry_wait_sec`` — the
     tunnel this repo targets wedges for long stretches and sometimes recovers,

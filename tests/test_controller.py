@@ -494,9 +494,9 @@ def test_native_backend_pallas_tick_parity(monkeypatch):
 
 def test_native_backend_pallas_failure_degrades_sticky(monkeypatch, caplog):
     """A Pallas program that fails to lower/execute must degrade the native
-    tick to the XLA path — once, stickily, with a warning — not crash-loop
-    the controller (decisions are bit-identical across impls, so degrading
-    changes latency, never behavior)."""
+    tick to the XLA path with a warning — one retry after the cool-off, then
+    permanently — not crash-loop the controller (decisions are bit-identical
+    across impls, so degrading changes latency, never behavior)."""
     from escalator_tpu.ops import kernel as kmod
 
     real_decide_jit = kmod.decide_jit
@@ -523,8 +523,57 @@ def test_native_backend_pallas_failure_degrades_sticky(monkeypatch, caplog):
     assert calls == ["pallas", "xla"]
     assert any("falling back" in r.message for r in caplog.records)
 
-    w.tick()  # sticky: no second pallas attempt
+    w.tick()  # fallback active: no immediate second pallas attempt
     assert calls == ["pallas", "xla", "xla"]
+
+    # after the cool-off, exactly ONE pallas retry; it fails again -> the
+    # fallback becomes permanent (no third attempt, ever)
+    w.controller.backend._PALLAS_RETRY_AFTER = 2  # shrink the cool-off
+    for _ in range(4):
+        w.tick()
+    assert calls.count("pallas") == 2
+    assert calls[-1] == "xla"
+
+
+def test_native_backend_pallas_transient_failure_recovers(monkeypatch, caplog):
+    """A Pallas failure that does NOT reproduce on the cool-off retry lifts
+    the fallback: one transient host error must not forfeit the measured
+    pallas win for the process lifetime (ADVICE r4)."""
+    from escalator_tpu.ops import kernel as kmod
+
+    real_decide_jit = kmod.decide_jit
+    calls = []
+
+    def once_flaky_decide_jit(cluster, now, impl="xla"):
+        calls.append(impl)
+        if impl == "pallas" and calls.count("pallas") == 1:
+            raise RuntimeError("transient transfer error")
+        # CPU rig: serve pallas requests through the real xla program (the
+        # impl routing, not the kernel, is under test)
+        return real_decide_jit(cluster, now, impl="xla")
+
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
+    nodes = build_test_nodes(3, NodeOpts(cpu=1000, mem=4 * 10**9))
+    pods = build_test_pods(2, PodOpts(
+        cpu=[100], mem=[10**8],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=1), nodes=nodes, pods=pods,
+              backend=make_native_backend)
+    w.controller.backend._kernel = type(
+        "K", (), {"decide_jit": staticmethod(once_flaky_decide_jit)})
+    w.controller.backend._PALLAS_RETRY_AFTER = 2
+
+    with caplog.at_level(logging.WARNING, logger="escalator_tpu.native"):
+        w.tick()          # pallas fails once -> xla fallback
+        w.tick()          # cool-off tick 1
+        w.tick()          # cool-off tick 2 -> retry fires and succeeds
+        w.tick()          # fallback lifted: native choice again
+    assert calls.count("pallas") >= 2
+    assert calls[-1] == "pallas"
+    assert any("retry succeeded" in r.message for r in caplog.records)
+    # the lifetime failure count survives the lift: a second failure (ever)
+    # would go permanently sticky instead of oscillating
+    assert w.controller.backend._pallas_failures == 1
 
 
 def test_native_backend_misconfigured_impl_fails_fast(monkeypatch):
