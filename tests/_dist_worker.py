@@ -57,6 +57,50 @@ def main() -> None:
     assert total == 6, total
     print(f"WORKER_OK pid={pid} total={total}", flush=True)
 
+    _grid_across_hosts(pid)
+
+
+def _grid_across_hosts(pid: int) -> None:
+    """The 2-D grid decider with its pod axis spanning the two processes:
+    the pod-partial psum crosses hosts (the DCN hop), and the result must
+    bit-match the process-local vmap(decide) on the same stacked cluster —
+    the multi-host compute plane validated on the decision path itself,
+    not just on a toy psum."""
+    from jax.sharding import NamedSharding
+
+    from escalator_tpu.ops import kernel
+    from escalator_tpu.parallel import grid as gridlib
+    from tests.test_grid import _stacked_cluster
+    from tests.test_podaxis import ALL_FIELDS, NOW
+
+    # same seed -> bit-identical host data on both processes; the shared
+    # fixture also mixes invalid/cordoned/no_delete lanes the way the
+    # single-host grid tests do
+    stacked = _stacked_cluster(
+        np.random.default_rng(42), Sg=1, G=2, P=17, N=6)  # 17: odd, pads
+    now = NOW
+
+    # expected: process-local vmap(decide) on this host's own device
+    expected = jax.jit(jax.vmap(lambda c: kernel.decide(c, now)))(
+        jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, jax.local_devices()[0]), stacked))
+
+    gmesh = gridlib.make_grid_mesh(jax.devices(), num_group_shards=1)
+    assert gmesh.shape == {"groups": 1, "pods": 2}, gmesh.shape
+    padded = gridlib.pad_stacked_pods_for_grid(stacked, gmesh)
+    specs = gridlib._cluster_specs()
+    placed = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.make_array_from_callback(
+            leaf.shape, NamedSharding(gmesh, spec), lambda idx, l=leaf: l[idx]),
+        padded, specs)
+    out = gridlib.make_grid_decider(gmesh)(placed, now)
+    jax.block_until_ready(out.nodes_delta)
+
+    for f in ALL_FIELDS:
+        got = np.asarray(getattr(out, f))  # fully replicated -> local read
+        np.testing.assert_array_equal(got, np.asarray(getattr(expected, f)), f)
+    print(f"WORKER_GRID_OK pid={pid}", flush=True)
+
 
 if __name__ == "__main__":
     main()

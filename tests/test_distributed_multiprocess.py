@@ -51,6 +51,7 @@ def test_two_process_fleet_staged_psum():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"WORKER_OK pid={pid} total=6" in out, out
+        assert f"WORKER_GRID_OK pid={pid}" in out, out
 
 
 def test_partial_config_raises():
