@@ -9,9 +9,10 @@ loops (pkg/k8s/util.go:27-51) with:
 - vectorized float64 percent/delta math over the ``[G]`` group axis, bit-matching
   calcPercentUsage (pkg/controller/util.go:58-81) and calcScaleUpDelta
   (pkg/controller/util.go:13-46) including the math.MaxFloat64 scale-from-zero sentinel,
-- two stable device argsorts producing the scale-down (oldest-first,
-  pkg/controller/sort.go:12-24) and untaint (newest-first, sort.go:27-39) orders for
-  every group at once, segment-partitioned by offsets,
+- ONE combined multi-key device sort producing both the scale-down
+  (oldest-first, pkg/controller/sort.go:12-24) and untaint (newest-first,
+  sort.go:27-39) orders for every group at once, segment-partitioned by
+  offsets (lanes carry a selection-class major key; see decide()),
 - the reaper eligibility mask (pkg/controller/scale_down.go:51-99) via a per-node
   pod-count segment sum.
 
@@ -131,30 +132,6 @@ def native_tick_impl(platform: str) -> str:
 
 def _segsum(values, segment_ids, num_segments):
     return jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
-
-
-def _grouped_order(keys, selected, group, num_groups, primary=None):
-    """Stable order of selected entries by (group asc, [primary asc,] key asc);
-    non-selected pushed to the tail. ``primary`` (optional, per-node) outranks
-    ``keys`` — used for emptiest-first scale-down, where it is the pod count
-    for nodes of emptiest_first groups and 0 elsewhere (0 everywhere keeps the
-    reference's pure creation-time order bit-for-bit).
-
-    One multi-key ``lax.sort`` instead of a chain of stable argsorts+gathers:
-    the trailing iota key reproduces stable input-order tie-breaking exactly
-    (no two lanes ever compare equal, so ``is_stable`` is irrelevant), and a
-    single comparator pass is ~2x cheaper than two full sorts — this is the
-    dominant cost of the decide tail at 50k nodes (measured 12 ms per stable
-    argsort on the CPU fallback)."""
-    N = keys.shape[0]
-    major = jnp.where(selected, group.astype(_I64), jnp.int64(num_groups))
-    iota = jax.lax.iota(_I64, N)
-    operands = (
-        (major, keys, iota) if primary is None
-        else (major, primary, keys, iota)
-    )
-    perm = jax.lax.sort(operands, num_keys=len(operands), is_stable=False)[-1]
-    return perm.astype(_I32)
 
 
 def aggregate_pods(p: PodArrays, node_group: jnp.ndarray, G: int, N: int,
@@ -433,12 +410,22 @@ def decide(
     # ---- selections (pkg/controller/sort.go; scale_up.go:118; scale_down.go:171) ----
     # emptiest_first groups rank victims by pod count before age; elsewhere the
     # primary key is 0, reducing to the reference's oldest-first order exactly.
-    # Each ordering is consumed only through its offsets window, so when a
-    # selection is EMPTY (no tainted nodes on a healthy cluster; no untainted
-    # during a full drain) the sort's result is never read — lax.cond skips
-    # the full node-axis sort at runtime in those cases. Under vmap (the
-    # sharded decider) cond lowers to select and both branches run; the
-    # trivial branch is an iota, so that costs nothing.
+    # BOTH orderings come out of ONE 4-key lax.sort (round 5; previously one
+    # sort each): every lane carries a class major — tainted first, untainted
+    # second, everything else last — so the tainted block sorts
+    # (group asc, creation desc) at the front, which IS untaint_order, and
+    # the untainted block sorts (group asc, primary, creation asc) right
+    # after it; rolling the tainted block to the tail yields
+    # scale_down_order. Consumers only read the offsets windows, and those
+    # are bit-identical to the two-sort formulation (the per-class keys and
+    # iota tie-break reproduce each old sort's order exactly); the tail
+    # regions beyond the windows are unspecified contract either way. The
+    # [N] sort is the decide tail's dominant cost (measured ~12 ms per
+    # 50k-node sort on the CPU fallback), so a taint-churn tick — both
+    # selections non-empty, the busy case — now pays it once, not twice.
+    # When BOTH selections are empty (all nodes cordoned/invalid) lax.cond
+    # skips the sort entirely; under vmap cond lowers to select and both
+    # branches run, the trivial branch being a free iota.
     victim_primary = jnp.where(
         g.emptiest[ngroup], node_pods_remaining64, jnp.int64(0)
     )
@@ -446,17 +433,23 @@ def decide(
     # under shard_map the sorted branch is device-varying and cond requires
     # both branches to match (XLA folds the zero away)
     trivial_order = jnp.arange(N, dtype=_I32) + ngroup.astype(_I32) * 0
-    scale_down_order = jax.lax.cond(
-        jnp.any(untainted_sel),
-        lambda _: _grouped_order(
-            n.creation_ns, untainted_sel, ngroup, G, primary=victim_primary
-        ),
-        lambda _: trivial_order,
-        None,
-    )
+
+    def _combined_order(_):
+        lane_class = jnp.where(
+            tainted_sel, jnp.int64(0),
+            jnp.where(untainted_sel, jnp.int64(1), jnp.int64(2)),
+        )
+        major = lane_class * jnp.int64(G) + ngroup.astype(_I64)
+        k1 = jnp.where(tainted_sel, -n.creation_ns, victim_primary)
+        k2 = jnp.where(tainted_sel, jnp.int64(0), n.creation_ns)
+        iota = jax.lax.iota(_I64, N)
+        return jax.lax.sort(
+            (major, k1, k2, iota), num_keys=4, is_stable=False
+        )[-1].astype(_I32)
+
     untaint_order = jax.lax.cond(
-        jnp.any(tainted_sel),
-        lambda _: _grouped_order(-n.creation_ns, tainted_sel, ngroup, G),
+        jnp.any(untainted_sel | tainted_sel),
+        _combined_order,
         lambda _: trivial_order,
         None,
     )
@@ -469,6 +462,9 @@ def decide(
 
     untainted_offsets = offsets(untainted_sel)
     tainted_offsets = offsets(tainted_sel)
+    # untainted block starts right after the tainted block in the combined
+    # permutation; the roll is an O(N) gather, ~free next to the sort
+    scale_down_order = jnp.roll(untaint_order, -tainted_offsets[G])
 
     # ---- reaper eligibility (pkg/controller/scale_down.go:51-99) ----
     node_pods_remaining = node_pods_remaining64.astype(_I32)

@@ -149,9 +149,9 @@ node_groups:
 class TestTieBreaking:
     """Exact-tie creation timestamps (and tied pod counts for emptiest_first)
     must order by input index — the deterministic tie-break CHANGELOG
-    documents. Locks the multi-key lax.sort's iota key in ops.kernel
-    (_grouped_order): a regression to an unstable or differently-keyed sort
-    flips these orders silently."""
+    documents. Locks the combined multi-key lax.sort's iota key in
+    ops.kernel (decide's _combined_order): a regression to an unstable or
+    differently-keyed sort flips these orders silently."""
 
     def _orders(self, group):
         cluster = pack_cluster([group])

@@ -6,10 +6,14 @@ distortion, documented there). This locks the structural claims the docs and
 kernel docstrings make against the actual archived artifact:
 
 - the traced program is the batched decide;
-- the two grouped orderings lower to exactly TWO multi-key sorts
-  (ops/kernel.py _grouped_order — one sort per ordering, not chains);
-- the two empty-selection skips are real runtime conditionals
-  (the lax.cond pair in ops/kernel.py decide).
+- the grouped orderings lower to multi-key sorts, not chains of argsorts;
+- the empty-selection skips are real runtime conditionals (lax.cond).
+
+The expected op counts are VINTAGE-AWARE: traces captured before the round-5
+combined-sort change (ops/kernel.py decide's _combined_order — both
+orderings from ONE 4-key sort behind ONE cond) show two sorts and two
+conditionals; traces of the current kernel must show one of each. The trace
+dir names are capture timestamps, which is how vintage is decided.
 """
 
 from __future__ import annotations
@@ -24,11 +28,16 @@ import pytest
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
+#: first capture timestamp at which the combined-sort kernel could appear
+#: (commit time of the one-sort decide, 2026-07-30 ~18:30Z)
+COMBINED_SORT_SINCE = "trace_20260730T183000Z"
+
 
 @functools.lru_cache(maxsize=2)
-def _device_op_names(variant="xla"):
-    """Op-name counts from the newest archived trace of the given variant
-    ("xla" = default decide; "pallas" = trace dirs suffixed -pallas)."""
+def _device_trace(variant="xla"):
+    """(op-name counts, trace dir name) from the newest archived trace of the
+    given variant ("xla" = default decide; "pallas" = dirs suffixed
+    -pallas)."""
     traces = [
         p for p in sorted(
             REPO.glob("tpu_traces/*/plugins/profile/*/*.trace.json.gz"))
@@ -39,34 +48,37 @@ def _device_op_names(variant="xla"):
     ]
     if not traces:
         pytest.skip(f"no archived {variant} device trace in this checkout")
-    data = json.loads(gzip.open(traces[-1]).read())
+    newest = traces[-1]
+    data = json.loads(gzip.open(newest).read())
     tracks = {
         e["pid"]: e["args"].get("name", "")
         for e in data["traceEvents"]
         if e.get("ph") == "M" and e.get("name") == "process_name"
     }
-    return collections.Counter(
+    names = collections.Counter(
         e["name"]
         for e in data["traceEvents"]
         if e.get("ph") == "X"
         and tracks.get(e.get("pid", -1), "").startswith("/device:")
     )
+    return names, newest.relative_to(REPO / "tpu_traces").parts[0]
 
 
 def test_trace_is_the_decide_program():
-    names = _device_op_names()
+    names, _ = _device_trace()
     assert any(n.startswith("jit_decide") for n in names), sorted(names)[:5]
 
 
-def test_orderings_are_two_sorts_and_two_conditionals():
-    names = _device_op_names()
+def test_ordering_sorts_and_conditionals_match_kernel_vintage():
+    names, trace_dir = _device_trace()
     sorts = [n for n in names if n.startswith("sort")]
     conds = [n for n in names if n.startswith("conditional")]
-    # one multi-key sort per ordering (scale-down victims, untaint
-    # candidates) — chains of argsorts would show up as more
-    assert len(sorts) == 2, sorts
-    # one lax.cond per ordering's empty-selection skip
-    assert len(conds) == 2, conds
+    # pre-round-5 kernels: one multi-key sort + one cond per ordering (two
+    # orderings); current kernel: ONE combined 4-key sort behind ONE cond.
+    # Either way, chains of argsorts would show up as more sorts.
+    want = 2 if trace_dir.split("-")[0] < COMBINED_SORT_SINCE else 1
+    assert len(sorts) == want, (trace_dir, sorts)
+    assert len(conds) == want, (trace_dir, conds)
     # every sort/cond executed exactly once per traced decide — anchored to
     # the decide op's own count, so a second program mixed into the trace
     # (even with uniform counts) cannot satisfy this
@@ -79,5 +91,5 @@ def test_pallas_trace_is_the_decide_program():
     ESCALATOR_TRACE_IMPL=pallas), it must at minimum be the decide program.
     Tighten this to assert the Mosaic kernel op once the first artifact
     shows its exact trace name (custom-call naming varies by toolchain)."""
-    names = _device_op_names("pallas")
+    names, _ = _device_trace("pallas")
     assert any(n.startswith("jit_decide") for n in names), sorted(names)[:10]
