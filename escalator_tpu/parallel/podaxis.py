@@ -54,7 +54,7 @@ DecisionArrays contract. For the in-between regime — a FEW huge groups —
 shard by group block so the ``tail(N)`` term above becomes ``tail(N/Sg)``
 instead of replicating, which is exactly the loss this module's cost model
 documents (bench cfg8 measured the replicated tail at 165 of 182 ms; the
-grid's 8x1 layout cut it ~7x on the same rig and went 1.29x FASTER than
+grid's 8x1 layout cut it ~7x on the same rig and went 1.46x FASTER than
 single-device where this module's pure pod-axis split ran 0.28x).
 """
 
