@@ -1,9 +1,12 @@
 """Threaded soak test — the ``go test -race`` analog (SURVEY.md §5: the reference
 runs its whole suite under the race detector, Makefile:13-14). Python has no tsan,
 so this drives the actual racy interleaving instead: the controller ticks on one
-thread while watch events mutate the cluster from others, across the two backends
+thread while watch events mutate the cluster from others, across the backends
 that share state with the ingest path (golden via the RLock'd in-memory client,
-native via the C++ store's single-writer lock). Correctness oracle: after the
+native via the C++ store's single-writer lock) plus the grid-mesh backend,
+whose lister-walk repack must stay torn-snapshot-free under the same churn
+and whose sharded decide must still match the fresh golden oracle after the
+mutators quiesce. Correctness oracle: after the
 mutators quiesce, one more decision through the soaked backend must match a fresh
 golden evaluation of the same final state — a torn snapshot or a lost dirty mark
 would leave the device-resident arrays permanently diverged, which is exactly what
@@ -16,7 +19,7 @@ import pytest
 
 from escalator_tpu.controller import controller as ctl
 from escalator_tpu.controller import node_group as ngmod
-from escalator_tpu.controller.backend import GoldenBackend
+from escalator_tpu.controller.backend import GoldenBackend, GridJaxBackend
 from escalator_tpu.controller.native_backend import make_native_backend
 from escalator_tpu.k8s.cache import EventfulClient
 from escalator_tpu.testsupport.builders import (
@@ -79,6 +82,8 @@ def _build_world(backend_kind: str):
     client = EventfulClient(nodes=nodes, pods=pods)
     if backend_kind == "native":
         backend = make_native_backend(client, [opts])
+    elif backend_kind == "grid":
+        backend = GridJaxBackend()
     else:
         backend = GoldenBackend()
     provider = MockCloudProvider()
@@ -137,7 +142,7 @@ def _mutator(client: EventfulClient, seed: int, stop: threading.Event,
         errors.append(e)
 
 
-@pytest.mark.parametrize("backend_kind", ["golden", "native"])
+@pytest.mark.parametrize("backend_kind", ["golden", "native", "grid"])
 def test_soak_ticks_while_watch_mutates(backend_kind):
     client, controller = _build_world(backend_kind)
     stop = threading.Event()
