@@ -277,15 +277,22 @@ int64_t ess_pod_slot(StateStore* s, const char* uid) {
   return s->pod_reg.lookup(uid);
 }
 
-// Batched ingest: one ctypes crossing per watch-delta batch instead of one per
-// event. Returns the number of entries applied; stops early (returning i) when
-// a new key hits capacity, so the caller can grow and resume from i.
-int64_t ess_upsert_pods_batch(StateStore* s, const char* const* uids,
-                              const int32_t* group, const int64_t* cpu_milli,
-                              const int64_t* mem_bytes, const int32_t* node_slot,
-                              int64_t n) {
+// Batched ingest, packed keys: one ctypes crossing per watch-delta batch,
+// with the keys in ONE NUL-delimited buffer rather than a char* array — the
+// ctypes marshaling of a per-string pointer array measured ~0.7 ms per 1000
+// keys on the bench rig (more than the store work itself) vs ~0.15 ms for a
+// single joined bytes object. Returns the number of entries applied; stops
+// early (returning i) when a new key hits capacity, so the caller can grow
+// and resume after skipping i keys in the buffer. The Python wrapper
+// validates that keys contain no NUL (framing would desynchronize).
+int64_t ess_upsert_pods_packed(StateStore* s, const char* uid_buf,
+                               const int32_t* group, const int64_t* cpu_milli,
+                               const int64_t* mem_bytes,
+                               const int32_t* node_slot, int64_t n) {
+  const char* p = uid_buf;
   for (int64_t i = 0; i < n; ++i) {
-    int64_t slot = s->pod_reg.acquire(uids[i]);
+    size_t len = std::strlen(p);  // one scan: shared by the key and the advance
+    int64_t slot = s->pod_reg.acquire(std::string(p, len));
     if (slot < 0) return i;
     s->pods.group[slot] = group[i];
     s->pods.cpu_milli[slot] = cpu_milli[i];
@@ -293,18 +300,22 @@ int64_t ess_upsert_pods_batch(StateStore* s, const char* const* uids,
     s->pods.node[slot] = node_slot[i];
     s->pods.valid[slot] = 1;
     s->pod_dirty.mark(slot);
+    p += len + 1;
   }
   return n;
 }
 
-int64_t ess_upsert_nodes_batch(StateStore* s, const char* const* names,
-                               const int32_t* group, const int64_t* cpu_milli,
-                               const int64_t* mem_bytes,
-                               const int64_t* creation_ns, const uint8_t* tainted,
-                               const uint8_t* cordoned, const uint8_t* no_delete,
-                               const int64_t* taint_time_sec, int64_t n) {
+int64_t ess_upsert_nodes_packed(StateStore* s, const char* name_buf,
+                                const int32_t* group, const int64_t* cpu_milli,
+                                const int64_t* mem_bytes,
+                                const int64_t* creation_ns,
+                                const uint8_t* tainted, const uint8_t* cordoned,
+                                const uint8_t* no_delete,
+                                const int64_t* taint_time_sec, int64_t n) {
+  const char* p = name_buf;
   for (int64_t i = 0; i < n; ++i) {
-    int64_t slot = s->node_reg.acquire(names[i]);
+    size_t len = std::strlen(p);
+    int64_t slot = s->node_reg.acquire(std::string(p, len));
     if (slot < 0) return i;
     s->nodes.group[slot] = group[i];
     s->nodes.cpu_milli[slot] = cpu_milli[i];
@@ -316,6 +327,7 @@ int64_t ess_upsert_nodes_batch(StateStore* s, const char* const* names,
     s->nodes.taint_time_sec[slot] = taint_time_sec[i];
     s->nodes.valid[slot] = 1;
     s->node_dirty.mark(slot);
+    p += len + 1;
   }
   return n;
 }

@@ -75,6 +75,39 @@ class TestDeltas:
         slots = [store.pod_slot(f"p{i}") for i in range(10)]
         assert sorted(pv["cpu_milli"][slots]) == list(range(10))
 
+    def test_batch_grow_resume_packed(self):
+        """A batch larger than capacity must grow mid-batch and resume at the
+        right key — locks the packed NUL-delimited buffer's resume framing
+        (an off-by-one in the skip re-join would bind values to wrong keys).
+        Varied-length keys make a framing slip detectable."""
+        store = statestore.NativeStateStore(pod_capacity=4, node_capacity=4)
+        uids = [f"pod-{'x' * (i % 7)}-{i}" for i in range(20)]
+        store.upsert_pods_batch(
+            uids, np.zeros(20, np.int32),
+            np.arange(20, dtype=np.int64), np.full(20, 5, np.int64))
+        names = [f"node-{'y' * (i % 5)}-{i}" for i in range(20)]
+        store.upsert_nodes_batch(
+            names, np.zeros(20, np.int32),
+            np.arange(100, 120, dtype=np.int64), np.full(20, 7, np.int64))
+        assert store.pod_count == 20 and store.node_count == 20
+        pv, nv = store.pod_views(), store.node_views()
+        for i, (u, nm) in enumerate(zip(uids, names)):
+            assert pv["cpu_milli"][store.pod_slot(u)] == i
+            assert nv["cpu_milli"][store.node_slot(nm)] == 100 + i
+
+    def test_packed_batch_rejects_nul_in_key(self):
+        """An embedded NUL would desynchronize the packed buffer framing —
+        must be a clean ValueError, not heap corruption."""
+        store = statestore.NativeStateStore(pod_capacity=4, node_capacity=4)
+        with pytest.raises(ValueError, match="NUL"):
+            store.upsert_pods_batch(
+                ["ok", "bad\0key"], np.zeros(2, np.int32),
+                np.ones(2, np.int64), np.ones(2, np.int64))
+        with pytest.raises(ValueError, match="NUL"):
+            store.upsert_nodes_batch(
+                ["n\0", "n2"], np.zeros(2, np.int32),
+                np.ones(2, np.int64), np.ones(2, np.int64))
+
 
 class TestKernelFeed:
     def test_decide_from_native_store(self):
