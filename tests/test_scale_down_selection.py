@@ -255,3 +255,24 @@ class TestEmptySelectionWindows:
         t_off = np.asarray(out.tainted_offsets)
         up = list(np.asarray(out.untaint_order)[t_off[0]:t_off[1]])
         assert up == sem.nodes_newest_first(nodes)
+
+
+def test_decide_compiles_to_one_sort():
+    """Structural lock, platform-independent (the TPU-trace twin lives in
+    test_trace_artifact.py): the compiled decide module must contain exactly
+    ONE sort instruction — the combined 4-key ordering sort. A second sort
+    appearing means the orderings split back into per-selection sorts (2x the
+    dominant tail cost) or an argsort chain crept in."""
+    import re
+
+    import jax
+
+    from tests.test_podaxis import _random_cluster
+
+    cluster = _random_cluster(np.random.default_rng(0), G=8, P=256, N=64)
+    # pre-optimization StableHLO: backend passes may legitimately split a
+    # sort, so the compiled module's count is NOT platform-stable — the
+    # traced program's is
+    txt = jax.jit(lambda c, t: kernel.decide(c, t)).lower(cluster, NOW).as_text()
+    insts = re.findall(r"stablehlo\.sort", txt)
+    assert len(insts) == 1, f"expected one stablehlo.sort, got {len(insts)}"
