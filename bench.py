@@ -18,7 +18,9 @@ Configs:
   cfg4_e2e    full-upload end-to-end tick (device_put + decide per iteration)
   cfg6        native incremental tick (C++ store, 1% churn) with a phase
               breakdown (upsert/drain/scatter/decide), a churn sweep
-              (0.1/1/10%) and the full-reupload comparison it replaces.
+              (0.1/1/10%), the full-reupload comparison it replaces, and
+              the fused single-dispatch + packed-transfer variants priced
+              alongside the default two-call/per-column path.
               Its store holds no tainted nodes, so this is the healthy-tick
               fast path (the empty-selection cond skips the untaint sort);
               cfg4 (10% tainted) prices the full-sort path
@@ -278,6 +280,19 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
     except Exception as e:  # pragma: no cover
         detail["cfg6_fused_tick_error"] = str(e)
 
+    # the packed-transfer alternative (delta batch as TWO byte buffers
+    # instead of sixteen per-column arrays, apply_dirty_packed): per-transfer
+    # latency is a transport property, so price both layouts per capture —
+    # the per-column default flips only if a device capture says so
+    try:
+        pk_phases = _native_tick_phases(
+            store, cache, impl, rng, now, num_pods=100_000, num_groups=2048,
+            n_churn=1000, iters=10, packed=True)
+        detail["cfg6_packed_transfer_tick_1pct_ms"] = pk_phases["total"]
+        detail["cfg6_packed_transfer_scatter_ms"] = pk_phases["scatter"]
+    except Exception as e:  # pragma: no cover
+        detail["cfg6_packed_transfer_error"] = str(e)
+
     # the alternative the incremental path replaces: re-upload the whole
     # cluster every tick (the reference's O(cluster) re-walk analog)
     host_cluster = ClusterArrays(groups=base.groups, pods=pods_v, nodes=nodes_v)
@@ -292,17 +307,21 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
 
 
 def _native_tick_phases(store, cache, impl, rng, now, num_pods, num_groups,
-                        n_churn, iters=10) -> dict:
+                        n_churn, iters=10, packed=False) -> dict:
     """Median per-phase ms (upsert/drain/scatter/decide/total) over ``iters``
     incremental ticks of ``n_churn`` pod upserts against a loaded store —
     the one measurement protocol cfg6 and cfg13 both use (upserts wrap
-    within ``num_pods`` existing uids so the store never grows mid-timing)."""
+    within ``num_pods`` existing uids so the store never grows mid-timing).
+    ``packed=True`` routes the scatter through apply_dirty_packed (two byte
+    buffers instead of sixteen per-column transfers) so captures price both
+    transfer layouts."""
     import jax
 
     from escalator_tpu.ops.kernel import decide_jit
 
+    apply_fn = cache.apply_dirty_packed if packed else cache.apply_dirty
     # warm the scatter program for this bucket size
-    cache.apply_dirty(np.arange(n_churn, dtype=np.int64), np.empty(0, np.int64))
+    apply_fn(np.arange(n_churn, dtype=np.int64), np.empty(0, np.int64))
     phases = {"upsert": [], "drain": [], "scatter": [], "decide": [],
               "total": []}
     for t in range(iters):
@@ -315,7 +334,7 @@ def _native_tick_phases(store, cache, impl, rng, now, num_pods, num_groups,
         t1 = time.perf_counter()
         pod_dirty, node_dirty = store.drain_dirty()
         t2 = time.perf_counter()
-        cache.apply_dirty(pod_dirty, node_dirty)
+        apply_fn(pod_dirty, node_dirty)
         jax.block_until_ready(cache.cluster.pods.cpu_milli)
         t3 = time.perf_counter()
         jax.block_until_ready(decide_jit(cache.cluster, now, impl=impl))

@@ -153,6 +153,58 @@ class TestIncrementalParity:
                     err_msg=f,
                 )
 
+    def test_packed_transfer_matches_per_column(self):
+        """apply_dirty_packed (two byte-buffer transfers) must leave the
+        resident cluster BIT-identical to apply_dirty (sixteen per-column
+        transfers) on the same churn — the bitcast round-trip through the
+        packed layout is exact for every column dtype incl. bool."""
+        import jax
+
+        rng = np.random.default_rng(13)
+        stores = [
+            statestore.NativeStateStore(pod_capacity=256, node_capacity=128)
+            for _ in range(2)
+        ]
+        groups = _groups(8)
+        now = np.int64(1_700_000_000)
+        for s in stores:
+            for i in range(100):
+                s.upsert_pod(f"p{i}", i % 8, 500, 10**9)
+            for i in range(40):
+                s.upsert_node(f"n{i}", i % 8, 4000, 16 * 10**9,
+                              creation_ns=i + 1)
+            s.drain_dirty()
+        caches = [
+            DeviceClusterCache(ClusterArrays(
+                groups=groups, pods=s.as_pod_node_arrays()[0],
+                nodes=s.as_pod_node_arrays()[1]))
+            for s in stores
+        ]
+        # regenerate identical churn per store (same seed stream)
+        for tick in range(3):
+            ops = [(int(rng.integers(0, 120)), int(rng.integers(0, 8)),
+                    int(rng.choice([100, 250, 1000])),
+                    int(rng.integers(0, 50)), bool(rng.integers(0, 2)))
+                   for _ in range(25)]
+            for s in stores:
+                for (pi, g, cpu, ni, taint) in ops:
+                    s.upsert_pod(f"p{pi}", g, cpu, 10**9)
+                    s.upsert_node(f"n{ni}", ni % 8, 4000, 16 * 10**9,
+                                  creation_ns=ni + 1, tainted=taint,
+                                  taint_time_sec=int(now) - 5)
+            ps0, ns0 = stores[0].drain_dirty()
+            caches[0].apply_dirty(ps0, ns0, groups)
+            ps1, ns1 = stores[1].drain_dirty()
+            caches[1].apply_dirty_packed(ps1, ns1, groups)
+            a, _ = jax.tree_util.tree_flatten(caches[0].cluster)
+            b, _ = jax.tree_util.tree_flatten(caches[1].cluster)
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        _assert_same_decisions(
+            decide_jit(caches[1].cluster, now),
+            _decide_full(stores[1], groups, now),
+        )
+
     def test_empty_delta_tick(self):
         store = statestore.NativeStateStore(pod_capacity=64, node_capacity=32)
         groups = _groups(2)
