@@ -112,7 +112,6 @@ class TestDeltas:
 class TestKernelFeed:
     def test_decide_from_native_store(self):
         """End-to-end: deltas into the store, zero-copy views into the kernel."""
-        from escalator_tpu.core import semantics as sem
         from escalator_tpu.core.arrays import ClusterArrays, GroupArrays
         from escalator_tpu.ops import kernel
 
