@@ -4,7 +4,8 @@ Layer map (mirrors SURVEY.md §1 of the reference, re-architected TPU-first):
 
 - ``escalator_tpu.core``       — typed cluster state, dense arrays, golden semantics
 - ``escalator_tpu.ops``        — batched JAX/XLA decision kernels
-- ``escalator_tpu.parallel``   — mesh sharding of the nodegroup axis (shard_map/pjit)
+- ``escalator_tpu.parallel``   — mesh sharding: group axis, pod axis, 2-D grid
+  (shard_map/pjit over flat or hybrid dcn/ici meshes)
 - ``escalator_tpu.controller`` — the imperative controller shell (tick loop, executors)
 - ``escalator_tpu.k8s``        — k8s object model, listers, taint mechanics, election
 - ``escalator_tpu.cloudprovider`` — provider SPI + implementations
@@ -13,4 +14,4 @@ Layer map (mirrors SURVEY.md §1 of the reference, re-architected TPU-first):
 - ``escalator_tpu.testsupport``— fake cluster builders, mock providers
 """
 
-__version__ = "0.2.1"
+__version__ = "0.3.0"
