@@ -75,6 +75,14 @@ def test_fused_tick_tiny(bench):
     ms = bench._time_fused_tick(store, cache, "xla", rng, np.int64(0),
                                 n_churn=32, iters=2)
     assert ms > 0
+    # the shared tick-phase protocol, both transfer layouts (cfg6/cfg13 use
+    # this; packed=True is the two-byte-buffer variant priced per capture)
+    for packed in (False, True):
+        phases = bench._native_tick_phases(
+            store, cache, "xla", rng, np.int64(0), num_pods=300,
+            num_groups=4, n_churn=32, iters=2, packed=packed)
+        assert phases["total"] > 0
+        assert set(phases) == {"upsert", "drain", "scatter", "decide", "total"}
 
 
 def test_plugin_roundtrip_tiny(bench):
