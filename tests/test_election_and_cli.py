@@ -355,6 +355,23 @@ class TestCliBackendMatrix:
         sim.write_text(SIM_STATE_YAML)
         return ng, sim
 
+    def test_fleet_example_all_backends_agree(self):
+        """The shipped 4-group fleet example: each group in a different
+        regime (scale-up / no-op / fast scale-down / scale-from-pending),
+        identical across backends — the README quickstart claim, locked."""
+        import pathlib
+
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        configs = (repo / "examples" / "nodegroups-fleet.yaml",
+                   repo / "examples" / "cluster-state-fleet.yaml")
+        want = self._run(configs, "golden")
+        assert want["deltas"] == {
+            "buildeng": 1, "dataeng": 0, "ci": -10, "batch": 3}
+        for backend in ("jax", "sharded-jax", "grid-jax", "podaxis-jax",
+                        "native"):
+            got = self._run(configs, backend)
+            assert got == want, f"{backend} disagrees on the fleet example"
+
     def test_all_backends_agree(self, configs):
         want = self._run(configs, "golden")
         assert want["deltas"] == {"buildeng": 1}
