@@ -942,6 +942,16 @@ def main() -> None:
     put = lambda c: jax.device_put(c, device)
 
     detail = {}
+    # contention stamp: this rig has ONE physical core, so any concurrent
+    # process (a test run, a second bench, the campaign's capture) inflates
+    # every timing. A load average well above ~1 at the start marks the whole
+    # artifact contention-suspect — the round-5 CPU artifact's packed-transfer
+    # rows (54.9 ms vs the prior 25.2 ms with every sibling metric stable)
+    # were exactly such a silent outlier.
+    try:
+        detail["host_load_avg_start"] = [round(v, 2) for v in os.getloadavg()]
+    except OSError:
+        pass
     # 1. single nodegroup, 500 pods, uniform
     detail["cfg1_1ng_500pods_ms"] = _time_decide(
         put(_rng_cluster_arrays(rng, 1, 500, 100)), now
@@ -1083,6 +1093,10 @@ def main() -> None:
     else:
         headline = detail["cfg4_e2e_full_upload_ms"]
         scope = "end_to_end_full_upload_tick(transfer+decide)"
+    try:
+        detail["host_load_avg_end"] = [round(v, 2) for v in os.getloadavg()]
+    except OSError:
+        pass
     record = {
         "metric": "e2e_tick_latency_2048ng_100kpods",
         "value": round(headline, 3),

@@ -1,0 +1,42 @@
+"""The driver-facing surface (``__graft_entry__``) must stay safe and correct.
+
+``entry()`` hands (fn, example_args) to a DRIVER that jit-compiles fn itself;
+on this machine a sitecustomize pins the default jax platform to the TPU
+tunnel, which can wedge indefinitely at backend init, so entry() must
+probe-and-pin (the guard ``dryrun_multichip`` always had) before the caller's
+compile can touch a backend. Reproduced live 2026-07-31: an unguarded
+``jit(entry_fn).compile()`` against the wedged tunnel slept forever in the
+axon client's retry loop.
+"""
+
+import jax
+import numpy as np
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    # flagship decide: per-group scale deltas for the 4-group example cluster
+    assert int(out.nodes_delta.shape[0]) == 4
+    jax.block_until_ready(out.nodes_delta)
+
+
+def test_entry_probes_before_returning(monkeypatch):
+    calls = []
+    from escalator_tpu import jaxconfig
+
+    monkeypatch.setattr(
+        jaxconfig,
+        "ensure_responsive_accelerator",
+        lambda **kw: calls.append(kw) or True,
+    )
+    fn, args = graft.entry()
+    assert calls, "entry() must probe-and-pin before the driver compiles fn"
+
+
+def test_dryrun_multichip_smoke():
+    # tests/conftest pins cpu with 8 virtual devices; the full sharded
+    # programs (1-D, hybrid, pod-axis, grid) must compile and bit-match
+    graft.dryrun_multichip(8)
