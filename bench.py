@@ -920,6 +920,13 @@ def run_sharded() -> None:
     print(json.dumps(out))
 
 
+def _loadavg():
+    try:
+        return [round(v, 2) for v in os.getloadavg()]
+    except (OSError, AttributeError):
+        return None
+
+
 def main() -> None:
     # probe-and-degrade with retries: a wedged accelerator tunnel must not hang
     # the bench, but it also recovers — so probe a few times before settling
@@ -948,10 +955,8 @@ def main() -> None:
     # artifact contention-suspect — the round-5 CPU artifact's packed-transfer
     # rows (54.9 ms vs the prior 25.2 ms with every sibling metric stable)
     # were exactly such a silent outlier.
-    try:
-        detail["host_load_avg_start"] = [round(v, 2) for v in os.getloadavg()]
-    except OSError:
-        pass
+    if (load := _loadavg()) is not None:
+        detail["host_load_avg_start"] = load
     # 1. single nodegroup, 500 pods, uniform
     detail["cfg1_1ng_500pods_ms"] = _time_decide(
         put(_rng_cluster_arrays(rng, 1, 500, 100)), now
@@ -1093,10 +1098,8 @@ def main() -> None:
     else:
         headline = detail["cfg4_e2e_full_upload_ms"]
         scope = "end_to_end_full_upload_tick(transfer+decide)"
-    try:
-        detail["host_load_avg_end"] = [round(v, 2) for v in os.getloadavg()]
-    except OSError:
-        pass
+    if (load := _loadavg()) is not None:
+        detail["host_load_avg_end"] = load
     record = {
         "metric": "e2e_tick_latency_2048ng_100kpods",
         "value": round(headline, 3),
