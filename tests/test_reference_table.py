@@ -40,12 +40,13 @@ from escalator_tpu.testsupport.cloud_provider import (
     MockNodeGroup,
 )
 from escalator_tpu.utils.clock import MockClock
-from test_controller import BACKENDS, LABEL_KEY, LABEL_VALUE, World, make_opts
-
-
-@pytest.fixture(params=list(BACKENDS), ids=list(BACKENDS))
-def backend(request):
-    return BACKENDS[request.param]()
+from test_controller import (  # noqa: F401  (backend is a pytest fixture)
+    LABEL_KEY,
+    LABEL_VALUE,
+    World,
+    backend,
+    make_opts,
+)
 
 
 def table_opts(min_nodes, max_nodes, scale_up):
@@ -113,6 +114,78 @@ def test_scale_node_group_table(row, backend, caplog):
     w.simulate_cloud_fills_nodes(ncpu, nmem)
     w.tick()
     assert w.state.scale_delta == 0, f"{name}: second run must converge to 0"
+
+
+# Mirror of TestScaleNodeGroup_MultipleRuns
+# (controller_scale_node_group_test.go:553-776): first-run delta pinned, then
+# N further ticks with the clock advancing — tainted nodes age past soft
+# grace and get reaped (provider target AND size shrink by the delta), or the
+# cooldown lock holds a from-zero scale-up at exactly one buy. The reference
+# advances by exactly the grace/cooldown period and relies on Go clock tie
+# behavior; here the advances are unambiguous (61s per run; 59s for the
+# locked row) because the tie is incidental, not semantics.
+#
+# (name, n_nodes, (n_pods, pod_cpu, pod_mem), opts overrides, cached?,
+#  runs, advance_per_run_sec, first_delta, final_target)
+MULTI_ROWS = [
+    # removal rows: the reference leaves ScaleUpCoolDownPeriod at the Go zero
+    # value (no lock is ever taken on a scale-down, but mirror it anyway)
+    ("fast_removal_to_min", 10, (0, 0, 0),
+     dict(min_nodes=5, scale_up_cool_down_period="0s"), False, 1, 61, -4, 6),
+    ("slow_removal", 10, (10, 1000, 1000),
+     dict(min_nodes=5, soft_delete_grace_period="5m",
+          scale_up_cool_down_period="0s", taint_effect="NoSchedule"),
+     False, 5, 61, -2, 8),
+    ("fast_removal_to_zero", 4, (0, 0, 0),
+     dict(min_nodes=0, scale_up_cool_down_period="0s"), False, 1, 61, -4, 0),
+    ("from_zero_no_cache_cooldown_holds", 0, (40, 200, 800),
+     dict(min_nodes=0), False, 1, 59, 1, 1),
+    ("from_zero_with_cache", 0, (40, 200, 800), dict(min_nodes=0), True,
+     1, 59, 6, 6),
+]
+
+NODE_CPU, NODE_MEM = 2000, 8000
+
+
+@pytest.mark.parametrize("row", MULTI_ROWS, ids=[r[0] for r in MULTI_ROWS])
+def test_scale_node_group_multiple_runs(row, backend):
+    (name, nn, (np_, pcpu, pmem), over, cached, runs, step, first_delta,
+     final_target) = row
+    kw = dict(
+        max_nodes=100,
+        scale_up_threshold_percent=70,
+        taint_lower_capacity_threshold_percent=40,
+        taint_upper_capacity_threshold_percent=60,
+        fast_node_removal_rate=4,
+        slow_node_removal_rate=2,
+        soft_delete_grace_period="1m",
+        hard_delete_grace_period="15m",
+        scale_up_cool_down_period="1m",
+        taint_effect="NoExecute",
+    )
+    kw.update(over)
+    opts = make_opts(**kw)
+    nodes = build_test_nodes(nn, NodeOpts(cpu=NODE_CPU, mem=NODE_MEM))
+    pods = build_test_pods(np_, PodOpts(
+        cpu=[pcpu], mem=[pmem],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE,
+    )) if np_ else []
+    w = World(opts, nodes=nodes, pods=pods, backend=backend)
+    if cached:
+        # the reference injects cached per-node allocatable directly
+        # (controller_scale_node_group_test.go:735-740)
+        w.state.kernel_state.cached_cpu_milli = NODE_CPU
+        w.state.kernel_state.cached_mem_bytes = NODE_MEM
+
+    w.tick()
+    assert w.state.scale_delta == first_delta, name
+
+    for _ in range(runs):
+        w.clock.advance(step)
+        w.tick()
+
+    assert w.group.target_size() == final_target, name
+    assert w.group.size() == final_target, name
 
 
 def test_node_lister_error_skips_group(backend):
