@@ -76,10 +76,10 @@ ROWS = [
     ("scale_up_from_0_node", (0, 1000, 10000), (1, 500, 1000), (0, 10, 70), 1, None),
     ("below_minimum", (1, 0, 0), (0, 0, 0), (5, 0, 0), 0, "less than minimum"),
     ("above_maximum", (10, 0, 0), (0, 0, 0), (0, 5, 0), 0, "larger than maximum"),
+    # reference rows 9-11 all reduce to this one row: its builder OMITS a
+    # resource when the option is negative, so the two "invalid
+    # usage/requests" rows are the zero-capacity row under other names
     ("div_zero_zero_capacity", (10, 0, 0), (5, 0, 0), (1, 100, 0), 0,
-     "cannot divide by zero"),
-    # reference rows 10-11: negative capacities, omitted by its builder
-    ("div_zero_negative_capacity", (10, 0, 0), (5, 0, 0), (1, 100, 0), 0,
      "cannot divide by zero"),
     ("no_need_to_scale_up", (10, 2000, 8000), (5, 1000, 2000), (1, 100, 70), 0, None),
     ("scale_up_test", (10, 1500, 5000), (100, 500, 600), (5, 100, 70), 38, None),
@@ -186,6 +186,44 @@ def test_scale_node_group_multiple_runs(row, backend):
 
     assert w.group.target_size() == final_target, name
     assert w.group.size() == final_target, name
+
+
+def test_untaint_to_min_nodes(backend):
+    """TestUntaintNodeGroupMinNodes (controller_scale_node_group_test.go:75-133):
+    10 tainted / 0 untainted with min=10 — the forced-min scale-up is satisfied
+    entirely by untainting; the provider is never asked for nodes."""
+    nodes = build_test_nodes(10, NodeOpts(cpu=1000, mem=1000, tainted=True,
+                                          taint_time_sec=1))
+    pods = build_test_pods(10, PodOpts(
+        cpu=[1000], mem=[1000],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=10, max_nodes=20,
+                        scale_up_threshold_percent=100),
+              nodes=nodes, pods=pods, backend=backend)
+    w.tick()
+    assert len(w.tainted_nodes()) == 0
+    assert len(w.client.list_nodes()) == 10
+    assert w.group.increase_calls == []
+    assert w.group.target_size() == 10
+
+
+def test_untaint_at_max_nodes(backend):
+    """TestUntaintNodeGroupMaxNodes (controller_scale_node_group_test.go:137-202):
+    at max size with 5 tainted + 5 untainted and 200% pressure — untainting is
+    allowed (it adds no nodes) and covers the delta up to max; the provider
+    increase is clamped at max and never called."""
+    nodes = (build_test_nodes(5, NodeOpts(cpu=1000, mem=1000, tainted=True,
+                                          taint_time_sec=1))
+             + build_test_nodes(5, NodeOpts(cpu=1000, mem=1000)))
+    pods = build_test_pods(10, PodOpts(
+        cpu=[1000], mem=[1000],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=2, max_nodes=10),
+              nodes=nodes, pods=pods, backend=backend)
+    w.tick()
+    assert len(w.tainted_nodes()) == 0
+    assert w.group.increase_calls == []
+    assert w.group.target_size() == 10
 
 
 def test_node_lister_error_skips_group(backend):

@@ -289,14 +289,21 @@ class TestSelectionAndReap:
         before_soft = mk(taint_time_sec=now - 100)
         past_hard = mk(taint_time_sec=now - 1000)
         no_delete = mk(taint_time_sec=now - 1000, no_delete=True)
-        tainted = [past_soft_empty, before_soft, past_hard, no_delete]
+        # past soft, before hard, NON-empty: waits for hard grace
+        # (scale_down.go:72-73 — soft deletes only empty nodes)
+        past_soft_busy = mk(taint_time_sec=now - 400)
+        tainted = [past_soft_empty, before_soft, past_hard, no_delete,
+                   past_soft_busy]
 
         # a pod keeps past_hard non-empty, but hard grace overrides
         pod = build_test_pods(1, PodOpts(cpu=[1], mem=[1]))[0]
         pod.node_name = past_hard.name
         busy_pod = build_test_pods(1, PodOpts(cpu=[1], mem=[1]))[0]
         busy_pod.node_name = before_soft.name
-        info = k8s.create_node_name_to_info_map([pod, busy_pod], tainted)
+        soft_busy_pod = build_test_pods(1, PodOpts(cpu=[1], mem=[1]))[0]
+        soft_busy_pod.node_name = past_soft_busy.name
+        info = k8s.create_node_name_to_info_map(
+            [pod, busy_pod, soft_busy_pod], tainted)
 
         out = sem.reap_eligible(
             tainted, info, soft_grace_sec=300, hard_grace_sec=900, now_unix_sec=now
