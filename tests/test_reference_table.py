@@ -40,13 +40,8 @@ from escalator_tpu.testsupport.cloud_provider import (
     MockNodeGroup,
 )
 from escalator_tpu.utils.clock import MockClock
-from test_controller import (  # noqa: F401  (backend is a pytest fixture)
-    LABEL_KEY,
-    LABEL_VALUE,
-    World,
-    backend,
-    make_opts,
-)
+from test_controller import LABEL_KEY, LABEL_VALUE, World, make_opts
+from test_controller import backend  # noqa: F401  (pytest fixture, used by name)
 
 
 def table_opts(min_nodes, max_nodes, scale_up):
