@@ -216,7 +216,8 @@ class NativeJaxBackend(ComputeBackend):
         degrading to the XLA scatter path if the Pallas program fails to
         lower/execute. ONE retry of the native choice happens after
         _PALLAS_RETRY_AFTER fallback ticks (a transient failure must not
-        forfeit the win forever); a second failure is sticky for the process. Outputs are bit-identical either way (the
+        forfeit the win forever); a second failure is sticky for the process.
+        Outputs are bit-identical either way (the
         parity suite locks that), so degrading changes latency, never
         decisions — same philosophy as the accelerator probe's CPU pin
         (jaxconfig.ensure_responsive_accelerator). A crash would instead

@@ -40,8 +40,8 @@ from escalator_tpu.testsupport.cloud_provider import (
     MockNodeGroup,
 )
 from escalator_tpu.utils.clock import MockClock
-from test_controller import LABEL_KEY, LABEL_VALUE, World, make_opts
-from test_controller import backend  # noqa: F401  (pytest fixture, used by name)
+from tests.test_controller import LABEL_KEY, LABEL_VALUE, World, make_opts
+from tests.test_controller import backend  # noqa: F401  (pytest fixture, used by name)
 
 
 def table_opts(min_nodes, max_nodes, scale_up):
