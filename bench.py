@@ -47,6 +47,11 @@ Configs:
 The full record is also written to BENCH_FULL_LATEST.json (named in the
 stdout line) so a driver that tail-grabs stdout can never truncate the
 artifact (round-4's BENCH_r04.json lost everything before cfg8 that way).
+Mid-run, every completed section is flushed to a partial file
+(ESCALATOR_TPU_BENCH_PARTIAL, default BENCH_PARTIAL_LATEST.json; removed on
+success): the tunnel can wedge mid-bench, and a killed run's completed
+sections are salvaged by tools/tpu_campaign.sh as TPU_PARTIAL_<ts>.json —
+summarized into later artifacts' ``detail.tpu_partials``.
 
 Timing notes: values are medians over N iters (min alongside) — CPU numbers on
 a shared VM drift several percent between runs, which round 2 mislabelled as a
@@ -476,7 +481,8 @@ def _memory_envelope(device, detail: dict) -> None:
 
 
 def _cfg9_pallas_matrix(detail, headline_cluster, host_headline,
-                        churned_cluster, rng, now, device) -> None:
+                        churned_cluster, rng, now, device,
+                        flush=None) -> None:
     """pallas-vs-xla on >=3 shapes with a computed conclusion (VERDICT r3
     item 2): (a) the contiguous 100k-lane headline layout, (b) the churned
     slot-reused interleaved layout from the native store (the on-device-sort
@@ -555,6 +561,13 @@ def _cfg9_pallas_matrix(detail, headline_cluster, host_headline,
         if xla_eff and pallas_eff:
             r["pallas_over_xla_min"] = round(pallas_eff / xla_eff, 3)
         rows[label] = r
+        # each row is 4 timing loops on a possibly-stalling tunnel — flush so
+        # a wedge mid-matrix keeps the rows already measured (and feeds the
+        # campaign watchdog's progress signal)
+        detail["cfg9_pallas_vs_xla"] = {
+            "rows": dict(rows), "conclusion": "(matrix in progress)"}
+        if flush is not None:
+            flush()
 
     row("contiguous_2048g_100kpods", headline_cluster,
         host_headline.pods.group, host_headline.pods.valid,
@@ -729,6 +742,40 @@ def _summarize_tpu_captures() -> list:
             if base.startswith("BENCH_r"):
                 row["prior_round"] = True  # earlier code, genuine TPU session
             rows.append(row)
+        except Exception as e:  # pragma: no cover
+            rows.append({"file": os.path.basename(path), "error": str(e)})
+    return rows
+
+
+def _summarize_tpu_partials() -> list:
+    """One row per salvaged partial capture (TPU_PARTIAL_*.json, kept by
+    tools/tpu_campaign.sh when a bench wedged mid-run): which sections the
+    session completed before dying, and its headline if cfg6 landed. Partial
+    evidence is still evidence — a wedge-prone tunnel may never hold still
+    for a full bench, and the fields a partial carries are real measurements
+    from a live session."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows = []
+    for path in sorted(glob.glob(os.path.join(here, "TPU_PARTIAL_*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            d = data.get("detail") or {}
+            # a section counts as completed only via a MEASURED key — error
+            # and skip markers (cfg6_native_tick_error, cfg12_skipped, ...)
+            # must not present a failed section as salvaged evidence
+            done = {k.split("_")[0] for k in d
+                    if k.startswith("cfg")
+                    and not k.endswith(("_error", "_skipped"))}
+            rows.append({
+                "file": os.path.basename(path),
+                "device_name": str(data.get("device", "")).split(" (")[0],
+                "degraded": "CPU fallback" in str(data.get("device", "")),
+                "sections": sorted(done, key=lambda s: int(s[3:] or 0)),
+                "e2e_tick_1pct_ms": d.get("cfg6_native_tick_1pct_churn_ms"),
+            })
         except Exception as e:  # pragma: no cover
             rows.append({"file": os.path.basename(path), "error": str(e)})
     return rows
@@ -927,6 +974,58 @@ def _loadavg():
         return None
 
 
+# per-run: concurrent benches (a driver run overlapping the campaign's — this
+# rig's documented contention case) must not share one partial file, or the
+# campaign's stall watchdog reads the OTHER run's progress and its salvage
+# copies the other session's sections. tools/tpu_campaign.sh passes a
+# TPU_PARTIAL_<ts>.json path (which TPU_BENCH_*.json capture globs never
+# match); standalone runs use the LATEST default.
+_PARTIAL_PATH = os.environ.get(
+    "ESCALATOR_TPU_BENCH_PARTIAL",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_PARTIAL_LATEST.json"),
+)
+
+
+def _device_label(device, degraded: bool) -> str:
+    return str(device) + (
+        " (accelerator unreachable; CPU fallback)" if degraded else "")
+
+
+def _round_floats(detail: dict) -> dict:
+    return {k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in detail.items()}
+
+
+def _atomic_json_write(path: str, rec: dict) -> None:
+    """tmp-write + rename: a campaign SIGKILL mid-write must never leave a
+    truncated file for the driver (or the salvage) to ingest."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _flush_partial(detail: dict, device, degraded: bool) -> None:
+    """Atomically write the sections measured SO FAR to the partial file. The
+    tunnel can wedge mid-run (round 4 lost its closing bench additions exactly
+    that way: added 07:07Z, tunnel dead 07:23Z, zero captures carried them) —
+    a killed bench must not lose the sections it completed.
+    tools/tpu_campaign.sh keeps this file as the salvaged capture when the
+    bench dies, and uses its mtime as the stall-watchdog progress signal, so
+    an early wedge costs the stall budget, not the whole bench timeout.
+    Removed on successful completion (the full artifact supersedes it)."""
+    try:
+        _atomic_json_write(_PARTIAL_PATH, {
+            "partial": True,
+            "device": _device_label(device, degraded),
+            "detail": _round_floats(detail),
+        })
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+
+
 def main() -> None:
     # probe-and-degrade with retries: a wedged accelerator tunnel must not hang
     # the bench, but it also recovers — so probe a few times before settling
@@ -957,10 +1056,14 @@ def main() -> None:
     # were exactly such a silent outlier.
     if (load := _loadavg()) is not None:
         detail["host_load_avg_start"] = load
+    # "bench started, nothing measured yet" baseline — a wedge inside cfg1's
+    # first compile is then distinguishable from a bench that never launched
+    _flush_partial(detail, device, degraded)
     # 1. single nodegroup, 500 pods, uniform
     detail["cfg1_1ng_500pods_ms"] = _time_decide(
         put(_rng_cluster_arrays(rng, 1, 500, 100)), now
     )
+    _flush_partial(detail, device, degraded)
     # 2. single nodegroup, 50k pods, mixed requests
     detail["cfg2_1ng_50kpods_ms"] = _time_decide(
         put(_rng_cluster_arrays(rng, 1, 50_000, 2_000, mixed=True)), now
@@ -972,6 +1075,7 @@ def main() -> None:
         ),
         now,
     )
+    _flush_partial(detail, device, degraded)
     # 4. BASELINE shape: 2048 nodegroups, 100k pods (kernel-only + e2e)
     host_headline = _rng_cluster_arrays(
         rng, 2048, 100_000, 50_000, mixed=True, heterogeneous=True,
@@ -987,6 +1091,7 @@ def main() -> None:
     detail["cfg4_kernel_only_min_ms"] = round(mn, 3)
     detail["cfg4_phases"] = _phase_breakdown(
         host_headline, headline_cluster, now, device)
+    _flush_partial(detail, device, degraded)
 
     # full-upload end-to-end tick: transfer the whole cluster + decide, per
     # iteration — the fallback headline when the native store is unavailable
@@ -997,6 +1102,7 @@ def main() -> None:
     e2e_med, e2e_min = _timeit(full_tick, iters=max(10, ITERS // 3))
     detail["cfg4_e2e_full_upload_ms"] = round(e2e_med, 3)
     detail["cfg4_e2e_full_upload_min_ms"] = round(e2e_min, 3)
+    _flush_partial(detail, device, degraded)
 
     # 5. scale-down ordering: 10k pods, heavy taint/cordon masking
     detail["cfg5_scaledown_10kpods_ms"] = _time_decide(
@@ -1015,6 +1121,7 @@ def main() -> None:
         churned_cluster = _cfg6_native(rng, now, device, detail, degraded)
     except Exception as e:  # pragma: no cover
         detail["cfg6_native_tick_error"] = str(e)
+    _flush_partial(detail, device, degraded)
 
     # 13. long-context stretch: native incremental tick at 1M pods/100k nodes
     # on one chip (runs before cfg9 so its decide program loads as early as
@@ -1023,17 +1130,22 @@ def main() -> None:
         _cfg13_native_1M(rng, now, device, detail, degraded)
     except Exception as e:  # pragma: no cover
         detail["cfg13_error"] = str(e)
+    _flush_partial(detail, device, degraded)
 
     # device memory: stats probe + computed envelope, after the biggest
     # clusters (cfg13's 1M-pod store) are resident so peak covers them
     _memory_envelope(device, detail)
+    _flush_partial(detail, device, degraded)
 
     # 9. pallas-vs-xla aggregation matrix (VERDICT r3 item 2): compiled Pallas
     # is TPU-only (interpret mode would measure the interpreter), so the
     # matrix is skipped on the CPU fallback
     if not degraded:
-        _cfg9_pallas_matrix(detail, headline_cluster, host_headline,
-                            churned_cluster, rng, now, device)
+        _cfg9_pallas_matrix(
+            detail, headline_cluster, host_headline, churned_cluster, rng,
+            now, device,
+            flush=lambda: _flush_partial(detail, device, degraded))
+    _flush_partial(detail, device, degraded)
 
     # 10. FFD bin-packing at bench scale (the marquee beyond-reference
     # feature, ops/binpack.py): 2048 groups x 64 pods x 32 real bins + 16
@@ -1056,6 +1168,7 @@ def main() -> None:
         detail["cfg11_whatif_sweep_min_ms"] = round(swp_min, 3)
     except Exception as e:  # pragma: no cover
         detail["cfg11_whatif_sweep_error"] = str(e)
+    _flush_partial(detail, device, degraded)
 
     # 12. the compute-plugin boundary at the headline shape (skipped when
     # grpc is unavailable; the local fallback path needs no pricing)
@@ -1065,6 +1178,7 @@ def main() -> None:
         detail["cfg12_skipped"] = f"grpc unavailable ({e.name})"
     except Exception as e:  # pragma: no cover
         detail["cfg12_plugin_error"] = str(e)
+    _flush_partial(detail, device, degraded)
 
     # 7/8. sharded paths (always in a subprocess on the 8-virtual-device CPU
     # mesh: the scaling SHAPE is the evidence; single-chip hardware can't host
@@ -1080,6 +1194,9 @@ def main() -> None:
 
     # cross-capture spread: summarize every TPU campaign capture in the repo
     detail["tpu_captures"] = _summarize_tpu_captures()
+    partials = _summarize_tpu_partials()
+    if partials:
+        detail["tpu_partials"] = partials
     # best archived on-TPU end-to-end tick: kept top-of-detail so a driver
     # run that lands in a wedged-tunnel window still carries the TPU
     # evidence prominently, clearly labeled as archived (sessions are
@@ -1106,30 +1223,26 @@ def main() -> None:
         "unit": "ms",
         "vs_baseline": round(target_ms / headline, 2),
         "headline_scope": scope,
-        "device": str(device)
-        + (" (accelerator unreachable; CPU fallback)" if degraded else ""),
+        "device": _device_label(device, degraded),
         "full_artifact": "BENCH_FULL_LATEST.json",
-        "detail": {
-            k: (round(v, 3) if isinstance(v, float) else v)
-            for k, v in detail.items()
-        },
+        "detail": _round_floats(detail),
     }
     # full artifact to a sibling file FIRST (VERDICT r4 item 6: the round-4
     # driver grabbed only the stdout tail and lost every section before cfg8
     # from BENCH_r04.json; this file carries every cfg section regardless of
     # how the driver captures stdout)
     try:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_FULL_LATEST.json")
-        # atomic: a campaign `timeout` SIGTERM mid-write must never leave a
-        # truncated file for the driver to ingest as a capture
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(record, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, path)
+        _atomic_json_write(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_FULL_LATEST.json"), record)
     except OSError:  # pragma: no cover - read-only checkout still prints
         record["full_artifact"] = "(write failed; stdout only)"
+    # the full artifact supersedes the partial; leaving it would let a later
+    # failed run get a STALE partial salvaged next to its own capture
+    try:
+        os.remove(_PARTIAL_PATH)
+    except OSError:
+        pass
     print(json.dumps(record))
 
 

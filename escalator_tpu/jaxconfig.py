@@ -50,6 +50,18 @@ def _pinned_to_cpu() -> bool:
         return False
 
 
+def guarded_devices() -> list:
+    """``jax.devices()`` behind the wedged-transport probe. Backend init is
+    exactly the call that hangs forever on a wedged tunnel, and raw library
+    use (mesh constructors, device caches — no CLI/backend guard upstream)
+    reaches it first. The probe is cached process-wide and fast-paths once
+    backends are live or the platform is cpu-pinned."""
+    ensure_responsive_accelerator()
+    import jax
+
+    return jax.devices()
+
+
 def ensure_responsive_accelerator(
     timeout_sec: float = 90.0,
     attempts: int = 1,

@@ -206,7 +206,14 @@ class DeviceClusterCache:
     """
 
     def __init__(self, host: ClusterArrays, device=None):
-        self._device = device if device is not None else jax.devices()[0]
+        if device is None:
+            # wedged-transport guard: raw library construction (no
+            # CLI/backend upstream) reaches backend init right here, and a
+            # wedged tunnel hangs it forever; cached per process
+            from escalator_tpu.jaxconfig import guarded_devices
+
+            device = guarded_devices()[0]
+        self._device = device
         self._host_pods = host.pods
         self._host_nodes = host.nodes
         self.pod_capacity = int(host.pods.valid.shape[0])

@@ -501,6 +501,25 @@ def decide(
     )
 
 
-#: jitted entry point; backend chosen by JAX (TPU when present, else CPU) — the CPU
-#: fallback is the same traced program, keeping parity guarantees cheap (SURVEY.md §7).
-decide_jit = jax.jit(decide, static_argnames=("impl",))
+_decide_jit_raw = jax.jit(decide, static_argnames=("impl",))
+
+
+def decide_jit(cluster: ClusterArrays, now_sec, impl: str = "xla",
+               aggregates=None):
+    """Jitted entry point; backend chosen by JAX (TPU when present, else CPU)
+    — the CPU fallback is the same traced program, keeping parity guarantees
+    cheap (SURVEY.md §7). Signature mirrors :func:`decide`.
+
+    Guarded against a wedged accelerator transport at the first dispatch:
+    raw library use (``pack_cluster`` → ``decide_jit``, no CLI/backend in
+    between — the verify doc's surface 1) never crosses the construction-site
+    guards in ``make_backend``/cli/sim/plugin, and a wedged first dispatch
+    would hang forever (observed 2026-07-31: 400 s with zero progress). The
+    probe result is cached process-wide and fast-paths when backends are
+    already live or the platform is cpu-pinned, so steady-state overhead is
+    one cached check per call; under an outer trace (the bench's vmapped
+    decide) the guard runs once at trace time."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _decide_jit_raw(cluster, now_sec, impl=impl, aggregates=aggregates)

@@ -133,7 +133,29 @@ def sweep_deltas_by_type(
     return post_cpu, post_mem, feasible, min_delta
 
 
-sweep_deltas_jit = jax.jit(sweep_deltas, static_argnames=("num_candidates",))
-sweep_deltas_by_type_jit = jax.jit(
+_sweep_deltas_raw = jax.jit(sweep_deltas, static_argnames=("num_candidates",))
+_sweep_deltas_by_type_raw = jax.jit(
     sweep_deltas_by_type, static_argnames=("num_candidates",)
 )
+
+
+def sweep_deltas_jit(cluster, num_candidates: int):
+    """Jitted :func:`sweep_deltas` with the wedged-transport guard at first
+    dispatch (same rationale as ``kernel.decide_jit``: raw library use never
+    crosses the CLI/backend construction guards, and a wedged accelerator
+    would hang the first dispatch forever; the probe is cached per process)."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _sweep_deltas_raw(cluster, num_candidates=num_candidates)
+
+
+def sweep_deltas_by_type_jit(cluster, type_cpu_milli, type_mem_bytes,
+                             num_candidates: int):
+    """Jitted :func:`sweep_deltas_by_type`; guarded like sweep_deltas_jit."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _sweep_deltas_by_type_raw(
+        cluster, type_cpu_milli, type_mem_bytes,
+        num_candidates=num_candidates)

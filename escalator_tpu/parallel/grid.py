@@ -51,7 +51,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from escalator_tpu.jaxconfig import ensure_x64
+from escalator_tpu.jaxconfig import ensure_x64, guarded_devices
 
 ensure_x64()
 
@@ -79,7 +79,7 @@ def make_grid_mesh(
     the per-tick psum then rides ICI; the ``groups`` axis needs no collective
     traffic at all, so it is the axis that can safely span DCN (the same
     layout logic as mesh.make_hybrid_mesh, scaling-book recipe)."""
-    devs = list(devices) if devices is not None else jax.devices()
+    devs = list(devices) if devices is not None else guarded_devices()
     n = len(devs)
     sg = n if num_group_shards is None else int(num_group_shards)
     if sg < 1 or n % sg != 0:

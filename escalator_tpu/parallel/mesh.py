@@ -23,7 +23,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from escalator_tpu.jaxconfig import ensure_x64
+from escalator_tpu.jaxconfig import ensure_x64, guarded_devices
 
 ensure_x64()
 
@@ -45,8 +45,10 @@ ICI_AXIS = "ici"
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """1-D mesh over the nodegroup axis. Multi-host: pass the global device list."""
-    devs = list(devices) if devices is not None else jax.devices()
+    """1-D mesh over the nodegroup axis. Multi-host: pass the global device list.
+    The default device list rides the wedged-transport guard
+    (jaxconfig.guarded_devices) — see that docstring."""
+    devs = list(devices) if devices is not None else guarded_devices()
     return Mesh(np.array(devs), (GROUP_AXIS,))
 
 
@@ -67,7 +69,7 @@ def make_hybrid_mesh(
     single-host tests, the real host count under multi-process ``jax.distributed``
     initialisation — see ``parallel.distributed.initialize``).
     """
-    devs = list(devices) if devices is not None else jax.devices()
+    devs = list(devices) if devices is not None else guarded_devices()
     if num_hosts is None:
         num_hosts = max(1, len({d.process_index for d in devs}))
     if len(devs) % num_hosts != 0:

@@ -122,6 +122,43 @@ def test_capture_summary_surfaces_dead_capture(bench, tmp_path, monkeypatch):
     assert row["error"] == "no bench record in capture"
 
 
+def test_partial_flush_and_salvage_summary(bench, tmp_path, monkeypatch):
+    """The mid-run partial artifact (wedge salvage): _flush_partial writes
+    atomically to the per-run path, accumulates sections across calls, and
+    _summarize_tpu_partials reports a salvaged file's completed sections —
+    the contract tools/tpu_campaign.sh's stall watchdog relies on."""
+    partial = tmp_path / "TPU_PARTIAL_19700101T000000Z.json"
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", str(partial))
+    detail = {"host_load_avg_start": [0.1], "cfg1_1ng_500pods_ms": 0.123456}
+    bench._flush_partial(detail, "FakeDev", degraded=True)
+    got = json.loads(partial.read_text())
+    assert got["partial"] is True
+    assert "CPU fallback" in got["device"]
+    assert got["detail"]["cfg1_1ng_500pods_ms"] == 0.123  # rounded like main()
+    # later flushes supersede in place (atomic replace, no .tmp left behind)
+    detail["cfg6_native_tick_1pct_churn_ms"] = 1.5
+    detail["cfg13_native_tick_1Mpods_1pct_churn_ms"] = 2.0
+    detail["cfg9_pallas_error"] = "lowering failed"   # NOT a completed section
+    detail["cfg12_skipped"] = "grpc unavailable"      # NOT a completed section
+    bench._flush_partial(detail, "FakeDev", degraded=True)
+    got = json.loads(partial.read_text())
+    assert got["detail"]["cfg6_native_tick_1pct_churn_ms"] == 1.5
+    assert not (tmp_path / (partial.name + ".tmp")).exists()
+    # the salvage summary picks it up, names its MEASURED sections in numeric
+    # order (error/skip markers excluded — a failed section is not salvaged
+    # evidence), and never lets a partial masquerade as a full capture
+    # (different glob prefix)
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    rows = bench._summarize_tpu_partials()
+    row = next(r for r in rows if r["file"] == partial.name)
+    assert row["sections"] == ["cfg1", "cfg6", "cfg13"]
+    assert row["degraded"] is True
+    assert row["e2e_tick_1pct_ms"] == 1.5
+    assert not any(r["file"].startswith("TPU_PARTIAL")
+                   for r in bench._summarize_tpu_captures()
+                   if "file" in r)
+
+
 def test_archived_e2e_filter(bench):
     rows = [
         {"file": "a", "value_ms": 1.4, "headline_scope": "end_to_end_x"},

@@ -28,8 +28,51 @@ EOF
     # r5's cfg13 (1M-pod store build + ~1M-lane decide compile + 8 ticks) and
     # the cfg9 pallas retimes add more — budget up again so a slow session
     # still lands its capture instead of timing out at the finish line
-    if ESCALATOR_TPU_BENCH_ITERS=12 ESCALATOR_TPU_BENCH_SKIP_SHARDED=1 \
-       timeout 3300 python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log"; then
+    # stall watchdog instead of one flat timeout: the tunnel can answer the
+    # probe and wedge seconds later (observed 2026-07-31T03:15Z — probe OK,
+    # bench stuck at the first compile with zero CPU for the full 55 min).
+    # bench.py flushes a per-run partial file after every section (per-run so
+    # a concurrent driver bench can't feed this watchdog a false progress
+    # signal; TPU_PARTIAL_* so capture globs never confuse it with a full
+    # TPU_BENCH_* capture); if it goes STALL_SEC without progress, kill the
+    # bench, keep the partial as salvaged evidence, and fall back to probing
+    # — a wedge costs the stall budget, not the whole bench budget. The
+    # budget is generous (15 min) because the heaviest single gaps between
+    # flushes — cfg13's 1M-pod build and one cfg9 row's four timing loops —
+    # can take several minutes on a tunnel-weather-slowed session.
+    PARTIAL="TPU_PARTIAL_${CAP#TPU_BENCH_}"
+    rm -f "$PARTIAL"
+    ESCALATOR_TPU_BENCH_ITERS=12 ESCALATOR_TPU_BENCH_SKIP_SHARDED=1 \
+       ESCALATOR_TPU_BENCH_PARTIAL="$PARTIAL" \
+       python bench.py > "$CAP" 2>"${CAP%.json}.stderr.log" &
+    BPID=$!
+    DEADLINE=$(( $(date +%s) + 3300 ))
+    STALL_SEC="${TPU_CAMPAIGN_STALL_SEC:-900}"
+    LAST=$(date +%s)
+    KILLED=""
+    while kill -0 "$BPID" 2>/dev/null; do
+      sleep 20
+      NOW=$(date +%s)
+      if [ -f "$PARTIAL" ]; then
+        M=$(stat -c %Y "$PARTIAL" 2>/dev/null || echo "$LAST")
+        [ "$M" -gt "$LAST" ] && LAST="$M"
+      fi
+      if [ "$NOW" -ge "$DEADLINE" ]; then
+        KILLED="deadline"; kill -9 "$BPID" 2>/dev/null; break
+      fi
+      if [ $(( NOW - LAST )) -ge "$STALL_SEC" ]; then
+        KILLED="stalled ${STALL_SEC}s"; kill -9 "$BPID" 2>/dev/null; break
+      fi
+    done
+    wait "$BPID" 2>/dev/null
+    BENCH_RC=$?
+    # a bench that finished during the last sleep window is a success even if
+    # the watchdog then fired on the dead pid — don't relabel (and delete!) a
+    # complete capture
+    if [ -n "$KILLED" ] && [ "$BENCH_RC" != "0" ]; then
+      BENCH_RC="killed ($KILLED)"
+    fi
+    if [ "$BENCH_RC" = "0" ]; then
       if grep -q "CPU fallback" "$CAP"; then
         echo "$(date -u +%FT%TZ) bench ran but degraded mid-run (kept $CAP)" >> "$LOG"
       else
@@ -65,7 +108,16 @@ EOF
         fi
       fi
     else
-      echo "$(date -u +%FT%TZ) bench run failed/timed out (see ${CAP%.json}.stderr.log)" >> "$LOG"
+      # keep whatever sections completed before the wedge: a partial carrying
+      # the fields a full capture never landed is still evidence (bench.py
+      # summarizes TPU_PARTIAL_* into detail.tpu_partials)
+      if grep -q '"cfg' "$PARTIAL" 2>/dev/null; then
+        echo "$(date -u +%FT%TZ) bench $BENCH_RC; completed sections kept -> $PARTIAL" >> "$LOG"
+      else
+        rm -f "$PARTIAL"
+        echo "$(date -u +%FT%TZ) bench $BENCH_RC with no completed sections (see ${CAP%.json}.stderr.log)" >> "$LOG"
+      fi
+      rm -f "$CAP"
     fi
   else
     echo "$(date -u +%FT%TZ) probe FAIL: $(tail -c 200 /tmp/tpu_probe_out | tr '\n' ' ')" >> "$LOG"
