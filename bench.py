@@ -21,9 +21,13 @@ Configs:
               (0.1/1/10%), the full-reupload comparison it replaces, and
               the fused single-dispatch + packed-transfer variants priced
               alongside the default two-call/per-column path.
-              Its store holds no tainted nodes, so this is the healthy-tick
-              fast path (the empty-selection cond skips the untaint sort);
-              cfg4 (10% tainted) prices the full-sort path
+              Its store is a CONVERGED cluster: every group's utilization
+              sits in the no-action band and no node is tainted, so the
+              lazy-orders protocol's light decide (no node sort) is the
+              steady-state path the headline measures. cfg6_drain_start
+              prices the first tick of a drain episode (light + ordered
+              re-dispatch, the protocol's worst case); cfg4 (10% tainted)
+              prices the always-ordered busy path kernel-only
   cfg7        mesh-sharded decider, 8192 groups / 1M pods: device-count
               scaling curve 1->2->4->8 (subprocess on a virtual CPU mesh when
               the main run has a single device; see the printed confound note)
@@ -227,15 +231,26 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
     from escalator_tpu.ops.device_state import DeviceClusterCache
     from escalator_tpu.ops.kernel import decide_jit, native_tick_impl
 
+    # STEADY-STATE load (round 5): balanced round-robin assignment with
+    # every group's utilization inside the (taint_upper 45, scale_up 70)
+    # no-action band — 48-49 pods x 1140m on 24-25 nodes x 4000m puts every
+    # group at 54.7-58.2% cpu. This is what the headline always claimed to
+    # measure ("incremental tick at 1% churn" = a CONVERGED cluster between
+    # scaling events); the previous random 500m load averaged ~25%
+    # utilization — a fleet-wide drain scenario re-decided every tick — and
+    # under the lazy-orders protocol that is a different (two-dispatch)
+    # program, priced separately below as cfg6_drain_start. Round-robin also
+    # makes the lane layout maximally group-interleaved, preserving the
+    # churned-layout story cfg9 inherits from this store.
     store = NativeStateStore(pod_capacity=1 << 17, node_capacity=1 << 16)
     store.upsert_pods_batch(
         [f"p{i}" for i in range(100_000)],
-        rng.integers(0, 2048, 100_000),
-        np.full(100_000, 500), np.full(100_000, 10**9),
+        np.arange(100_000, dtype=np.int64) % 2048,
+        np.full(100_000, 1140), np.full(100_000, 10**9),
     )
     store.upsert_nodes_batch(
         [f"n{i}" for i in range(50_000)],
-        rng.integers(0, 2048, 50_000),
+        np.arange(50_000, dtype=np.int64) % 2048,
         np.full(50_000, 4000), np.full(50_000, 16 * 10**9),
     )
     pods_v, nodes_v = store.as_pod_node_arrays()
@@ -268,7 +283,7 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
     for frac, n in (("0.1pct", 100), ("1pct", 1000), ("10pct", 10_000)):
         sweep[frac] = _native_tick_phases(
             store, cache, impl, rng, now, num_pods=100_000, num_groups=2048,
-            n_churn=n, iters=10)
+            n_churn=n, iters=10, churn_cpu=1140, stable_groups=True)
     detail["cfg6_native_tick_1pct_churn_ms"] = sweep["1pct"]["total"]
     detail["cfg6_phases_1pct"] = sweep["1pct"]
     detail["cfg6_churn_sweep"] = {k: v["total"] for k, v in sweep.items()}
@@ -280,8 +295,12 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
     # defaults to the two-call path on a claim of "measured faster" — keep
     # that claim measured, per capture, in the artifact
     try:
+        # with_orders=False: on this steady-state store the two-call path
+        # dispatches the light program every tick (the sweep above), so the
+        # comparable fused figure is the light fused program
         detail["cfg6_fused_tick_1pct_ms"] = _time_fused_tick(
-            store, cache, impl, rng, now)
+            store, cache, impl, rng, now, churn_cpu=1140, stable_groups=True,
+            with_orders=False)
     except Exception as e:  # pragma: no cover
         detail["cfg6_fused_tick_error"] = str(e)
 
@@ -292,7 +311,8 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
     try:
         pk_phases = _native_tick_phases(
             store, cache, impl, rng, now, num_pods=100_000, num_groups=2048,
-            n_churn=1000, iters=10, packed=True)
+            n_churn=1000, iters=10, packed=True, churn_cpu=1140,
+            stable_groups=True)
         detail["cfg6_packed_transfer_tick_1pct_ms"] = pk_phases["total"]
         detail["cfg6_packed_transfer_scatter_ms"] = pk_phases["scatter"]
     except Exception as e:  # pragma: no cover
@@ -308,31 +328,87 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
 
     full_med, _ = _timeit(full_reupload, iters=10)
     detail["cfg6_full_reupload_ms"] = round(full_med, 3)
+
+    # drain-start tick: rewrite most lanes cheap so every group falls below
+    # taint_lower — the FIRST tick of a drain episode pays the lazy
+    # protocol's worst case, light decide + ordered re-dispatch (ticks after
+    # it see tainted nodes and dispatch once, ordered — cfg4's shape). This
+    # is the scenario the pre-round-5 cfg6 store accidentally measured every
+    # tick; keep it priced so the two-dispatch cost stays visible. Runs
+    # LAST, after the reupload baseline read its (zero-copy!) views of the
+    # steady store; the steady values are then restored through the normal
+    # scatter path so cfg9 inherits the converged store on the churned
+    # (slot-reused, round-robin-interleaved) layout it wants.
+    try:
+        store.upsert_pods_batch(
+            [f"p{i}" for i in range(60_000)],
+            np.arange(60_000, dtype=np.int64) % 2048,
+            np.full(60_000, 100), np.full(60_000, 10**8),
+        )
+        drain = _native_tick_phases(
+            store, cache, impl, rng, now, num_pods=100_000, num_groups=2048,
+            n_churn=1000, iters=5, churn_cpu=100, stable_groups=True)
+        detail["cfg6_drain_start_tick_ms"] = drain["total"]
+        detail["cfg6_drain_start_decide_ms"] = drain["decide"]
+    except Exception as e:  # pragma: no cover
+        detail["cfg6_drain_start_error"] = str(e)
+    finally:
+        store.upsert_pods_batch(
+            [f"p{i}" for i in range(60_000)],
+            np.arange(60_000, dtype=np.int64) % 2048,
+            np.full(60_000, 1140), np.full(60_000, 10**9),
+        )
+        pod_dirty, node_dirty = store.drain_dirty()
+        cache.apply_dirty(pod_dirty, node_dirty)
+        jax.block_until_ready(cache.cluster.pods.cpu_milli)
     return cache.cluster
 
 
 def _native_tick_phases(store, cache, impl, rng, now, num_pods, num_groups,
-                        n_churn, iters=10, packed=False) -> dict:
+                        n_churn, iters=10, packed=False,
+                        churn_cpu=250, stable_groups=False) -> dict:
     """Median per-phase ms (upsert/drain/scatter/decide/total) over ``iters``
     incremental ticks of ``n_churn`` pod upserts against a loaded store —
     the one measurement protocol cfg6 and cfg13 both use (upserts wrap
     within ``num_pods`` existing uids so the store never grows mid-timing).
     ``packed=True`` routes the scatter through apply_dirty_packed (two byte
     buffers instead of sixteen per-column transfers) so captures price both
-    transfer layouts."""
+    transfer layouts.
+
+    The decide phase runs the SAME lazy-orders protocol the native backend
+    uses (kernel.lazy_orders_decide): the bench stores hold no tainted
+    nodes, so a steady-state tick prices the light program + the host
+    delta check, and any tick whose deltas go negative honestly pays the
+    ordered re-dispatch inside its timed window."""
     import jax
 
-    from escalator_tpu.ops.kernel import decide_jit
+    from escalator_tpu.ops.kernel import decide_jit, lazy_orders_decide
 
+    nodes_view = store.as_pod_node_arrays()[1]
+    tainted_any = bool(
+        (np.asarray(nodes_view.tainted) & np.asarray(nodes_view.valid)).any())
     apply_fn = cache.apply_dirty_packed if packed else cache.apply_dirty
-    # warm the scatter program for this bucket size
+    # warm the scatter program for this bucket size, and the light decide
+    # program the lazy protocol dispatches on steady-state ticks (the full
+    # program is warmed by the callers' own decide timing)
     apply_fn(np.arange(n_churn, dtype=np.int64), np.empty(0, np.int64))
+    jax.block_until_ready(
+        decide_jit(cache.cluster, now, impl=impl, with_orders=False))
     phases = {"upsert": [], "drain": [], "scatter": [], "decide": [],
               "total": []}
     for t in range(iters):
-        uids = [f"p{(t * n_churn + i) % num_pods}" for i in range(n_churn)]
-        groups = rng.integers(0, num_groups, n_churn)
-        cpu = np.full(n_churn, 250)
+        idx = (t * n_churn + np.arange(n_churn)) % num_pods
+        uids = [f"p{i}" for i in idx]
+        # stable_groups churns a pod IN PLACE in its round-robin group
+        # (cfg6's steady-state store must keep every group's pod count and
+        # so its utilization band); cfg13's store sits far from any
+        # threshold, so cross-group churn is harmless there
+        groups = idx % num_groups if stable_groups else rng.integers(
+            0, num_groups, n_churn)
+        # churn at the caller's base request magnitude so a steady-state
+        # store STAYS in its utilization band across the timing loop (cfg6);
+        # stores far from a threshold (cfg13) keep the default
+        cpu = np.full(n_churn, churn_cpu)
         mem = np.full(n_churn, 10**9)
         t0 = time.perf_counter()
         store.upsert_pods_batch(uids, groups, cpu, mem)
@@ -342,7 +418,11 @@ def _native_tick_phases(store, cache, impl, rng, now, num_pods, num_groups,
         apply_fn(pod_dirty, node_dirty)
         jax.block_until_ready(cache.cluster.pods.cpu_milli)
         t3 = time.perf_counter()
-        jax.block_until_ready(decide_jit(cache.cluster, now, impl=impl))
+        lazy_orders_decide(
+            lambda w: jax.block_until_ready(
+                decide_jit(cache.cluster, now, impl=impl, with_orders=w)),
+            tainted_any,
+        )
         t4 = time.perf_counter()
         phases["upsert"].append((t1 - t0) * 1e3)
         phases["drain"].append((t2 - t1) * 1e3)
@@ -353,11 +433,15 @@ def _native_tick_phases(store, cache, impl, rng, now, num_pods, num_groups,
 
 
 def _time_fused_tick(store, cache, impl, rng, now, n_churn=1000,
-                     iters=10) -> float:
+                     iters=10, churn_cpu=250, stable_groups=False,
+                     with_orders=True) -> float:
     """Median ms of the fused scatter+decide tick (ONE device dispatch via
     DeviceClusterCache.apply_dirty_and_decide) under the same churn the
     two-call phase loop measures. Upserts wrap within the store's current
-    pod count so capacity never grows mid-timing."""
+    pod count so capacity never grows mid-timing. ``with_orders=False``
+    prices the lazy-orders light program — the comparable figure on a
+    steady-state store, where the two-call path dispatches light every
+    tick."""
     import jax
 
     num_pods = int(np.asarray(cache.cluster.pods.valid).sum())
@@ -367,13 +451,16 @@ def _time_fused_tick(store, cache, impl, rng, now, n_churn=1000,
 
     def fused_tick(t=[0]):
         t[0] += 1
-        uids = [f"p{(t[0] * n_churn + i) % num_pods}" for i in range(n_churn)]
+        idx = (t[0] * n_churn + np.arange(n_churn)) % num_pods
+        uids = [f"p{i}" for i in idx]
         store.upsert_pods_batch(
-            uids, rng.integers(0, groups_n, n_churn),
-            np.full(n_churn, 250), np.full(n_churn, 10**9))
+            uids,
+            idx % groups_n if stable_groups else rng.integers(
+                0, groups_n, n_churn),
+            np.full(n_churn, churn_cpu), np.full(n_churn, 10**9))
         pod_dirty, node_dirty = store.drain_dirty()
         out = cache.apply_dirty_and_decide(
-            pod_dirty, node_dirty, now, impl=impl)
+            pod_dirty, node_dirty, now, impl=impl, with_orders=with_orders)
         jax.block_until_ready(out)
 
     med, _ = _timeit(fused_tick, iters=iters)

@@ -78,6 +78,7 @@ class NativeJaxBackend(ComputeBackend):
         self._impl_fallback: "str | None" = None
         self._pallas_failures = 0
         self._ticks_since_fallback = 0
+        self._dispatches_this_tick = 0
 
     def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
         """First live node per group -> GroupState cached capacity
@@ -157,6 +158,12 @@ class NativeJaxBackend(ComputeBackend):
             # thread wrote since).
             unpack_group = np.array(nodes.group)
             unpack_cordoned = np.array(nodes.valid) & np.array(nodes.cordoned)
+            # lazy-orders gate (kernel.lazy_orders_decide): tainted presence in
+            # the DECIDED snapshot (dry-mode view included) — when no node is
+            # tainted and no group scales down, no ordering window is ever
+            # read, and the decide skips its dominant [N]-lane sort
+            tainted_any = bool(
+                (np.asarray(nodes.valid) & np.asarray(nodes.tainted)).any())
             # Packing-aware groups: gather their pod/bin lanes from the same
             # locked snapshot; the device FFD runs after decide, outside the lock
             packing_rows = self._gather_packing_inputs(group_inputs, pods, nodes)
@@ -196,12 +203,25 @@ class NativeJaxBackend(ComputeBackend):
         self._overridden_slots = overridden
         t1 = time.perf_counter()
         # blocks on the result itself: an async device failure must surface
-        # inside the resilient wrapper, not here
-        out = self._decide_resilient(np.int64(now_sec))
+        # inside the resilient wrapper, not here. The lazy protocol sorts
+        # only when an ordering has a consumer; imported from the real kernel
+        # module (not self._kernel, which tests stub at the decide_jit seam —
+        # the protocol is pure host logic, the stub still intercepts every
+        # dispatch inside _decide_resilient)
+        from escalator_tpu.ops.kernel import lazy_orders_decide
+
+        # a drain-start tick dispatches twice; the pallas cool-off counter
+        # must still advance once per TICK (see _decide_resilient)
+        self._dispatches_this_tick = 0
+        out, ordered = lazy_orders_decide(
+            lambda w: self._decide_resilient(np.int64(now_sec), with_orders=w),
+            tainted_any,
+        )
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = self._unpack(out, group_inputs, unpack_group, unpack_cordoned)
+        results = self._unpack(out, group_inputs, unpack_group, unpack_cordoned,
+                               ordered=ordered)
         if packing_rows:
             sel = set(PackingPostPass.select(results, group_inputs))
             self._packing.apply_arrays(
@@ -209,7 +229,7 @@ class NativeJaxBackend(ComputeBackend):
             )
         return results
 
-    def _decide_resilient(self, now_sec):
+    def _decide_resilient(self, now_sec, with_orders: bool = True):
         """Run the decide with the native tick's impl selection (pallas on
         TPU — the churned slot-reused layout is where the sorted MXU sweep
         measured 1.57x faster than XLA scatter; ops.kernel.native_tick_impl),
@@ -228,6 +248,10 @@ class NativeJaxBackend(ComputeBackend):
 
         native = native_tick_impl(self._cache.device.platform)
         impl = self._impl_fallback or native
+        # a lazy-orders drain-start tick calls this twice (light + ordered);
+        # the cool-off is documented in TICKS, so only the tick's first
+        # dispatch advances it
+        self._dispatches_this_tick += 1
         if (
             self._impl_fallback is not None
             and self._pallas_failures == 1
@@ -236,7 +260,8 @@ class NativeJaxBackend(ComputeBackend):
             # degraded by a single failure: retry the native choice once
             # after a cool-off (the failure may have been transient — host
             # OOM, one-off transfer error — not the Pallas program itself)
-            self._ticks_since_fallback += 1
+            if self._dispatches_this_tick == 1:
+                self._ticks_since_fallback += 1
             if self._ticks_since_fallback >= self._PALLAS_RETRY_AFTER:
                 impl = native
         # misconfiguration stays fail-fast (same ValueError every backend
@@ -249,7 +274,8 @@ class NativeJaxBackend(ComputeBackend):
             # side Pallas failure surfaces at block_until_ready, and it must
             # surface inside this try for the fallback to catch it
             out = jax.block_until_ready(self._kernel.decide_jit(
-                self._cache.cluster, now_sec, impl=impl))
+                self._cache.cluster, now_sec, impl=impl,
+                with_orders=with_orders))
             if impl == native and self._impl_fallback is not None:
                 # the retry succeeded: the failure was transient, lift the
                 # fallback. _pallas_failures is a LIFETIME count, deliberately
@@ -277,7 +303,8 @@ class NativeJaxBackend(ComputeBackend):
             )
             self._impl_fallback = "xla"
             return jax.block_until_ready(self._kernel.decide_jit(
-                self._cache.cluster, now_sec, impl="xla"))
+                self._cache.cluster, now_sec, impl="xla",
+                with_orders=with_orders))
 
     def _gather_packing_inputs(self, group_inputs, pods, nodes):
         """[(gi, pod_cpu, pod_mem, bin_cpu, bin_mem, template, budget)] for
@@ -314,8 +341,16 @@ class NativeJaxBackend(ComputeBackend):
         return rows
 
     def _unpack(self, out, group_inputs, node_group: np.ndarray,
-                cordoned_mask: np.ndarray) -> List[GroupDecision]:
-        """Slot-order-agnostic unpack: node indices resolve through the bridge."""
+                cordoned_mask: np.ndarray,
+                ordered: bool = True) -> List[GroupDecision]:
+        """Slot-order-agnostic unpack: node indices resolve through the bridge.
+
+        ordered=False means the decide ran WITHOUT the ordering sort
+        (lazy-orders light path): the order fields are placeholders, and by
+        the protocol's gate no consumer exists — no tainted nodes (untaint
+        and reap windows empty) and no negative delta (scale-down windows
+        unread). Candidate lists stay empty rather than materializing
+        windows of an unordered permutation."""
         status = np.asarray(out.status)
         delta = np.asarray(out.nodes_delta)
         cpu_pct = np.asarray(out.cpu_percent)
@@ -381,11 +416,11 @@ class NativeJaxBackend(ComputeBackend):
                 down_pairs = [
                     (int(i), node_at(int(i)))
                     for i in down[u_off[gi] : u_off[gi + 1]]
-                ]
+                ] if ordered else []
                 up_pairs = [
                     (int(i), node_at(int(i)))
                     for i in up[t_off[gi] : t_off[gi + 1]]
-                ]
+                ] if ordered else []
                 results.append(
                     GroupDecision(
                         decision=decision,

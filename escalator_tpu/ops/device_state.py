@@ -180,10 +180,11 @@ def _scatter_update_from_packed(pods, nodes, groups, pod_buf, node_buf,
     )
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("impl",))
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("impl", "with_orders"))
 def _scatter_update_decide(
     pods, nodes, groups, pod_idx, pod_vals, node_idx, node_vals, now_sec,
-    impl="xla",
+    impl="xla", with_orders=True,
 ):
     """Fused tick: scatter this tick's deltas AND run the decision kernel in ONE
     device program. Measured on the v5e tunnel this is NOT faster than the
@@ -194,7 +195,8 @@ def _scatter_update_decide(
     cluster = _scatter_body(
         pods, nodes, groups, pod_idx, pod_vals, node_idx, node_vals
     )
-    return cluster, _kernel.decide(cluster, now_sec, impl=impl)
+    return cluster, _kernel.decide(cluster, now_sec, impl=impl,
+                                   with_orders=with_orders)
 
 
 class DeviceClusterCache:
@@ -333,16 +335,20 @@ class DeviceClusterCache:
         now_sec,
         groups: Optional[GroupArrays] = None,
         impl: str = "xla",
+        with_orders: bool = True,
     ):
         """Fused per-tick path: scatter the dirty lanes and run the decision
         kernel in one device dispatch. Returns the DecisionArrays; the updated
-        cluster stays resident (``self.cluster``)."""
+        cluster stays resident (``self.cluster``). ``with_orders=False`` is
+        the lazy-orders light program (kernel.decide docstring) so the fused
+        variant prices the same steady-state tick as the two-call path."""
         if groups is None:
             groups = self._cluster.groups
         pidx, pvals, nidx, nvals = self._gather_deltas(pod_slots, node_slots)
         self._cluster, out = _scatter_update_decide(
             self._cluster.pods, self._cluster.nodes, groups,
             pidx, pvals, nidx, nvals, jnp.int64(now_sec), impl=impl,
+            with_orders=with_orders,
         )
         return out
 

@@ -36,6 +36,7 @@ ensure_x64()
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import tree_util
 
 from escalator_tpu.core.arrays import NO_TAINT_TIME, ClusterArrays, GroupArrays, NodeArrays, PodArrays
@@ -230,6 +231,7 @@ def decide(
     now_sec: jnp.ndarray,
     impl: str = "xla",
     aggregates=None,
+    with_orders: bool = True,
 ) -> DecisionArrays:
     """Evaluate every nodegroup's scale decision. Pure; shapes static; jit-safe.
 
@@ -242,7 +244,19 @@ def decide(
     aggregates optionally injects precomputed (pod_aggs, node_aggs) from
     :func:`aggregate_pods`/:func:`aggregate_nodes` — used by the pod-axis
     sharded path, which psums shard-partial sums into exactly these values.
-    """
+
+    with_orders=False (static) skips the combined node-ordering sort — the
+    decide tail's dominant cost (~12 ms per 50k-node sort on the CPU
+    fallback) — and returns input-order permutations in the two order
+    fields, which are then NOT the documented selection orders. Every other
+    field is bit-identical to the with_orders=True program. This is the
+    light half of the lazy-orders tick protocol (:func:`lazy_orders_decide`):
+    the reference only ever sorts inside an executor that consumes the
+    order (taintOldestN, pkg/controller/scale_down.go:171; untaintNewestN,
+    scale_up.go:118), so a tick that taints/untaints/reaps nothing never
+    pays for ordering. Public callers keep the default; the sharded
+    deciders always order (their windows are part of the bit-parity
+    contract)."""
     if impl not in ("xla", "pallas"):
         raise ValueError(f"unknown aggregation impl {impl!r}")
     g: GroupArrays = cluster.groups
@@ -447,13 +461,6 @@ def decide(
             (major, k1, k2, iota), num_keys=4, is_stable=False
         )[-1].astype(_I32)
 
-    untaint_order = jax.lax.cond(
-        jnp.any(untainted_sel | tainted_sel),
-        _combined_order,
-        lambda _: trivial_order,
-        None,
-    )
-
     def offsets(sel):
         counts = _segsum(sel.astype(_I64), ngroup, G)
         return jnp.concatenate(
@@ -462,9 +469,20 @@ def decide(
 
     untainted_offsets = offsets(untainted_sel)
     tainted_offsets = offsets(tainted_sel)
-    # untainted block starts right after the tainted block in the combined
-    # permutation; the roll is an O(N) gather, ~free next to the sort
-    scale_down_order = jnp.roll(untaint_order, -tainted_offsets[G])
+    if with_orders:
+        untaint_order = jax.lax.cond(
+            jnp.any(untainted_sel | tainted_sel),
+            _combined_order,
+            lambda _: trivial_order,
+            None,
+        )
+        # untainted block starts right after the tainted block in the
+        # combined permutation; the roll is an O(N) gather, ~free next to
+        # the sort
+        scale_down_order = jnp.roll(untaint_order, -tainted_offsets[G])
+    else:
+        untaint_order = trivial_order
+        scale_down_order = trivial_order
 
     # ---- reaper eligibility (pkg/controller/scale_down.go:51-99) ----
     node_pods_remaining = node_pods_remaining64.astype(_I32)
@@ -501,11 +519,11 @@ def decide(
     )
 
 
-_decide_jit_raw = jax.jit(decide, static_argnames=("impl",))
+_decide_jit_raw = jax.jit(decide, static_argnames=("impl", "with_orders"))
 
 
 def decide_jit(cluster: ClusterArrays, now_sec, impl: str = "xla",
-               aggregates=None):
+               aggregates=None, with_orders: bool = True):
     """Jitted entry point; backend chosen by JAX (TPU when present, else CPU)
     — the CPU fallback is the same traced program, keeping parity guarantees
     cheap (SURVEY.md §7). Signature mirrors :func:`decide`.
@@ -522,4 +540,31 @@ def decide_jit(cluster: ClusterArrays, now_sec, impl: str = "xla",
     from escalator_tpu.jaxconfig import ensure_responsive_accelerator
 
     ensure_responsive_accelerator()
-    return _decide_jit_raw(cluster, now_sec, impl=impl, aggregates=aggregates)
+    return _decide_jit_raw(cluster, now_sec, impl=impl, aggregates=aggregates,
+                           with_orders=with_orders)
+
+
+def lazy_orders_decide(dispatch, tainted_any: bool):
+    """The lazy-orders tick protocol: pay the node-ordering sort only when a
+    consumer exists, mirroring the reference, which sorts exclusively inside
+    the executors that read an order (taintOldestN scale_down.go:171,
+    untaintNewestN scale_up.go:118) and therefore never sorts on a
+    steady-state tick.
+
+    ``dispatch(with_orders: bool) -> DecisionArrays`` runs one (blocking)
+    decide — callers wrap their own resilience/timing around it. Orders are
+    needed exactly when (a) tainted nodes exist (untaint executor + reaper
+    both walk the tainted windows — the caller knows this pre-dispatch from
+    its host-side state snapshot), or (b) some group decided to scale down
+    (the taint executor walks the untainted windows — known only post-
+    dispatch from nodes_delta, so that case re-dispatches WITH orders: two
+    device round-trips on the tick a drain begins, zero sorts on every
+    healthy tick). Returns ``(out, ordered)``; when ``ordered`` is False the
+    two order fields are input-order placeholders and no window may be read.
+    """
+    if tainted_any:
+        return dispatch(True), True
+    out = dispatch(False)
+    if bool((np.asarray(out.nodes_delta) < 0).any()):
+        return dispatch(True), True
+    return out, False

@@ -317,3 +317,93 @@ def test_make_backend_probes_accelerator(monkeypatch):
     with pytest.raises(ValueError):
         bmod.make_backend("not-a-backend")
     assert probed == [True]  # unknown kinds fail fast before probing
+
+
+NON_ORDER_FIELDS = (
+    "status nodes_delta cpu_percent mem_percent cpu_request_milli "
+    "mem_request_bytes cpu_capacity_milli mem_capacity_bytes num_pods "
+    "num_nodes num_untainted num_tainted num_cordoned untainted_offsets "
+    "tainted_offsets reap_mask node_pods_remaining"
+).split()
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_light_decide_matches_full_on_non_order_fields(seed):
+    """with_orders=False (the lazy-orders light program) must bit-match the
+    full decide on every field EXCEPT the two order permutations — the
+    contract kernel.lazy_orders_decide and the native backend's healthy-tick
+    fast path rely on."""
+    rng = random.Random(seed)
+    groups = [random_group(rng, gi) for gi in range(16)]
+    cluster = pack_cluster(groups, pad_pods=1024, pad_nodes=512)
+    full = kernel.decide_jit(cluster, np.int64(NOW))
+    light = kernel.decide_jit(cluster, np.int64(NOW), with_orders=False)
+    for field in NON_ORDER_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(light, field)), np.asarray(getattr(full, field)),
+            err_msg=f"light-vs-full mismatch on {field}",
+        )
+
+
+def test_lazy_orders_decide_protocol():
+    """The gate: tainted state sorts up front; a negative delta re-dispatches
+    with orders; a healthy steady-state tick never sorts."""
+    calls = []
+
+    def make_dispatch(cluster):
+        def dispatch(with_orders):
+            calls.append(with_orders)
+            return kernel.decide_jit(cluster, np.int64(NOW),
+                                     with_orders=with_orders)
+        return dispatch
+
+    # tainted present -> one ordered dispatch, no light attempt
+    rng = random.Random(7)
+    groups = [random_group(rng, gi) for gi in range(8)]
+    cluster = pack_cluster(groups)
+    tainted_exists = bool(
+        (np.asarray(cluster.nodes.valid)
+         & np.asarray(cluster.nodes.tainted)).any())
+    assert tainted_exists, "seed must produce tainted nodes"
+    out, ordered = kernel.lazy_orders_decide(make_dispatch(cluster), True)
+    assert ordered and calls == [True]
+
+    # healthy low-usage group -> delta < 0 -> light then ordered re-dispatch
+    calls.clear()
+    opts = PodOpts(cpu=[100], mem=[10**8])
+    pods = [build_test_pod(opts)]
+    nodes = [
+        build_test_node(NodeOpts(name=f"h{i}", cpu=4000, mem=16 * 10**9))
+        for i in range(6)
+    ]
+    cfg = sem.GroupConfig(
+        min_nodes=1, max_nodes=30, taint_lower_percent=30,
+        taint_upper_percent=45, scale_up_percent=70, slow_removal_rate=1,
+        fast_removal_rate=2, soft_delete_grace_sec=300,
+        hard_delete_grace_sec=900,
+    )
+    drain = pack_cluster([(pods, nodes, cfg, sem.GroupState())])
+    out, ordered = kernel.lazy_orders_decide(make_dispatch(drain), False)
+    assert ordered and calls == [False, True]
+    assert int(np.asarray(out.nodes_delta)[0]) < 0
+    # the re-dispatched result carries REAL orders: the untainted window is
+    # the golden oldest-first victim order
+    u_off = np.asarray(out.untainted_offsets)
+    down = np.asarray(out.scale_down_order)[u_off[0]:u_off[1]]
+    golden = sem.nodes_oldest_first(nodes)
+    assert [nodes[i].name for i in golden] == [
+        nodes[i].name for i in down
+    ]
+
+    # steady-state (delta 0, no tainted) -> one light dispatch, no sort
+    calls.clear()
+    from escalator_tpu.testsupport.builders import build_test_pods
+
+    # 12 pods x 500m = 6000m on 3 nodes x 4000m = 50% — inside the
+    # (taint_upper 45, scale_up 70) no-action band
+    balanced_pods = build_test_pods(12, PodOpts(cpu=[500], mem=[10**9]))
+    balanced = pack_cluster(
+        [(balanced_pods, nodes[:3], cfg, sem.GroupState())])
+    out, ordered = kernel.lazy_orders_decide(make_dispatch(balanced), False)
+    assert not ordered and calls == [False]
+    assert int(np.asarray(out.nodes_delta)[0]) == 0
