@@ -8,8 +8,15 @@
 test:
 	python -m pytest tests/ -x -q
 
+# CI installs ruff (see .github/workflows/ci.yml); on a rig without it,
+# degrade to a syntax sweep so `make lint` still catches E9-class breakage
 lint:
-	ruff check escalator_tpu tests bench.py
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check escalator_tpu tests bench.py; \
+	else \
+	  echo "ruff not installed (CI runs the full check); syntax sweep only"; \
+	  python -m compileall -q escalator_tpu tests bench.py; \
+	fi
 
 typecheck:
 	mypy escalator_tpu
