@@ -594,3 +594,66 @@ def test_native_backend_misconfigured_impl_fails_fast(monkeypatch):
     cfg = make_opts().to_group_config()
     with pytest.raises(ValueError, match="unknown aggregation impl"):
         backend.decide([(pods, nodes, cfg, sem.GroupState())], now_sec=0)
+
+
+def test_native_backend_lazy_dispatch_lifecycle():
+    """The lazy-orders protocol's tick behavior through a drain lifecycle:
+    a steady tick dispatches ONCE without orders; the tick a drain begins
+    dispatches twice (light, then ordered once the negative delta shows);
+    every tick after that — tainted nodes present — dispatches once,
+    ordered. Locks the dispatch economics the protocol exists for
+    (kernel.lazy_orders_decide; docs/performance.md 'Lazy-orders tick')."""
+    dispatches = []
+
+    def observing(world):
+        backend = world.controller.backend
+        real = backend._decide_resilient
+
+        def spy(now_sec, with_orders=True):
+            dispatches.append(with_orders)
+            return real(now_sec, with_orders=with_orders)
+
+        backend._decide_resilient = spy
+
+    # steady world: 13 pods x 500m on 3 x 4000m = 54% cpu, inside the
+    # (45, 70) no-action band -> one light dispatch per tick
+    nodes = build_test_nodes(3, NodeOpts(cpu=4000, mem=16 * 10**9))
+    pods = build_test_pods(13, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=1), nodes=nodes, pods=pods,
+              backend=make_native_backend)
+    observing(w)
+    w.tick()
+    w.tick()
+    assert dispatches == [False, False], dispatches
+
+    # drain world: 2 pods x 100m on 3 nodes = 6.7% -> fast scale-down.
+    # Tick 1 discovers the negative delta on the light dispatch and
+    # re-dispatches ordered; its executor taints nodes, so tick 2 goes
+    # straight to ONE ordered dispatch.
+    dispatches.clear()
+    nodes = build_test_nodes(3, NodeOpts(cpu=4000, mem=16 * 10**9))
+    pods = build_test_pods(2, PodOpts(
+        cpu=[100], mem=[10**8],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=1), nodes=nodes, pods=pods,
+              backend=make_native_backend)
+    observing(w)
+    w.tick()
+    assert dispatches == [False, True], dispatches
+    w.tick()
+    assert dispatches == [False, True, True], dispatches
+
+    # overload world: 100% > scale_up 70 -> positive delta, no tainted ->
+    # the light dispatch suffices (untaint has nothing to walk)
+    dispatches.clear()
+    nodes = build_test_nodes(2, NodeOpts(cpu=4000, mem=16 * 10**9))
+    pods = build_test_pods(16, PodOpts(
+        cpu=[500], mem=[10**9],
+        node_selector_key=LABEL_KEY, node_selector_value=LABEL_VALUE))
+    w = World(make_opts(min_nodes=1), nodes=nodes, pods=pods,
+              backend=make_native_backend)
+    observing(w)
+    w.tick()
+    assert dispatches == [False], dispatches

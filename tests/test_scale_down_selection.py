@@ -8,6 +8,7 @@ must stay bit-identical to the reference order even in mixed-mode batches.
 """
 
 import numpy as np
+import pytest
 
 from escalator_tpu.core import semantics as sem
 from escalator_tpu.core.arrays import pack_cluster
@@ -257,12 +258,18 @@ class TestEmptySelectionWindows:
         assert up == sem.nodes_newest_first(nodes)
 
 
-def test_decide_compiles_to_one_sort():
+@pytest.mark.parametrize("with_orders,want_sorts", [(True, 1), (False, 0)],
+                         ids=["ordered", "light"])
+def test_decide_sort_count_by_variant(with_orders, want_sorts):
     """Structural lock, platform-independent (the TPU-trace twin lives in
-    test_trace_artifact.py): the compiled decide module must contain exactly
-    ONE sort instruction — the combined 4-key ordering sort. A second sort
-    appearing means the orderings split back into per-selection sorts (2x the
-    dominant tail cost) or an argsort chain crept in."""
+    test_trace_artifact.py): the ordered decide must contain exactly ONE
+    sort instruction — the combined 4-key ordering sort (a second means the
+    orderings split back into per-selection sorts, 2x the dominant tail
+    cost) — and the with_orders=False light program (the lazy-orders fast
+    path, kernel.lazy_orders_decide) must contain ZERO, or the steady-state
+    win is silently forfeited. Counted on the pre-optimization StableHLO:
+    backend passes may legitimately split a sort, so the compiled module's
+    count is NOT platform-stable — the traced program's is."""
     import re
 
     import jax
@@ -270,9 +277,10 @@ def test_decide_compiles_to_one_sort():
     from tests.test_podaxis import _random_cluster
 
     cluster = _random_cluster(np.random.default_rng(0), G=8, P=256, N=64)
-    # pre-optimization StableHLO: backend passes may legitimately split a
-    # sort, so the compiled module's count is NOT platform-stable — the
-    # traced program's is
-    txt = jax.jit(lambda c, t: kernel.decide(c, t)).lower(cluster, NOW).as_text()
+    txt = jax.jit(
+        lambda c, t: kernel.decide(c, t, with_orders=with_orders)
+    ).lower(cluster, NOW).as_text()
     insts = re.findall(r"stablehlo\.sort", txt)
-    assert len(insts) == 1, f"expected one stablehlo.sort, got {len(insts)}"
+    assert len(insts) == want_sorts, (
+        f"with_orders={with_orders}: expected {want_sorts} stablehlo.sort, "
+        f"got {len(insts)}")
