@@ -153,8 +153,16 @@ class PaddedPacker:
         )
 
 
-def _unpack(out, group_inputs) -> List[GroupDecision]:
-    """Shared kernel-output -> GroupDecision conversion for array backends."""
+def _unpack(out, group_inputs, ordered: bool = True) -> List[GroupDecision]:
+    """Shared kernel-output -> GroupDecision conversion for array backends.
+
+    ordered=False means the decide ran the lazy-orders light program
+    (kernel.decide with_orders=False): the order permutations are
+    placeholders, and by the protocol's gate no consumer exists — no tainted
+    nodes and no negative delta — so the candidate lists stay empty instead
+    of materializing windows of an unordered permutation. reap_nodes and
+    node_pods_remaining come from flat (non-order) outputs and stay exact
+    either way."""
     status = np.asarray(out.status)
     delta = np.asarray(out.nodes_delta)
     cpu_pct = np.asarray(out.cpu_percent)
@@ -168,10 +176,14 @@ def _unpack(out, group_inputs) -> List[GroupDecision]:
     n_crd = np.asarray(out.num_cordoned)
     n_all = np.asarray(out.num_nodes)
     n_pods = np.asarray(out.num_pods)
-    down = np.asarray(out.scale_down_order)
-    up = np.asarray(out.untaint_order)
-    u_off = np.asarray(out.untainted_offsets)
-    t_off = np.asarray(out.tainted_offsets)
+    if ordered:
+        # device->host copies of the [pad_nodes] order arrays only when the
+        # windows will actually be read — on the light path these are
+        # placeholder permutations and the transfer would be pure waste
+        down = np.asarray(out.scale_down_order)
+        up = np.asarray(out.untaint_order)
+        u_off = np.asarray(out.untainted_offsets)
+        t_off = np.asarray(out.tainted_offsets)
     reap = np.asarray(out.reap_mask)
     remaining = np.asarray(out.node_pods_remaining)
 
@@ -197,8 +209,12 @@ def _unpack(out, group_inputs) -> List[GroupDecision]:
             num_nodes=int(n_all[gi]),
             num_pods=int(n_pods[gi]),
         )
-        down_nodes = [flat_nodes[i] for i in down[u_off[gi] : u_off[gi + 1]]]
-        up_nodes = [flat_nodes[i] for i in up[t_off[gi] : t_off[gi + 1]]]
+        down_nodes = [
+            flat_nodes[i] for i in down[u_off[gi] : u_off[gi + 1]]
+        ] if ordered else []
+        up_nodes = [
+            flat_nodes[i] for i in up[t_off[gi] : t_off[gi + 1]]
+        ] if ordered else []
         results.append(
             GroupDecision(
                 decision=decision,
@@ -378,17 +394,27 @@ class JaxBackend(ComputeBackend):
         self._packing = PackingPostPass()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        import jax
+
         t0 = time.perf_counter()
         cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
         t1 = time.perf_counter()
-        out = self._kernel.decide_jit(cluster, np.int64(now_sec), impl=self._impl)
-        import jax
-
-        jax.block_until_ready(out)
+        # lazy-orders protocol (kernel.lazy_orders_decide): the packed node
+        # columns already carry the dry-mode taint view, so the gate reads
+        # the decided snapshot. Same economics as the native backend: no
+        # node-ordering sort on steady ticks.
+        tainted_any = bool(
+            (np.asarray(cluster.nodes.valid)
+             & np.asarray(cluster.nodes.tainted)).any())
+        out, ordered = self._kernel.lazy_orders_decide(
+            lambda w: jax.block_until_ready(self._kernel.decide_jit(
+                cluster, np.int64(now_sec), impl=self._impl, with_orders=w)),
+            tainted_any,
+        )
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = _unpack(out, group_inputs)
+        results = _unpack(out, group_inputs, ordered=ordered)
         self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
         return results
 
