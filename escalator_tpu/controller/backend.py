@@ -379,6 +379,21 @@ class PackingPostPass:
         metrics.solver_packing_latency.observe(time.perf_counter() - t0)
 
 
+def _lazy_decide(nodes, dispatch):
+    """The lazy-orders gate shared by every array backend
+    (kernel.lazy_orders_decide): ``nodes`` is the packed/stacked host-side
+    node section carrying the dry-mode taint view — the decided snapshot —
+    and ``dispatch(with_orders) -> DecisionArrays`` runs one blocking decide
+    on whichever program variant the caller owns. Returns ``(out, ordered)``
+    for :func:`_unpack`. One implementation so the gate condition can never
+    drift between backends."""
+    from escalator_tpu.ops.kernel import lazy_orders_decide
+
+    tainted_any = bool(
+        (np.asarray(nodes.valid) & np.asarray(nodes.tainted)).any())
+    return lazy_orders_decide(dispatch, tainted_any)
+
+
 class JaxBackend(ComputeBackend):
     """Single-device (or data-parallel-free) batched kernel. The jit cache is keyed
     on padded shapes; capacities grow by powers of two."""
@@ -399,17 +414,12 @@ class JaxBackend(ComputeBackend):
         t0 = time.perf_counter()
         cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
         t1 = time.perf_counter()
-        # lazy-orders protocol (kernel.lazy_orders_decide): the packed node
-        # columns already carry the dry-mode taint view, so the gate reads
-        # the decided snapshot. Same economics as the native backend: no
-        # node-ordering sort on steady ticks.
-        tainted_any = bool(
-            (np.asarray(cluster.nodes.valid)
-             & np.asarray(cluster.nodes.tainted)).any())
-        out, ordered = self._kernel.lazy_orders_decide(
+        # lazy-orders protocol: same economics as the native backend — no
+        # node-ordering sort on steady ticks (gate shared via _lazy_decide)
+        out, ordered = _lazy_decide(
+            cluster.nodes,
             lambda w: jax.block_until_ready(self._kernel.decide_jit(
                 cluster, np.int64(now_sec), impl=self._impl, with_orders=w)),
-            tainted_any,
         )
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
@@ -431,6 +441,8 @@ class ShardedJaxBackend(ComputeBackend):
         self._mesh = mesh if mesh is not None else meshlib.make_mesh()
         self._init_common(impl)
         self._decider = meshlib.make_sharded_decider(self._mesh, impl=self._impl)
+        self._decider_light = meshlib.make_sharded_decider(
+            self._mesh, impl=self._impl, with_orders=False)
         self._num_shards = self._mesh.devices.size
 
     def _init_common(self, impl: Optional[str]) -> None:
@@ -470,8 +482,16 @@ class ShardedJaxBackend(ComputeBackend):
         )
         placed = self._place(sharded)
         t1 = time.perf_counter()
-        out = self._decider(placed, np.int64(now_sec))
-        jax.block_until_ready(out)
+        # lazy-orders protocol across the mesh: under vmap the ordered
+        # variant can never skip its sorts dynamically (cond lowers to
+        # select), so the static light decider is the only sort-free
+        # steady-state path on sharded backends (gate shared: _lazy_decide)
+        out, ordered = _lazy_decide(
+            sharded.nodes,
+            lambda w: jax.block_until_ready(
+                (self._decider if w else self._decider_light)(
+                    placed, np.int64(now_sec))),
+        )
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
@@ -484,7 +504,7 @@ class ShardedJaxBackend(ComputeBackend):
                 aux, [np.asarray(leaf[s]) for leaf in leaves]
             )
             shard_inputs = [group_inputs[gi] for gi in shard_groups]
-            shard_results = _unpack(shard_out, shard_inputs)
+            shard_results = _unpack(shard_out, shard_inputs, ordered=ordered)
             for local, gi in enumerate(shard_groups):
                 results[gi] = shard_results[local]
         # PackingPostPass.select indexes results[gi] by group_inputs position,
@@ -553,6 +573,8 @@ class GridJaxBackend(ShardedJaxBackend):
         self._mesh = mesh
         self._init_common(impl)
         self._decider = gridlib.make_grid_decider(self._mesh, impl=self._impl)
+        self._decider_light = gridlib.make_grid_decider(
+            self._mesh, impl=self._impl, with_orders=False)
         self._num_shards = int(self._mesh.shape[meshlib.GROUP_AXIS])
 
     def _place(self, sharded):
@@ -581,6 +603,8 @@ class PodAxisJaxBackend(ComputeBackend):
         self._mesh = mesh if mesh is not None else meshlib.make_mesh()
         self._impl = impl if impl is not None else _kernel_impl()
         self._decider = podaxis.make_podaxis_decider(self._mesh, impl=self._impl)
+        self._decider_light = podaxis.make_podaxis_decider(
+            self._mesh, impl=self._impl, with_orders=False)
         self._packer = PaddedPacker()
         self._packing = PackingPostPass()
 
@@ -593,12 +617,19 @@ class PodAxisJaxBackend(ComputeBackend):
             self._podaxis.pad_pods_for_mesh(cluster, self._mesh), self._mesh
         )
         t1 = time.perf_counter()
-        out = self._decider(placed, np.int64(now_sec))
-        jax.block_until_ready(out)
+        # lazy-orders protocol: this path's replicated decide tail IS the
+        # node sort (podaxis.py cost model), so the light variant removes
+        # the dominant replicated term on steady ticks (gate: _lazy_decide)
+        out, ordered = _lazy_decide(
+            cluster.nodes,
+            lambda w: jax.block_until_ready(
+                (self._decider if w else self._decider_light)(
+                    placed, np.int64(now_sec))),
+        )
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = _unpack(out, group_inputs)
+        results = _unpack(out, group_inputs, ordered=ordered)
         self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
         return results
 

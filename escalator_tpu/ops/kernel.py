@@ -254,9 +254,11 @@ def decide(
     the reference only ever sorts inside an executor that consumes the
     order (taintOldestN, pkg/controller/scale_down.go:171; untaintNewestN,
     scale_up.go:118), so a tick that taints/untaints/reaps nothing never
-    pays for ordering. Public callers keep the default; the sharded
-    deciders always order (their windows are part of the bit-parity
-    contract)."""
+    pays for ordering. Public callers keep the default; every array backend
+    (native, repack jax, and the sharded three via order-free decider
+    variants) runs the protocol, while the decider factories' ORDERED
+    outputs remain the sharded-vs-single bit-parity contract and the gRPC
+    plugin always ships full orders."""
     if impl not in ("xla", "pallas"):
         raise ValueError(f"unknown aggregation impl {impl!r}")
     g: GroupArrays = cluster.groups

@@ -140,7 +140,8 @@ def place_grid(cluster: ClusterArrays, mesh: Mesh) -> ClusterArrays:
     )
 
 
-def make_grid_decider(mesh: Mesh, impl: Optional[str] = None):
+def make_grid_decider(mesh: Mesh, impl: Optional[str] = None,
+                      with_orders: bool = True):
     """jitted ``(stacked_cluster, now_sec) -> DecisionArrays`` over the 2-D
     grid. Outputs carry the leading shard axis (sharded over ``groups``,
     replicated over ``pods``) — the same contract as
@@ -151,7 +152,10 @@ def make_grid_decider(mesh: Mesh, impl: Optional[str] = None):
 
     ``impl`` follows ESCALATOR_TPU_KERNEL_IMPL when omitted, as everywhere.
     The per-shard pod axis must be a multiple of the ``pods`` mesh axis
-    (:func:`pad_stacked_pods_for_grid`)."""
+    (:func:`pad_stacked_pods_for_grid`). ``with_orders=False`` is the
+    lazy-orders light variant (kernel.decide docstring): the grid's whole
+    reason to exist is sharding the sort-dominated decide tail — the light
+    program removes that tail entirely on steady ticks."""
     if impl is None:
         impl = kernel.default_impl()
 
@@ -177,7 +181,8 @@ def make_grid_decider(mesh: Mesh, impl: Optional[str] = None):
             pod_aggs = (flat[:G], flat[G:2 * G], flat[2 * G:3 * G], flat[3 * G:])
             node_aggs = kernel.aggregate_nodes(c.nodes, G, impl)
             return kernel.decide(
-                c, now_sec, impl=impl, aggregates=(pod_aggs, node_aggs)
+                c, now_sec, impl=impl, aggregates=(pod_aggs, node_aggs),
+                with_orders=with_orders,
             )
 
         return jax.vmap(one_block)(cluster)

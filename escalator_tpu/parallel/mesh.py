@@ -194,13 +194,21 @@ def pack_cluster_sharded(
     return ClusterArrays.tree_unflatten(None, stacked), assignment
 
 
-def make_sharded_decider(mesh: Mesh, impl: Optional[str] = None):
+def make_sharded_decider(mesh: Mesh, impl: Optional[str] = None,
+                         with_orders: bool = True):
     """jitted ``(sharded_cluster, now_sec) -> DecisionArrays`` with the leading shard
     axis partitioned over the mesh (1-D or hybrid). Local blocks may hold several
     shards (vmap'ed); no collectives are emitted — per-group decisions are
     shard-local by construction. ``impl`` selects the aggregation sweep exactly
     as in ``ops.kernel.decide``; when omitted it follows ESCALATOR_TPU_KERNEL_IMPL
-    (ops.kernel.default_impl), so the env switch reaches direct callers too."""
+    (ops.kernel.default_impl), so the env switch reaches direct callers too.
+
+    ``with_orders=False`` builds the lazy-orders LIGHT variant (see
+    ``kernel.decide``): under vmap the ordered program's empty-selection
+    ``cond`` lowers to ``select`` — both branches always run — so a static
+    order-free variant is the only way a sharded steady-state tick skips its
+    node sorts. Ordered outputs (the default) remain the sharded-vs-single
+    bit-parity contract the tests and dryrun assert."""
     from escalator_tpu.ops.kernel import default_impl
 
     if impl is None:
@@ -218,9 +226,10 @@ def make_sharded_decider(mesh: Mesh, impl: Optional[str] = None):
         check_vma=(impl != "pallas"),
     )
     def sharded_decide(cluster: ClusterArrays, now_sec) -> DecisionArrays:
-        return jax.vmap(lambda c, t: decide(c, t, impl=impl), in_axes=(0, None))(
-            cluster, now_sec
-        )
+        return jax.vmap(
+            lambda c, t: decide(c, t, impl=impl, with_orders=with_orders),
+            in_axes=(0, None),
+        )(cluster, now_sec)
 
     return sharded_decide
 

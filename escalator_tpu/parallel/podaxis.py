@@ -146,15 +146,18 @@ def _build_pod_sweep(mesh: Mesh, impl: str, G: int, N: int):
     return pod_sweep
 
 
-def make_podaxis_decider(mesh: Mesh, impl: str | None = None):
+def make_podaxis_decider(mesh: Mesh, impl: str | None = None,
+                         with_orders: bool = True):
     """jitted ``(cluster, now_sec) -> DecisionArrays`` with the O(P) pod sweep
     sharded over the mesh and combined with psum. Bit-identical to
     ``kernel.decide`` on the same cluster (integer partial sums commute).
 
     ``impl`` defaults to ESCALATOR_TPU_KERNEL_IMPL (ops.kernel.default_impl).
     The pod axis length must be a multiple of the mesh size
-    (:func:`pad_pods_for_mesh`).
-    """
+    (:func:`pad_pods_for_mesh`). ``with_orders=False`` is the lazy-orders
+    light variant (kernel.decide docstring) — this path's replicated decide
+    tail IS the node sort, so the light program removes its dominant
+    replicated term entirely on steady ticks."""
     if impl is None:
         impl = kernel.default_impl()
 
@@ -166,7 +169,8 @@ def make_podaxis_decider(mesh: Mesh, impl: str | None = None):
         pod_aggs = pod_sweep(cluster.pods, cluster.nodes.group)
         node_aggs = kernel.aggregate_nodes(cluster.nodes, G, impl)
         return kernel.decide(
-            cluster, now_sec, impl=impl, aggregates=(pod_aggs, node_aggs)
+            cluster, now_sec, impl=impl, aggregates=(pod_aggs, node_aggs),
+            with_orders=with_orders,
         )
 
     return decide_podaxis

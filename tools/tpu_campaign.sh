@@ -7,6 +7,10 @@
 cd "$(dirname "$0")/.." || exit 1
 LOG=TPU_ATTEMPTS.log
 INTERVAL="${TPU_CAMPAIGN_INTERVAL:-300}"
+# traces whose dir name predates this cutoff document a superseded decide
+# program (pre-combined-sort) — keep in sync with COMBINED_SORT_SINCE in
+# tests/test_trace_artifact.py; bump BOTH when the traced program changes
+TRACE_VINTAGE_CUTOFF="trace_20260730T183000Z"
 while true; do
   TS=$(date -u +%FT%TZ)
   # probe in a fresh subprocess: a wedged tunnel hangs even jnp.ones(8), and no
@@ -88,11 +92,10 @@ EOF
           [ -d "$d" ] || continue
           case "$(basename "$d")" in
             *-pallas) ;;
-            *) # vintage gate: traces predating the combined-sort kernel
-               # (cutoff shared with tests/test_trace_artifact.py) document
-               # a superseded program — a fresh window should still capture
-               # the current one; the archived trace stays as evidence
-               if [ "$(basename "$d")" \> "trace_20260730T183000Z" ] && \
+            *) # vintage gate: pre-cutoff traces document a superseded
+               # program — a fresh window should still capture the current
+               # one; the archived trace stays as evidence
+               if [ "$(basename "$d")" \> "$TRACE_VINTAGE_CUTOFF" ] && \
                   ls "$d"/plugins/profile/*/*.trace.json.gz >/dev/null 2>&1; then
                  HAVE_XLA_TRACE=1
                fi ;;
@@ -110,7 +113,7 @@ EOF
           [ -d "$d" ] || continue
           # same vintage gate as the xla guard above: a pre-combined-sort
           # pallas trace documents the superseded two-sort decide too
-          if [ "$(basename "$d")" \> "trace_20260730T183000Z" ] && \
+          if [ "$(basename "$d")" \> "$TRACE_VINTAGE_CUTOFF" ] && \
              ls "$d"/plugins/profile/*/*.trace.json.gz >/dev/null 2>&1; then
             HAVE_PALLAS_TRACE=1
           fi
