@@ -153,16 +153,23 @@ class PaddedPacker:
         )
 
 
-def _unpack(out, group_inputs, ordered: bool = True) -> List[GroupDecision]:
+def _unpack(out, group_inputs, ordered: bool = True,
+            node_masks=None) -> List[GroupDecision]:
     """Shared kernel-output -> GroupDecision conversion for array backends.
 
     ordered=False means the decide ran the lazy-orders light program
     (kernel.decide with_orders=False): the order permutations are
-    placeholders, and by the protocol's gate no consumer exists — no tainted
-    nodes and no negative delta — so the candidate lists stay empty instead
-    of materializing windows of an unordered permutation. reap_nodes and
-    node_pods_remaining come from flat (non-order) outputs and stay exact
-    either way."""
+    placeholders, and by the protocol's gate no ORDERING consumer exists —
+    no tainted nodes and no negative delta. The candidate lists are then
+    populated as UNORDERED membership from ``node_masks`` (the packed
+    ``NodeArrays`` the decide saw, carrying the dry-mode taint view): the
+    controller reads them as membership too — `_calculate_new_node_metrics`
+    falls back to ``untainted + tainted + cordoned`` when an event-driven
+    backend passes no node objects (controller.py:348), and an empty list
+    there logged a spurious "expected new nodes: N actual: 0" after every
+    scale-up (ADVICE r5). Without masks they stay empty (legacy callers).
+    reap_nodes and node_pods_remaining come from flat (non-order) outputs
+    and stay exact either way."""
     status = np.asarray(out.status)
     delta = np.asarray(out.nodes_delta)
     cpu_pct = np.asarray(out.cpu_percent)
@@ -184,6 +191,13 @@ def _unpack(out, group_inputs, ordered: bool = True) -> List[GroupDecision]:
         up = np.asarray(out.untaint_order)
         u_off = np.asarray(out.untainted_offsets)
         t_off = np.asarray(out.tainted_offsets)
+    elif node_masks is not None:
+        # unordered membership from the decided node view (no sort ran)
+        nvalid = np.asarray(node_masks.valid)
+        ntainted = np.asarray(node_masks.tainted)
+        ncordoned = np.asarray(node_masks.cordoned)
+        untainted_mask = nvalid & ~ntainted & ~ncordoned
+        tainted_mask = nvalid & ntainted & ~ncordoned
     reap = np.asarray(out.reap_mask)
     remaining = np.asarray(out.node_pods_remaining)
 
@@ -209,12 +223,15 @@ def _unpack(out, group_inputs, ordered: bool = True) -> List[GroupDecision]:
             num_nodes=int(n_all[gi]),
             num_pods=int(n_pods[gi]),
         )
-        down_nodes = [
-            flat_nodes[i] for i in down[u_off[gi] : u_off[gi + 1]]
-        ] if ordered else []
-        up_nodes = [
-            flat_nodes[i] for i in up[t_off[gi] : t_off[gi + 1]]
-        ] if ordered else []
+        if ordered:
+            down_nodes = [
+                flat_nodes[i] for i in down[u_off[gi] : u_off[gi + 1]]
+            ]
+            up_nodes = [
+                flat_nodes[i] for i in up[t_off[gi] : t_off[gi + 1]]
+            ]
+        else:
+            down_nodes, up_nodes = [], []
         results.append(
             GroupDecision(
                 decision=decision,
@@ -222,6 +239,19 @@ def _unpack(out, group_inputs, ordered: bool = True) -> List[GroupDecision]:
                 untaint_order=up_nodes,
             )
         )
+    if not ordered and node_masks is not None:
+        # membership lists by the packer's contiguous per-group node ranges
+        # (the same layout the reap slicing below relies on)
+        base = 0
+        for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+            idxs = range(base, base + len(nodes))
+            results[gi].scale_down_order = [
+                flat_nodes[i] for i in idxs if untainted_mask[i]
+            ]
+            results[gi].untaint_order = [
+                flat_nodes[i] for i in idxs if tainted_mask[i]
+            ]
+            base += len(nodes)
     # reap + pods-remaining are flat-indexed; slice out each group's node range
     base = 0
     for gi, (pods, nodes, config, state) in enumerate(group_inputs):
@@ -424,7 +454,8 @@ class JaxBackend(ComputeBackend):
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = _unpack(out, group_inputs, ordered=ordered)
+        results = _unpack(out, group_inputs, ordered=ordered,
+                          node_masks=cluster.nodes)
         self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
         return results
 
@@ -499,12 +530,20 @@ class ShardedJaxBackend(ComputeBackend):
         # Reassemble per-shard outputs back to the caller's group order.
         results: List[Optional[GroupDecision]] = [None] * len(group_inputs)
         leaves, aux = out.tree_flatten()
+        nodes_t = type(sharded.nodes)
         for s, shard_groups in enumerate(assignment):
             shard_out = type(out).tree_unflatten(
                 aux, [np.asarray(leaf[s]) for leaf in leaves]
             )
             shard_inputs = [group_inputs[gi] for gi in shard_groups]
-            shard_results = _unpack(shard_out, shard_inputs, ordered=ordered)
+            # mask views are only read on the light path (_unpack ignores
+            # them when ordered); skip building the per-shard SoA otherwise
+            shard_masks = nodes_t(**{
+                f: np.asarray(getattr(sharded.nodes, f))[s]
+                for f in nodes_t.__dataclass_fields__
+            }) if not ordered else None
+            shard_results = _unpack(shard_out, shard_inputs, ordered=ordered,
+                                    node_masks=shard_masks)
             for local, gi in enumerate(shard_groups):
                 results[gi] = shard_results[local]
         # PackingPostPass.select indexes results[gi] by group_inputs position,
@@ -592,14 +631,23 @@ class PodAxisJaxBackend(ComputeBackend):
     host->device traffic is O(cluster), not O(changes). The placement is at
     least split across devices (podaxis.place shards the big pod axis), but
     callers with tiny churn and huge clusters should prefer the native
-    backend; this one targets the few-groups/many-pods decide-bound regime."""
+    backend; this one targets the few-groups/many-pods decide-bound regime.
+
+    Busy ticks (round 6): the ordered decide runs with the GROUP-BLOCK-
+    SHARDED ordering tail (ops.order_tail) — the backend partitions the
+    packed node lanes into per-device blocks each tick (O(N) numpy,
+    high-water padded so the jit cache stays small), so a drain tick's
+    combined sort shards across the mesh instead of replicating on every
+    device (bench cfg8 measured that replication at 218 of 241 ms)."""
 
     name = "podaxis-jax"
 
     def __init__(self, mesh=None, impl: Optional[str] = None):
+        from escalator_tpu.ops import order_tail
         from escalator_tpu.parallel import mesh as meshlib, podaxis
 
         self._podaxis = podaxis
+        self._order_tail = order_tail
         self._mesh = mesh if mesh is not None else meshlib.make_mesh()
         self._impl = impl if impl is not None else _kernel_impl()
         self._decider = podaxis.make_podaxis_decider(self._mesh, impl=self._impl)
@@ -607,6 +655,18 @@ class PodAxisJaxBackend(ComputeBackend):
             self._mesh, impl=self._impl, with_orders=False)
         self._packer = PaddedPacker()
         self._packing = PackingPostPass()
+        self._block_pad = 0
+
+    def _node_blocks(self, cluster):
+        """Per-tick contiguous-group block map for the sharded ordering tail,
+        high-water padded (same recompile-avoidance as every other pad)."""
+        blocks = self._order_tail.assign_order_blocks(
+            np.asarray(cluster.nodes.group), np.asarray(cluster.nodes.valid),
+            int(self._mesh.devices.size),
+            num_groups=int(cluster.groups.valid.shape[0]),
+        )
+        self._block_pad = max(self._block_pad, _round_up(blocks.shape[1], 8))
+        return self._order_tail.pad_order_blocks(blocks, self._block_pad)
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
         import jax
@@ -619,17 +679,22 @@ class PodAxisJaxBackend(ComputeBackend):
         t1 = time.perf_counter()
         # lazy-orders protocol: this path's replicated decide tail IS the
         # node sort (podaxis.py cost model), so the light variant removes
-        # the dominant replicated term on steady ticks (gate: _lazy_decide)
+        # the dominant replicated term on steady ticks (gate: _lazy_decide);
+        # a busy tick pays the BLOCK-SHARDED sort, not the replicated one.
+        # The block map is built inside the dispatch, ordered branch only —
+        # steady ticks (the common case) never pay its O(N) host argsort
         out, ordered = _lazy_decide(
             cluster.nodes,
             lambda w: jax.block_until_ready(
-                (self._decider if w else self._decider_light)(
-                    placed, np.int64(now_sec))),
+                self._decider(placed, np.int64(now_sec),
+                              self._node_blocks(cluster))
+                if w else self._decider_light(placed, np.int64(now_sec))),
         )
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = _unpack(out, group_inputs, ordered=ordered)
+        results = _unpack(out, group_inputs, ordered=ordered,
+                          node_masks=cluster.nodes)
         self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
         return results
 

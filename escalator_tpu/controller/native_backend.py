@@ -157,7 +157,13 @@ class NativeJaxBackend(ComputeBackend):
             # assembly must group by the DECIDED state, not whatever a watch
             # thread wrote since).
             unpack_group = np.array(nodes.group)
-            unpack_cordoned = np.array(nodes.valid) & np.array(nodes.cordoned)
+            unpack_valid = np.array(nodes.valid)
+            unpack_tainted_col = np.array(nodes.tainted)
+            unpack_cordoned_col = np.array(nodes.cordoned)
+            unpack_cordoned = unpack_valid & unpack_cordoned_col
+            unpack_untainted = (
+                unpack_valid & ~unpack_tainted_col & ~unpack_cordoned_col
+            )
             # lazy-orders gate (kernel.lazy_orders_decide): tainted presence in
             # the DECIDED snapshot (dry-mode view included) — when no node is
             # tainted and no group scales down, no ordering window is ever
@@ -221,7 +227,7 @@ class NativeJaxBackend(ComputeBackend):
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
         results = self._unpack(out, group_inputs, unpack_group, unpack_cordoned,
-                               ordered=ordered)
+                               ordered=ordered, untainted_mask=unpack_untainted)
         if packing_rows:
             sel = set(PackingPostPass.select(results, group_inputs))
             self._packing.apply_arrays(
@@ -342,15 +348,23 @@ class NativeJaxBackend(ComputeBackend):
 
     def _unpack(self, out, group_inputs, node_group: np.ndarray,
                 cordoned_mask: np.ndarray,
-                ordered: bool = True) -> List[GroupDecision]:
+                ordered: bool = True,
+                untainted_mask: "np.ndarray | None" = None,
+                ) -> List[GroupDecision]:
         """Slot-order-agnostic unpack: node indices resolve through the bridge.
 
         ordered=False means the decide ran WITHOUT the ordering sort
         (lazy-orders light path): the order fields are placeholders, and by
-        the protocol's gate no consumer exists — no tainted nodes (untaint
-        and reap windows empty) and no negative delta (scale-down windows
-        unread). Candidate lists stay empty rather than materializing
-        windows of an unordered permutation."""
+        the protocol's gate no ORDERING consumer exists — no tainted nodes
+        (untaint and reap windows empty) and no negative delta (scale-down
+        windows unread). scale_down_order is still populated as UNORDERED
+        membership from ``untainted_mask`` (the decided snapshot): the
+        controller's registration-lag metric reads the candidate lists as
+        plain membership when this backend passes no node objects
+        (controller.py:348), and leaving them empty logged a spurious
+        "expected new nodes: N actual: 0" after every scale-up (ADVICE r5).
+        untaint_order stays empty — the light gate guarantees no tainted
+        node exists in the decided snapshot."""
         status = np.asarray(out.status)
         delta = np.asarray(out.nodes_delta)
         cpu_pct = np.asarray(out.cpu_percent)
@@ -392,6 +406,12 @@ class NativeJaxBackend(ComputeBackend):
                 cordoned_by_group.setdefault(int(node_group[slot]), []).append(
                     node_at(int(slot))
                 )
+            membership_by_group: Dict[int, list] = {}
+            if not ordered and untainted_mask is not None:
+                for slot in np.nonzero(untainted_mask)[0]:
+                    membership_by_group.setdefault(
+                        int(node_group[slot]), []
+                    ).append((int(slot), node_at(int(slot))))
 
             results = []
             for gi, (pods, nodes, config, state) in enumerate(group_inputs):
@@ -416,7 +436,7 @@ class NativeJaxBackend(ComputeBackend):
                 down_pairs = [
                     (int(i), node_at(int(i)))
                     for i in down[u_off[gi] : u_off[gi + 1]]
-                ] if ordered else []
+                ] if ordered else membership_by_group.get(gi, [])
                 up_pairs = [
                     (int(i), node_at(int(i)))
                     for i in up[t_off[gi] : t_off[gi + 1]]

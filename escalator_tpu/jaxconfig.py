@@ -21,6 +21,32 @@ def ensure_x64() -> None:
     _configured = True
 
 
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` compatibility seam: newer jax exposes it top-level
+    with a ``check_vma`` kwarg; the 0.4.x line ships
+    ``jax.experimental.shard_map.shard_map`` with the same knob named
+    ``check_rep``. Every shard_map in this codebase goes through here so a
+    jax upgrade/downgrade is one function's concern. Usable directly or as
+    ``@partial(shard_map, mesh=..., in_specs=..., out_specs=...)``."""
+    import functools
+
+    import jax
+
+    if f is None:
+        return functools.partial(
+            shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    try:
+        sm = jax.shard_map
+        kw = {"check_vma": check_vma}
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        kw = {"check_rep": check_vma}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 _probe_result = None
 
 

@@ -258,7 +258,11 @@ def decide(
     (native, repack jax, and the sharded three via order-free decider
     variants) runs the protocol, while the decider factories' ORDERED
     outputs remain the sharded-vs-single bit-parity contract and the gRPC
-    plugin always ships full orders."""
+    plugin always ships full orders. One scoped exception: the pod-axis
+    decider's block-sharded busy tail (ops.order_tail) guarantees bit-
+    parity per offset WINDOW — the documented consumer contract — while
+    the unspecified region beyond the windows may differ (its docstring
+    carries the argument)."""
     if impl not in ("xla", "pallas"):
         raise ValueError(f"unknown aggregation impl {impl!r}")
     g: GroupArrays = cluster.groups
@@ -281,10 +285,13 @@ def decide(
     num_tainted = nt64.astype(_I32)
     num_cordoned = nc64.astype(_I32)
 
-    nvalid = n.valid
-    ngroup = jnp.where(nvalid, n.group, 0)
-    untainted_sel = nvalid & ~n.tainted & ~n.cordoned
-    tainted_sel = nvalid & n.tainted & ~n.cordoned
+    # shared selection-classification seam (ops.order_tail) so the pod-axis
+    # block-sharded tail sorts with exactly these masks/keys
+    from escalator_tpu.ops.order_tail import node_selection_masks
+
+    ngroup, untainted_sel, tainted_sel = node_selection_masks(
+        n.valid, n.group, n.tainted, n.cordoned
+    )
 
     # ---- percent usage (pkg/controller/util.go:58-81) ----
     # Memory percent uses MilliValue (= bytes*1000) in the reference; replicate the
@@ -451,17 +458,17 @@ def decide(
     trivial_order = jnp.arange(N, dtype=_I32) + ngroup.astype(_I32) * 0
 
     def _combined_order(_):
-        lane_class = jnp.where(
-            tainted_sel, jnp.int64(0),
-            jnp.where(untainted_sel, jnp.int64(1), jnp.int64(2)),
-        )
-        major = lane_class * jnp.int64(G) + ngroup.astype(_I64)
-        k1 = jnp.where(tainted_sel, -n.creation_ns, victim_primary)
-        k2 = jnp.where(tainted_sel, jnp.int64(0), n.creation_ns)
+        # key construction + the single 4-key sort live in ops.order_tail so
+        # the grid's per-block tail and the pod-axis block-sharded tail run
+        # literally the same ordering program as this replicated one
+        from escalator_tpu.ops.order_tail import combined_order_sort
+
         iota = jax.lax.iota(_I64, N)
-        return jax.lax.sort(
-            (major, k1, k2, iota), num_keys=4, is_stable=False
-        )[-1].astype(_I32)
+        _, perm = combined_order_sort(
+            ngroup, tainted_sel, untainted_sel, victim_primary,
+            n.creation_ns, G, iota,
+        )
+        return perm.astype(_I32)
 
     def offsets(sel):
         counts = _segsum(sel.astype(_I64), ngroup, G)

@@ -1,0 +1,323 @@
+"""The combined node-ordering sort, single-device and group-block-sharded.
+
+Every consumer of a node ordering in this codebase goes through ONE 4-key
+``lax.sort`` (round 5, ops/kernel.py decide): each lane carries a selection-
+class major key — tainted first, untainted second, everything else last — so
+the tainted block sorts (group asc, creation desc) at the front (untaint
+order, reference pkg/controller/sort.go:27-39) and the untainted block sorts
+(group asc, victim-primary, creation asc) right after it (scale-down order,
+sort.go:12-24). :func:`combined_order_sort` is that sort, extracted here so
+the single-device kernel, the grid's per-block tail, and the pod-axis
+sharded tail all run literally the same key construction.
+
+The second half of this module is the **group-block-sharded ordering tail**
+(round 6): ``parallel.podaxis`` replicates its node arrays, so its ordered
+(busy/drain-tick) decide used to pay the full [N] sort once per device —
+bench cfg8 measured that replicated tail at 218 of 241 ms on the 8-virtual-
+device rig (0.23x vs single device; VERDICT r5 weak-point 2). The grid
+backend already had the fix — nodes shard by group block, each device sorts
+only its block — but its layout is baked into the 2-D packer. Here the same
+idea is expressed as a standalone tail any replicated-node decider can call:
+
+- :func:`assign_order_blocks` (host, O(N)) partitions the node lanes into S
+  CONTIGUOUS-GROUP blocks balanced by lane count and returns a ``[S, Nb]``
+  gather map (``-1`` padding);
+- :func:`make_sharded_order_tail` builds the jitted device tail: one
+  ``shard_map`` in which each device gathers its block's lanes, runs the
+  combined sort on ``[Nb]`` lanes (skipped entirely via ``lax.cond`` when
+  the block has no tainted/untainted lane — the all-padding blocks of a
+  single-giant-group cluster), then a cheap replicated O(N) reassembly
+  scatters the per-block class segments back into the global permutation.
+
+Why the reassembly is exact where it matters: the global sort's major key is
+``class * G + group`` and blocks are ascending contiguous group ranges, so
+the global class-c segment is the concatenation, block by block, of each
+block's class-c segment — same keys, same global-lane-index tie-break, so
+the scale-down and untaint WINDOWS (the only contractually ordered regions,
+see kernel.decide) are bit-identical to the single-device sort. The region
+beyond the windows (class-2 lanes: invalid/cordoned) is unspecified contract
+either way and may differ when a selection-free block skips its sort.
+
+Cost model per busy tick, S devices, balanced groups: the replicated
+``sort(N)`` term becomes ``sort(N/S)`` per device (the grid's win, now on
+the pod-axis path); one giant group degenerates to ONE device paying
+``sort(N)`` while the rest skip — on real chips that is the single-device
+tail (not S of them burning energy), and on this repo's 1-core bench rig it
+is the difference between 8x serialized sorts and 1 (bench cfg8 busy rows).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from escalator_tpu.jaxconfig import ensure_x64, shard_map
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+
+def node_selection_masks(valid, group, tainted, cordoned):
+    """The ONE definition of how node lanes classify for ordering/selection:
+    ``(key_group, untainted_sel, tainted_sel)`` with invalid lanes keyed to
+    group 0. kernel.decide and the pod-axis sharded tail both build their
+    sort keys from this, so the selection semantics cannot drift between
+    the replicated and block-sharded ordering programs."""
+    key_group = jnp.where(valid, group, 0)
+    untainted_sel = valid & ~tainted & ~cordoned
+    tainted_sel = valid & tainted & ~cordoned
+    return key_group, untainted_sel, tainted_sel
+
+
+def combined_order_sort(
+    group: jnp.ndarray,          # int [L] group id per lane (invalid lanes -> 0)
+    tainted_sel: jnp.ndarray,    # bool [L]
+    untainted_sel: jnp.ndarray,  # bool [L]
+    victim_primary: jnp.ndarray,  # int64 [L] pods-remaining for emptiest_first, else 0
+    creation_ns: jnp.ndarray,    # int64 [L]
+    num_groups: int,
+    lane_key: jnp.ndarray,       # int64 [L] unique tie-break / payload (global index)
+    pad_mask: Optional[jnp.ndarray] = None,  # bool [L] lanes beyond the real set
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """ONE 4-key ``lax.sort`` producing the combined ordering (see module
+    docstring). Returns ``(sorted_major, sorted_lane_key)``: the lane keys in
+    combined order, plus each lane's major key (``class * G + group``) from
+    which the selection class is recoverable as ``major // G``. ``pad_mask``
+    lanes get class 3 and sink below every real lane (the sharded tail's
+    block padding)."""
+    lane_class = jnp.where(
+        tainted_sel, jnp.int64(0),
+        jnp.where(untainted_sel, jnp.int64(1), jnp.int64(2)),
+    )
+    if pad_mask is not None:
+        lane_class = jnp.where(pad_mask, jnp.int64(3), lane_class)
+    major = lane_class * jnp.int64(num_groups) + group.astype(_I64)
+    k1 = jnp.where(tainted_sel, -creation_ns, victim_primary)
+    k2 = jnp.where(tainted_sel, jnp.int64(0), creation_ns)
+    out = jax.lax.sort((major, k1, k2, lane_key), num_keys=4, is_stable=False)
+    return out[0], out[-1]
+
+
+# ---------------------------------------------------------------------------
+# Host-side block partition
+# ---------------------------------------------------------------------------
+
+
+def assign_order_blocks(
+    node_group: np.ndarray,
+    node_valid: np.ndarray,
+    num_blocks: int,
+    num_groups: Optional[int] = None,
+) -> np.ndarray:
+    """Partition the node lanes into ``num_blocks`` contiguous-group blocks
+    balanced by lane count (host-side, O(N + G) numpy — the pod-axis analog
+    of what ``mesh.pack_cluster_sharded`` does at pack time for the grid).
+
+    Groups are assigned to blocks by cumulative lane count, so every group's
+    lanes land in exactly ONE block and block group-ranges ascend — the
+    property the sharded tail's exact reassembly relies on. Invalid lanes
+    carry key group 0 (exactly as ``kernel.decide``'s ``ngroup`` does) and
+    ride with group 0's block. Returns an int32 ``[num_blocks, Nb]`` global-
+    lane-index map, ``-1`` padded; one giant group yields one full block and
+    ``num_blocks - 1`` all-padding blocks (whose devices skip their sort).
+    """
+    node_group = np.asarray(node_group)
+    node_valid = np.asarray(node_valid)
+    N = int(node_group.shape[0])
+    if num_groups is None:
+        num_groups = int(node_group.max()) + 1 if N else 1
+    key_group = np.where(node_valid, node_group, 0).astype(np.int64)
+    counts = np.bincount(key_group, minlength=num_groups)
+    # contiguous ranges: group g's block = scaled position of its first lane
+    # in the cumulative count (floor keeps blocks ascending and contiguous)
+    before = np.cumsum(counts) - counts
+    block_of_group = np.minimum(
+        before * num_blocks // max(N, 1), num_blocks - 1
+    ).astype(np.int64)
+    lane_block = block_of_group[key_group]
+    order = np.argsort(lane_block, kind="stable")
+    per_block = np.bincount(lane_block, minlength=num_blocks)
+    Nb = max(int(per_block.max()) if N else 0, 1)
+    blocks = np.full((num_blocks, Nb), -1, np.int32)
+    start = 0
+    for b in range(num_blocks):
+        n_b = int(per_block[b])
+        blocks[b, :n_b] = order[start:start + n_b]
+        start += n_b
+    return blocks
+
+
+def pad_order_blocks(blocks: np.ndarray, width: int) -> np.ndarray:
+    """Pad the block map's lane axis to ``width`` (-1 lanes): callers keep a
+    high-water-mark width so the jitted tail's shape set stays small as the
+    cluster's block balance shifts tick to tick."""
+    Nb = blocks.shape[1]
+    if width <= Nb:
+        return blocks
+    return np.pad(blocks, ((0, 0), (0, width - Nb)), constant_values=-1)
+
+
+# ---------------------------------------------------------------------------
+# Device-side sharded tail
+# ---------------------------------------------------------------------------
+
+
+def _leading_spec(mesh: Mesh) -> P:
+    names = tuple(mesh.axis_names)
+    return P(names if len(names) > 1 else names[0])
+
+
+def make_sharded_order_tail(mesh: Mesh):
+    """Build the group-block-sharded ordering tail for ``mesh`` (1-D or
+    hybrid; the block axis spans ALL mesh axes, so S = total devices).
+
+    Returns ``tail(group, tainted_sel, untainted_sel, victim_primary,
+    creation_ns, num_groups, block_index) -> (untaint_order, scale_down_order)``
+    — trace-safe (call under jit). Inputs are the replicated per-node arrays
+    exactly as ``kernel.decide`` computes them; ``block_index`` is the
+    ``[S, Nb]`` host map from :func:`assign_order_blocks`. Outputs are the
+    replicated ``[N]`` int32 permutations with the same window contract as
+    ``kernel.decide``'s (see module docstring for the exactness argument).
+    """
+    spec = _leading_spec(mesh)
+    axis_names = tuple(mesh.axis_names)
+    num_blocks = int(mesh.devices.size)
+
+    def tail(group, tainted_sel, untainted_sel, victim_primary, creation_ns,
+             num_groups: int, block_index):
+        N = int(group.shape[0])
+        G = int(num_groups)
+        S, Nb = block_index.shape
+        if S != num_blocks:
+            raise ValueError(
+                f"block_index has {S} blocks for a {num_blocks}-device mesh"
+            )
+        axis_sizes = [int(mesh.shape[ax]) for ax in axis_names]
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), spec),
+            out_specs=P(),
+            # replicated inputs + a device-varying gather map: the vma
+            # checker cannot see through the in-body psums that restore
+            # replication
+            check_vma=False,
+        )
+        def block_perm(g_all, t_all, u_all, vp_all, cr_all, idx):
+            idx = idx.reshape(-1)                       # [Nb] local block
+            pad = idx < 0
+            safe = jnp.clip(idx, 0, N - 1)
+            t_l = jnp.where(pad, False, t_all[safe])
+            u_l = jnp.where(pad, False, u_all[safe])
+
+            # ---- this block's class counts -> every block's, via one tiny
+            # [S, 3] psum (each device contributes its own row). Classes
+            # need no ordering, so this runs before (and regardless of) the
+            # sort. my_row is the device's position along the block axis.
+            my_row = jnp.int64(0)
+            for ax, size in zip(axis_names, axis_sizes):
+                my_row = my_row * size + jax.lax.axis_index(ax)
+            cls_l = jnp.where(
+                t_l, jnp.int64(0), jnp.where(u_l, jnp.int64(1), jnp.int64(2))
+            )
+            cls_l = jnp.where(pad, jnp.int64(3), cls_l)
+            counts_local = jnp.stack(
+                [jnp.sum((cls_l == c).astype(_I64)) for c in range(3)]
+            )
+            counts_all = jnp.where(
+                (jnp.arange(S, dtype=_I64) == my_row)[:, None],
+                counts_local[None, :], jnp.int64(0),
+            )
+            for ax in reversed(axis_names):
+                counts_all = jax.lax.psum(counts_all, ax)   # [S, 3]
+            class_tot = counts_all.sum(axis=0)
+            class_start = jnp.concatenate(
+                [jnp.zeros(1, _I64), jnp.cumsum(class_tot)]
+            )[:3]
+            before_me = jnp.where(
+                (jnp.arange(S, dtype=_I64) < my_row)[:, None],
+                counts_all, jnp.int64(0),
+            ).sum(axis=0)                                   # [3]
+            starts = class_start + before_me
+
+            def live_block(_):
+                """Gather the block's lanes, order them (sorting only when
+                an ordering window can reference them), and scatter them at
+                their global positions."""
+                g_l = jnp.where(pad, 0, g_all[safe])
+                vp_l = jnp.where(pad, jnp.int64(0), vp_all[safe])
+                cr_l = jnp.where(pad, jnp.int64(0), cr_all[safe])
+                gidx = jnp.where(pad, jnp.int64(-1), idx.astype(_I64))
+
+                def do_sort(_):
+                    return combined_order_sort(
+                        g_l, t_l, u_l, vp_l, cr_l, G, gidx, pad_mask=pad
+                    )
+
+                def skip_sort(_):
+                    # no tainted/untainted lane here: nothing this block
+                    # holds is inside any ordering window, so its class-2
+                    # segment may stay in block order (unspecified region)
+                    major = cls_l * jnp.int64(G) + g_l.astype(_I64)
+                    return major, gidx
+
+                major_s, gidx_s = jax.lax.cond(
+                    jnp.any(t_l | u_l), do_sort, skip_sort, None
+                )
+                cls = jnp.clip(major_s // jnp.int64(max(G, 1)), 0, 3)
+                # rank within this block's class-c sequence; global position
+                # = block segment start + rank; pads scatter off-array
+                rank = jnp.select(
+                    [cls == c for c in range(3)],
+                    [jnp.cumsum((cls == c).astype(_I64)) - 1
+                     for c in range(3)],
+                    jnp.int64(0),
+                )
+                pos = jnp.where(
+                    cls >= 3, jnp.int64(N),
+                    jnp.take(starts, jnp.clip(cls, 0, 2), mode="clip") + rank,
+                )
+                return jnp.zeros(N, _I32).at[pos].set(
+                    gidx_s.astype(_I32), mode="drop"
+                )
+
+            # an all-padding block (a giant-group layout leaves S-1 of them)
+            # contributes nothing: skip its gathers/ranks/scatter entirely.
+            # Collectives stay OUTSIDE both conds — every device runs them.
+            part = jax.lax.cond(
+                jnp.any(~pad), live_block, lambda _: jnp.zeros(N, _I32), None
+            )
+            # blocks write disjoint position sets covering 0..N-1, so ONE
+            # psum assembles the full permutation (and replicates it)
+            for ax in reversed(axis_names):
+                part = jax.lax.psum(part, ax)
+            return part
+
+        perm = block_perm(
+            group, tainted_sel, untainted_sel,
+            victim_primary, creation_ns, block_index,
+        )
+        # tainted block first in the combined permutation (= untaint order);
+        # rolling it to the tail yields scale-down order, as in kernel.decide
+        total_tainted = jnp.sum(tainted_sel.astype(_I64))
+        scale_down = jnp.roll(perm, -total_tainted)
+        return perm, scale_down
+
+    return tail
+
+
+__all__: Sequence[str] = (
+    "combined_order_sort",
+    "assign_order_blocks",
+    "pad_order_blocks",
+    "make_sharded_order_tail",
+)

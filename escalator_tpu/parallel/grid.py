@@ -42,6 +42,14 @@ Reference stakes: the serial O(P) aggregation loop this distributes is
 /root/reference/pkg/k8s/util.go:27-38; the per-group sort the tail shards is
 /root/reference/pkg/controller/sort.go:12-39; the reference runs both on one
 CPU core per cluster with no distribution story at all (SURVEY.md §2.7).
+
+Round 6: the combined-ordering sort this module's per-block tail runs (via
+kernel.decide) was extracted to ``ops.order_tail.combined_order_sort``, and
+the same group-block-sharding idea became a standalone tail
+(``order_tail.make_sharded_order_tail``) that ``parallel.podaxis`` wires
+into its ordered decider — this module and the pod-axis path now consume
+literally the same ordering program, so their window semantics cannot
+drift.
 """
 
 from __future__ import annotations
@@ -51,7 +59,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from escalator_tpu.jaxconfig import ensure_x64, guarded_devices
+from escalator_tpu.jaxconfig import ensure_x64, guarded_devices, shard_map
 
 ensure_x64()
 
@@ -161,7 +169,7 @@ def make_grid_decider(mesh: Mesh, impl: Optional[str] = None,
 
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(_cluster_specs(), P()),
         out_specs=P(GROUP_AXIS),
@@ -200,7 +208,7 @@ def time_grid_phases(mesh: Mesh, cluster: ClusterArrays, _timeit,
 
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(_cluster_specs(), ),
         out_specs=P(GROUP_AXIS),

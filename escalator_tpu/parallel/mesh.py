@@ -23,7 +23,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from escalator_tpu.jaxconfig import ensure_x64, guarded_devices
+from escalator_tpu.jaxconfig import ensure_x64, guarded_devices, shard_map
 
 ensure_x64()
 
@@ -217,7 +217,7 @@ def make_sharded_decider(mesh: Mesh, impl: Optional[str] = None,
 
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec, P()),
         out_specs=spec,
@@ -262,7 +262,7 @@ def make_fleet_decider(mesh: Mesh):
 
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec, P()),
         out_specs=(spec, P()),
@@ -295,7 +295,7 @@ def make_sharded_sweeper(mesh: Mesh, num_candidates: int):
     spec = _group_spec(mesh)
 
     @jax.jit
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec)
     def sharded_sweep(cluster: ClusterArrays):
         return jax.vmap(lambda c: sweep_deltas(c, num_candidates))(cluster)
 

@@ -56,15 +56,28 @@ instead of replicating, which is exactly the loss this module's cost model
 documents (bench cfg8 measured the replicated tail at 165 of 182 ms; the
 grid's 8x1 layout cut it ~7x on the same rig and went 1.46x FASTER than
 single-device where this module's pure pod-axis split ran 0.28x).
+
+ROUND 6 — the busy tick no longer replicates its sort. The ``tail(N)``
+term above had one remaining ordered-path consumer: a busy/drain tick
+needs the combined node-ordering sort, and this module used to run it
+whole on every device. The ordered decider now accepts a per-tick
+``node_blocks`` map (``ops.order_tail.assign_order_blocks``) and runs the
+sort GROUP-BLOCK-SHARDED — each device sorts its own contiguous-group
+block and one psum reassembles the permutation, so the busy-tick cost
+model becomes ``sweep(P)/S + psum + light_tail(N) + sort(N/S_blocks)``;
+a single giant group degenerates to ONE device paying ``sort(N)`` while
+the rest skip via ``lax.cond`` (see ops/order_tail.py for the exactness
+argument and bench cfg8's busy/steady/legacy rows for the measurements).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 import numpy as np
 
-from escalator_tpu.jaxconfig import ensure_x64
+from escalator_tpu.jaxconfig import ensure_x64, shard_map
 
 ensure_x64()
 
@@ -74,7 +87,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from escalator_tpu.core.arrays import ClusterArrays, PodArrays
 from escalator_tpu.ops import device_state as _ds  # noqa: F401  (registers SoA pytrees)
-from escalator_tpu.ops import kernel
+from escalator_tpu.ops import kernel, order_tail
 
 
 def _pod_spec(mesh: Mesh) -> P:
@@ -128,7 +141,7 @@ def _build_pod_sweep(mesh: Mesh, impl: str, G: int, N: int):
     pod_spec = _pod_spec(mesh)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(pod_spec, P()),
         out_specs=P(),
@@ -148,29 +161,66 @@ def _build_pod_sweep(mesh: Mesh, impl: str, G: int, N: int):
 
 def make_podaxis_decider(mesh: Mesh, impl: str | None = None,
                          with_orders: bool = True):
-    """jitted ``(cluster, now_sec) -> DecisionArrays`` with the O(P) pod sweep
-    sharded over the mesh and combined with psum. Bit-identical to
-    ``kernel.decide`` on the same cluster (integer partial sums commute).
+    """jitted ``(cluster, now_sec, node_blocks=None) -> DecisionArrays`` with
+    the O(P) pod sweep sharded over the mesh and combined with psum.
+    Bit-identical to ``kernel.decide`` on the same cluster (integer partial
+    sums commute); when ``node_blocks`` is given, bit-identical on every
+    non-order field and on every ordering WINDOW (the kernel's documented
+    selection contract), while the unspecified region beyond the windows may
+    differ — see ops.order_tail.
 
     ``impl`` defaults to ESCALATOR_TPU_KERNEL_IMPL (ops.kernel.default_impl).
     The pod axis length must be a multiple of the mesh size
     (:func:`pad_pods_for_mesh`). ``with_orders=False`` is the lazy-orders
     light variant (kernel.decide docstring) — this path's replicated decide
     tail IS the node sort, so the light program removes its dominant
-    replicated term entirely on steady ticks."""
+    replicated term entirely on steady ticks.
+
+    ``node_blocks`` (ordered variant only) is the ``[S, Nb]`` contiguous-
+    group block map from ``order_tail.assign_order_blocks``: the busy-tick
+    fix (round 6). With it, the combined ordering sort runs GROUP-BLOCK-
+    SHARDED — each device sorts only its block's ``[Nb]`` lanes (devices
+    whose block holds no selected lane skip the sort entirely) instead of
+    every device replicating the full ``[N]`` sort, which bench cfg8
+    measured at 218 of 241 ms on the 8-virtual-device rig. Without it the
+    legacy replicated ordered program runs (kept for raw callers that want
+    strict full-array bit-parity, e.g. the multichip dryrun)."""
     if impl is None:
         impl = kernel.default_impl()
+    tail = order_tail.make_sharded_order_tail(mesh) if with_orders else None
 
     @jax.jit
-    def decide_podaxis(cluster: ClusterArrays, now_sec) -> kernel.DecisionArrays:
+    def decide_podaxis(cluster: ClusterArrays, now_sec,
+                       node_blocks=None) -> kernel.DecisionArrays:
         G = cluster.groups.valid.shape[0]
         N = cluster.nodes.valid.shape[0]
         pod_sweep = _build_pod_sweep(mesh, impl, G, N)
         pod_aggs = pod_sweep(cluster.pods, cluster.nodes.group)
         node_aggs = kernel.aggregate_nodes(cluster.nodes, G, impl)
-        return kernel.decide(
+        if not with_orders or node_blocks is None:
+            return kernel.decide(
+                cluster, now_sec, impl=impl, aggregates=(pod_aggs, node_aggs),
+                with_orders=with_orders,
+            )
+        # block-sharded ordering: run the LIGHT decide (no replicated sort),
+        # then splice in the sharded tail's permutations
+        out = kernel.decide(
             cluster, now_sec, impl=impl, aggregates=(pod_aggs, node_aggs),
-            with_orders=with_orders,
+            with_orders=False,
+        )
+        n = cluster.nodes
+        ngroup, untainted_sel, tainted_sel = order_tail.node_selection_masks(
+            n.valid, n.group, n.tainted, n.cordoned
+        )
+        victim_primary = jnp.where(
+            cluster.groups.emptiest[ngroup], pod_aggs[3], jnp.int64(0)
+        )
+        untaint_order, scale_down_order = tail(
+            ngroup, tainted_sel, untainted_sel, victim_primary,
+            n.creation_ns, G, node_blocks,
+        )
+        return dataclasses.replace(
+            out, untaint_order=untaint_order, scale_down_order=scale_down_order
         )
 
     return decide_podaxis

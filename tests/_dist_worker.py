@@ -19,6 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+from escalator_tpu.jaxconfig import shard_map  # noqa: E402
 from escalator_tpu.parallel import distributed  # noqa: E402
 from escalator_tpu.parallel.mesh import DCN_AXIS, ICI_AXIS  # noqa: E402
 
@@ -47,7 +48,7 @@ def main() -> None:
     arr = jax.make_array_from_callback((4,), sharding, lambda idx: data[idx])
 
     @jax.jit
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(DCN_AXIS), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=P(DCN_AXIS), out_specs=P())
     def staged_total(x):
         s = jax.numpy.sum(x)
         s = jax.lax.psum(s, ICI_AXIS)  # fast intra-host axis first

@@ -140,6 +140,9 @@ def test_partial_flush_and_salvage_summary(bench, tmp_path, monkeypatch):
     detail["cfg13_native_tick_1Mpods_1pct_churn_ms"] = 2.0
     detail["cfg9_pallas_error"] = "lowering failed"   # NOT a completed section
     detail["cfg12_skipped"] = "grpc unavailable"      # NOT a completed section
+    # a wedge mid-matrix leaves only the in-progress key: NOT a completed
+    # section either (ADVICE r5 — the final key is written only at the end)
+    detail["cfg10_ffd_pack_partial"] = {"rows": {}}
     bench._flush_partial(detail, "FakeDev", degraded=True)
     got = json.loads(partial.read_text())
     assert got["detail"]["cfg6_native_tick_1pct_churn_ms"] == 1.5
@@ -157,6 +160,18 @@ def test_partial_flush_and_salvage_summary(bench, tmp_path, monkeypatch):
     assert not any(r["file"].startswith("TPU_PARTIAL")
                    for r in bench._summarize_tpu_captures()
                    if "file" in r)
+
+
+def test_smoke_mode_parity(bench):
+    """`python bench.py --smoke` (tier-1-safe): the round-6 hot paths — the
+    group-block-sharded ordering tail and both blocked-FFD scan programs —
+    run at tiny shapes with parity asserted inside run_smoke itself."""
+    out = bench.run_smoke()
+    assert out["smoke_cfg8_parity"] == "ok"
+    assert out["smoke_cfg10_parity"] == "ok"
+    # the prepass exercised BOTH scan programs, not one of them twice
+    assert out["smoke_cfg10_replicaset_path"] == "runs"
+    assert out["smoke_cfg10_mixed_path"] == "pods"
 
 
 def test_archived_e2e_filter(bench):

@@ -128,6 +128,74 @@ class TestFFD:
             budget=2,
         )
 
+    def test_identical_pods_take_the_run_path(self):
+        """Many identical pods: the histogram prepass must collapse them to
+        runs (the blocked scan) and stay bit-exact — including the budget
+        cap and the partially-filled-bin boundary inside a run."""
+        pods = [(600, 10**8)] * 17 + [(300, 5 * 10**7)] * 9
+        stats = binpack.pack_compression_stats(
+            np.array([[c for c, _ in pods]], np.int64),
+            np.array([[m for _, m in pods]], np.int64),
+            np.ones((1, len(pods)), bool),
+            np.array([1000], np.int64), np.array([10**9], np.int64),
+        )
+        assert stats["path"] == "runs"
+        self._run_case(
+            pods=pods,
+            bins=[(1000, 10**9), (700, 10**9), (2500, 10**9)],
+            template=(1000, 10**9),
+            budget=3,
+        )
+
+    def test_single_pod_bins(self):
+        """Bins that hold exactly one pod each: every take is 0/1, the run
+        fill must advance bin-by-bin."""
+        self._run_case(
+            pods=[(900, 10**8)] * 6,
+            bins=[(1000, 10**9)] * 4,
+            template=(1000, 10**9),
+            budget=1,
+        )
+
+    def test_zero_request_pods(self):
+        """Zero-request pods fit every valid bin (division-free capacity is
+        unbounded); all must land in the first bin, as the golden model
+        places them."""
+        self._run_case(
+            pods=[(0, 0)] * 5 + [(500, 10**8)],
+            bins=[(1000, 10**9), (400, 10**9)],
+            template=(1000, 10**9),
+            budget=2,
+        )
+
+    def test_values_beyond_trim_range_stay_exact(self):
+        """cpu above the f32-exact bound (2**24) must force the int64 scan
+        program; results still match the golden model bit-for-bit."""
+        big = 1 << 30
+        self._run_case(
+            pods=[(big, 10**8), (big // 2, 10**8), (7, 10**8)],
+            bins=[(big + 5, 10**9)],
+            template=(big, 10**9),
+            budget=2,
+        )
+
+    def test_compression_stats_paths(self):
+        rng = np.random.default_rng(0)
+        G, P = 4, 32
+        pv = np.ones((G, P), bool)
+        tc = np.full(G, 4000, np.int64)
+        tm = np.full(G, 16 * 10**9, np.int64)
+        # distinct-heavy: every pod unique -> per-pod scan
+        pc = (np.arange(G * P, dtype=np.int64).reshape(G, P) + 1) * 7
+        pm = (np.arange(G * P, dtype=np.int64).reshape(G, P) + 1) * 11
+        assert binpack.pack_compression_stats(pc, pm, pv, tc, tm)["path"] == "pods"
+        # one replica shape -> run scan with a tiny step count
+        stats = binpack.pack_compression_stats(
+            np.full((G, P), 500, np.int64), np.full((G, P), 10**9, np.int64),
+            pv, tc, tm,
+        )
+        assert stats["path"] == "runs" and stats["scan_steps"] <= 4
+
     @pytest.mark.parametrize("seed", [0, 1, 2])
     def test_randomized_against_reference(self, seed):
         rng = random.Random(seed)

@@ -1,0 +1,207 @@
+"""Group-block-sharded ordering tail (ops.order_tail): window-exact parity.
+
+The pod-axis decider's busy-tick path (podaxis.make_podaxis_decider with a
+``node_blocks`` map) replaces the replicated full-[N] combined sort with
+per-device block sorts + a psum reassembly. The contract is the kernel's
+documented one: every NON-order field bit-identical to the single-device
+kernel, and both ordering permutations bit-identical INSIDE every per-group
+offset window (the only regions consumers may read; the class-2 region
+beyond the windows is explicitly unspecified — see ops/order_tail.py).
+Adversarial layouts from the round-6 issue: group-interleaved node slots,
+empty groups, all-tainted clusters, a single giant group (S-1 blocks empty,
+the lax.cond skip path), emptiest-first victim keys, and high-water-padded
+block maps.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from escalator_tpu.core.arrays import NO_TAINT_TIME, NodeArrays  # noqa: E402
+from escalator_tpu.ops import kernel, order_tail  # noqa: E402
+from escalator_tpu.parallel import podaxis  # noqa: E402
+from escalator_tpu.parallel.mesh import make_hybrid_mesh, make_mesh  # noqa: E402
+from tests.test_podaxis import ALL_FIELDS, NOW, _random_cluster  # noqa: E402
+
+ORDER_FIELDS = ("scale_down_order", "untaint_order")
+G_DEFAULT = 16
+
+
+def _assert_window_parity(single, sharded, G):
+    """Non-order fields bit-equal; order fields bit-equal inside every
+    window; both order outputs remain valid permutations of [N]."""
+    for f in ALL_FIELDS:
+        if f in ORDER_FIELDS:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(single, f)), np.asarray(getattr(sharded, f)),
+            err_msg=f,
+        )
+    u_off = np.asarray(single.untainted_offsets)
+    t_off = np.asarray(single.tainted_offsets)
+    down_s, down_b = (np.asarray(o.scale_down_order) for o in (single, sharded))
+    up_s, up_b = (np.asarray(o.untaint_order) for o in (single, sharded))
+    for g in range(G):
+        np.testing.assert_array_equal(
+            down_s[u_off[g]:u_off[g + 1]], down_b[u_off[g]:u_off[g + 1]],
+            err_msg=f"scale-down window g={g}",
+        )
+        np.testing.assert_array_equal(
+            up_s[t_off[g]:t_off[g + 1]], up_b[t_off[g]:t_off[g + 1]],
+            err_msg=f"untaint window g={g}",
+        )
+    N = down_s.shape[0]
+    assert sorted(down_b.tolist()) == list(range(N))
+    assert sorted(up_b.tolist()) == list(range(N))
+
+
+def _run_sharded(cluster, G, mesh=None, block_pad=None):
+    mesh = mesh if mesh is not None else make_mesh()
+    S = int(mesh.devices.size)
+    placed = podaxis.place(podaxis.pad_pods_for_mesh(cluster, mesh), mesh)
+    blocks = order_tail.assign_order_blocks(
+        cluster.nodes.group, cluster.nodes.valid, S, num_groups=G)
+    if block_pad is not None:
+        blocks = order_tail.pad_order_blocks(blocks, block_pad)
+    return podaxis.make_podaxis_decider(mesh)(placed, NOW, blocks)
+
+
+@pytest.mark.parametrize("giant_group", [False, True])
+@pytest.mark.parametrize("P", [1000, 1001])  # 1001 exercises pod padding
+def test_sharded_tail_window_parity(P, giant_group):
+    """Group-interleaved node slots (the _random_cluster default) with and
+    without one dominant giant group."""
+    rng = np.random.default_rng(P + int(giant_group))
+    cluster = _random_cluster(rng, G=G_DEFAULT, P=P, N=200,
+                              giant_group=giant_group)
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+    sharded = _run_sharded(cluster, G_DEFAULT)
+    _assert_window_parity(single, sharded, G_DEFAULT)
+
+
+def test_single_group_all_nodes_one_block():
+    """ONE group owns every node: S-1 blocks are pure padding and take the
+    cond skip branch; group 0's block must still sort bit-exactly."""
+    rng = np.random.default_rng(3)
+    cluster = _random_cluster(rng, G=1, P=512, N=160)
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+    sharded = _run_sharded(cluster, 1)
+    _assert_window_parity(single, sharded, 1)
+    blocks = order_tail.assign_order_blocks(
+        cluster.nodes.group, cluster.nodes.valid, 8, num_groups=1)
+    # the partition really is degenerate: one live block, seven empty
+    assert (blocks[1:] < 0).all() and (blocks[0] >= 0).all()
+
+
+def test_empty_groups_and_all_tainted():
+    rng = np.random.default_rng(4)
+    cluster = _random_cluster(rng, G=G_DEFAULT, P=1000, N=200)
+    n = cluster.nodes
+    # groups 3..7 own no nodes (shift their nodes to group 8+); all nodes
+    # tainted -> every scale-down window empty, untaint windows carry all
+    group = np.asarray(n.group).copy()
+    group[(group >= 3) & (group <= 7)] = 8
+    cluster.nodes = NodeArrays(
+        group=group, cpu_milli=n.cpu_milli, mem_bytes=n.mem_bytes,
+        creation_ns=n.creation_ns,
+        tainted=np.ones_like(np.asarray(n.tainted)),
+        cordoned=np.zeros_like(np.asarray(n.cordoned)),
+        no_delete=n.no_delete,
+        taint_time_sec=np.where(
+            np.asarray(n.valid), int(NOW) - 100, NO_TAINT_TIME
+        ).astype(np.int64),
+        valid=n.valid,
+    )
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+    sharded = _run_sharded(cluster, G_DEFAULT)
+    _assert_window_parity(single, sharded, G_DEFAULT)
+
+
+def test_emptiest_first_victim_keys_cross_blocks():
+    """emptiest_first groups rank victims by pods-remaining before age; the
+    sharded tail must thread the victim-primary key through its block sorts."""
+    rng = np.random.default_rng(5)
+    cluster = _random_cluster(rng, G=8, P=2048, N=128)
+    cluster.groups.emptiest = np.ones_like(np.asarray(cluster.groups.emptiest))
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+    sharded = _run_sharded(cluster, 8)
+    _assert_window_parity(single, sharded, 8)
+
+
+def test_high_water_padded_block_map():
+    """pad_order_blocks widens the lane axis with -1 (the backend's
+    high-water jit-cache policy); results must not change."""
+    rng = np.random.default_rng(6)
+    cluster = _random_cluster(rng, G=G_DEFAULT, P=1000, N=200)
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+    sharded = _run_sharded(cluster, G_DEFAULT, block_pad=512)
+    _assert_window_parity(single, sharded, G_DEFAULT)
+
+
+def test_hybrid_mesh_tail():
+    """The (dcn, ici) two-axis mesh: block axis spans both axes; the psum
+    reassembly runs staged over each."""
+    rng = np.random.default_rng(7)
+    cluster = _random_cluster(rng, G=8, P=1003, N=120, giant_group=True)
+    single = kernel.decide_jit(jax.device_put(cluster), NOW)
+    hybrid = make_hybrid_mesh(num_hosts=2)
+    sharded = _run_sharded(cluster, 8, mesh=hybrid)
+    _assert_window_parity(single, sharded, 8)
+
+
+def test_assign_order_blocks_properties():
+    """Contiguous ascending group ranges, every lane in exactly one block,
+    invalid lanes riding with group 0."""
+    rng = np.random.default_rng(8)
+    N, G, S = 333, 12, 8
+    group = rng.integers(0, G, N).astype(np.int32)
+    valid = rng.random(N) < 0.9
+    blocks = order_tail.assign_order_blocks(group, valid, S, num_groups=G)
+    assert blocks.shape[0] == S
+    lanes = blocks[blocks >= 0]
+    assert sorted(lanes.tolist()) == list(range(N))
+    key_group = np.where(valid, group, 0)
+    # group ranges ascend block to block and never straddle blocks
+    seen_groups = [np.unique(key_group[blocks[b][blocks[b] >= 0]])
+                   for b in range(S)]
+    flat = [g for arr in seen_groups for g in arr]
+    assert flat == sorted(flat)
+    for a in range(S):
+        for b in range(a + 1, S):
+            assert not set(seen_groups[a]) & set(seen_groups[b])
+
+
+def test_sharded_tail_is_block_sized_in_the_lowering():
+    """The busy-tick regression lock: the ordered pod-axis program with a
+    block map contains exactly ONE sort, and that sort runs on [Nb] block
+    lanes — NOT on the full replicated [N] node axis (round 5's 218 ms
+    cfg8 tail). The light program stays sort-free."""
+    import re
+
+    rng = np.random.default_rng(9)
+    N, Nb = 256, 32  # balanced 8-block partition: Nb = N / 8
+    cluster = _random_cluster(rng, G=8, P=512, N=N)
+    # balanced layout so every block gets exactly N // 8 lanes
+    cluster.nodes.group = np.sort(np.arange(N) % 8).astype(np.int32)
+    cluster.nodes.valid = np.ones(N, bool)
+    mesh = make_mesh()
+    blocks = order_tail.assign_order_blocks(
+        cluster.nodes.group, cluster.nodes.valid, 8, num_groups=8)
+    assert blocks.shape == (8, Nb)
+    placed = podaxis.place(podaxis.pad_pods_for_mesh(cluster, mesh), mesh)
+
+    ordered = podaxis.make_podaxis_decider(mesh)
+    txt = ordered.lower(placed, NOW, blocks).as_text()
+    assert len(re.findall(r"stablehlo\.sort", txt)) == 1
+    # the sort's operand tuple (after its comparator region closes) must be
+    # block-sized, not node-axis-sized
+    m = re.search(r"stablehlo\.sort.*?\}\) : \(([^)]*)\)", txt, flags=re.S)
+    assert m, "sort operand signature not found"
+    sig = m.group(1)
+    assert f"tensor<{Nb}x" in sig, sig
+    assert f"tensor<{N}x" not in sig, sig
+
+    light = podaxis.make_podaxis_decider(mesh, with_orders=False)
+    txt_light = light.lower(placed, NOW).as_text()
+    assert len(re.findall(r"stablehlo\.sort", txt_light)) == 0
