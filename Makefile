@@ -3,7 +3,7 @@
 # The race detector's role here is played by the threaded concurrency soak,
 # which runs as part of the suite (tests/test_concurrency_soak.py).
 
-.PHONY: test lint typecheck build-native bench dryrun clean
+.PHONY: test lint typecheck analyze build-native bench dryrun clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -18,8 +18,16 @@ lint:
 	  python -m compileall -q escalator_tpu tests bench.py; \
 	fi
 
+# scoped to the annotated core subset (pyproject [tool.mypy] files=...);
+# the full-tree sweep is the CI typecheck-full job, staged non-blocking
 typecheck:
-	mypy escalator_tpu
+	mypy
+
+# jaxlint: jaxpr/HLO-level invariant analysis over every kernel entry point
+# (rules R1-R6, docs/static-analysis.md). Pins cpu + 8 virtual devices
+# itself; nonzero exit on any unwaived finding — a blocking CI step.
+analyze:
+	python -m escalator_tpu.analysis
 
 # the C++ state store builds lazily on first use; this forces a fresh build
 build-native:

@@ -74,6 +74,7 @@ artifact carries the evidence of independent TPU sessions.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import subprocess
@@ -460,9 +461,10 @@ def _time_fused_tick(store, cache, impl, rng, now, n_churn=1000,
     # (no explicit warm-up needed: _timeit's warm call compiles the fused
     # program for this bucket size before timing starts)
 
-    def fused_tick(t=[0]):
-        t[0] += 1
-        idx = (t[0] * n_churn + np.arange(n_churn)) % num_pods
+    tick_no = itertools.count(1)
+
+    def fused_tick():
+        idx = (next(tick_no) * n_churn + np.arange(n_churn)) % num_pods
         uids = [f"p{i}" for i in idx]
         store.upsert_pods_batch(
             uids,
@@ -990,7 +992,7 @@ def run_sharded() -> None:
             for s in range(S)
         ]
         leaves = [c.tree_flatten()[0] for c in shards]
-        stacked = [np.stack(parts) for parts in zip(*leaves)]
+        stacked = [np.stack(parts) for parts in zip(*leaves, strict=True)]
         return ClusterArrays.tree_unflatten(None, stacked)
 
     curve = {}
@@ -1108,7 +1110,7 @@ def run_sharded() -> None:
     ]
     leaves8 = [c.tree_flatten()[0] for c in blocks]
     stacked8 = ClusterArrays.tree_unflatten(
-        None, [np.stack(parts) for parts in zip(*leaves8)])
+        None, [np.stack(parts) for parts in zip(*leaves8, strict=True)])
 
     vdecide = jax.jit(jax.vmap(lambda c, t: decide_jit(c, t), in_axes=(0, None)))
     stacked_dev = jax.device_put(stacked8, devices[0])

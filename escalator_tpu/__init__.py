@@ -6,6 +6,8 @@ Layer map (mirrors SURVEY.md §1 of the reference, re-architected TPU-first):
 - ``escalator_tpu.ops``        — batched JAX/XLA decision kernels
 - ``escalator_tpu.parallel``   — mesh sharding: group axis, pod axis, 2-D grid
   (shard_map/pjit over flat or hybrid dcn/ici meshes)
+- ``escalator_tpu.analysis``   — jaxlint: jaxpr/HLO-level invariant analyzer
+  over every kernel entry point (CI gate, ``python -m escalator_tpu.analysis``)
 - ``escalator_tpu.controller`` — the imperative controller shell (tick loop, executors)
 - ``escalator_tpu.k8s``        — k8s object model, listers, taint mechanics, election
 - ``escalator_tpu.cloudprovider`` — provider SPI + implementations

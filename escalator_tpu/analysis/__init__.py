@@ -1,0 +1,55 @@
+"""jaxlint: jaxpr/HLO-level invariant analysis for every kernel entry point.
+
+The reference blocks merges on ``go vet`` + race detector + lint
+(/root/reference/Makefile:13-17). This package is the JAX equivalent for the
+hazard classes ruff/mypy cannot see — replicated heavy ops, silent dtype
+demotion, broken buffer donation, collective creep, retrace storms — run as
+``python -m escalator_tpu.analysis`` (text or ``--json``; nonzero exit on
+unwaived findings), ``make analyze``, a CI job, and the
+``tests/test_jaxlint.py`` gate.
+
+Layout: ``registry`` (what to trace: entries + shapes + budgets),
+``walker`` (the context-carrying jaxpr equation stream), ``rules`` (R1-R6 +
+engine), ``waivers`` (the visible-debt ledger).
+
+Exports resolve LAZILY (PEP 562): ``python -m escalator_tpu.analysis``
+executes this module before ``__main__`` gets a chance to pin the
+cpu/8-device environment, so nothing here may import jax eagerly — the
+registry (and through it jax) loads on first attribute access, which in the
+CLI happens only after ``_pin_cpu_mesh`` has run.
+"""
+
+from typing import Any
+
+_EXPORTS = {
+    "KernelEntry": "escalator_tpu.analysis.registry",
+    "TracedEntry": "escalator_tpu.analysis.registry",
+    "default_registry": "escalator_tpu.analysis.registry",
+    "representative_cluster": "escalator_tpu.analysis.registry",
+    "stacked_cluster": "escalator_tpu.analysis.registry",
+    "AnalysisReport": "escalator_tpu.analysis.rules",
+    "EntryReport": "escalator_tpu.analysis.rules",
+    "Finding": "escalator_tpu.analysis.rules",
+    "analyze_entry": "escalator_tpu.analysis.rules",
+    "run_analysis": "escalator_tpu.analysis.rules",
+    "WAIVERS": "escalator_tpu.analysis.waivers",
+    "load_waivers": "escalator_tpu.analysis.waivers",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    try:
+        module_name = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), name)
+
+
+def __dir__() -> list:
+    return sorted(set(globals()) | set(_EXPORTS))

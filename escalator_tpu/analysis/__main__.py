@@ -1,0 +1,121 @@
+"""``python -m escalator_tpu.analysis`` — the jaxlint CI gate.
+
+Pins the CPU backend with 8 virtual devices BEFORE importing jax (this is
+why ``analysis/__init__.py`` resolves its exports lazily — the package init
+runs before this module, and an eager registry import there would drag jax
+in ahead of the pin): the
+analyzer's subject is the traced program structure, which is identical on
+every backend, and the mesh entries need 8 devices to build (the same
+environment tests/conftest.py pins, and the only configuration whose parity
+math is bit-exact — TPU f64 emulation is not). A sitecustomize on some rigs
+pins jax_platforms to the TPU tunnel, so the config is re-pinned after
+import, exactly as the test conftest does.
+
+Exit status: 0 when every finding is waived or absent, 1 otherwise —
+suitable as a blocking CI step (`make analyze`).
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+
+def _pin_cpu_mesh() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    # OVERRIDE any existing device count rather than only appending when
+    # absent: a leftover =2 from a bench run would silently skip every
+    # multi-device entry — the whole R1 surface — while the gate reports
+    # green. This process exists only to run the analyzer; it owns the flag.
+    flags, n = re.subn(
+        r"--xla_force_host_platform_device_count=\d+",
+        "--xla_force_host_platform_device_count=8", flags,
+    )
+    if n == 0:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m escalator_tpu.analysis",
+        description="jaxpr/HLO-level invariant analyzer (rules R1-R6) over "
+                    "every registered kernel entry point",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--entries", default=None,
+                        help="comma-separated entry-name filter (fnmatch "
+                             "patterns allowed)")
+    parser.add_argument("--waivers", default=None,
+                        help="extra waiver file (JSON list of "
+                             "{rule, entry, reason})")
+    parser.add_argument("--no-retrace", action="store_true",
+                        help="skip rule R6's compile probes (fast mode for "
+                             "inner-loop use; CI runs the full set)")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered entries and exit")
+    args = parser.parse_args(argv)
+
+    _pin_cpu_mesh()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from escalator_tpu.analysis import default_registry, load_waivers, run_analysis
+
+    entries = default_registry()
+    if args.entries:
+        import fnmatch
+
+        patterns = [p.strip() for p in args.entries.split(",") if p.strip()]
+        entries = [
+            e for e in entries
+            if any(fnmatch.fnmatch(e.name, p) for p in patterns)
+        ]
+        if not entries:
+            print(f"no registry entry matches {args.entries!r}",
+                  file=sys.stderr)
+            return 2
+    if args.list:
+        for e in entries:
+            print(f"{e.name:40s} {e.kind:10s} {e.module}")
+        return 0
+
+    extra = load_waivers(args.waivers) if args.waivers else None
+    report = run_analysis(entries=entries, extra_waivers=extra,
+                          with_retrace=not args.no_retrace)
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        for er in report.entries:
+            mark = {"ok": "ok", "skipped": "SKIP", "waived": "waived",
+                    "findings": "FAIL", "error": "ERROR"}[er.status]
+            line = f"[{mark:6s}] {er.name}"
+            if er.status == "skipped":
+                line += f"  ({er.info.get('reason', '')})"
+            print(line)
+            for f in er.findings:
+                flag = "waived" if f.waived else f.rule
+                print(f"    {flag}: {f.summary}")
+                if f.detail:
+                    print(f"        {f.detail}")
+                if f.waived and f.waiver_reason:
+                    print(f"        waiver: {f.waiver_reason}")
+        n = len(report.unwaived)
+        print(f"\n{n} unwaived finding(s) over {len(report.entries)} entries")
+    # a skipped entry means a rule surface did not run — for a blocking gate
+    # that is a failure, not a pass (belt to the XLA_FLAGS override's braces)
+    skipped = [e.name for e in report.entries if e.status == "skipped"]
+    if skipped:
+        print(f"GATE INCOMPLETE: entries skipped: {', '.join(skipped)}",
+              file=sys.stderr)
+        return 1
+    return 1 if report.unwaived else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,733 @@
+"""Kernel registry: every public jitted/sharded entry point, with the
+representative shapes and per-entry budgets the rules check against.
+
+The reference gates merges on ``go vet`` + race + lint
+(/root/reference/Makefile:13-17); the analog here has to know *what to
+trace*. This registry is that list — one entry per public device program
+(``ops/kernel.py``, ``ops/order_tail.py``, ``ops/binpack.py``,
+``ops/device_state.py``, ``ops/simulate.py``, ``parallel/grid.py``,
+``parallel/podaxis.py``, ``parallel/mesh.py``) with:
+
+- a lazy ``build`` producing the callable + representative args (small,
+  deterministic shapes; distinct sizes per axis so a sort over the global
+  node axis cannot be confused with one over a block);
+- ``global_axes``: the full pod/node axis sizes rule R1 treats as
+  "replicated work if an in-mesh sort spans me";
+- the declared output dtype contract (rule R2 — the float64/int64 parity
+  surface of ``core/semantics.py``/``core/arrays.py``, enforced instead of
+  documented);
+- a pinned collective budget (rule R3 — a new ``psum`` on the hot path is a
+  finding, not a silent regression);
+- whether lowering must carry buffer donation (rule R5, the
+  ``ops/device_state.py`` donate_argnums sites);
+- a retrace budget + probe (rule R6 — compile-count across a two-tick
+  sweep, catching static-argnum churn).
+
+Entries are cheap to *declare*; everything expensive (tracing, lowering,
+probing) happens lazily in the rule engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from escalator_tpu.jaxconfig import ensure_x64
+
+ensure_x64()
+
+import jax
+import jax.numpy as jnp
+
+from escalator_tpu.core.arrays import (
+    NO_TAINT_TIME,
+    ClusterArrays,
+    GroupArrays,
+    NodeArrays,
+    PodArrays,
+)
+
+NOW = np.int64(1_700_000_000)
+
+# The gate traces one deterministic program set: aggregation impl is pinned
+# to "xla" in every builder below so ESCALATOR_TPU_KERNEL_IMPL in the
+# environment cannot change what the analyzer sees (the pallas sweep is a
+# different — interpreter-mode on CPU — program; lint findings must not
+# depend on a rig's env).
+
+# Representative shapes. Deliberately pairwise-distinct (and distinct from
+# any derived block size) so rule R1's "operand length == global axis
+# length" match cannot alias: a block sort over [NB] lanes never equals the
+# global [NODES], and neither equals [GROUPS] or [PODS].
+GROUPS = 6
+PODS = 168          # divisible by the 8-device mesh (podaxis shard_map)
+NODES = 52
+SHARD_GROUPS = 3    # per-shard sizes for the stacked (mesh/grid) layouts
+SHARD_PODS = 40
+SHARD_NODES = 16
+
+#: The DecisionArrays dtype contract — the bit-parity surface documented in
+#: core/arrays.py comments, now enforced. float64 percents and int64
+#: request/capacity sums are the fields the golden model compares bit-exact.
+DECISION_DTYPES: Dict[str, str] = {
+    "status": "int32",
+    "nodes_delta": "int32",
+    "cpu_percent": "float64",
+    "mem_percent": "float64",
+    "cpu_request_milli": "int64",
+    "mem_request_bytes": "int64",
+    "cpu_capacity_milli": "int64",
+    "mem_capacity_bytes": "int64",
+    "num_pods": "int32",
+    "num_nodes": "int32",
+    "num_untainted": "int32",
+    "num_tainted": "int32",
+    "num_cordoned": "int32",
+    "scale_down_order": "int32",
+    "untainted_offsets": "int32",
+    "untaint_order": "int32",
+    "tainted_offsets": "int32",
+    "reap_mask": "bool",
+    "node_pods_remaining": "int32",
+}
+
+SWEEP_DTYPES: Dict[str, str] = {
+    "post_cpu_percent": "float64",
+    "post_mem_percent": "float64",
+    "feasible": "bool",
+    "min_feasible_delta": "int32",
+}
+
+
+@dataclass
+class TracedEntry:
+    """What ``KernelEntry.build`` returns: the traceable callable plus the
+    concrete representative arguments, and (optionally) the underlying
+    jit-wrapped callable for lowering-level checks (rule R5)."""
+
+    fn: Callable
+    args: Tuple[Any, ...]
+    jitted: Optional[Any] = None   # has .lower(*args) when donation is checked
+    lower: Optional[Callable[[], Any]] = None  # overrides jitted.lower(*args)
+                                               # (entries with static argnames)
+
+
+def _identity(out: Any) -> Any:
+    return out
+
+
+@dataclass
+class KernelEntry:
+    name: str
+    module: str
+    kind: str                       # "jit" | "shard_map"
+    build: Callable[[], TracedEntry]
+    mapped: bool = False            # multi-device program: R1/R3 apply
+    min_devices: int = 1
+    global_axes: Mapping[str, int] = field(default_factory=dict)
+    output_dtypes: Optional[Mapping[str, str]] = None
+    output_select: Callable[[Any], Any] = _identity
+    collective_budget: Optional[int] = None
+    donate_expected: bool = False
+    retrace_budget: Optional[int] = None
+    retrace_probe: Optional[Callable[[], int]] = None
+
+
+def representative_cluster(G: int = GROUPS, P: int = PODS, N: int = NODES,
+                           seed: int = 0) -> ClusterArrays:
+    """Deterministic small cluster with every lane class populated (tainted,
+    cordoned, invalid, unassigned pods) so each traced program exercises its
+    full branch surface."""
+    rng = np.random.default_rng(seed)
+    tainted = rng.random(N) < 0.25
+    return ClusterArrays(
+        groups=GroupArrays(
+            min_nodes=rng.integers(0, 2, G).astype(np.int32),
+            max_nodes=np.full(G, 10**6, np.int32),
+            taint_lower=np.full(G, 30, np.int32),
+            taint_upper=np.full(G, 45, np.int32),
+            scale_up_thr=np.full(G, 70, np.int32),
+            slow_rate=np.ones(G, np.int32),
+            fast_rate=np.full(G, 3, np.int32),
+            locked=rng.random(G) < 0.1,
+            requested_nodes=rng.integers(0, 4, G).astype(np.int32),
+            cached_cpu_milli=np.full(G, 4000, np.int64),
+            cached_mem_bytes=np.full(G, 16 * 10**9, np.int64),
+            soft_grace_sec=np.full(G, 300, np.int64),
+            hard_grace_sec=np.full(G, 900, np.int64),
+            emptiest=(np.arange(G) % 3 == 0),
+            valid=np.ones(G, bool),
+        ),
+        pods=PodArrays(
+            group=rng.integers(0, G, P).astype(np.int32),
+            cpu_milli=rng.integers(0, 8000, P).astype(np.int64),
+            mem_bytes=rng.integers(0, 32 * 10**9, P).astype(np.int64),
+            node=rng.integers(-1, N, P).astype(np.int32),
+            valid=rng.random(P) < 0.95,
+        ),
+        nodes=NodeArrays(
+            group=rng.integers(0, G, N).astype(np.int32),
+            cpu_milli=np.full(N, 4000, np.int64),
+            mem_bytes=np.full(N, 16 * 10**9, np.int64),
+            creation_ns=rng.integers(1, 10**12, N).astype(np.int64),
+            tainted=tainted,
+            cordoned=(~tainted) & (rng.random(N) < 0.05),
+            no_delete=rng.random(N) < 0.02,
+            taint_time_sec=np.where(
+                tainted, int(NOW) - rng.integers(0, 2000, N), NO_TAINT_TIME
+            ).astype(np.int64),
+            valid=rng.random(N) < 0.97,
+        ),
+    )
+
+
+def stacked_cluster(num_shards: int, G: int = SHARD_GROUPS,
+                    P: int = SHARD_PODS, N: int = SHARD_NODES,
+                    seed: int = 1) -> ClusterArrays:
+    """Stacked [S, ...] cluster (the mesh/grid layout from
+    ``mesh.pack_cluster_sharded``), built by stacking per-shard clusters."""
+    shards = [
+        representative_cluster(G, P, N, seed=seed + s) for s in range(num_shards)
+    ]
+    leaves = [c.tree_flatten()[0] for c in shards]
+    stacked = [np.stack(parts) for parts in zip(*leaves, strict=True)]
+    return ClusterArrays.tree_unflatten(None, stacked)
+
+
+# ---------------------------------------------------------------------------
+# Entry builders (all lazy: nothing traces or compiles at registry import)
+# ---------------------------------------------------------------------------
+
+
+def _build_kernel_decide() -> TracedEntry:
+    from escalator_tpu.ops import kernel
+
+    cluster = representative_cluster()
+    fn = lambda c, t: kernel.decide(c, t)  # noqa: E731
+    return TracedEntry(fn=fn, args=(cluster, NOW), jitted=kernel._decide_jit_raw)
+
+
+def _probe_kernel_retraces() -> int:
+    """Two ticks, ordered + light programs, same shapes: at most one compile
+    per (with_orders,) variant. Shapes are registry-local, so a cold process
+    observes exactly the budget; a warm one (tests) observes fewer."""
+    from escalator_tpu.ops import kernel
+
+    before = kernel._decide_jit_raw._cache_size()
+    for seed in (11, 12):
+        cluster = representative_cluster(seed=seed)
+        for with_orders in (True, False):
+            jax.block_until_ready(
+                kernel._decide_jit_raw(cluster, NOW, with_orders=with_orders)
+            )
+    return kernel._decide_jit_raw._cache_size() - before
+
+
+def _build_mesh_decider() -> TracedEntry:
+    from escalator_tpu.parallel import mesh as pmesh
+
+    m = pmesh.make_mesh()
+    cluster = stacked_cluster(int(m.devices.size))
+    decider = pmesh.make_sharded_decider(m, impl="xla")
+    return TracedEntry(fn=decider, args=(cluster, NOW), jitted=decider)
+
+
+def _build_fleet_decider() -> TracedEntry:
+    from escalator_tpu.parallel import mesh as pmesh
+
+    m = pmesh.make_mesh()
+    cluster = stacked_cluster(int(m.devices.size))
+    decider = pmesh.make_fleet_decider(m)
+    return TracedEntry(fn=decider, args=(cluster, NOW), jitted=decider)
+
+
+def _build_mesh_sweeper() -> TracedEntry:
+    from escalator_tpu.parallel import mesh as pmesh
+
+    m = pmesh.make_mesh()
+    cluster = stacked_cluster(int(m.devices.size))
+    sweeper = pmesh.make_sharded_sweeper(m, num_candidates=9)
+    return TracedEntry(fn=sweeper, args=(cluster,), jitted=sweeper)
+
+
+def _podaxis_fixture(seed: int = 0):
+    from escalator_tpu.ops import order_tail
+    from escalator_tpu.parallel import mesh as pmesh, podaxis
+
+    m = pmesh.make_mesh()
+    cluster = podaxis.pad_pods_for_mesh(representative_cluster(seed=seed), m)
+    blocks = order_tail.assign_order_blocks(
+        np.asarray(cluster.nodes.group),
+        np.asarray(cluster.nodes.valid),
+        int(m.devices.size),
+        num_groups=GROUPS,
+    )
+    return m, cluster, blocks
+
+
+def _build_podaxis_blocks() -> TracedEntry:
+    from escalator_tpu.parallel import podaxis
+
+    m, cluster, blocks = _podaxis_fixture()
+    decider = podaxis.make_podaxis_decider(m, impl="xla")
+    fn = lambda c, t, b: decider(c, t, b)  # noqa: E731
+    return TracedEntry(fn=fn, args=(cluster, NOW, blocks), jitted=decider)
+
+
+def _build_podaxis_light() -> TracedEntry:
+    from escalator_tpu.parallel import podaxis
+
+    m, cluster, _ = _podaxis_fixture()
+    decider = podaxis.make_podaxis_decider(m, impl="xla", with_orders=False)
+    fn = lambda c, t: decider(c, t)  # noqa: E731
+    return TracedEntry(fn=fn, args=(cluster, NOW), jitted=decider)
+
+
+def _build_podaxis_legacy() -> TracedEntry:
+    """The strict full-array-parity replicated ordered program (multichip
+    dryrun's contract): every device pays the full [N] sort. Kept on purpose;
+    waiver-listed for R1 rather than lint-clean (see analysis/waivers.py)."""
+    from escalator_tpu.parallel import podaxis
+
+    m, cluster, _ = _podaxis_fixture()
+    decider = podaxis.make_podaxis_decider(m, impl="xla")
+    fn = lambda c, t: decider(c, t)  # noqa: E731  (no node_blocks)
+    return TracedEntry(fn=fn, args=(cluster, NOW), jitted=decider)
+
+
+def _probe_podaxis_retraces() -> int:
+    """Fresh deciders, two block-sharded ticks + two light ticks: one compile
+    per decider. Block maps are padded to a fixed width, exactly as a backend
+    holding a high-water mark would, so the tick-to-tick block rebalance must
+    not retrace."""
+    from escalator_tpu.ops import order_tail
+    from escalator_tpu.parallel import podaxis
+
+    m, _, _ = _podaxis_fixture()
+    ordered = podaxis.make_podaxis_decider(m, impl="xla")
+    light = podaxis.make_podaxis_decider(m, impl="xla", with_orders=False)
+    compiles = 0
+    for decider, with_blocks in ((ordered, True), (light, False)):
+        before = decider._cache_size()
+        for seed in (21, 22):
+            _, cluster, blocks = _podaxis_fixture(seed=seed)
+            if with_blocks:
+                blocks = order_tail.pad_order_blocks(blocks, NODES)
+                out = decider(cluster, NOW, blocks)
+            else:
+                out = decider(cluster, NOW)
+            jax.block_until_ready(out)
+        compiles += decider._cache_size() - before
+    return compiles
+
+
+def _grid_fixture():
+    from escalator_tpu.parallel import grid
+
+    m = grid.make_grid_mesh(num_group_shards=4)
+    cluster = grid.pad_stacked_pods_for_grid(stacked_cluster(4, seed=5), m)
+    return m, cluster
+
+
+def _build_grid_decider() -> TracedEntry:
+    from escalator_tpu.parallel import grid
+
+    m, cluster = _grid_fixture()
+    decider = grid.make_grid_decider(m, impl="xla")
+    return TracedEntry(fn=decider, args=(cluster, NOW), jitted=decider)
+
+
+def _probe_grid_retraces() -> int:
+    from escalator_tpu.parallel import grid
+
+    m, _ = _grid_fixture()
+    decider = grid.make_grid_decider(m, impl="xla")
+    before = decider._cache_size()
+    for seed in (31, 32):
+        cluster = grid.pad_stacked_pods_for_grid(stacked_cluster(4, seed=seed), m)
+        jax.block_until_ready(decider(cluster, NOW))
+    return decider._cache_size() - before
+
+
+def _build_order_tail() -> TracedEntry:
+    from escalator_tpu.ops import order_tail
+
+    m, cluster, blocks = _podaxis_fixture()
+    tail = order_tail.make_sharded_order_tail(m)
+    n = cluster.nodes
+    ngroup, untainted_sel, tainted_sel = order_tail.node_selection_masks(
+        np.asarray(n.valid), np.asarray(n.group), np.asarray(n.tainted),
+        np.asarray(n.cordoned),
+    )
+    victim_primary = np.zeros(NODES, np.int64)
+    fn = lambda g, t, u, v, c, b: tail(g, t, u, v, c, GROUPS, b)  # noqa: E731
+    jitted = jax.jit(fn)
+    return TracedEntry(
+        fn=fn,
+        args=(ngroup, tainted_sel, untainted_sel, victim_primary,
+              np.asarray(n.creation_ns), blocks),
+        jitted=jitted,
+    )
+
+
+def _scatter_fixture():
+    from escalator_tpu.ops import device_state as ds
+
+    cluster = representative_cluster(seed=7)
+    pods = ds._pad_one_lane(cluster.pods, ds._POD_PAD)
+    nodes = ds._pad_one_lane(cluster.nodes, ds._NODE_PAD)
+    pod_slots = np.arange(0, 24, dtype=np.int64)
+    node_slots = np.arange(0, 12, dtype=np.int64)
+    pidx, pvals = ds._gather_padded(
+        cluster.pods, pod_slots, ds._bucket(len(pod_slots)), PODS, ds._POD_PAD
+    )
+    nidx, nvals = ds._gather_padded(
+        cluster.nodes, node_slots, ds._bucket(len(node_slots)), NODES,
+        ds._NODE_PAD,
+    )
+    return cluster, pods, nodes, pidx, pvals, nidx, nvals
+
+
+def _build_scatter_update() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    cluster, pods, nodes, pidx, pvals, nidx, nvals = _scatter_fixture()
+    args = (pods, nodes, cluster.groups, pidx, pvals, nidx, nvals)
+    return TracedEntry(fn=ds._scatter_body, args=args, jitted=ds._scatter_update)
+
+
+def _build_scatter_update_packed() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    cluster, pods, nodes, pidx, pvals, nidx, nvals = _scatter_fixture()
+    pod_buf = ds._pack_delta_bytes(pidx, pvals)
+    node_buf = ds._pack_delta_bytes(nidx, nvals)
+    pod_dts = ds._field_dtypes(cluster.pods)
+    node_dts = ds._field_dtypes(cluster.nodes)
+    fn = lambda p, n, g, pb, nb: ds._scatter_update_from_packed(  # noqa: E731
+        p, n, g, pb, nb, pod_dts, node_dts
+    )
+    return TracedEntry(
+        fn=fn,
+        args=(pods, nodes, cluster.groups, pod_buf, node_buf),
+        jitted=ds._scatter_update_from_packed,
+        lower=lambda: ds._scatter_update_from_packed.lower(
+            pods, nodes, cluster.groups, pod_buf, node_buf,
+            pod_dts=pod_dts, node_dts=node_dts,
+        ),
+    )
+
+
+def _build_scatter_update_decide() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    cluster, pods, nodes, pidx, pvals, nidx, nvals = _scatter_fixture()
+    fn = lambda p, n, g, pi, pv, ni, nv, t: ds._scatter_update_decide(  # noqa: E731
+        p, n, g, pi, pv, ni, nv, t
+    )
+    args = (pods, nodes, cluster.groups, pidx, pvals, nidx, nvals,
+            jnp.int64(NOW))
+    return TracedEntry(fn=fn, args=args, jitted=ds._scatter_update_decide)
+
+
+def _build_simulate_sweep() -> TracedEntry:
+    from escalator_tpu.ops import simulate
+
+    cluster = representative_cluster(seed=9)
+    fn = lambda c: simulate.sweep_deltas(c, 9)  # noqa: E731
+    return TracedEntry(fn=fn, args=(cluster,), jitted=simulate._sweep_deltas_raw)
+
+
+def _build_simulate_sweep_by_type() -> TracedEntry:
+    from escalator_tpu.ops import simulate
+
+    cluster = representative_cluster(seed=9)
+    type_cpu = np.array([2000, 4000, 8000], np.int64)
+    type_mem = np.array([8, 16, 32], np.int64) * 10**9
+    fn = lambda c, tc, tm: simulate.sweep_deltas_by_type(c, tc, tm, 9)  # noqa: E731
+    return TracedEntry(
+        fn=fn, args=(cluster, type_cpu, type_mem),
+        jitted=simulate._sweep_deltas_by_type_raw,
+    )
+
+
+def _binpack_fixture(distinct_heavy: bool):
+    from escalator_tpu.ops import binpack
+
+    G, P, M = 3, 32, 8
+    rng = np.random.default_rng(13)
+    if distinct_heavy:
+        pod_cpu = rng.integers(1, 4000, (G, P)).astype(np.int64)
+        pod_mem = rng.integers(1, 10**9, (G, P)).astype(np.int64)
+    else:
+        shapes = np.array([[500, 10**8], [1000, 2 * 10**8]], np.int64)
+        pick = rng.integers(0, 2, (G, P))
+        pod_cpu = shapes[pick, 0]
+        pod_mem = shapes[pick, 1]
+    pod_valid = rng.random((G, P)) < 0.9
+    bin_cpu = np.full((G, M), 4000, np.int64)
+    bin_mem = np.full((G, M), 16 * 10**9, np.int64)
+    bin_valid = rng.random((G, M)) < 0.9
+    template_cpu = np.full(G, 4000, np.int64)
+    template_mem = np.full(G, 16 * 10**9, np.int64)
+    prep = binpack._host_prep(pod_cpu, pod_mem, pod_valid, template_cpu,
+                              template_mem)
+    return (binpack, prep, pod_valid, bin_cpu, bin_mem, bin_valid,
+            template_cpu, template_mem)
+
+
+def _build_binpack_runs() -> TracedEntry:
+    (binpack, prep, pod_valid, bin_cpu, bin_mem, bin_valid, template_cpu,
+     template_mem) = _binpack_fixture(distinct_heavy=False)
+    perm, inv, s_cpu, s_mem, s_valid, runs, R = prep
+    run_cpu, run_mem, run_count, run_start, run_id = runs
+    fn = lambda *a: binpack._pack_runs_device(*a, new_bin_budget=4)  # noqa: E731
+    args = (run_cpu, run_mem, run_count, run_start, run_id, s_valid, inv,
+            pod_valid, bin_cpu, bin_mem, bin_valid, template_cpu, template_mem)
+    return TracedEntry(fn=fn, args=args, jitted=binpack._pack_runs_device)
+
+
+def _build_binpack_pods() -> TracedEntry:
+    """The dtype-trimmed per-pod fallback: its int64->float32 carry cast is
+    deliberate and exactness-guarded (binpack module docstring) — registered
+    so R2 provably does NOT confuse it with a float64 parity demotion."""
+    (binpack, prep, pod_valid, bin_cpu, bin_mem, bin_valid, template_cpu,
+     template_mem) = _binpack_fixture(distinct_heavy=True)
+    perm, inv, s_cpu, s_mem, s_valid, runs, R = prep
+    fn = lambda *a: binpack._pack_pods_device(  # noqa: E731
+        *a, new_bin_budget=4, trim_dtypes=True
+    )
+    args = (s_cpu, s_mem, s_valid, inv, pod_valid, bin_cpu, bin_mem,
+            bin_valid, template_cpu, template_mem)
+    return TracedEntry(fn=fn, args=args, jitted=binpack._pack_pods_device)
+
+
+_PACK_TUPLE_DTYPES: Dict[str, str] = {
+    "0": "int32",   # assignment
+    "1": "int32",   # new_nodes_needed / used_virtual
+    "2": "int32",   # unplaced
+    "3": "int64",   # bins_remaining_cpu
+    "4": "int64",   # bins_remaining_mem
+}
+
+
+def default_registry() -> List[KernelEntry]:
+    """The analyzed surface: every public device entry point, with budgets.
+
+    Collective budgets are the audited per-tick counts on a 1-D mesh (a
+    hybrid dcn/ici mesh stages each logical collective once per axis; the
+    analyzer pins the 1-D program, the invariant that matters being "no NEW
+    collective appears"). Retrace budgets are compiles per two-tick sweep.
+    """
+    e = KernelEntry
+    return [
+        e(
+            name="kernel.decide",
+            module="escalator_tpu.ops.kernel",
+            kind="jit",
+            build=_build_kernel_decide,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            collective_budget=0,
+            retrace_budget=2,  # ordered + lazy-orders light program
+            retrace_probe=_probe_kernel_retraces,
+        ),
+        e(
+            name="mesh.sharded_decider",
+            module="escalator_tpu.parallel.mesh",
+            kind="shard_map",
+            build=_build_mesh_decider,
+            mapped=True,
+            min_devices=8,
+            global_axes={
+                "pods": 8 * SHARD_PODS,
+                "nodes": 8 * SHARD_NODES,
+            },
+            output_dtypes=DECISION_DTYPES,
+            collective_budget=0,  # decisions are shard-local by construction
+        ),
+        e(
+            name="mesh.fleet_decider",
+            module="escalator_tpu.parallel.mesh",
+            kind="shard_map",
+            build=_build_fleet_decider,
+            mapped=True,
+            min_devices=8,
+            global_axes={
+                "pods": 8 * SHARD_PODS,
+                "nodes": 8 * SHARD_NODES,
+            },
+            output_dtypes=DECISION_DTYPES,
+            output_select=lambda out: out[0],
+            collective_budget=1,  # ONE stacked fleet-totals psum
+        ),
+        e(
+            name="mesh.sharded_sweeper",
+            module="escalator_tpu.parallel.mesh",
+            kind="shard_map",
+            build=_build_mesh_sweeper,
+            mapped=True,
+            min_devices=8,
+            global_axes={
+                "pods": 8 * SHARD_PODS,
+                "nodes": 8 * SHARD_NODES,
+            },
+            output_dtypes=SWEEP_DTYPES,
+            collective_budget=0,
+        ),
+        e(
+            name="podaxis.decider_blocks",
+            module="escalator_tpu.parallel.podaxis",
+            kind="shard_map",
+            build=_build_podaxis_blocks,
+            mapped=True,
+            min_devices=8,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            # pod-sweep psum + sharded-tail class-count psum + reassembly psum
+            collective_budget=3,
+            retrace_budget=2,  # one compile each: block-sharded + light
+            retrace_probe=_probe_podaxis_retraces,
+        ),
+        e(
+            name="podaxis.decider_light",
+            module="escalator_tpu.parallel.podaxis",
+            kind="shard_map",
+            build=_build_podaxis_light,
+            mapped=True,
+            min_devices=8,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            collective_budget=1,  # the pod-sweep psum only
+        ),
+        e(
+            name="podaxis.decider_legacy_replicated",
+            module="escalator_tpu.parallel.podaxis",
+            kind="shard_map",
+            build=_build_podaxis_legacy,
+            mapped=True,
+            min_devices=8,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            collective_budget=1,
+        ),
+        e(
+            name="order_tail.sharded_tail",
+            module="escalator_tpu.ops.order_tail",
+            kind="shard_map",
+            build=_build_order_tail,
+            mapped=True,
+            min_devices=8,
+            global_axes={"nodes": NODES},
+            output_dtypes={"0": "int32", "1": "int32"},
+            collective_budget=2,  # class-count psum + reassembly psum
+        ),
+        e(
+            name="grid.decider",
+            module="escalator_tpu.parallel.grid",
+            kind="shard_map",
+            build=_build_grid_decider,
+            mapped=True,
+            min_devices=8,
+            global_axes={
+                "pods": 4 * SHARD_PODS,
+                "nodes": 4 * SHARD_NODES,
+            },
+            output_dtypes=DECISION_DTYPES,
+            collective_budget=1,  # ONE stacked [3G+N] psum over the pod axis
+            retrace_budget=1,
+            retrace_probe=_probe_grid_retraces,
+        ),
+        e(
+            name="device_state.scatter_update",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_scatter_update,
+            collective_budget=0,
+            donate_expected=True,   # donate_argnums=(0, 1): resident pods/nodes
+        ),
+        e(
+            name="device_state.scatter_update_packed",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_scatter_update_packed,
+            collective_budget=0,
+            donate_expected=True,
+        ),
+        e(
+            name="device_state.scatter_update_decide",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_scatter_update_decide,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,
+            donate_expected=True,
+        ),
+        e(
+            name="simulate.sweep_deltas",
+            module="escalator_tpu.ops.simulate",
+            kind="jit",
+            build=_build_simulate_sweep,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=SWEEP_DTYPES,
+            collective_budget=0,
+        ),
+        e(
+            name="simulate.sweep_deltas_by_type",
+            module="escalator_tpu.ops.simulate",
+            kind="jit",
+            build=_build_simulate_sweep_by_type,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes={
+                "0": "float64", "1": "float64", "2": "bool", "3": "int32",
+            },
+            collective_budget=0,
+        ),
+        e(
+            name="binpack.pack_runs",
+            module="escalator_tpu.ops.binpack",
+            kind="jit",
+            build=_build_binpack_runs,
+            output_dtypes=_PACK_TUPLE_DTYPES,
+            collective_budget=0,
+        ),
+        e(
+            name="binpack.pack_pods_trimmed",
+            module="escalator_tpu.ops.binpack",
+            kind="jit",
+            build=_build_binpack_pods,
+            output_dtypes=_PACK_TUPLE_DTYPES,
+            collective_budget=0,
+        ),
+    ]
+
+
+def shape_tree_items(tree: Any, prefix: str = "") -> List[Tuple[str, Any]]:
+    """Flatten an ``eval_shape`` result into (name, ShapeDtypeStruct) pairs:
+    dataclass outputs name leaves by field, tuples by position — the names
+    the dtype contracts in this registry use."""
+    if dataclasses.is_dataclass(tree) and not isinstance(tree, type):
+        out: List[Tuple[str, Any]] = []
+        for f in dataclasses.fields(tree):
+            sub = getattr(tree, f.name)
+            sub_prefix = f"{prefix}.{f.name}" if prefix else f.name
+            out.extend(shape_tree_items(sub, sub_prefix))
+        return out
+    if isinstance(tree, (tuple, list)):
+        out = []
+        for i, sub in enumerate(tree):
+            sub_prefix = f"{prefix}.{i}" if prefix else str(i)
+            out.extend(shape_tree_items(sub, sub_prefix))
+        return out
+    if isinstance(tree, dict):
+        out = []
+        for key in sorted(tree):
+            sub_prefix = f"{prefix}.{key}" if prefix else str(key)
+            out.extend(shape_tree_items(tree[key], sub_prefix))
+        return out
+    return [(prefix or "out", tree)]

@@ -1,0 +1,464 @@
+"""jaxlint rule engine: six jaxpr/HLO-level invariants, each a regression
+class this repo has already paid for once (or documented only in comments).
+
+R1  replicated-heavy-op   — a ``sort``/``argsort``/``scan`` spanning a full
+                            global pod/node axis inside a multi-device
+                            program: the PR-1 busy-tail bug class (a full
+                            ``[N]`` ordering sort replicated on every device
+                            of the pod-axis mesh, 0.23x scaling).
+R2  dtype-parity contract — parity-critical float64/int64 outputs declared
+                            in ``core/`` must stay those dtypes end to end;
+                            no f64->f32/f16/bf16 demotion anywhere in the
+                            traced program; x64 must be on at trace time.
+R3  collective hygiene    — every collective names bound mesh axes only, and
+                            each entry's collective count stays within its
+                            pinned budget (a NEW collective on the hot path
+                            fails loudly instead of shipping).
+R4  host-sync hazard      — no ``io_callback``/``pure_callback``/debug
+                            callbacks inside decider programs (a host
+                            round-trip per tick would dwarf the kernel).
+R5  donation verification — every ``donate_argnums`` site actually lowers
+                            with buffer aliasing (``ops/device_state.py``'s
+                            O(changes) resident-update path silently becomes
+                            O(cluster) HBM traffic if a refactor drops it).
+R6  retrace budget        — each registered entry compiles at most its
+                            pinned number of times across a two-tick
+                            representative sweep (catches static-argnum /
+                            weak-type churn that melts the jit cache).
+
+Findings carry the nesting path from the walker, so "where is this sort"
+is answered in the report, not by re-deriving the trace.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from escalator_tpu.analysis.registry import (
+    KernelEntry,
+    TracedEntry,
+    shape_tree_items,
+)
+from escalator_tpu.analysis.walker import EqnSite, iter_sites
+
+#: Collective primitives (jaxpr names) R3 audits. ``psum2`` is what a real
+#: ``psum`` becomes under shard_map's replication-checker rewrite
+#: (check_rep/check_vma on); ``pbroadcast`` is deliberately ABSENT — the
+#: rewrite inserts it as a zero-communication replication annotation (113 of
+#: them in the mesh decider trace), not a data-moving collective.
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "psum2", "all_gather", "all_to_all", "ppermute", "pmin", "pmax",
+    "psum_scatter", "reduce_scatter", "pgather",
+})
+
+#: Host-callback primitives R4 forbids inside device entry points.
+CALLBACK_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+})
+
+#: Float demotion targets R2 flags when fed from float64.
+_DEMOTED_FLOATS = ("float32", "float16", "bfloat16")
+
+#: Lowering/compilation markers proving buffer donation survived (R5).
+_LOWERED_ALIAS_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+_COMPILED_ALIAS_MARKER = "input_output_alias"
+
+
+@dataclass
+class Finding:
+    rule: str            # "R1".."R6", or "ERR" for analysis failures
+    entry: str
+    summary: str
+    detail: str = ""
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "entry": self.entry,
+            "summary": self.summary,
+            "detail": self.detail,
+            "waived": self.waived,
+            "waiver_reason": self.waiver_reason,
+        }
+
+
+@dataclass
+class EntryReport:
+    name: str
+    status: str                      # "ok" | "findings" | "skipped" | "error"
+    findings: List[Finding] = field(default_factory=list)
+    info: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class AnalysisReport:
+    entries: List[EntryReport]
+    x64_enabled: bool
+
+    @property
+    def findings(self) -> List[Finding]:
+        return [f for e in self.entries for f in e.findings]
+
+    @property
+    def unwaived(self) -> List[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "x64_enabled": self.x64_enabled,
+            "unwaived_findings": len(self.unwaived),
+            "entries": [
+                {
+                    "name": e.name,
+                    "status": e.status,
+                    "info": e.info,
+                    "findings": [f.to_dict() for f in e.findings],
+                }
+                for e in self.entries
+            ],
+        }
+
+
+def apply_waivers(findings: Sequence[Finding],
+                  waivers: Sequence[Mapping[str, str]]) -> None:
+    """Mark findings matching a waiver (rule exact, entry fnmatch pattern).
+    Waived findings stay in the report — visible, just not gate-failing."""
+    for f in findings:
+        for w in waivers:
+            if w.get("rule") == f.rule and fnmatch.fnmatch(
+                f.entry, w.get("entry", "")
+            ):
+                f.waived = True
+                f.waiver_reason = w.get("reason", "")
+                break
+
+
+# ---------------------------------------------------------------------------
+# Individual rules (pure functions over the walked equation stream)
+# ---------------------------------------------------------------------------
+
+
+def _sort_span(eqn) -> Optional[int]:
+    """Length of the sorted dimension for a sort eqn (None for non-sorts)."""
+    if eqn.primitive.name != "sort":
+        return None
+    dim = int(eqn.params.get("dimension", 0))
+    shape = tuple(eqn.invars[0].aval.shape)
+    if not shape:
+        return None
+    return int(shape[dim])
+
+
+def rule_replicated_heavy(entry: KernelEntry,
+                          sites: Sequence[EqnSite]) -> List[Finding]:
+    """R1: in a multi-device entry, a sort/scan spanning a full registered
+    global axis runs whole on every device holding it — the replicated-tail
+    class. Sharded programs sort block-sized operands, which never equal the
+    global axis length (the registry picks pairwise-distinct shapes).
+
+    Scope is the ENTRY (entry.mapped), never site.mapped: the bug class this
+    exists for — the legacy pod-axis ordered program's full-[N] sort — sits
+    OUTSIDE any shard_map body (replicated node arrays, SPMD jit), so
+    filtering sites by shard_map nesting would blind the rule to its
+    flagship detection (the mutation test in tests/test_jaxlint.py pins
+    this)."""
+    if not entry.mapped or not entry.global_axes:
+        return []
+    findings = []
+    for site in sites:
+        span: Optional[int] = None
+        if site.primitive == "sort":
+            span = _sort_span(site.eqn)
+        elif site.primitive == "scan":
+            span = int(site.eqn.params.get("length", 0))
+        if span is None or span <= 1:
+            continue
+        for axis_name, size in entry.global_axes.items():
+            if span == size:
+                findings.append(Finding(
+                    rule="R1",
+                    entry=entry.name,
+                    summary=(
+                        f"{site.primitive} spans the full global {axis_name} "
+                        f"axis ({span} lanes) in a multi-device program"
+                    ),
+                    detail=(
+                        f"at {site.pretty_path()}; every device pays the "
+                        f"whole O({axis_name} log {axis_name}) op — shard it "
+                        "by group block (ops.order_tail) or waive the legacy "
+                        "path explicitly"
+                    ),
+                ))
+    return findings
+
+
+def rule_dtype_parity(entry: KernelEntry, sites: Sequence[EqnSite],
+                      out_shapes: Any) -> List[Finding]:
+    """R2: output dtype contract + no float64 demotion inside the program.
+    ``out_shapes`` is the ShapeDtypeStruct pytree from the engine's single
+    trace (make_jaxpr(..., return_shape=True)) — no second trace here."""
+    findings = []
+    if entry.output_dtypes is not None:
+        selected = entry.output_select(out_shapes)
+        actual = dict(shape_tree_items(selected))
+        for name, want in entry.output_dtypes.items():
+            got = actual.get(name)
+            if got is None:
+                findings.append(Finding(
+                    rule="R2", entry=entry.name,
+                    summary=f"declared parity output {name!r} missing from "
+                            "the traced output tree",
+                    detail=f"traced outputs: {sorted(actual)}",
+                ))
+            elif str(got.dtype) != want:
+                findings.append(Finding(
+                    rule="R2", entry=entry.name,
+                    summary=(
+                        f"parity output {name!r} is {got.dtype}, contract "
+                        f"says {want}"
+                    ),
+                    detail="the float64/int64 bit-parity contract of "
+                           "core/semantics.py is enforced, not advisory",
+                ))
+    for site in sites:
+        if site.primitive != "convert_element_type":
+            continue
+        src = str(site.eqn.invars[0].aval.dtype)
+        dst = str(site.eqn.params.get("new_dtype", ""))
+        if src == "float64" and dst in _DEMOTED_FLOATS:
+            findings.append(Finding(
+                rule="R2", entry=entry.name,
+                summary=f"float64 value demoted to {dst} mid-program",
+                detail=f"at {site.pretty_path()}; parity math must stay f64 "
+                       "end to end",
+            ))
+    return findings
+
+
+def rule_collective_hygiene(entry: KernelEntry,
+                            sites: Sequence[EqnSite]) -> List[Finding]:
+    """R3: collectives name bound mesh axes; count stays within budget."""
+    findings = []
+    count = 0
+    for site in sites:
+        if site.primitive not in COLLECTIVE_PRIMITIVES:
+            continue
+        count += 1
+        axes = site.eqn.params.get("axes",
+                                   site.eqn.params.get("axis_name", ()))
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        if not axes:
+            findings.append(Finding(
+                rule="R3", entry=entry.name,
+                summary=f"{site.primitive} with no named axis",
+                detail=f"at {site.pretty_path()}",
+            ))
+            continue
+        for ax in axes:
+            if not isinstance(ax, str):
+                findings.append(Finding(
+                    rule="R3", entry=entry.name,
+                    summary=(
+                        f"{site.primitive} over positional axis {ax!r} — "
+                        "collectives must name a mesh axis"
+                    ),
+                    detail=f"at {site.pretty_path()}",
+                ))
+            elif site.bound_axes and ax not in site.bound_axes:
+                findings.append(Finding(
+                    rule="R3", entry=entry.name,
+                    summary=f"{site.primitive} names axis {ax!r} not bound "
+                            "by any enclosing mesh",
+                    detail=f"at {site.pretty_path()}; bound axes: "
+                           f"{sorted(site.bound_axes)}",
+                ))
+    if entry.collective_budget is not None and count > entry.collective_budget:
+        findings.append(Finding(
+            rule="R3", entry=entry.name,
+            summary=(
+                f"{count} collectives traced, budget is "
+                f"{entry.collective_budget} — a new collective joined the "
+                "hot path"
+            ),
+            detail="raise the pinned budget in analysis/registry.py only "
+                   "with a bench number justifying the extra round-trip",
+        ))
+    return findings
+
+
+def rule_host_sync(entry: KernelEntry,
+                   sites: Sequence[EqnSite]) -> List[Finding]:
+    """R4: no host callbacks inside device entry points."""
+    return [
+        Finding(
+            rule="R4", entry=entry.name,
+            summary=f"host callback primitive {site.primitive} inside a "
+                    "decider program",
+            detail=f"at {site.pretty_path()}; a host round-trip per tick "
+                   "dwarfs the kernel (SURVEY.md §7 host<->device path)",
+        )
+        for site in sites
+        if site.primitive in CALLBACK_PRIMITIVES
+    ]
+
+
+def rule_donation(entry: KernelEntry, traced: TracedEntry) -> List[Finding]:
+    """R5: the lowered program actually carries buffer aliasing."""
+    if not entry.donate_expected:
+        return []
+    if traced.jitted is None or not hasattr(traced.jitted, "lower"):
+        return [Finding(
+            rule="R5", entry=entry.name,
+            summary="entry declares donation but exposes no lowerable jit "
+                    "callable",
+            detail="registry bug: pass the jit-wrapped function as "
+                   "TracedEntry.jitted (or a lower thunk)",
+        )]
+    lowered = (traced.lower() if traced.lower is not None
+               else traced.jitted.lower(*traced.args))
+    text = lowered.as_text()
+    if any(marker in text for marker in _LOWERED_ALIAS_MARKERS):
+        return []
+    # Some jax versions only materialize aliasing at compile time; check the
+    # compiled HLO before declaring the donation dropped.
+    try:
+        compiled_text = lowered.compile().as_text()
+    except Exception:  # pragma: no cover - backend-specific compile failure
+        compiled_text = ""
+    if _COMPILED_ALIAS_MARKER in compiled_text:
+        return []
+    return [Finding(
+        rule="R5", entry=entry.name,
+        summary="no input/output buffer alias in the lowered program — "
+                "donation was silently dropped",
+        detail="ops/device_state.py's O(changes) resident update becomes "
+               "O(cluster) HBM traffic without donation; check "
+               "donate_argnums and that donated/returned avals still match",
+    )]
+
+
+def rule_retrace_budget(entry: KernelEntry, compiles: int) -> List[Finding]:
+    """R6: compile count across the representative two-tick sweep."""
+    if entry.retrace_budget is None or compiles <= entry.retrace_budget:
+        return []
+    return [Finding(
+        rule="R6", entry=entry.name,
+        summary=(
+            f"{compiles} compiles across the two-tick sweep, budget is "
+            f"{entry.retrace_budget} — retrace storm"
+        ),
+        detail="same shapes must hit the jit cache; look for static-argnum "
+               "churn, weak-type flips, or python-object hash instability",
+    )]
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+def analyze_entry(entry: KernelEntry, with_retrace: bool = True) -> EntryReport:
+    """Run every applicable rule on one registry entry. Failures to build or
+    trace are loud ERR findings, never silent skips — an entry that stops
+    tracing is exactly the refactor this gate exists to catch."""
+    import jax
+
+    if entry.min_devices > len(jax.devices()):
+        return EntryReport(
+            name=entry.name, status="skipped",
+            info={"reason": f"needs {entry.min_devices} devices, have "
+                            f"{len(jax.devices())}"},
+        )
+    try:
+        traced = entry.build()
+        closed, out_shapes = jax.make_jaxpr(
+            traced.fn, return_shape=True
+        )(*traced.args)
+        sites = list(iter_sites(closed))
+    except Exception as exc:
+        return EntryReport(
+            name=entry.name, status="error",
+            findings=[Finding(
+                rule="ERR", entry=entry.name,
+                summary=f"entry failed to build/trace: {type(exc).__name__}",
+                detail=str(exc)[:500],
+            )],
+        )
+    findings: List[Finding] = []
+    findings += rule_replicated_heavy(entry, sites)
+    findings += rule_dtype_parity(entry, sites, out_shapes)
+    findings += rule_collective_hygiene(entry, sites)
+    findings += rule_host_sync(entry, sites)
+    compiles: Optional[int] = None
+    try:
+        findings += rule_donation(entry, traced)
+        if with_retrace and entry.retrace_probe is not None:
+            compiles = entry.retrace_probe()
+            findings += rule_retrace_budget(entry, compiles)
+    except Exception as exc:
+        findings.append(Finding(
+            rule="ERR", entry=entry.name,
+            summary=f"lowering/probe failed: {type(exc).__name__}",
+            detail=str(exc)[:500],
+        ))
+    info = {
+        "equations": len(sites),
+        "collectives": sum(
+            1 for s in sites if s.primitive in COLLECTIVE_PRIMITIVES
+        ),
+        "sorts": [
+            {"span": _sort_span(s.eqn), "path": s.pretty_path()}
+            for s in sites if s.primitive == "sort"
+        ],
+    }
+    if compiles is not None:
+        info["retrace_compiles"] = compiles
+    return EntryReport(
+        name=entry.name,
+        status="findings" if findings else "ok",
+        findings=findings,
+        info=info,
+    )
+
+
+def run_analysis(entries: Optional[Sequence[KernelEntry]] = None,
+                 extra_waivers: Optional[Sequence[Mapping[str, str]]] = None,
+                 with_retrace: bool = True) -> AnalysisReport:
+    """Analyze ``entries`` (default: the full registry) and apply waivers.
+
+    The gate condition is ``not report.unwaived``: waived findings print but
+    do not fail. x64-at-trace-time (the R2 precondition) is checked once,
+    globally — every kernel module calls ``jaxconfig.ensure_x64`` before
+    tracing, and this asserts that stays true in whatever process embeds the
+    analyzer."""
+    import jax
+
+    from escalator_tpu.analysis.registry import default_registry
+    from escalator_tpu.analysis.waivers import WAIVERS
+
+    if entries is None:
+        entries = default_registry()
+    reports = [analyze_entry(e, with_retrace=with_retrace) for e in entries]
+    x64 = bool(jax.config.jax_enable_x64)
+    if not x64:
+        reports.append(EntryReport(
+            name="<global>", status="findings",
+            findings=[Finding(
+                rule="R2", entry="<global>",
+                summary="jax_enable_x64 is OFF at analysis time",
+                detail="the float64/int64 parity contract cannot hold; "
+                       "jaxconfig.ensure_x64 must run before any trace",
+            )],
+        ))
+    waivers = list(WAIVERS) + list(extra_waivers or [])
+    all_findings = [f for r in reports for f in r.findings]
+    apply_waivers(all_findings, waivers)
+    for r in reports:
+        if r.findings and all(f.waived for f in r.findings):
+            r.status = "waived"
+    return AnalysisReport(entries=reports, x64_enabled=x64)

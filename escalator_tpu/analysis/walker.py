@@ -1,0 +1,117 @@
+"""Jaxpr walker: a flat, context-carrying equation stream for the rule engine.
+
+``ruff``/``mypy`` see Python; the hazards that have actually cost this repo
+performance and parity live one level down, in the traced program — a full
+``[N]`` sort replicated on every device (PR 1's 0.23x busy tick), a
+``float64`` parity output silently demoted, a collective sneaking onto the
+hot path. Those are visible only in the jaxpr, so the analyzer walks it.
+
+:func:`iter_sites` yields every equation of a traced entry — including the
+equations of every sub-jaxpr reachable through ``pjit``/``shard_map``/
+``pmap``/``scan``/``while``/``cond`` (and any other higher-order primitive:
+descent is generic over jaxpr-valued params, so a new jax version's control
+flow shows up instead of silently hiding) — tagged with the context the
+rules need:
+
+- ``path``: human-readable nesting trail for findings ("where is this sort");
+- ``mapped``: whether the site sits inside a ``shard_map``/``pmap`` body —
+  diagnostic context only. Rule R1 deliberately does NOT filter on it: in an
+  SPMD jit program over a mesh, replicated work also lives OUTSIDE the
+  shard_map bodies (the legacy pod-axis sort R1 exists to catch traces at
+  ``pjit:decide_podaxis/cond``, with no shard_map frame above it), so R1
+  keys off the ENTRY being multi-device, not the site;
+- ``bound_axes``: the mesh/pmap axis names in scope, so collective hygiene
+  can check that every ``psum`` names an axis that is actually bound.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator, Tuple
+
+#: Higher-order primitives that put their body on every device of a mesh —
+#: inside these, a full-global-axis sort/scan is replicated work (rule R1).
+MAPPED_PRIMITIVES = ("shard_map", "xla_pmap", "pmap")
+
+
+@dataclass(frozen=True)
+class EqnSite:
+    """One equation plus the walking context the rules match against."""
+
+    eqn: Any                      # jax.core.JaxprEqn
+    path: Tuple[str, ...]         # nesting trail, outermost first
+    mapped: bool                  # inside a shard_map/pmap body
+    bound_axes: frozenset         # mesh/pmap axis names in scope
+
+    @property
+    def primitive(self) -> str:
+        return self.eqn.primitive.name
+
+    def pretty_path(self) -> str:
+        return "/".join(self.path) if self.path else "<top>"
+
+
+def _label(eqn) -> str:
+    """Short label for the nesting trail: primitive name, plus the wrapped
+    function's name for pjit (that is what a human greps for)."""
+    name = eqn.primitive.name
+    fn = eqn.params.get("name")
+    if name == "pjit" and isinstance(fn, str):
+        return f"pjit:{fn}"
+    return name
+
+
+def _axes_of(eqn) -> frozenset:
+    """Axis names a mapped primitive binds for its body."""
+    name = eqn.primitive.name
+    if name == "shard_map":
+        mesh = eqn.params.get("mesh")
+        if mesh is not None and hasattr(mesh, "axis_names"):
+            return frozenset(str(a) for a in mesh.axis_names)
+        return frozenset()
+    axis = eqn.params.get("axis_name")
+    if axis is None:
+        return frozenset()
+    if isinstance(axis, (tuple, list)):
+        return frozenset(str(a) for a in axis)
+    return frozenset((str(axis),))
+
+
+def _sub_jaxprs(eqn) -> Iterator[Any]:
+    """Every jaxpr-valued param of ``eqn`` (generic descent: params named
+    ``jaxpr``, ``branches``, ``cond_jaxpr``/``body_jaxpr``, ``call_jaxpr``,
+    and anything a future primitive invents all match structurally)."""
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for v in vals:
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner               # ClosedJaxpr -> its Jaxpr
+            elif hasattr(v, "eqns"):
+                yield v                   # raw Jaxpr
+
+
+def _walk(jaxpr, path: Tuple[str, ...], mapped: bool,
+          bound_axes: frozenset) -> Iterator[EqnSite]:
+    for eqn in jaxpr.eqns:
+        yield EqnSite(eqn=eqn, path=path, mapped=mapped, bound_axes=bound_axes)
+        sub_mapped = mapped or eqn.primitive.name in MAPPED_PRIMITIVES
+        sub_axes = bound_axes | _axes_of(eqn)
+        sub_path = path + (_label(eqn),)
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk(sub, sub_path, sub_mapped, sub_axes)
+
+
+def iter_sites(closed_jaxpr) -> Iterator[EqnSite]:
+    """Yield an :class:`EqnSite` for every equation reachable from a traced
+    entry (``jax.make_jaxpr(fn)(*args)``), sub-jaxprs included."""
+    yield from _walk(closed_jaxpr.jaxpr, (), False, frozenset())
+
+
+def count_primitives(closed_jaxpr) -> dict:
+    """primitive name -> occurrence count over the whole nested program
+    (diagnostic output for ``--json``; also handy in tests)."""
+    counts: dict = {}
+    for site in iter_sites(closed_jaxpr):
+        counts[site.primitive] = counts.get(site.primitive, 0) + 1
+    return counts

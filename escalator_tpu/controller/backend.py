@@ -207,7 +207,7 @@ def _unpack(out, group_inputs, ordered: bool = True,
         flat_nodes.extend(nodes)
 
     results: List[GroupDecision] = []
-    for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+    for gi, (_pods, _nodes, _config, _state) in enumerate(group_inputs):
         decision = semantics.Decision(
             status=semantics.DecisionStatus(int(status[gi])),
             nodes_delta=int(delta[gi]),
@@ -243,7 +243,7 @@ def _unpack(out, group_inputs, ordered: bool = True,
         # membership lists by the packer's contiguous per-group node ranges
         # (the same layout the reap slicing below relies on)
         base = 0
-        for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+        for gi, (_pods, nodes, _config, _state) in enumerate(group_inputs):
             idxs = range(base, base + len(nodes))
             results[gi].scale_down_order = [
                 flat_nodes[i] for i in idxs if untainted_mask[i]
@@ -254,7 +254,7 @@ def _unpack(out, group_inputs, ordered: bool = True,
             base += len(nodes)
     # reap + pods-remaining are flat-indexed; slice out each group's node range
     base = 0
-    for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+    for gi, (_pods, nodes, _config, _state) in enumerate(group_inputs):
         idxs = range(base, base + len(nodes))
         results[gi].reap_nodes = [flat_nodes[i] for i in idxs if reap[i]]
         results[gi].node_pods_remaining = {
@@ -358,8 +358,8 @@ class PackingPostPass:
             # FFD is the same math, just per group on the host
             for gi, pc, pm, bc, bm, template, budget in device_rows:
                 _, used, unplaced = semantics.ffd_pack_pure(
-                    list(zip(pc.tolist(), pm.tolist())),
-                    list(zip(bc.tolist(), bm.tolist())),
+                    list(zip(pc.tolist(), pm.tolist(), strict=True)),
+                    list(zip(bc.tolist(), bm.tolist(), strict=True)),
                     template, budget,
                 )
                 results[gi].decision.nodes_delta = used + unplaced
@@ -391,7 +391,7 @@ class PackingPostPass:
             bin_valid = np.zeros((Gp, M), bool)
             t_cpu = np.ones(Gp, np.int64)
             t_mem = np.ones(Gp, np.int64)
-            for i, (gi, pc, pm, bc, bm, template, _b) in enumerate(rows):
+            for i, (_gi, pc, pm, bc, bm, template, _b) in enumerate(rows):
                 pod_cpu[i, : pc.size] = pc
                 pod_mem[i, : pm.size] = pm
                 pod_valid[i, : pc.size] = True

@@ -185,7 +185,9 @@ class Controller:
                 except Exception as e:  # noqa: PERF203
                     err = e
             if err is not None:
-                raise err
+                # the retry loop already logged each failure; the implicit
+                # first_err context adds nothing (err may BE first_err)
+                raise err from None
 
         # Phase 1: per-group provider checks + lister reads (object level).
         batch: List[Tuple[str, NodeGroupState, List[k8s.Pod], List[k8s.Node]]] = []
@@ -246,7 +248,7 @@ class Controller:
         )
 
         # Phase 3: per-group side effects.
-        for (name, state, pods, nodes), gd in zip(batch, decisions):
+        for (name, state, pods, nodes), gd in zip(batch, decisions, strict=True):
             delta = self._act_on_decision(name, state, pods, nodes, gd)
             metrics.node_group_scale_delta.labels(name).set(delta)
             state.scale_delta = delta
@@ -544,11 +546,11 @@ class Controller:
             nodes_to_remove = semantics.clamp_scale_down(
                 len(opts.untainted_nodes), opts.nodes_delta, state.opts.min_nodes
             )
-        except ValueError:
+        except ValueError as exc:
             raise RuntimeError(
                 f"the number of nodes ({len(opts.untainted_nodes)}) is less than"
                 f" specified minimum of {state.opts.min_nodes}. Taking no action"
-            )
+            ) from exc
         log.info("[%s] scaling down: tainting %d nodes", state.opts.name,
                  nodes_to_remove)
         metrics.node_group_taint_event.labels(state.opts.name).inc(nodes_to_remove)

@@ -88,8 +88,8 @@ class NativeJaxBackend(ComputeBackend):
             return
         node_groups = nodes.group[valid_idx]
         uniq, first = np.unique(node_groups, return_index=True)
-        first_slot = {int(gid): int(valid_idx[fi]) for gid, fi in zip(uniq, first)}
-        for gi, (_, _, config, state) in enumerate(group_inputs):
+        first_slot = {int(gid): int(valid_idx[fi]) for gid, fi in zip(uniq, first, strict=True)}
+        for gi, (_, _, _config, state) in enumerate(group_inputs):
             slot = first_slot.get(gi)
             if slot is not None:
                 state.cached_cpu_milli = int(nodes.cpu_milli[slot])
@@ -414,7 +414,7 @@ class NativeJaxBackend(ComputeBackend):
                     ).append((int(slot), node_at(int(slot))))
 
             results = []
-            for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+            for gi, (_pods, _nodes, _config, _state) in enumerate(group_inputs):
                 decision = semantics.Decision(
                     status=semantics.DecisionStatus(int(status[gi])),
                     nodes_delta=int(delta[gi]),

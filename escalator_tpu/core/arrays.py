@@ -198,7 +198,7 @@ def pack_cluster(
     N = _pad_to(total_nodes, pad_nodes)
 
     # refresh cached capacity BEFORE packing group rows (controller.go:208-211)
-    for pods, nodes, config, state in group_inputs:
+    for _pods, nodes, _config, state in group_inputs:
         if nodes:
             state.cached_cpu_milli = nodes[0].cpu_allocatable_milli
             state.cached_mem_bytes = nodes[0].mem_allocatable_bytes
@@ -227,7 +227,7 @@ def pack_cluster(
 
     pi = 0
     ni = 0
-    for gi, (pods, nodes, config, state) in enumerate(group_inputs):
+    for gi, (pods, nodes, _config, _state) in enumerate(group_inputs):
         dry = bool(dry_mode_flags[gi]) if dry_mode_flags is not None else False
         tracker = set(taint_trackers[gi]) if taint_trackers is not None else set()
 

@@ -224,7 +224,7 @@ def make_sharded_order_tail(mesh: Mesh):
             # need no ordering, so this runs before (and regardless of) the
             # sort. my_row is the device's position along the block axis.
             my_row = jnp.int64(0)
-            for ax, size in zip(axis_names, axis_sizes):
+            for ax, size in zip(axis_names, axis_sizes, strict=True):
                 my_row = my_row * size + jax.lax.axis_index(ax)
             cls_l = jnp.where(
                 t_l, jnp.int64(0), jnp.where(u_l, jnp.int64(1), jnp.int64(2))

@@ -324,7 +324,7 @@ def fused_segment_sums(
         xla_path,
         None,
     )
-    return dict(zip(names, results))
+    return dict(zip(names, results, strict=True))
 
 
 def path_report(ids, valid, int_columns=None, num_segments: int = 0) -> Dict[str, bool]:

@@ -190,7 +190,7 @@ def pack_cluster_sharded(
         for shard in assignment
     ]
     leaves = [c.tree_flatten()[0] for c in shards]
-    stacked = [np.stack(parts) for parts in zip(*leaves)]
+    stacked = [np.stack(parts) for parts in zip(*leaves, strict=True)]
     return ClusterArrays.tree_unflatten(None, stacked), assignment
 
 

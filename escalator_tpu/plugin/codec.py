@@ -60,7 +60,7 @@ def _encode_arrays(named: List[Tuple[str, np.ndarray]]) -> bytes:
     frame[:4] = _MAGIC
     struct.pack_into("<I", frame, 4, len(head))
     frame[8:base] = head
-    for spec, arr in zip(header, arrays):
+    for spec, arr in zip(header, arrays, strict=True):
         start = base + spec["offset"]
         frame[start : start + spec["nbytes"]] = memoryview(arr).cast("B")
     return bytes(frame)

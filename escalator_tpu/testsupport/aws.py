@@ -106,9 +106,8 @@ class FakeEC2:
                 {"InstanceId": i, "LaunchTime": 0.0,
                  "State": {"Name": "running"}},
             )
-        out = {"Instances": [{"InstanceIds": ids}] if ids else [],
-               "Errors": list(self.fleet_errors)}
-        return out
+        return {"Instances": [{"InstanceIds": ids}] if ids else [],
+                "Errors": list(self.fleet_errors)}
 
     def describe_instance_status(self, InstanceIds, IncludeAllInstances=False, **kw):
         self.calls.append(("describe_instance_status", list(InstanceIds)))

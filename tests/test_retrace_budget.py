@@ -1,0 +1,94 @@
+"""R6 regression: the kernel/grid/podaxis entries compile EXACTLY once per
+program variant across a two-tick smoke sweep.
+
+The analyzer's R6 rule pins an upper bound; this test pins the exact count,
+on shapes no other test uses (primes — a shared jit cache entry from another
+test file would make "0 compiles" pass a broken cache-key silently). What it
+catches: accidental static-argnum churn (a python scalar that should be a
+traced array, a dict arg that rebuilds each tick, a numpy scalar flipping
+weak-type), which melts the jit cache and turns every tick into a
+multi-second retrace — invisible to correctness tests, fatal to the 50 ms
+budget.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from escalator_tpu.analysis.registry import representative_cluster  # noqa: E402
+from escalator_tpu.ops import kernel, order_tail  # noqa: E402
+from escalator_tpu.parallel import grid, mesh as pmesh, podaxis  # noqa: E402
+
+# Shapes unique to this file (primes; no other test traces these sizes).
+G, P, N = 7, 184, 61          # P % 8 == 0 for the podaxis mesh split
+SG, SP, SN = 5, 24, 11        # per-shard sizes for the stacked grid layout
+NOW = np.int64(1_700_000_123)
+
+
+def _cluster(seed):
+    return representative_cluster(G=G, P=P, N=N, seed=seed)
+
+
+def test_kernel_decide_compiles_once_per_variant():
+    before = kernel._decide_jit_raw._cache_size()
+    for seed in (101, 102):                      # two ticks, fresh data
+        for with_orders in (True, False):        # ordered + lazy-light
+            jax.block_until_ready(
+                kernel._decide_jit_raw(_cluster(seed), NOW,
+                                       with_orders=with_orders)
+            )
+    compiles = kernel._decide_jit_raw._cache_size() - before
+    assert compiles == 2, (
+        f"expected exactly 2 compiles (ordered + light), got {compiles}: "
+        "the second tick retraced — look for static-argnum/weak-type churn"
+    )
+
+
+def test_podaxis_decider_compiles_once_across_block_rebalance():
+    m = pmesh.make_mesh()
+    decider = podaxis.make_podaxis_decider(m)
+    before = decider._cache_size()
+    for seed in (111, 112):
+        cluster = podaxis.pad_pods_for_mesh(_cluster(seed), m)
+        blocks = order_tail.assign_order_blocks(
+            np.asarray(cluster.nodes.group), np.asarray(cluster.nodes.valid),
+            int(m.devices.size), num_groups=G,
+        )
+        # a backend holds a high-water-mark width exactly so the per-tick
+        # block rebalance cannot retrace; replicate that here
+        blocks = order_tail.pad_order_blocks(blocks, N)
+        jax.block_until_ready(decider(cluster, NOW, blocks))
+    compiles = decider._cache_size() - before
+    assert compiles == 1, (
+        f"expected exactly 1 compile for two block-sharded ticks, got "
+        f"{compiles}"
+    )
+
+
+def test_grid_decider_compiles_once():
+    m = grid.make_grid_mesh(num_group_shards=4)
+
+    def stacked(seed):
+        shards = [
+            representative_cluster(G=SG, P=SP, N=SN, seed=seed + s)
+            for s in range(4)
+        ]
+        leaves = [c.tree_flatten()[0] for c in shards]
+        from escalator_tpu.core.arrays import ClusterArrays
+
+        return grid.pad_stacked_pods_for_grid(
+            ClusterArrays.tree_unflatten(
+                None, [np.stack(parts) for parts in zip(*leaves, strict=True)]
+            ),
+            m,
+        )
+
+    decider = grid.make_grid_decider(m)
+    before = decider._cache_size()
+    for seed in (121, 122):
+        jax.block_until_ready(decider(stacked(seed), NOW))
+    compiles = decider._cache_size() - before
+    assert compiles == 1, (
+        f"expected exactly 1 compile for two grid ticks, got {compiles}"
+    )

@@ -100,7 +100,7 @@ class TestKernelParity:
 
         golden = GoldenBackend().decide(fresh(), int(NOW))
         jaxed = JaxBackend().decide(fresh(), int(NOW))
-        for g, j in zip(golden, jaxed):
+        for g, j in zip(golden, jaxed, strict=True):
             assert [n.name for n in g.scale_down_order] == [
                 n.name for n in j.scale_down_order
             ]
