@@ -52,6 +52,11 @@ Configs:
               100k nodes / 2048 groups on ONE chip (1% churn), cfg6-style
               phase split — the measured single-chip point the v5e-8
               extrapolation in docs/performance.md anchors on
+  cfg14       incremental vs full decide (round-8 tentpole: persistent
+              group aggregates + dirty-group compaction) across the churn
+              sweep at 100k and 1M pods, with per-tick dirty-group counts,
+              bit-exact scale-delta parity per sweep point, and the
+              refresh-audit cost priced alongside
 
 The full record is also written to BENCH_FULL_LATEST.json (named in the
 stdout line) so a driver that tail-grabs stdout can never truncate the
@@ -534,6 +539,109 @@ def _cfg13_native_1M(rng, now, device, detail: dict, degraded: bool) -> None:
                               iters=3 if degraded else 8)
     detail["cfg13_native_tick_1Mpods_1pct_churn_ms"] = med["total"]
     detail["cfg13_phases_1pct"] = med
+
+
+def _cfg14_incremental_vs_full(rng, now, device, detail: dict,
+                               degraded: bool) -> None:
+    """cfg14 (round 8): the INCREMENTAL decide (persistent device-resident
+    group aggregates + dirty-group compaction, ops.device_state.
+    IncrementalDecider) priced against the full-recompute decide across the
+    churn sweep (0.1/1/10%) at both the BASELINE 100k-pod shape and the
+    1M-pod stretch shape, recording dirty-group counts per tick. Decide
+    phase ONLY (the upsert/drain/scatter phases are already O(churn),
+    cfg6): per tick, the incremental path dispatches its lazy-light
+    delta_decide on the compacted dirty rows while the full path re-runs
+    the whole light program — same resident cluster, so scale-delta parity
+    is asserted bit-exact at every sweep point (recorded, and locked at
+    tiny scale by --smoke / tier-1). The acceptance bar: 0.1%-churn
+    incremental decide >= 5x faster than the full decide on the same rig in
+    the same session."""
+    import jax
+
+    from escalator_tpu.core.arrays import ClusterArrays
+    from escalator_tpu.native.statestore import NativeStateStore
+    from escalator_tpu.ops.device_state import DeviceClusterCache, IncrementalDecider
+    from escalator_tpu.ops.kernel import GROUP_DECISION_FIELDS, decide_jit
+
+    shapes = [
+        # (label, pods, nodes, groups, per-pod cpu keeping every group in
+        #  the (45, 70) no-action band under round-robin, timed ticks)
+        ("100k", 100_000, 50_000, 2048, 1140, 10),
+        ("1M", 1_000_000, 100_000, 2048, 230, 3 if degraded else 5),
+    ]
+    cfg14 = {}
+    for label, P, N, G, cpu_m, iters in shapes:
+        store = NativeStateStore(
+            pod_capacity=1 << (P - 1).bit_length(),
+            node_capacity=1 << (N - 1).bit_length(),
+        )
+        for lo in range(0, P, 100_000):
+            hi = min(P, lo + 100_000)
+            store.upsert_pods_batch(
+                [f"p{i}" for i in range(lo, hi)],
+                np.arange(lo, hi, dtype=np.int64) % G,
+                np.full(hi - lo, cpu_m), np.full(hi - lo, 10**9),
+            )
+        store.upsert_nodes_batch(
+            [f"n{i}" for i in range(N)], np.arange(N, dtype=np.int64) % G,
+            np.full(N, 4000), np.full(N, 16 * 10**9),
+        )
+        pods_v, nodes_v = store.as_pod_node_arrays()
+        base = _rng_cluster_arrays(rng, G, 1, 1)
+        store.drain_dirty()
+        cache = DeviceClusterCache(
+            ClusterArrays(groups=base.groups, pods=pods_v, nodes=nodes_v),
+            device=device,
+        )
+        inc = IncrementalDecider(cache, refresh_every=0)
+        inc.decide(now, False)   # bootstrap: full decide seeds the columns
+        jax.block_until_ready(
+            decide_jit(cache.cluster, now, with_orders=False))
+        rows = {}
+        for frac, n_churn in (("0.1pct", P // 1000), ("1pct", P // 100),
+                              ("10pct", P // 10)):
+            delta_ms, full_ms, dirty = [], [], []
+            parity = "ok"
+            for t in range(iters + 1):   # tick 0 warms the delta bucket
+                idx = (t * n_churn + np.arange(n_churn)) % P
+                store.upsert_pods_batch(
+                    [f"p{i}" for i in idx], idx % G,
+                    np.full(n_churn, cpu_m), np.full(n_churn, 10**9))
+                pd, nd = store.drain_dirty()
+                inc.apply_gathered(cache.gather_deltas(pd, nd))
+                t0 = time.perf_counter()
+                out_i, _ordered = inc.decide(now, False)
+                t1 = time.perf_counter()
+                full = jax.block_until_ready(
+                    decide_jit(cache.cluster, now, with_orders=False))
+                t2 = time.perf_counter()
+                if t > 0:
+                    delta_ms.append((t1 - t0) * 1e3)
+                    full_ms.append((t2 - t1) * 1e3)
+                    dirty.append(inc.last_dirty_count)
+                for f in GROUP_DECISION_FIELDS:
+                    if not np.array_equal(np.asarray(getattr(out_i, f)),
+                                          np.asarray(getattr(full, f))):
+                        parity = f"MISMATCH: {f} at tick {t}"
+            inc_med = float(np.median(delta_ms))
+            full_med = float(np.median(full_ms))
+            rows[frac] = {
+                "incremental_decide_ms": round(inc_med, 3),
+                "full_decide_ms": round(full_med, 3),
+                "dirty_groups_median": int(np.median(dirty)),
+                "speedup": round(full_med / inc_med, 2) if inc_med else None,
+                "parity": parity,
+            }
+        # the refresh audit, priced: the O(cluster) self-check a production
+        # cadence amortizes (and proof the maintained state held)
+        t0 = time.perf_counter()
+        audit_ok = inc.refresh()
+        rows["refresh_audit_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        rows["refresh_audit_ok"] = bool(audit_ok)
+        cfg14[label] = rows
+        del inc, cache, store, pods_v, nodes_v
+    detail["cfg14_incremental_vs_full"] = cfg14
+    detail["cfg14_speedup_0p1pct_100k"] = cfg14["100k"]["0.1pct"]["speedup"]
 
 
 def _memory_envelope(device, detail: dict) -> None:
@@ -1229,6 +1337,64 @@ def run_smoke() -> dict:
     # the prepass must have exercised BOTH scan programs
     assert out["smoke_cfg10_replicaset_path"] == "runs"
     assert out["smoke_cfg10_mixed_path"] == "pods"
+
+    # ---- cfg14 path: incremental delta decide vs full recompute ----------
+    # A compact multi-tick run of the round-8 incremental stack (native
+    # store -> DeviceClusterCache -> IncrementalDecider): steady ticks run
+    # delta_decide on the compacted dirty rows, the drain tick exercises
+    # the ordered aggregate-fed re-dispatch, and EVERY tick asserts
+    # bit-exact parity (all fields, scale delta included) against a full
+    # decide_jit on the same resident cluster — so tier-1 locks the
+    # incremental/full contract, not just cfg14's timings.
+    from escalator_tpu.core.arrays import ClusterArrays
+    from escalator_tpu.native.statestore import NativeStateStore
+    from escalator_tpu.ops.device_state import DeviceClusterCache, IncrementalDecider
+    from escalator_tpu.ops.kernel import lazy_orders_decide
+
+    Gi = 8
+    store = NativeStateStore(pod_capacity=1 << 9, node_capacity=1 << 7)
+    store.upsert_pods_batch([f"sp{i}" for i in range(160)],
+                            np.arange(160) % Gi,
+                            np.full(160, 500), np.full(160, 10**9))
+    store.upsert_nodes_batch([f"sn{i}" for i in range(40)],
+                             np.arange(40) % Gi,
+                             np.full(40, 4000), np.full(40, 16 * 10**9))
+    pods_v, nodes_v = store.as_pod_node_arrays()
+    base = _rng_cluster_arrays(rng, Gi, 1, 1)
+    store.drain_dirty()
+    cache = DeviceClusterCache(
+        ClusterArrays(groups=base.groups, pods=pods_v, nodes=nodes_v))
+    inc = IncrementalDecider(cache, refresh_every=3)
+    dirty_counts = []
+    ordered_ticks = []
+    for t in range(6):
+        # steady ticks churn 5 pods in-place (5 dirty groups of 8: the
+        # compaction is observably selective); ticks 4-5 cheapen 60 pods so
+        # every group falls below taint_lower — a drain begins (ordered)
+        n, cpu = (5, 500) if t < 4 else (60, 100)
+        idx = (t * 12 + np.arange(n)) % 160
+        store.upsert_pods_batch([f"sp{i}" for i in idx], idx % Gi,
+                                np.full(n, cpu), np.full(n, 10**9))
+        pd, nd = store.drain_dirty()
+        inc.apply_gathered(cache.gather_deltas(pd, nd))
+        out_i, ordered = inc.decide(now, False)
+        ref, ref_ordered = lazy_orders_decide(
+            lambda w: jax.block_until_ready(
+                decide_jit(cache.cluster, now, with_orders=w)), False)
+        assert ordered == ref_ordered, f"cfg14 smoke tick {t}: protocol"
+        for f in ref.__dataclass_fields__:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out_i, f)), np.asarray(getattr(ref, f)),
+                err_msg=f"cfg14 smoke tick {t}: {f}")
+        dirty_counts.append(inc.last_dirty_count)
+        ordered_ticks.append(bool(ordered))
+    # both protocol paths must have run, the dirty set must have been
+    # selective, and the cadence audit must have fired clean
+    assert any(ordered_ticks) and not all(ordered_ticks), ordered_ticks
+    assert any(0 < c < Gi for c in dirty_counts), dirty_counts
+    assert inc.refreshes >= 1
+    out["smoke_cfg14_parity"] = "ok"
+    out["smoke_cfg14_dirty_counts"] = dirty_counts
     return out
 
 
@@ -1395,6 +1561,15 @@ def main() -> None:
         _cfg13_native_1M(rng, now, device, detail, degraded)
     except Exception as e:  # pragma: no cover
         detail["cfg13_error"] = str(e)
+    _flush_partial(detail, device, degraded)
+
+    # 14. incremental vs full decide across the churn sweep (round-8
+    # tentpole): dirty-group-compacted delta_decide vs the full recompute,
+    # at 100k and 1M pods, parity asserted per tick
+    try:
+        _cfg14_incremental_vs_full(rng, now, device, detail, degraded)
+    except Exception as e:  # pragma: no cover
+        detail["cfg14_error"] = str(e)
     _flush_partial(detail, device, degraded)
 
     # device memory: stats probe + computed envelope, after the biggest

@@ -101,6 +101,23 @@ SWEEP_DTYPES: Dict[str, str] = {
     "min_feasible_delta": "int32",
 }
 
+#: The persistent-aggregate contract (round 8): every sum column is int64 —
+#: the R2 dtype guarantee that makes delta maintenance drift-free (a float
+#: column here would accumulate rounding and break the refresh audit).
+AGGREGATE_DTYPES: Dict[str, str] = {
+    "cpu_req": "int64",
+    "mem_req": "int64",
+    "num_pods": "int64",
+    "cpu_cap": "int64",
+    "mem_cap": "int64",
+    "num_nodes": "int64",
+    "num_untainted": "int64",
+    "num_tainted": "int64",
+    "num_cordoned": "int64",
+    "node_pods_remaining": "int64",
+    "dirty": "bool",
+}
+
 
 @dataclass
 class TracedEntry:
@@ -433,6 +450,119 @@ def _build_scatter_update_decide() -> TracedEntry:
     return TracedEntry(fn=fn, args=args, jitted=ds._scatter_update_decide)
 
 
+def _delta_fixture(seed: int = 15, dirty_rows=(0, 2, 4)):
+    """Concrete incremental-decide state: persistent aggregates + decision
+    columns from a real bootstrap, plus a compacted dirty batch."""
+    from escalator_tpu.ops import kernel
+
+    cluster = representative_cluster(seed=seed)
+    aggs = kernel.compute_aggregates_jit(cluster)
+    light = kernel._decide_jit_raw(cluster, NOW, with_orders=False)
+    prev = tuple(getattr(light, f) for f in kernel.GROUP_DECISION_FIELDS)
+    mask = np.zeros(GROUPS, bool)
+    mask[list(dirty_rows)] = True
+    idx = kernel.dirty_indices(mask)
+    return cluster, aggs, prev, idx
+
+
+def _build_delta_decide() -> TracedEntry:
+    from escalator_tpu.ops import kernel
+
+    cluster, aggs, prev, idx = _delta_fixture()
+    fn = lambda c, a, p, i, t: kernel._delta_decide_core(  # noqa: E731
+        c.groups, c.nodes, a, p, i, t)
+    return TracedEntry(fn=fn, args=(cluster, aggs, prev, idx, NOW),
+                       jitted=kernel._delta_decide_raw)
+
+
+def _probe_delta_decide_retraces() -> int:
+    """Two ticks in the SAME dirty bucket (different rows): the dirty-row
+    contents must not be a cache key — exactly one compile. (Bucket-boundary
+    behavior is pinned exactly in tests/test_retrace_budget.py; the registry
+    shape G=6 caps the bucket at 6, so only one bucket exists here.)"""
+    import jax
+
+    from escalator_tpu.ops import kernel
+
+    cluster, aggs, prev, _ = _delta_fixture(seed=41, dirty_rows=(1, 2))
+    before = kernel._delta_decide_raw._cache_size()
+    for rows in ((1, 2), (3, 5)):
+        mask = np.zeros(GROUPS, bool)
+        mask[list(rows)] = True
+        out, aggs = kernel._delta_decide_raw(
+            cluster, aggs, prev, kernel.dirty_indices(mask), NOW)
+        jax.block_until_ready(out)
+        prev = tuple(getattr(out, f) for f in kernel.GROUP_DECISION_FIELDS)
+    return kernel._delta_decide_raw._cache_size() - before
+
+
+def _build_scatter_update_aggs() -> TracedEntry:
+    from escalator_tpu.core.arrays import ClusterArrays
+    from escalator_tpu.ops import device_state as ds, kernel
+
+    cluster, pods, nodes, pidx, pvals, nidx, nvals = _scatter_fixture()
+    padded = ClusterArrays(groups=cluster.groups, pods=pods, nodes=nodes)
+    aggs = kernel.compute_aggregates_jit(padded)
+    args = (pods, nodes, cluster.groups, cluster.groups, pidx, pvals, nidx,
+            nvals, aggs)
+    return TracedEntry(fn=ds._scatter_update_aggs, args=args,
+                       jitted=ds._scatter_update_aggs)
+
+
+def _build_podaxis_delta_scatter() -> TracedEntry:
+    from escalator_tpu.ops import kernel
+    from escalator_tpu.parallel import podaxis
+
+    m, cluster, _ = _podaxis_fixture(seed=17)
+    aggs = kernel.compute_aggregates_jit(cluster)
+    scat = podaxis.make_delta_scatter(m)
+    B = 8
+    P_ = int(cluster.pods.valid.shape[0])
+    N_ = int(cluster.nodes.valid.shape[0])
+
+    def take(soa, idx, oob):
+        out = {}
+        for f in soa.__dataclass_fields__:
+            a = np.asarray(getattr(soa, f))
+            v = np.zeros(B, a.dtype)
+            sel = idx < oob
+            v[sel] = a[idx[sel]]
+            out[f] = v
+        return type(soa)(**out)
+
+    pidx = np.full(B, P_, np.int32)
+    pidx[:3] = [1, 40, 100]
+    nidx = np.full(B, N_, np.int32)
+    nidx[:2] = [2, 11]
+    pod_old = take(cluster.pods, pidx, P_)
+    node_old = take(cluster.nodes, nidx, N_)
+    args = (cluster.pods, cluster.nodes, cluster.groups, cluster.groups,
+            pidx, pod_old, pod_old, nidx, node_old, node_old, aggs)
+    return TracedEntry(fn=scat, args=args, jitted=scat)
+
+
+def _build_grid_delta_decider() -> TracedEntry:
+    import jax
+
+    from escalator_tpu.ops import kernel
+    from escalator_tpu.parallel import grid
+
+    m, cluster = _grid_fixture()
+    vaggs = jax.vmap(lambda c: kernel.compute_aggregates(c))(cluster)
+    vlight = jax.vmap(
+        lambda c: kernel.decide(c, NOW, with_orders=False))(cluster)
+    prev = tuple(
+        np.asarray(getattr(vlight, f)) for f in kernel.GROUP_DECISION_FIELDS)
+    Gb = int(cluster.groups.valid.shape[1])
+    idx = np.stack([
+        kernel.dirty_indices(np.eye(1, Gb, s % Gb, dtype=bool)[0])
+        for s in range(4)
+    ])
+    decider = grid.make_grid_delta_decider(m)
+    args = (cluster.groups, cluster.nodes, vaggs, prev, idx, NOW)
+    return TracedEntry(fn=decider, args=args, jitted=decider)
+
+
 def _build_simulate_sweep() -> TracedEntry:
     from escalator_tpu.ops import simulate
 
@@ -666,6 +796,58 @@ def default_registry() -> List[KernelEntry]:
             output_dtypes=DECISION_DTYPES,
             output_select=lambda out: out[1],
             collective_budget=0,
+            donate_expected=True,
+        ),
+        e(
+            name="kernel.delta_decide",
+            module="escalator_tpu.ops.kernel",
+            kind="jit",
+            build=_build_delta_decide,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            output_select=lambda out: out[0],
+            collective_budget=0,   # the lazy incremental path: zero psums
+            donate_expected=True,  # persistent aggregates + decision columns
+            retrace_budget=1,      # dirty CONTENTS are not a cache key
+            retrace_probe=_probe_delta_decide_retraces,
+        ),
+        e(
+            name="device_state.scatter_update_aggs",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_scatter_update_aggs,
+            output_dtypes=AGGREGATE_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,
+            donate_expected=True,  # resident pods/nodes + aggregate columns
+        ),
+        e(
+            name="podaxis.delta_scatter",
+            module="escalator_tpu.parallel.podaxis",
+            kind="shard_map",
+            build=_build_podaxis_delta_scatter,
+            mapped=True,
+            min_devices=8,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=AGGREGATE_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,   # replicated delta batch: no collectives
+            donate_expected=True,
+        ),
+        e(
+            name="grid.delta_decider",
+            module="escalator_tpu.parallel.grid",
+            kind="shard_map",
+            build=_build_grid_delta_decider,
+            mapped=True,
+            min_devices=8,
+            global_axes={
+                "pods": 4 * SHARD_PODS,
+                "nodes": 4 * SHARD_NODES,
+            },
+            output_dtypes=DECISION_DTYPES,
+            output_select=lambda out: out[0],
+            collective_budget=0,   # per-block math, dirty masks per shard
             donate_expected=True,
         ),
         e(

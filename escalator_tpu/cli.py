@@ -90,13 +90,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sim-state", default="",
                    help="YAML cluster state for in-memory simulation mode")
     p.add_argument("--backend", default="auto",
-                   choices=["auto", "jax", "sharded-jax", "grid-jax",
-                            "podaxis-jax", "golden", "native", "grpc"],
+                   choices=["auto", "jax", "incremental-jax", "sharded-jax",
+                            "grid-jax", "podaxis-jax", "golden", "native",
+                            "grpc"],
                    help="compute backend for the scale decision (native ="
-                        " event-driven C++ state store + jax kernel; grpc ="
-                        " remote compute plugin; podaxis-jax = pod-axis"
-                        " sharding for one dominant giant group; grid-jax ="
-                        " 2-D groups x pods mesh for few huge groups)")
+                        " event-driven C++ state store + jax kernel, add"
+                        " ESCALATOR_TPU_INCREMENTAL_DECIDE=1 for the"
+                        " delta-maintained decide; incremental-jax = repack"
+                        " backend with host-diffed O(churn) device work;"
+                        " grpc = remote compute plugin; podaxis-jax ="
+                        " pod-axis sharding for one dominant giant group;"
+                        " grid-jax = 2-D groups x pods mesh for few huge"
+                        " groups)")
     p.add_argument("--plugin-address", default="127.0.0.1:50551",
                    help="compute plugin address for --backend grpc")
     p.add_argument("--once", action="store_true",

@@ -460,6 +460,108 @@ class JaxBackend(ComputeBackend):
         return results
 
 
+def _changed_slots(old_soa, new_soa) -> np.ndarray:
+    """Lane indices where ANY column differs between two packed SoA views —
+    the host-diff delta extraction IncrementalJaxBackend feeds the scatter
+    path (vectorized numpy compares; O(cluster) host time, microseconds per
+    100k lanes, in exchange for O(churn) device work)."""
+    changed = None
+    for f in old_soa.__dataclass_fields__:
+        d = np.asarray(getattr(old_soa, f)) != np.asarray(getattr(new_soa, f))
+        changed = d if changed is None else (changed | d)
+    return np.nonzero(changed)[0].astype(np.int64)
+
+
+class IncrementalJaxBackend(ComputeBackend):
+    """Single-device repack backend with the round-8 INCREMENTAL decide.
+
+    Same object-level contract as :class:`JaxBackend`, different economics:
+    the packed cluster stays device-resident across ticks
+    (ops.device_state.DeviceClusterCache); each tick re-packs on the host
+    (O(cluster) numpy — unavoidable without an event source; the native
+    backend removes that too), HOST-DIFFS the packed columns against the
+    previous tick's, and ships only the changed lanes through the scatter +
+    aggregate-delta program. The decide then runs
+    ``kernel.delta_decide`` on the compacted dirty groups
+    (ops.device_state.IncrementalDecider): steady-state device work is
+    O(churn + dirty groups + N elementwise) instead of the full O(P) sweep.
+    Dry-mode taint views are baked into the packed columns by pack_cluster,
+    so the diff picks them up like any other lane change. A padded-capacity
+    change (cluster growth past the high-water mark) rebuilds the residency
+    and re-derives the aggregates from scratch.
+
+    Lane stability note: the diff compares positionally, so a caller whose
+    lister order reshuffles between ticks inflates the delta batch (every
+    moved lane reads as changed) — NEVER the results, which depend only on
+    the diff being complete. The controller's group-ordered walk is stable
+    in practice; the native backend's slot-keyed store makes it structural."""
+
+    name = "incremental-jax"
+
+    def __init__(self, impl: Optional[str] = None,
+                 refresh_every: Optional[int] = None):
+        from escalator_tpu.ops import kernel  # defers jax import
+
+        self._kernel = kernel
+        self._packer = PaddedPacker()
+        self._impl = impl if impl is not None else _kernel_impl()
+        self._packing = PackingPostPass()
+        self._refresh_every = refresh_every
+        self._cache = None
+        self._inc = None
+        self._host_prev = None   # (PodArrays, NodeArrays) of the last pack
+
+    def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        from escalator_tpu.ops.device_state import (
+            DeviceClusterCache,
+            IncrementalDecider,
+        )
+
+        t0 = time.perf_counter()
+        cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
+        P = int(cluster.pods.valid.shape[0])
+        N = int(cluster.nodes.valid.shape[0])
+        rebuild = (
+            self._cache is None
+            or self._cache.pod_capacity != P
+            or self._cache.node_capacity != N
+            # the GROUP pad is high-water too, but it can grow while the
+            # pod/node pads stand still (a 9th nodegroup, few new lanes) —
+            # the [G]-shaped aggregates and persistent columns must rebuild
+            # with it, not broadcast-crash against the resident shapes
+            or int(self._cache.cluster.groups.valid.shape[0])
+            != int(cluster.groups.valid.shape[0])
+        )
+        if rebuild:
+            self._cache = DeviceClusterCache(cluster)
+            self._inc = IncrementalDecider(
+                self._cache, impl=self._impl,
+                refresh_every=self._refresh_every, on_mismatch="repair")
+        else:
+            pod_slots = _changed_slots(self._host_prev[0], cluster.pods)
+            node_slots = _changed_slots(self._host_prev[1], cluster.nodes)
+            self._cache.set_host(cluster.pods, cluster.nodes)
+            self._inc.apply_gathered(
+                self._cache.gather_deltas(pod_slots, node_slots),
+                cluster.groups,
+            )
+        # pack_cluster allocates fresh arrays every call, so keeping the
+        # references IS the snapshot — no copy
+        self._host_prev = (cluster.pods, cluster.nodes)
+        t1 = time.perf_counter()
+        tainted_any = bool(
+            (np.asarray(cluster.nodes.valid)
+             & np.asarray(cluster.nodes.tainted)).any())
+        out, ordered = self._inc.decide(now_sec, tainted_any)
+        t2 = time.perf_counter()
+        metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
+        metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+        results = _unpack(out, group_inputs, ordered=ordered,
+                          node_masks=cluster.nodes)
+        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
+        return results
+
+
 class ShardedJaxBackend(ComputeBackend):
     """Nodegroup axis sharded over a device mesh (escalator_tpu.parallel.mesh)."""
 
@@ -716,13 +818,16 @@ def make_backend(kind: str = "auto") -> ComputeBackend:
     elsewhere (their compute is remote)."""
     if kind == "golden":
         return GoldenBackend()
-    if kind not in ("jax", "sharded-jax", "grid-jax", "podaxis-jax", "auto"):
+    if kind not in ("jax", "incremental-jax", "sharded-jax", "grid-jax",
+                    "podaxis-jax", "auto"):
         raise ValueError(f"unknown backend {kind!r}")
     from escalator_tpu.jaxconfig import ensure_responsive_accelerator
 
     ensure_responsive_accelerator()
     if kind == "jax":
         return JaxBackend()
+    if kind == "incremental-jax":
+        return IncrementalJaxBackend()
     if kind == "sharded-jax":
         return ShardedJaxBackend()
     if kind == "grid-jax":

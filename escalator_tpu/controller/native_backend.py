@@ -51,7 +51,11 @@ class NativeJaxBackend(ComputeBackend):
     needs_objects = False
 
     def __init__(self, client: EventfulClient, groups: Sequence[GroupFilters],
-                 pod_capacity: int = 1 << 17, node_capacity: int = 1 << 15):
+                 pod_capacity: int = 1 << 17, node_capacity: int = 1 << 15,
+                 incremental: "bool | None" = None,
+                 refresh_every: "int | None" = None):
+        import os
+
         from escalator_tpu.native.statestore import NativeStateStore
         from escalator_tpu.ops import kernel
 
@@ -64,6 +68,22 @@ class NativeJaxBackend(ComputeBackend):
         # Device-resident cluster cache (ops/device_state.py): built on first
         # decide, scatter-updated with the store's dirty slots per tick.
         self._cache = None
+        # Incremental decide (round 8, ops/device_state.IncrementalDecider):
+        # persistent per-group aggregates maintained by the scatter's exact
+        # deltas + dirty-group-compacted decision math — steady-state decide
+        # becomes O(dirty groups + N elementwise) instead of O(cluster).
+        # Opt-in (param, else ESCALATOR_TPU_INCREMENTAL_DECIDE=1): the
+        # incremental dispatch pair pins the XLA scatter path (its delta
+        # batches are exactly the tiny-scatter shape; the Pallas sweep's win
+        # is the full-cluster interleaved sweep this mode exists to avoid),
+        # so the pallas resilience machinery below stays on the legacy path.
+        if incremental is None:
+            incremental = os.environ.get(
+                "ESCALATOR_TPU_INCREMENTAL_DECIDE", "0"
+            ).lower() in ("1", "true", "yes")
+        self._incremental = bool(incremental)
+        self._refresh_every = refresh_every
+        self._inc = None
         # node slots whose device lanes were overridden by last tick's dry-mode
         # view — they must be re-scattered (possibly back to raw) this tick
         self._overridden_slots = np.empty(0, np.int64)
@@ -177,6 +197,16 @@ class NativeJaxBackend(ComputeBackend):
                 self._cache is None
                 or self._cache.pod_capacity != self.store.pod_capacity
                 or self._cache.node_capacity != self.store.node_capacity
+                # incremental state is [G]-shaped: a group-count change that
+                # crosses the pad_groups power-of-two boundary (8 -> 9
+                # groups) changes the packed groups shape with the store
+                # capacities unchanged — the aggregates and persistent
+                # columns must rebuild, not broadcast-crash. The legacy path
+                # tolerates the swap (groups ride through whole), so the
+                # extra rebuild is scoped to incremental mode.
+                or (self._incremental and self._cache is not None
+                    and int(self._cache.cluster.groups.valid.shape[0])
+                    != int(groups.valid.shape[0]))
             )
             if rebuild:
                 # first tick or store growth: copy the full columns under the
@@ -201,6 +231,18 @@ class NativeJaxBackend(ComputeBackend):
             self._cache = DeviceClusterCache(
                 ClusterArrays(groups=groups, pods=pods_snap, nodes=nodes_snap)
             )
+            if self._incremental:
+                from escalator_tpu.ops.device_state import IncrementalDecider
+
+                # a production controller must not crash-loop on an audit
+                # mismatch: repair (recompute + full dirty) and log loudly
+                self._inc = IncrementalDecider(
+                    self._cache, impl="xla",
+                    refresh_every=self._refresh_every, on_mismatch="repair")
+        elif self._inc is not None:
+            # incremental: same scatter batch, but the device program also
+            # folds the exact aggregate deltas + dirty marks (one dispatch)
+            self._inc.apply_gathered(gathered, groups)
         else:
             # two async dispatches (scatter, then decide) pipeline back-to-back;
             # measured faster than the fused single-program alternative
@@ -208,6 +250,22 @@ class NativeJaxBackend(ComputeBackend):
             self._cache.apply_gathered(gathered, groups)
         self._overridden_slots = overridden
         t1 = time.perf_counter()
+        if self._inc is not None:
+            # incremental dispatch pair (delta_decide light / aggregate-fed
+            # ordered) with the same lazy-orders gate semantics
+            out, ordered = self._inc.decide(now_sec, tainted_any)
+            t2 = time.perf_counter()
+            metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
+            metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+            results = self._unpack(out, group_inputs, unpack_group,
+                                   unpack_cordoned, ordered=ordered,
+                                   untainted_mask=unpack_untainted)
+            if packing_rows:
+                sel = set(PackingPostPass.select(results, group_inputs))
+                self._packing.apply_arrays(
+                    results, [row for row in packing_rows if row[0] in sel]
+                )
+            return results
         # blocks on the result itself: an async device failure must surface
         # inside the resilient wrapper, not here. The lazy protocol sorts
         # only when an ordering has a consumer; imported from the real kernel
@@ -467,6 +525,8 @@ def make_native_backend(
     node_group_options,
     pod_capacity: int = 1 << 12,
     node_capacity: int = 1 << 10,
+    incremental: "bool | None" = None,
+    refresh_every: "int | None" = None,
 ) -> NativeJaxBackend:
     """Wire group filters from NodeGroupOptions (same filters the listers use).
 
@@ -493,5 +553,7 @@ def make_native_backend(
             )
         )
     return NativeJaxBackend(
-        client, filters, pod_capacity=pod_capacity, node_capacity=node_capacity
+        client, filters, pod_capacity=pod_capacity,
+        node_capacity=node_capacity, incremental=incremental,
+        refresh_every=refresh_every,
     )

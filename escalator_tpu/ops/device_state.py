@@ -27,8 +27,10 @@ simply re-uploaded rather than diffed.
 
 from __future__ import annotations
 
-from dataclasses import fields
-from functools import partial
+import logging
+from dataclasses import fields, replace
+from functools import partial, reduce
+from operator import or_
 from typing import Optional
 
 import numpy as np
@@ -199,6 +201,176 @@ def _scatter_update_decide(
                                    with_orders=with_orders)
 
 
+# ---------------------------------------------------------------------------
+# Incremental aggregates (round-8 tentpole): the scatter phase, which already
+# knows exactly which lanes changed, also emits exact per-group aggregate
+# deltas into the persistent GroupAggregates columns and marks dirty groups.
+# ---------------------------------------------------------------------------
+
+
+def aggregate_lane_deltas(pod_old, pod_new, node_old, node_new,
+                          node_group_old, node_group_new, G: int, N: int):
+    """Exact int64 aggregate deltas from a delta batch's (old, new) lane
+    values: subtract each touched lane's old contribution, add its new one.
+    The i64 milli-CPU / byte columns (the R2 dtype-parity contract) make
+    this drift-free by construction — integer sums commute and associate
+    exactly, so ``aggregate + delta`` is bit-equal to a from-scratch
+    recompute. Contribution terms mirror ``kernel.aggregate_pods`` /
+    ``kernel.aggregate_nodes`` term by term.
+
+    ``pod_old``/``pod_new`` are PodArrays of the SAME ``[B]`` lanes before
+    and after the scatter (pad lanes carry identical never-valid values on
+    both sides and so contribute zero); likewise the node batch. Lane
+    indices within a batch must be unique — the native store drains a
+    DEDUPLICATED dirty list, and the host-diff backends emit np.nonzero
+    indices; a duplicate would double-count its old contribution.
+    ``node_group_old``/``node_group_new`` are the full ``[N]`` node->group
+    vectors before/after the scatter (the same-group pod filter of
+    ``node_pods_remaining`` reads them).
+
+    Returns ``(deltas: dict, touched: bool[G], node_group_changed: bool[])``
+    where ``deltas`` has one ``[G]`` int64 entry per group-sum column plus
+    ``node_pods_remaining`` (``[N]`` int64), ``touched`` marks every group a
+    delta landed in (the dirty-mask contribution), and
+    ``node_group_changed`` is True when any batched node lane's group column
+    changed — the one case where pods OUTSIDE the batch change their
+    pods-remaining contribution and the caller must re-sweep that column
+    (``kernel.node_pods_remaining_sweep``)."""
+    import jax
+    import jax.numpy as jnp
+
+    seg = lambda v, i, n: jax.ops.segment_sum(v, i, num_segments=n)  # noqa: E731
+    I64 = jnp.int64
+
+    def pod_terms(p, node_group):
+        w = p.valid.astype(I64)
+        gid = jnp.where(p.valid, p.group, 0)
+        on_w = (
+            p.valid
+            & (p.node >= 0)
+            & (p.group == node_group[jnp.clip(p.node, 0, N - 1)])
+        )
+        tgt = jnp.where(p.valid & (p.node >= 0), p.node, 0)
+        return gid, w, on_w.astype(I64), tgt
+
+    gid_o, w_o, on_o, tgt_o = pod_terms(pod_old, node_group_old)
+    gid_n, w_n, on_n, tgt_n = pod_terms(pod_new, node_group_new)
+
+    def node_terms(n):
+        gid = jnp.where(n.valid, n.group, 0)
+        u = (n.valid & ~n.tainted & ~n.cordoned).astype(I64)
+        t = (n.valid & n.tainted & ~n.cordoned).astype(I64)
+        c = (n.valid & n.cordoned).astype(I64)
+        return gid, n.valid.astype(I64), u, t, c
+
+    ngid_o, nv_o, u_o, t_o, c_o = node_terms(node_old)
+    ngid_n, nv_n, u_n, t_n, c_n = node_terms(node_new)
+
+    deltas = {
+        "cpu_req": seg(pod_new.cpu_milli * w_n, gid_n, G)
+        - seg(pod_old.cpu_milli * w_o, gid_o, G),
+        "mem_req": seg(pod_new.mem_bytes * w_n, gid_n, G)
+        - seg(pod_old.mem_bytes * w_o, gid_o, G),
+        "num_pods": seg(w_n, gid_n, G) - seg(w_o, gid_o, G),
+        "node_pods_remaining": seg(on_n, tgt_n, N) - seg(on_o, tgt_o, N),
+        "cpu_cap": seg(node_new.cpu_milli * u_n, ngid_n, G)
+        - seg(node_old.cpu_milli * u_o, ngid_o, G),
+        "mem_cap": seg(node_new.mem_bytes * u_n, ngid_n, G)
+        - seg(node_old.mem_bytes * u_o, ngid_o, G),
+        "num_nodes": seg(nv_n, ngid_n, G) - seg(nv_o, ngid_o, G),
+        "num_untainted": seg(u_n, ngid_n, G) - seg(u_o, ngid_o, G),
+        "num_tainted": seg(t_n, ngid_n, G) - seg(t_o, ngid_o, G),
+        "num_cordoned": seg(c_n, ngid_n, G) - seg(c_o, ngid_o, G),
+    }
+    touched = jnp.zeros(G, bool)
+    for gid, valid in ((gid_o, pod_old.valid), (gid_n, pod_new.valid),
+                       (ngid_o, node_old.valid), (ngid_n, node_new.valid)):
+        # invalid lanes point at group 0 with a False update: a no-op
+        touched = touched.at[gid].max(valid)
+    # ANY group-column change counts, valid or not: aggregate_pods' same-group
+    # filter reads node_group regardless of the node's validity, so a stale
+    # group column flipping under an invalid lane still moves pods-remaining
+    node_group_changed = jnp.any(node_old.group != node_new.group)
+    return deltas, touched, node_group_changed
+
+
+def group_rows_changed(groups_old, groups_new):
+    """Elementwise ``[G]`` mask of group config/state rows that changed —
+    the dirty-mask contribution of the per-tick group re-upload. Shared by
+    the native scatter program and the pod-axis delta scatter so the
+    config-dirty semantics cannot drift."""
+    return reduce(or_, (
+        getattr(groups_old, f.name) != getattr(groups_new, f.name)
+        for f in fields(type(groups_new))
+    ))
+
+
+def fold_aggregate_deltas(aggs, deltas, touched, group_row_changed,
+                          node_pods_remaining):
+    """Apply one batch's exact deltas to the maintained
+    :class:`kernel.GroupAggregates` — THE single place the column list is
+    folded, used by both ``_scatter_update_aggs`` and
+    ``parallel.podaxis.make_delta_scatter`` (a column added to
+    GroupAggregates that is missed here fails loudly at construction
+    instead of silently breaking the refresh audit's bit-equality on one
+    path). ``node_pods_remaining`` is passed ready-made because the two
+    callers correct the node-group-change case differently (in-program
+    re-sweep vs host-level flag)."""
+    return _kernel.GroupAggregates(
+        cpu_req=aggs.cpu_req + deltas["cpu_req"],
+        mem_req=aggs.mem_req + deltas["mem_req"],
+        num_pods=aggs.num_pods + deltas["num_pods"],
+        cpu_cap=aggs.cpu_cap + deltas["cpu_cap"],
+        mem_cap=aggs.mem_cap + deltas["mem_cap"],
+        num_nodes=aggs.num_nodes + deltas["num_nodes"],
+        num_untainted=aggs.num_untainted + deltas["num_untainted"],
+        num_tainted=aggs.num_tainted + deltas["num_tainted"],
+        num_cordoned=aggs.num_cordoned + deltas["num_cordoned"],
+        node_pods_remaining=node_pods_remaining,
+        dirty=aggs.dirty | touched | group_row_changed,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 8))
+def _scatter_update_aggs(pods, nodes, groups_old, groups_new, pod_idx,
+                         pod_vals, node_idx, node_vals, aggs):
+    """The incremental tick's scatter: apply the dirty-lane deltas to the
+    resident arrays (exactly ``_scatter_body``) AND maintain the persistent
+    per-group aggregates in the same device program — subtract each touched
+    lane's old contribution, add its new one, and fold the touched groups
+    (plus every group whose config/state row changed between ``groups_old``
+    and ``groups_new``) into the dirty mask that ``kernel.delta_decide``
+    consumes. Donates pods/nodes (as ``_scatter_update``) and the aggregate
+    columns (each output sum aliases its input buffer: one add in place)."""
+    G = groups_new.valid.shape[0]
+    N = nodes.valid.shape[0]
+    gather = lambda soa, idx: type(soa)(  # noqa: E731
+        **{f.name: getattr(soa, f.name)[idx] for f in fields(soa)}
+    )
+    pod_old = gather(pods, pod_idx)
+    node_old = gather(nodes, node_idx)
+    node_group_old = nodes.group
+    cluster = _scatter_body(
+        pods, nodes, groups_new, pod_idx, pod_vals, node_idx, node_vals
+    )
+    deltas, touched, node_group_changed = aggregate_lane_deltas(
+        pod_old, pod_vals, node_old, node_vals,
+        node_group_old, cluster.nodes.group, G, N,
+    )
+    # the rare exact-correction case: a node lane's group column changed, so
+    # pods outside the batch moved their pods-remaining contribution — one
+    # O(P) column re-sweep (still no O(P) group sums; those are delta-exact)
+    npr = jax.lax.cond(
+        node_group_changed,
+        lambda: _kernel.node_pods_remaining_sweep(
+            cluster.pods, cluster.nodes.group, N),
+        lambda: aggs.node_pods_remaining + deltas["node_pods_remaining"],
+    )
+    aggs_out = fold_aggregate_deltas(
+        aggs, deltas, touched, group_rows_changed(groups_old, groups_new), npr)
+    return cluster, aggs_out
+
+
 class DeviceClusterCache:
     """Keeps the packed cluster resident on one device across ticks.
 
@@ -294,6 +466,23 @@ class DeviceClusterCache:
         )
         return self._cluster
 
+    def apply_gathered_with_aggregates(self, gathered, groups, aggs):
+        """:meth:`apply_gathered` fused with the persistent-aggregate delta
+        maintenance (``_scatter_update_aggs``): scatter the batch into the
+        resident arrays and return the updated :class:`GroupAggregates`
+        (donated in, replaced out — drop the old reference). ``groups`` may
+        be None to keep the resident group rows (no config-dirty compare
+        triggers then)."""
+        groups_old = self._cluster.groups
+        if groups is None:
+            groups = groups_old
+        pidx, pvals, nidx, nvals = gathered
+        self._cluster, aggs = _scatter_update_aggs(
+            self._cluster.pods, self._cluster.nodes, groups_old, groups,
+            pidx, pvals, nidx, nvals, aggs,
+        )
+        return self._cluster, aggs
+
     def apply_dirty(
         self,
         pod_slots: np.ndarray,
@@ -357,3 +546,148 @@ class DeviceClusterCache:
         resident shapes must follow). Rare by design — capacities double."""
         self.__init__(host, self._device)
         return self._cluster
+
+
+class AggregateParityError(AssertionError):
+    """The incrementally maintained aggregates diverged from a from-scratch
+    recompute — the refresh audit's bit-equality contract was broken (a
+    delta-maintenance bug, or a caller mutating resident state outside the
+    incremental scatter path)."""
+
+
+class IncrementalDecider:
+    """Owns the persistent incremental-decide state for one
+    :class:`DeviceClusterCache`: the :class:`kernel.GroupAggregates`
+    maintained by scatter deltas, the persistent ``[G]`` decision columns,
+    and the refresh-cadence self-audit — the round-8 tentpole's
+    orchestration, shared by the native backend, the host-diff repack
+    backend (controller.backend.IncrementalJaxBackend) and bench cfg14.
+
+    Per tick: :meth:`apply_gathered` (instead of the cache's plain
+    ``apply_gathered``) scatters the dirty lanes AND folds their exact
+    aggregate deltas + dirty-group marks in one device program; then
+    :meth:`decide` runs the lazy-orders protocol over the incremental
+    programs — the LIGHT dispatch is ``kernel.delta_decide`` on the
+    compacted dirty rows (O(D + N), no O(P) sweep, no sort, zero
+    collectives), and the ORDERED dispatch is the full ``kernel.decide``
+    fed the persistent aggregates (so even drain ticks skip the O(cluster)
+    aggregation; the ordering tail already runs only there).
+
+    ``refresh_every`` (default env ESCALATOR_TPU_REFRESH_EVERY, else 256)
+    periodically re-derives the aggregates from scratch and asserts
+    BIT-equality against the maintained state, so correctness is
+    self-auditing in production; ``on_mismatch`` is "raise"
+    (:class:`AggregateParityError`) or "repair" (log an error, adopt the
+    recomputed truth, mark every group dirty). The audit is O(cluster) —
+    same cost as one pre-round-8 decide — amortized over the cadence.
+
+    The aggregate sweeps pin ``impl="xla"``-style scatter adds regardless of
+    the construction ``impl`` only at delta scale; the bootstrap/refresh
+    full sweeps honor ``impl`` (a TPU caller keeps the measured Pallas win
+    where it exists — the O(cluster) recompute)."""
+
+    def __init__(self, cache: DeviceClusterCache, impl: str = "xla",
+                 refresh_every: Optional[int] = None,
+                 on_mismatch: str = "raise"):
+        import os
+
+        if on_mismatch not in ("raise", "repair"):
+            raise ValueError(f"unknown on_mismatch {on_mismatch!r}")
+        if refresh_every is None:
+            refresh_every = int(os.environ.get(
+                "ESCALATOR_TPU_REFRESH_EVERY", "256"))
+        self._cache = cache
+        self._impl = impl
+        self._refresh_every = int(refresh_every)
+        self._on_mismatch = on_mismatch
+        self._aggs = _kernel.compute_aggregates_jit(cache.cluster, impl=impl)
+        self._prev_cols = None   # tuple in kernel.GROUP_DECISION_FIELDS order
+        self._ticks = 0
+        self.last_dirty_count = 0
+        self.refreshes = 0
+
+    @property
+    def aggregates(self):
+        return self._aggs
+
+    def apply_gathered(self, gathered, groups=None) -> ClusterArrays:
+        """Scatter a ``cache.gather_deltas`` batch into the resident arrays
+        while maintaining the aggregates + dirty mask. Replaces the plain
+        ``cache.apply_gathered`` in an incremental tick."""
+        cluster, self._aggs = self._cache.apply_gathered_with_aggregates(
+            gathered, groups, self._aggs)
+        return cluster
+
+    def _set_prev(self, out) -> None:
+        self._prev_cols = tuple(
+            getattr(out, f) for f in _kernel.GROUP_DECISION_FIELDS)
+
+    def decide(self, now_sec, tainted_any: bool):
+        """One lazy-orders tick (``kernel.lazy_orders_decide``) over the
+        incremental dispatch pair. Returns ``(DecisionArrays, ordered)``
+        with the protocol's exact semantics: when ``ordered`` is False the
+        order fields are input-order placeholders and no window may be
+        read."""
+        import jax
+
+        self._ticks += 1
+        if self._refresh_every and self._ticks % self._refresh_every == 0:
+            self.refresh()
+        now = np.int64(now_sec)
+
+        def dispatch(with_orders):
+            if with_orders or self._prev_cols is None:
+                # full decide, fed the persistent aggregates: the O(P)/O(N)
+                # sweeps are skipped; every [G] row recomputes (cheap), so
+                # the persistent columns refresh wholesale
+                out = jax.block_until_ready(_kernel.decide_jit(
+                    self._cache.cluster, now, impl=self._impl,
+                    aggregates=_kernel.aggregates_tuple(self._aggs),
+                    with_orders=with_orders,
+                ))
+                self._set_prev(out)
+                return out
+            dirty = np.asarray(self._aggs.dirty)
+            self.last_dirty_count = int(dirty.sum())
+            idx = _kernel.dirty_indices(dirty)
+            out, self._aggs = _kernel.delta_decide_jit(
+                self._cache.cluster, self._aggs, self._prev_cols, idx, now)
+            out = jax.block_until_ready(out)
+            self._set_prev(out)
+            return out
+
+        return _kernel.lazy_orders_decide(dispatch, tainted_any)
+
+    def refresh(self) -> bool:
+        """Re-derive the aggregates from the resident cluster and assert
+        bit-equality against the incrementally maintained state (the
+        self-audit). Returns True when the audit passed."""
+        import jax
+
+        self.refreshes += 1
+        fresh = jax.block_until_ready(
+            _kernel.compute_aggregates_jit(self._cache.cluster,
+                                           impl=self._impl))
+        mismatched = [
+            f.name for f in fields(_kernel.GroupAggregates)
+            if f.name != "dirty"
+            and not np.array_equal(np.asarray(getattr(self._aggs, f.name)),
+                                   np.asarray(getattr(fresh, f.name)))
+        ]
+        if not mismatched:
+            return True
+        msg = (
+            "incremental aggregate refresh mismatch on columns "
+            f"{mismatched} after {self._ticks} ticks — the maintained "
+            "state diverged from a from-scratch recompute"
+        )
+        if self._on_mismatch == "raise":
+            raise AggregateParityError(msg)
+        logging.getLogger("escalator_tpu.device_state").error(
+            "%s; repairing: adopting the recompute and marking every group "
+            "dirty", msg)
+        G = int(np.asarray(fresh.dirty).shape[0])
+        import jax.numpy as jnp
+
+        self._aggs = replace(fresh, dirty=jnp.ones(G, bool))
+        return False

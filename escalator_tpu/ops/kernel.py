@@ -28,7 +28,9 @@ this kernel is parity-tested against.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, fields
+from functools import partial
 
 from escalator_tpu.jaxconfig import ensure_x64
 
@@ -87,20 +89,116 @@ tree_util.register_pytree_node(
     DecisionArrays, DecisionArrays.tree_flatten, DecisionArrays.tree_unflatten
 )
 
+#: The [G] DecisionArrays columns the incremental path persists across ticks
+#: (everything except the per-node selections, which are recomputed O(N)
+#: elementwise each tick). Order matters nowhere; membership is the contract
+#: delta_decide's scatter loop and the parity soak both iterate.
+GROUP_DECISION_FIELDS = (
+    "status", "nodes_delta", "cpu_percent", "mem_percent",
+    "cpu_request_milli", "mem_request_bytes",
+    "cpu_capacity_milli", "mem_capacity_bytes",
+    "num_pods", "num_nodes", "num_untainted", "num_tainted", "num_cordoned",
+)
+
+
+@dataclass
+class GroupAggregates:
+    """Persistent device-resident aggregate state for the incremental decide
+    (the round-8 tentpole): the exact integer sums ``aggregate_pods`` /
+    ``aggregate_nodes`` produce, maintained by per-tick deltas from the
+    scatter phase (ops.device_state) instead of an O(cluster) recompute.
+    All sums are int64 — the R2 dtype-parity contract makes the delta
+    maintenance drift-free by construction (no float accumulation anywhere).
+
+    ``dirty`` marks groups whose decision may have changed since the last
+    decide: any group an aggregate delta landed in, plus any group whose
+    config/state row changed. ``delta_decide`` consumes (and clears) it.
+    """
+
+    cpu_req: jnp.ndarray              # int64 [G]
+    mem_req: jnp.ndarray              # int64 [G]
+    num_pods: jnp.ndarray             # int64 [G]
+    cpu_cap: jnp.ndarray              # int64 [G]
+    mem_cap: jnp.ndarray              # int64 [G]
+    num_nodes: jnp.ndarray            # int64 [G]
+    num_untainted: jnp.ndarray        # int64 [G]
+    num_tainted: jnp.ndarray          # int64 [G]
+    num_cordoned: jnp.ndarray         # int64 [G]
+    node_pods_remaining: jnp.ndarray  # int64 [N]
+    dirty: jnp.ndarray                # bool [G]
+
+    def tree_flatten(self):
+        return [getattr(self, f.name) for f in fields(self)], None
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+tree_util.register_pytree_node(
+    GroupAggregates, GroupAggregates.tree_flatten, GroupAggregates.tree_unflatten
+)
+
 _I32 = jnp.int32
 _I64 = jnp.int64
 _F64 = jnp.float64
 
 
-def default_impl() -> str:
+#: Platforms the CPU-fallback auto-select has already logged for (the log is
+#: one-time per process+platform; the decision itself repeats every call).
+_AUTOSELECT_LOGGED: set = set()
+
+
+def _resolve_impl_env(env: str, platform: "str | None") -> str:
+    """CPU-fallback guard shared by the env-driven impl selectors (round 8):
+    a deployment that pins ESCALATOR_TPU_KERNEL_IMPL=pallas for its TPU fleet
+    and then lands on the CPU fallback (wedged tunnel, dev laptop, CI) would
+    silently run interpreter-mode Pallas on the hot path — bench cfg9
+    measured that path losing 5.8-120x to the XLA scatter sweep on every row
+    on this chip. Auto-select "xla" there, with a ONE-TIME log naming the
+    measured reason. ``pallas-force`` bypasses the guard (tests and debugging
+    want interpreter Pallas on purpose) and resolves to "pallas" everywhere.
+    Any other value — including the SET-but-empty string — passes through
+    untouched, so decide()'s fail-fast ValueError contract is unchanged."""
+    if env == "pallas-force":
+        return "pallas"
+    if env != "pallas":
+        return env
+    from escalator_tpu.jaxconfig import PALLAS_COMPILED_PLATFORMS
+
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    if platform in PALLAS_COMPILED_PLATFORMS:
+        return "pallas"
+    if platform not in _AUTOSELECT_LOGGED:
+        _AUTOSELECT_LOGGED.add(platform)
+        logging.getLogger("escalator_tpu.kernel").warning(
+            "ESCALATOR_TPU_KERNEL_IMPL=pallas on platform %r: auto-selecting "
+            "impl='xla' — compiled Pallas exists only on %s, and bench cfg9 "
+            "measured interpreter-mode Pallas 5.8-120x slower than the XLA "
+            "scatter sweep on every row on this chip. Set "
+            "ESCALATOR_TPU_KERNEL_IMPL=pallas-force to run it anyway.",
+            platform, sorted(PALLAS_COMPILED_PLATFORMS))
+    return "xla"
+
+
+def default_impl(platform: "str | None" = None) -> str:
     """Aggregation-sweep selector from ESCALATOR_TPU_KERNEL_IMPL: "xla"
     (default, one scatter-add per column) or "pallas" (the fused MXU sweep).
     Read by every decider constructor that doesn't get an explicit ``impl`` —
     backends, the mesh-sharded and pod-axis deciders alike — so the env switch
-    means the same thing everywhere. Invalid values fail fast in decide()."""
+    means the same thing everywhere. Invalid values fail fast in decide().
+
+    A ``pallas`` env on a platform without compiled Pallas auto-selects
+    "xla" with a one-time log (see :func:`_resolve_impl_env`); ``platform``
+    defaults to the live jax backend and is only resolved when the env asks
+    for pallas, so the common path never touches jax."""
     import os
 
-    return os.environ.get("ESCALATOR_TPU_KERNEL_IMPL", "xla")
+    return _resolve_impl_env(
+        os.environ.get("ESCALATOR_TPU_KERNEL_IMPL", "xla"), platform)
 
 
 def native_tick_impl(platform: str) -> str:
@@ -120,19 +218,40 @@ def native_tick_impl(platform: str) -> str:
 
     An env var that is SET but empty falls through to decide()'s fail-fast
     ValueError, same as ``default_impl`` — the knob misconfigured must not
-    behave differently across backends."""
+    behave differently across backends. A ``pallas`` env on a platform
+    without compiled Pallas auto-selects "xla" with a one-time log
+    (:func:`_resolve_impl_env`); ``pallas-force`` overrides that guard."""
     import os
 
     from escalator_tpu.jaxconfig import PALLAS_COMPILED_PLATFORMS
 
     env = os.environ.get("ESCALATOR_TPU_KERNEL_IMPL")
     if env is not None:
-        return env
+        return _resolve_impl_env(env, platform)
     return "pallas" if platform in PALLAS_COMPILED_PLATFORMS else "xla"
 
 
 def _segsum(values, segment_ids, num_segments):
     return jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
+
+
+def node_pods_remaining_sweep(p: PodArrays, node_group: jnp.ndarray, N: int):
+    """The per-node pod-count half of :func:`aggregate_pods` (the
+    same-group filter of controller.go:259), callable on its own: the
+    incremental scatter path re-runs JUST this O(P) sweep on the rare tick a
+    node lane's group column changes (pods pointing at that node flip their
+    contribution without appearing in the delta batch — see
+    ops.device_state._scatter_update_aggs). Returns int64 ``[N]``."""
+    pvalid = p.valid
+    pod_node = jnp.where(pvalid & (p.node >= 0), p.node, 0)
+    pod_on_node_w = (
+        pvalid
+        & (p.node >= 0)
+        # a pod only counts for its own group's node-info map (the reference
+        # builds the map from group-filtered pod+node lists, controller.go:259)
+        & (p.group == node_group[jnp.clip(p.node, 0, N - 1)])
+    )
+    return _segsum(pod_on_node_w.astype(_I64), pod_node, N)
 
 
 def aggregate_pods(p: PodArrays, node_group: jnp.ndarray, G: int, N: int,
@@ -152,15 +271,6 @@ def aggregate_pods(p: PodArrays, node_group: jnp.ndarray, G: int, N: int,
     pgroup = jnp.where(pvalid, p.group, 0)
     pw = pvalid.astype(_I64)
 
-    pod_node = jnp.where(pvalid & (p.node >= 0), p.node, 0)
-    pod_on_node_w = (
-        pvalid
-        & (p.node >= 0)
-        # a pod only counts for its own group's node-info map (the reference
-        # builds the map from group-filtered pod+node lists, controller.go:259)
-        & (p.group == node_group[jnp.clip(p.node, 0, N - 1)])
-    )
-
     if impl == "pallas":
         from escalator_tpu.ops import pallas_kernel
 
@@ -178,7 +288,7 @@ def aggregate_pods(p: PodArrays, node_group: jnp.ndarray, G: int, N: int,
         cpu_req = _segsum(p.cpu_milli * pw, pgroup, G)
         mem_req = _segsum(p.mem_bytes * pw, pgroup, G)
         num_pods = _segsum(pw, pgroup, G)
-    node_pods_remaining = _segsum(pod_on_node_w.astype(_I64), pod_node, N)
+    node_pods_remaining = node_pods_remaining_sweep(p, node_group, N)
     return cpu_req, mem_req, num_pods, node_pods_remaining
 
 
@@ -226,73 +336,86 @@ def aggregate_nodes(n: NodeArrays, G: int, impl: str = "xla"):
     )
 
 
-def decide(
-    cluster: ClusterArrays,
-    now_sec: jnp.ndarray,
-    impl: str = "xla",
-    aggregates=None,
-    with_orders: bool = True,
-) -> DecisionArrays:
-    """Evaluate every nodegroup's scale decision. Pure; shapes static; jit-safe.
+# ---------------------------------------------------------------------------
+# Incremental decide (round 8): persistent aggregates + dirty-group compaction
+# ---------------------------------------------------------------------------
 
-    impl selects the aggregation sweep: "xla" = one scatter-add per column
-    (jax.ops.segment_sum); "pallas" = the fused windowed one-hot-matmul MXU
-    kernel (ops.pallas_kernel), which self-sorts group-interleaved lanes on
-    device and falls back to the scatter path only for out-of-range values or
-    sub-lane-per-group pathology. Outputs are bit-identical either way.
 
-    aggregates optionally injects precomputed (pod_aggs, node_aggs) from
-    :func:`aggregate_pods`/:func:`aggregate_nodes` — used by the pod-axis
-    sharded path, which psums shard-partial sums into exactly these values.
-
-    with_orders=False (static) skips the combined node-ordering sort — the
-    decide tail's dominant cost (~12 ms per 50k-node sort on the CPU
-    fallback) — and returns input-order permutations in the two order
-    fields, which are then NOT the documented selection orders. Every other
-    field is bit-identical to the with_orders=True program. This is the
-    light half of the lazy-orders tick protocol (:func:`lazy_orders_decide`):
-    the reference only ever sorts inside an executor that consumes the
-    order (taintOldestN, pkg/controller/scale_down.go:171; untaintNewestN,
-    scale_up.go:118), so a tick that taints/untaints/reaps nothing never
-    pays for ordering. Public callers keep the default; every array backend
-    (native, repack jax, and the sharded three via order-free decider
-    variants) runs the protocol, while the decider factories' ORDERED
-    outputs remain the sharded-vs-single bit-parity contract and the gRPC
-    plugin always ships full orders. One scoped exception: the pod-axis
-    decider's block-sharded busy tail (ops.order_tail) guarantees bit-
-    parity per offset WINDOW — the documented consumer contract — while
-    the unspecified region beyond the windows may differ (its docstring
-    carries the argument)."""
-    if impl not in ("xla", "pallas"):
-        raise ValueError(f"unknown aggregation impl {impl!r}")
-    g: GroupArrays = cluster.groups
-    p: PodArrays = cluster.pods
-    n: NodeArrays = cluster.nodes
+def compute_aggregates(cluster: ClusterArrays, impl: str = "xla") -> GroupAggregates:
+    """Full O(cluster) recompute of the persistent aggregate state — the
+    bootstrap (first tick / cache rebuild) and the periodic refresh audit's
+    reference. Exactly the sums :func:`decide` computes when ``aggregates``
+    is not injected, so a :class:`GroupAggregates` maintained by deltas is
+    REQUIRED to stay bit-equal to this function's output (integer sums
+    commute and associate exactly; there is no float anywhere)."""
+    g = cluster.groups
+    n = cluster.nodes
     G = g.valid.shape[0]
     N = n.valid.shape[0]
-
-    # ---- aggregation (replaces pkg/k8s/util.go:27-51 per-group loops) ----
-    if aggregates is None:
-        pod_aggs = aggregate_pods(p, n.group, G, N, impl)
-        node_aggs = aggregate_nodes(n, G, impl)
-    else:
-        pod_aggs, node_aggs = aggregates
-    cpu_req, mem_req, num_pods64, node_pods_remaining64 = pod_aggs
-    cpu_cap, mem_cap, nn64, nu64, nt64, nc64 = node_aggs
-    num_pods = num_pods64.astype(_I32)
-    num_nodes = nn64.astype(_I32)
-    num_untainted = nu64.astype(_I32)
-    num_tainted = nt64.astype(_I32)
-    num_cordoned = nc64.astype(_I32)
-
-    # shared selection-classification seam (ops.order_tail) so the pod-axis
-    # block-sharded tail sorts with exactly these masks/keys
-    from escalator_tpu.ops.order_tail import node_selection_masks
-
-    ngroup, untainted_sel, tainted_sel = node_selection_masks(
-        n.valid, n.group, n.tainted, n.cordoned
+    cpu_req, mem_req, num_pods, npr = aggregate_pods(
+        cluster.pods, n.group, G, N, impl)
+    cpu_cap, mem_cap, nn, nu, nt, nc = aggregate_nodes(n, G, impl)
+    return GroupAggregates(
+        cpu_req=cpu_req, mem_req=mem_req, num_pods=num_pods,
+        cpu_cap=cpu_cap, mem_cap=mem_cap, num_nodes=nn,
+        num_untainted=nu, num_tainted=nt, num_cordoned=nc,
+        node_pods_remaining=npr,
+        dirty=jnp.zeros(G, bool),
     )
 
+
+compute_aggregates_jit = jax.jit(compute_aggregates, static_argnames=("impl",))
+
+
+def aggregates_tuple(aggs: GroupAggregates):
+    """Adapter: a maintained :class:`GroupAggregates` as the
+    ``(pod_aggs, node_aggs)`` tuple :func:`decide` accepts via its
+    ``aggregates=`` parameter — an incremental caller's ORDERED/full ticks
+    skip the O(cluster) sweeps too, paying only the [G] math + [N] tail."""
+    return (
+        (aggs.cpu_req, aggs.mem_req, aggs.num_pods, aggs.node_pods_remaining),
+        (aggs.cpu_cap, aggs.mem_cap, aggs.num_nodes, aggs.num_untainted,
+         aggs.num_tainted, aggs.num_cordoned),
+    )
+
+
+_MIN_DIRTY_BUCKET = 8
+
+
+def dirty_indices(dirty_mask, min_bucket: int = _MIN_DIRTY_BUCKET):
+    """Host-side dirty-row compaction: int32 ``[D]`` indices of set rows,
+    padded to a power-of-two bucket (min ``min_bucket``, capped at G) so the
+    delta-decide jit compiles a handful of shapes as churn fluctuates — the
+    same bounded-retrace policy as the lane buckets in ops.device_state.
+    Pad entries are ``G`` (one past the last row): gathers clip them onto a
+    real row whose result is then DISCARDED by the ``mode="drop"`` scatter.
+    """
+    dirty_mask = np.asarray(dirty_mask)
+    idx = np.nonzero(dirty_mask)[0]
+    G = int(dirty_mask.shape[0])
+    bucket = min(G, max(min_bucket, 1 << max(len(idx) - 1, 0).bit_length()))
+    bucket = max(bucket, len(idx))  # G below the min bucket: never truncate
+    out = np.full(bucket, G, np.int32)
+    out[: len(idx)] = idx
+    return out
+
+
+def group_decision_math(g: GroupArrays, cpu_req, mem_req, cpu_cap, mem_cap,
+                        num_pods, num_nodes, num_untainted):
+    """The per-group decision core — percent usage (pkg/controller/util.go:
+    58-81), scale-up delta (util.go:13-46), threshold switch
+    (controller.go:332-351) and the status priority cascade — as ONE
+    shape-polymorphic elementwise function: :func:`decide` runs it on the
+    full ``[G]`` rows, :func:`delta_decide` on a compacted ``[D]`` dirty
+    batch. Single implementation so the two paths cannot drift; every op is
+    elementwise, so the same int64/float64 inputs produce bit-identical
+    outputs at either shape.
+
+    ``cpu_req``/``mem_req``/``cpu_cap``/``mem_cap`` are the int64 aggregate
+    sums; counts are int32. Returns ``(status, nodes_delta, cpu_percent,
+    mem_percent, cpu_req_masked, mem_req_masked, cpu_cap_masked,
+    mem_cap_masked)`` — the masked sums apply the reference's
+    pre-aggregation-exit zeroing (controller.go:233-255)."""
     # ---- percent usage (pkg/controller/util.go:58-81) ----
     # Memory percent uses MilliValue (= bytes*1000) in the reference; replicate the
     # exact int64->float64 conversion order for bit-parity.
@@ -430,6 +553,112 @@ def decide(
     cpu_cap = jnp.where(pre_agg_exit, zero64, cpu_cap)
     mem_cap = jnp.where(pre_agg_exit, zero64, mem_cap)
 
+    return (status, nodes_delta, cpu_pct_out, mem_pct_out,
+            cpu_req, mem_req, cpu_cap, mem_cap)
+
+
+def _node_offsets(sel, ngroup, G):
+    """Per-group window offsets for a node selection class ([G+1] int32)."""
+    counts = _segsum(sel.astype(_I64), ngroup, G)
+    return jnp.concatenate(
+        [jnp.zeros(1, _I64), jnp.cumsum(counts)]
+    ).astype(_I32)
+
+
+def _reap_eligibility(n: NodeArrays, g: GroupArrays, ngroup, tainted_sel,
+                      node_pods_remaining, now_sec):
+    """Reaper mask (pkg/controller/scale_down.go:51-99), O(N) elementwise —
+    shared by decide() and the delta path. ``node_pods_remaining`` is i32."""
+    has_tt = n.taint_time_sec != NO_TAINT_TIME
+    age = now_sec.astype(_I64) - n.taint_time_sec
+    return (
+        tainted_sel
+        & ~n.no_delete
+        & has_tt
+        & (age > g.soft_grace_sec[ngroup])
+        & ((node_pods_remaining == 0) | (age > g.hard_grace_sec[ngroup]))
+    )
+
+
+def decide(
+    cluster: ClusterArrays,
+    now_sec: jnp.ndarray,
+    impl: str = "xla",
+    aggregates=None,
+    with_orders: bool = True,
+) -> DecisionArrays:
+    """Evaluate every nodegroup's scale decision. Pure; shapes static; jit-safe.
+
+    impl selects the aggregation sweep: "xla" = one scatter-add per column
+    (jax.ops.segment_sum); "pallas" = the fused windowed one-hot-matmul MXU
+    kernel (ops.pallas_kernel), which self-sorts group-interleaved lanes on
+    device and falls back to the scatter path only for out-of-range values or
+    sub-lane-per-group pathology. Outputs are bit-identical either way.
+
+    aggregates optionally injects precomputed (pod_aggs, node_aggs) from
+    :func:`aggregate_pods`/:func:`aggregate_nodes` — used by the pod-axis
+    sharded path, which psums shard-partial sums into exactly these values,
+    and by the incremental path's ordered/full ticks, which feed the
+    persistently maintained :class:`GroupAggregates` through
+    :func:`aggregates_tuple` (so even drain ticks skip the O(cluster)
+    sweeps).
+
+    with_orders=False (static) skips the combined node-ordering sort — the
+    decide tail's dominant cost (~12 ms per 50k-node sort on the CPU
+    fallback) — and returns input-order permutations in the two order
+    fields, which are then NOT the documented selection orders. Every other
+    field is bit-identical to the with_orders=True program. This is the
+    light half of the lazy-orders tick protocol (:func:`lazy_orders_decide`):
+    the reference only ever sorts inside an executor that consumes the
+    order (taintOldestN, pkg/controller/scale_down.go:171; untaintNewestN,
+    scale_up.go:118), so a tick that taints/untaints/reaps nothing never
+    pays for ordering. Public callers keep the default; every array backend
+    (native, repack jax, and the sharded three via order-free decider
+    variants) runs the protocol, while the decider factories' ORDERED
+    outputs remain the sharded-vs-single bit-parity contract and the gRPC
+    plugin always ships full orders. One scoped exception: the pod-axis
+    decider's block-sharded busy tail (ops.order_tail) guarantees bit-
+    parity per offset WINDOW — the documented consumer contract — while
+    the unspecified region beyond the windows may differ (its docstring
+    carries the argument)."""
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown aggregation impl {impl!r}")
+    g: GroupArrays = cluster.groups
+    p: PodArrays = cluster.pods
+    n: NodeArrays = cluster.nodes
+    G = g.valid.shape[0]
+    N = n.valid.shape[0]
+
+    # ---- aggregation (replaces pkg/k8s/util.go:27-51 per-group loops) ----
+    if aggregates is None:
+        pod_aggs = aggregate_pods(p, n.group, G, N, impl)
+        node_aggs = aggregate_nodes(n, G, impl)
+    else:
+        pod_aggs, node_aggs = aggregates
+    cpu_req, mem_req, num_pods64, node_pods_remaining64 = pod_aggs
+    cpu_cap, mem_cap, nn64, nu64, nt64, nc64 = node_aggs
+    num_pods = num_pods64.astype(_I32)
+    num_nodes = nn64.astype(_I32)
+    num_untainted = nu64.astype(_I32)
+    num_tainted = nt64.astype(_I32)
+    num_cordoned = nc64.astype(_I32)
+
+    # shared selection-classification seam (ops.order_tail) so the pod-axis
+    # block-sharded tail sorts with exactly these masks/keys
+    from escalator_tpu.ops.order_tail import node_selection_masks
+
+    ngroup, untainted_sel, tainted_sel = node_selection_masks(
+        n.valid, n.group, n.tainted, n.cordoned
+    )
+
+    # ---- per-group decision math (the shared elementwise core; the delta
+    # path runs the SAME function on a compacted dirty batch) ----
+    (status, nodes_delta, cpu_pct_out, mem_pct_out,
+     cpu_req, mem_req, cpu_cap, mem_cap) = group_decision_math(
+        g, cpu_req, mem_req, cpu_cap, mem_cap,
+        num_pods, num_nodes, num_untainted,
+    )
+
     # ---- selections (pkg/controller/sort.go; scale_up.go:118; scale_down.go:171) ----
     # emptiest_first groups rank victims by pod count before age; elsewhere the
     # primary key is 0, reducing to the reference's oldest-first order exactly.
@@ -470,14 +699,8 @@ def decide(
         )
         return perm.astype(_I32)
 
-    def offsets(sel):
-        counts = _segsum(sel.astype(_I64), ngroup, G)
-        return jnp.concatenate(
-            [jnp.zeros(1, _I64), jnp.cumsum(counts)]
-        ).astype(_I32)
-
-    untainted_offsets = offsets(untainted_sel)
-    tainted_offsets = offsets(tainted_sel)
+    untainted_offsets = _node_offsets(untainted_sel, ngroup, G)
+    tainted_offsets = _node_offsets(tainted_sel, ngroup, G)
     if with_orders:
         untaint_order = jax.lax.cond(
             jnp.any(untainted_sel | tainted_sel),
@@ -495,15 +718,8 @@ def decide(
 
     # ---- reaper eligibility (pkg/controller/scale_down.go:51-99) ----
     node_pods_remaining = node_pods_remaining64.astype(_I32)
-    has_tt = n.taint_time_sec != NO_TAINT_TIME
-    age = now_sec.astype(_I64) - n.taint_time_sec
-    reap_mask = (
-        tainted_sel
-        & ~n.no_delete
-        & has_tt
-        & (age > g.soft_grace_sec[ngroup])
-        & ((node_pods_remaining == 0) | (age > g.hard_grace_sec[ngroup]))
-    )
+    reap_mask = _reap_eligibility(
+        n, g, ngroup, tainted_sel, node_pods_remaining, now_sec)
 
     return DecisionArrays(
         status=status,
@@ -551,6 +767,121 @@ def decide_jit(cluster: ClusterArrays, now_sec, impl: str = "xla",
     ensure_responsive_accelerator()
     return _decide_jit_raw(cluster, now_sec, impl=impl, aggregates=aggregates,
                            with_orders=with_orders)
+
+
+def _delta_decide_core(groups: GroupArrays, nodes: NodeArrays,
+                       aggs: GroupAggregates, prev_cols, dirty_idx, now_sec):
+    """The incremental decide body (round-8 tentpole), shape-agnostic over
+    the dirty-batch width ``D`` — shared by :func:`delta_decide_jit` (single
+    device) and ``parallel.grid.make_grid_delta_decider`` (per group block).
+
+    ``prev_cols`` is the persistent decision state: the ``[G]`` columns of
+    the last decide, as a tuple in ``GROUP_DECISION_FIELDS`` order.
+    ``dirty_idx`` is the host-compacted ``[D]`` dirty-row batch
+    (:func:`dirty_indices`): pad entries are ``G``, clipped on gather and
+    dropped on scatter, so padding rows cost flops but never write.
+
+    The decision math runs ONLY on the ``[D]`` gathered rows — the same
+    :func:`group_decision_math` ops :func:`decide` runs on all ``[G]`` rows,
+    so scattered results are bit-identical to a full recompute given exact
+    aggregates. The ``[N]`` elementwise tail (selection masks, window
+    offsets, reaper mask, pods-remaining cast) is recomputed every tick: it
+    is the only part of the output that depends on ``now_sec``, and it is
+    O(N) elementwise with no sort — the ordering sorts stay exclusive to
+    the ordered/full path (this is the lazy-orders LIGHT program's shape:
+    order fields are input-order placeholders, no window may be read).
+
+    Returns ``(DecisionArrays, GroupAggregates)`` — the aggregates with the
+    processed dirty rows cleared."""
+    from escalator_tpu.ops.order_tail import node_selection_masks
+
+    G = groups.valid.shape[0]
+    N = nodes.valid.shape[0]
+    safe_idx = jnp.clip(dirty_idx, 0, G - 1)
+    take = lambda a: jnp.take(a, safe_idx, axis=0)  # noqa: E731
+
+    g_d = GroupArrays(
+        **{f.name: take(getattr(groups, f.name)) for f in fields(GroupArrays)}
+    )
+    num_pods_d = take(aggs.num_pods).astype(_I32)
+    num_nodes_d = take(aggs.num_nodes).astype(_I32)
+    num_untainted_d = take(aggs.num_untainted).astype(_I32)
+    (status_d, delta_d, cpu_pct_d, mem_pct_d,
+     cpu_req_d, mem_req_d, cpu_cap_d, mem_cap_d) = group_decision_math(
+        g_d, take(aggs.cpu_req), take(aggs.mem_req),
+        take(aggs.cpu_cap), take(aggs.mem_cap),
+        num_pods_d, num_nodes_d, num_untainted_d,
+    )
+    updates = {
+        "status": status_d,
+        "nodes_delta": delta_d,
+        "cpu_percent": cpu_pct_d,
+        "mem_percent": mem_pct_d,
+        "cpu_request_milli": cpu_req_d,
+        "mem_request_bytes": mem_req_d,
+        "cpu_capacity_milli": cpu_cap_d,
+        "mem_capacity_bytes": mem_cap_d,
+        "num_pods": num_pods_d,
+        "num_nodes": num_nodes_d,
+        "num_untainted": num_untainted_d,
+        "num_tainted": take(aggs.num_tainted).astype(_I32),
+        "num_cordoned": take(aggs.num_cordoned).astype(_I32),
+    }
+    cols = dict(zip(GROUP_DECISION_FIELDS, prev_cols, strict=True))
+    for name, val in updates.items():
+        # pad rows (index G) drop; real rows overwrite the persistent column
+        cols[name] = cols[name].at[dirty_idx].set(val, mode="drop")
+
+    ngroup, untainted_sel, tainted_sel = node_selection_masks(
+        nodes.valid, nodes.group, nodes.tainted, nodes.cordoned
+    )
+    # identical expression to decide()'s light trivial_order (the +0*ngroup
+    # sharding-variance tie — see decide())
+    trivial_order = jnp.arange(N, dtype=_I32) + ngroup.astype(_I32) * 0
+    node_pods_remaining = aggs.node_pods_remaining.astype(_I32)
+    out = DecisionArrays(
+        scale_down_order=trivial_order,
+        untainted_offsets=_node_offsets(untainted_sel, ngroup, G),
+        untaint_order=trivial_order,
+        tainted_offsets=_node_offsets(tainted_sel, ngroup, G),
+        reap_mask=_reap_eligibility(
+            nodes, groups, ngroup, tainted_sel, node_pods_remaining, now_sec),
+        node_pods_remaining=node_pods_remaining,
+        **cols,
+    )
+    aggs_out = GroupAggregates(
+        cpu_req=aggs.cpu_req, mem_req=aggs.mem_req, num_pods=aggs.num_pods,
+        cpu_cap=aggs.cpu_cap, mem_cap=aggs.mem_cap, num_nodes=aggs.num_nodes,
+        num_untainted=aggs.num_untainted, num_tainted=aggs.num_tainted,
+        num_cordoned=aggs.num_cordoned,
+        node_pods_remaining=aggs.node_pods_remaining,
+        dirty=aggs.dirty.at[dirty_idx].set(False, mode="drop"),
+    )
+    return out, aggs_out
+
+
+@partial(jax.jit, donate_argnums=(1, 2))
+def _delta_decide_raw(cluster: ClusterArrays, aggs: GroupAggregates,
+                      prev_cols, dirty_idx, now_sec):
+    return _delta_decide_core(cluster.groups, cluster.nodes, aggs, prev_cols,
+                              dirty_idx, now_sec)
+
+
+def delta_decide_jit(cluster: ClusterArrays, aggs: GroupAggregates,
+                     prev_cols, dirty_idx, now_sec):
+    """Jitted incremental decide: O(D + N) work instead of the full decide's
+    O(P + N) sweeps — the steady-state tick when churn is small. The jit
+    cache keys on the dirty bucket width ``D`` (power-of-two padded by
+    :func:`dirty_indices`, so shapes stay few).
+
+    DONATES ``aggs`` and ``prev_cols``: both are persistent device state and
+    the returned values replace them — callers must drop their old
+    references (ops.device_state.IncrementalDecider owns this protocol).
+    Same wedged-transport guard as :func:`decide_jit`."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _delta_decide_raw(cluster, aggs, prev_cols, dirty_idx, now_sec)
 
 
 def lazy_orders_decide(dispatch, tainted_any: bool):

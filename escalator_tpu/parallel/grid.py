@@ -198,6 +198,57 @@ def make_grid_decider(mesh: Mesh, impl: Optional[str] = None,
     return grid_decide
 
 
+def make_grid_delta_decider(mesh: Mesh):
+    """Round-8 incremental decide over the 2-D grid: jitted
+    ``(stacked_groups, stacked_nodes, stacked_aggs, stacked_prev_cols,
+    dirty_idx, now_sec) -> (stacked DecisionArrays, stacked
+    GroupAggregates)`` where every input carries the grid's leading
+    ``[Sg, ...]`` shard axis and ``dirty_idx`` is ``[Sg, D]`` — each group
+    block's dirty rows compacted on the host per shard (pad entries = Gb,
+    the block-local group capacity; same :func:`kernel.dirty_indices`
+    policy, with D the max bucket across blocks so shapes agree).
+
+    Dirty masks live per shard (``stacked_aggs.dirty[s]``), and every term
+    is block-local: the compacted ``[D]`` decision math, the persistent
+    column scatters, and the O(Nb) elementwise tail all run inside the
+    block's mesh row with ZERO collectives — the lazy/steady incremental
+    tick needs no pod axis at all (the aggregates are persistent; the pod
+    sweep and its psum exist only on full-recompute ticks), which is the
+    entire point. The body is literally ``kernel._delta_decide_core`` per
+    block, so per-block outputs are bit-identical to the single-device
+    delta path on the same block (tests/test_incremental_decide.py pins
+    it). Aggregates and prev columns are donated (persistent device
+    state, same protocol as ``kernel.delta_decide_jit``)."""
+    from escalator_tpu.core.arrays import GroupArrays, NodeArrays
+    from escalator_tpu.ops.kernel import GROUP_DECISION_FIELDS, GroupAggregates
+
+    soa = lambda cls, spec: cls(**{f: spec for f in cls.__dataclass_fields__})
+    row = P(GROUP_AXIS)
+    in_specs = (
+        soa(GroupArrays, row),
+        soa(NodeArrays, row),
+        GroupAggregates(*([row] * 11)),
+        tuple(row for _ in GROUP_DECISION_FIELDS),
+        row,
+        P(),
+    )
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(row, row),
+    )
+    def grid_delta_decide(groups, nodes, aggs, prev_cols, dirty_idx, now_sec):
+        def one_block(g, n, a, p, d):
+            return kernel._delta_decide_core(g, n, a, p, d, now_sec)
+
+        return jax.vmap(one_block)(groups, nodes, aggs, prev_cols, dirty_idx)
+
+    return grid_delta_decide
+
+
 def time_grid_phases(mesh: Mesh, cluster: ClusterArrays, _timeit,
                      impl: Optional[str] = None) -> dict:
     """Phase split for the bench (cfg8 grid rows): the sharded pod sweep +

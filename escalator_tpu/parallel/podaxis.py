@@ -226,6 +226,115 @@ def make_podaxis_decider(mesh: Mesh, impl: str | None = None,
     return decide_podaxis
 
 
+def make_delta_scatter(mesh: Mesh):
+    """Round-8 incremental state maintenance for the pod-axis layout: keep
+    the placed cluster RESIDENT across ticks (killing this backend's
+    documented O(cluster) per-tick re-place) and scatter a tiny replicated
+    delta batch into it while maintaining replicated per-device
+    :class:`kernel.GroupAggregates` — with ZERO collectives.
+
+    The batch carries ``(idx, old_vals, new_vals)`` for the touched lanes
+    (host-diff style, ops.controller.backend._changed_slots economics): the
+    old values ride in the batch precisely so no device ever has to gather
+    another shard's lanes — each device scatters the in-range slice of the
+    pod batch into its own shard (global index minus the shard offset;
+    out-of-range and pad lanes drop), applies the full replicated node
+    batch, and folds the identical aggregate deltas from the replicated
+    batch into its own aggregate copy. Dirty masks therefore live per
+    shard/device and stay bitwise-identical by construction. Steady ticks
+    then run ``kernel.delta_decide_jit`` on the resident cluster (the delta
+    program never reads the pod axis — aggregates are persistent), and
+    ordered/drain ticks run the existing block-sharded ordered decider with
+    ``aggregates=kernel.aggregates_tuple(aggs)``.
+
+    Returns jitted ``(pods, nodes, groups_old, groups_new, pidx, pod_old,
+    pod_new, nidx, node_old, node_new, aggs) -> (cluster, aggs,
+    node_group_changed)`` — same argument shape as
+    ``device_state._scatter_update_aggs`` plus the old-value batches.
+    ``node_group_changed`` (a replicated scalar bool) is the one exact-
+    correction case the zero-collective program cannot absorb: a node
+    lane's group column changed, so pods OUTSIDE the batch moved their
+    pods-remaining contribution — the caller must re-derive the aggregates
+    with the sharded full sweep on that (rare) tick. Pad lanes use
+    ``idx = len(axis)`` (out of range everywhere) with identical old/new
+    values. Donates the resident pod/node columns and the aggregates."""
+    from dataclasses import fields as _fields
+
+    from escalator_tpu.ops import device_state as ds
+    from escalator_tpu.ops.kernel import GroupAggregates
+
+    names = tuple(mesh.axis_names)
+    pod_spec = _pod_spec(mesh)
+    soa_spec = lambda cls, spec: cls(  # noqa: E731
+        **{f: spec for f in cls.__dataclass_fields__})
+    from escalator_tpu.core.arrays import GroupArrays, NodeArrays
+
+    cluster_spec = ClusterArrays(
+        groups=soa_spec(GroupArrays, P()),
+        pods=soa_spec(PodArrays, pod_spec),
+        nodes=soa_spec(NodeArrays, P()),
+    )
+    repl_aggs = GroupAggregates(*([P()] * 11))
+
+    @partial(jax.jit, donate_argnums=(0, 1, 10))
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(soa_spec(PodArrays, pod_spec), soa_spec(NodeArrays, P()),
+                  soa_spec(GroupArrays, P()), soa_spec(GroupArrays, P()), P(),
+                  soa_spec(PodArrays, P()), soa_spec(PodArrays, P()), P(),
+                  soa_spec(NodeArrays, P()), soa_spec(NodeArrays, P()),
+                  repl_aggs),
+        out_specs=(cluster_spec, repl_aggs, P()),
+        # the pod scatter writes device-varying lanes from replicated
+        # values; replication of every P() output is established by
+        # construction (identical math on identical replicated inputs), a
+        # pattern the checker cannot express — same waiver as the pod sweep
+        check_vma=False,
+    )
+    def delta_scatter(pods, nodes, groups_old, groups_new, pidx, pod_old,
+                      pod_new, nidx, node_old, node_new, aggs):
+        shard_len = pods.valid.shape[0]
+        G = groups_new.valid.shape[0]
+        N = nodes.valid.shape[0]
+        linear = jnp.int32(0)
+        for nm in names:
+            linear = linear * int(mesh.shape[nm]) + jax.lax.axis_index(nm)
+        start = linear * shard_len
+        # negative indices WRAP in jax (mode="drop" only drops past-the-end),
+        # so lanes owned by earlier shards must be mapped to an explicit
+        # out-of-bounds sentinel, not left negative
+        in_shard = (pidx >= start) & (pidx < start + shard_len)
+        local_idx = jnp.where(in_shard, pidx - start, shard_len)
+        pods2 = type(pods)(**{
+            f.name: getattr(pods, f.name).at[local_idx].set(
+                getattr(pod_new, f.name), mode="drop")
+            for f in _fields(pods)
+        })
+        nodes2 = type(nodes)(**{
+            f.name: getattr(nodes, f.name).at[nidx].set(
+                getattr(node_new, f.name), mode="drop")
+            for f in _fields(nodes)
+        })
+        deltas, touched, ng_changed = ds.aggregate_lane_deltas(
+            pod_old, pod_new, node_old, node_new,
+            nodes.group, nodes2.group, G, N,
+        )
+        # the node-group-change correction is HOST-level here (the flag in
+        # the return; an in-program re-sweep would need the full pod axis
+        # and so a psum), so the incremental npr is folded unconditionally
+        aggs2 = ds.fold_aggregate_deltas(
+            aggs, deltas, touched,
+            ds.group_rows_changed(groups_old, groups_new),
+            aggs.node_pods_remaining + deltas["node_pods_remaining"],
+        )
+        out_cluster = ClusterArrays(
+            groups=groups_new, pods=pods2, nodes=nodes2)
+        return out_cluster, aggs2, ng_changed
+
+    return delta_scatter
+
+
 def time_pod_sweep(mesh: Mesh, cluster: ClusterArrays, _timeit,
                    impl: str | None = None) -> float:
     """Median ms of the sharded pod sweep ALONE (no decide tail) — the phase

@@ -172,6 +172,10 @@ def test_smoke_mode_parity(bench):
     # the prepass exercised BOTH scan programs, not one of them twice
     assert out["smoke_cfg10_replicaset_path"] == "runs"
     assert out["smoke_cfg10_mixed_path"] == "pods"
+    # round 8: the incremental/full decide contract (delta_decide on dirty
+    # rows bit-exact vs full recompute, both lazy paths) is tier-1-locked
+    assert out["smoke_cfg14_parity"] == "ok"
+    assert any(c > 0 for c in out["smoke_cfg14_dirty_counts"])
 
 
 def test_archived_e2e_filter(bench):

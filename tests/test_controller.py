@@ -12,6 +12,7 @@ from escalator_tpu.controller import controller as ctl
 from escalator_tpu.controller import node_group as ngmod
 from escalator_tpu.controller.backend import (
     GoldenBackend,
+    IncrementalJaxBackend,
     JaxBackend,
     GridJaxBackend,
     PodAxisJaxBackend,
@@ -114,6 +115,12 @@ BACKENDS = {
     "grid": lambda: GridJaxBackend(),
     # factory taking (client, ng_opts_list); World detects and applies it
     "native": lambda: make_native_backend,
+    # round-8 incremental paths: delta-maintained aggregates + dirty-group
+    # compacted decide, through the full controller lifecycle (refresh
+    # cadence of 3 so the bit-equality audit fires mid-lifecycle too)
+    "incremental": lambda: IncrementalJaxBackend(refresh_every=3),
+    "native-inc": lambda: (lambda client, opts: make_native_backend(
+        client, opts, incremental=True, refresh_every=3)),
 }
 
 
@@ -485,7 +492,7 @@ def test_native_backend_pallas_tick_parity(monkeypatch):
         live = sorted(idx[n.name] for n in w.client.list_nodes())
         return tainted_after_4, live, w.group.target_size()
 
-    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas-force")
     got = lifecycle(make_native_backend)
     monkeypatch.delenv("ESCALATOR_TPU_KERNEL_IMPL")
     want = lifecycle(GoldenBackend())
@@ -508,7 +515,7 @@ def test_native_backend_pallas_failure_degrades_sticky(monkeypatch, caplog):
             raise RuntimeError("mosaic lowering exploded")
         return real_decide_jit(cluster, now, impl=impl)
 
-    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas-force")
     nodes = build_test_nodes(3, NodeOpts(cpu=1000, mem=4 * 10**9))
     pods = build_test_pods(2, PodOpts(
         cpu=[100], mem=[10**8],
@@ -556,7 +563,7 @@ def test_native_backend_pallas_transient_failure_recovers(monkeypatch, caplog):
         # impl routing, not the kernel, is under test)
         return real_decide_jit(cluster, now, impl="xla")
 
-    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas-force")
     nodes = build_test_nodes(3, NodeOpts(cpu=1000, mem=4 * 10**9))
     pods = build_test_pods(2, PodOpts(
         cpu=[100], mem=[10**8],

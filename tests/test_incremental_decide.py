@@ -1,0 +1,427 @@
+"""Randomized multi-tick parity soak for the incremental decide (round 8).
+
+The tentpole's contract is unforgiving: a :class:`GroupAggregates`
+maintained by scatter deltas must stay BIT-equal to a from-scratch
+recompute, and ``kernel.delta_decide`` on the compacted dirty rows must be
+bit-identical to a full ``decide_jit`` on the same resident cluster — on
+every tick of an arbitrary churn sequence, on both the lazy (light) and
+ordered paths. These tests drive seeded sequences of pod upserts/deletes,
+node add/remove (with slot reuse), taint/untaint/cordon flips, group
+config/state mutations and group add/remove through the real native store +
+``DeviceClusterCache`` + ``IncrementalDecider`` stack and compare against
+the full-recompute kernel after EVERY tick. The sharded variants
+(grid per-block delta decider, pod-axis delta scatter) get the same
+bit-equality treatment at their layouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from escalator_tpu.analysis.registry import (  # noqa: E402
+    representative_cluster,
+    stacked_cluster,
+)
+from escalator_tpu.core.arrays import NO_TAINT_TIME, ClusterArrays  # noqa: E402
+from escalator_tpu.ops import kernel  # noqa: E402
+from escalator_tpu.ops.device_state import (  # noqa: E402
+    AggregateParityError,
+    DeviceClusterCache,
+    IncrementalDecider,
+)
+
+NOW = 1_700_000_000
+
+
+def _assert_decisions_equal(got, want, context=""):
+    for f in dataclasses.fields(want):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, f.name)), np.asarray(getattr(want, f.name)),
+            err_msg=f"{context}: field {f.name}",
+        )
+
+
+def _assert_aggs_equal(got, want, context=""):
+    for f in dataclasses.fields(kernel.GroupAggregates):
+        if f.name == "dirty":
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, f.name)), np.asarray(getattr(want, f.name)),
+            err_msg=f"{context}: aggregate {f.name}",
+        )
+
+
+def _store_world(seed: int, G: int = 8):
+    """Native store + device cache + incremental decider over a small
+    churning cluster; groups ride in from a representative GroupArrays."""
+    from escalator_tpu.native.statestore import NativeStateStore
+
+    rng = np.random.default_rng(seed)
+    store = NativeStateStore(pod_capacity=1 << 9, node_capacity=1 << 7)
+    store.upsert_pods_batch(
+        [f"p{i}" for i in range(180)], rng.integers(0, G, 180),
+        np.full(180, 500), np.full(180, 10**9),
+    )
+    store.upsert_nodes_batch(
+        [f"n{i}" for i in range(40)], rng.integers(0, G, 40),
+        np.full(40, 4000), np.full(40, 16 * 10**9),
+        creation_ns=rng.integers(1, 10**12, 40),
+    )
+    pods_v, nodes_v = store.as_pod_node_arrays()
+    groups = representative_cluster(G=G, P=1, N=1, seed=seed).groups
+    store.drain_dirty()
+    cache = DeviceClusterCache(
+        ClusterArrays(groups=groups, pods=pods_v, nodes=nodes_v))
+    return rng, store, groups, cache
+
+
+def _random_churn(rng, store, groups, t, G):
+    """One tick's randomized mutations across every event class. Mutates the
+    host GroupArrays in place (config/state churn) and returns nothing —
+    dirtiness flows through the store's drain + the group-row compare."""
+    n = int(rng.integers(1, 25))
+    idx = rng.integers(0, 180, n)
+    store.upsert_pods_batch(
+        [f"p{i}" for i in idx], idx % G,
+        rng.integers(100, 2000, n), rng.integers(10**8, 2 * 10**9, n),
+        node_slot=rng.integers(-1, 40, n),
+    )
+    if rng.random() < 0.3:
+        store.delete_pod(f"p{int(rng.integers(0, 180))}")
+    if rng.random() < 0.4:
+        # node churn: capacity/taint/cordon flips, occasionally a group move
+        # (exercises the node-group-changed pods-remaining re-sweep)
+        ni = int(rng.integers(0, 40))
+        tainted = bool(rng.random() < 0.5)
+        store.upsert_node(
+            f"n{ni}", int(rng.integers(0, G)) if rng.random() < 0.2 else ni % G,
+            4000, 16 * 10**9,
+            creation_ns=int(rng.integers(1, 10**12)),
+            tainted=tainted,
+            cordoned=bool(rng.random() < 0.2),
+            taint_time_sec=(NOW - int(rng.integers(0, 2000))
+                            if tainted else NO_TAINT_TIME),
+        )
+    if rng.random() < 0.25:
+        store.delete_node(f"n{int(rng.integers(0, 40))}")
+    if rng.random() < 0.3:
+        # group config/state churn — must dirty the row via the device compare
+        gi = int(rng.integers(0, G))
+        groups.locked[gi] = bool(rng.random() < 0.5)
+        groups.requested_nodes[gi] = int(rng.integers(0, 5))
+        groups.scale_up_thr[gi] = int(rng.choice([60, 70, 80]))
+    if rng.random() < 0.1:
+        # group add/remove: the valid flip IS the add/remove at array level
+        gi = int(rng.integers(0, G))
+        groups.valid[gi] = not bool(groups.valid[gi])
+
+
+@pytest.mark.parametrize("seed", [3, 17])
+def test_multi_tick_parity_soak(seed):
+    """After EVERY tick of a seeded churn sequence, the incremental decision
+    (lazy or ordered, per the real gate) is bit-exact against a from-scratch
+    ``decide_jit`` on the same resident cluster, and the maintained
+    aggregates are bit-equal to ``compute_aggregates``."""
+    G = 8
+    rng, store, groups, cache = _store_world(seed, G)
+    inc = IncrementalDecider(cache, refresh_every=0)  # audited manually below
+    ordered_seen = light_seen = 0
+
+    def one_tick(t):
+        nonlocal ordered_seen, light_seen
+        pod_dirty, node_dirty = store.drain_dirty()
+        # groups re-uploaded every tick (they are tiny), exactly as the
+        # backends do — config churn dirties rows via the device compare
+        inc.apply_gathered(cache.gather_deltas(pod_dirty, node_dirty), groups)
+        nv = store.as_pod_node_arrays()[1]
+        tainted_any = bool(
+            (np.asarray(nv.valid) & np.asarray(nv.tainted)).any())
+        out, ordered = inc.decide(NOW, tainted_any)
+        ref, ref_ordered = kernel.lazy_orders_decide(
+            lambda w: jax.block_until_ready(kernel.decide_jit(
+                cache.cluster, np.int64(NOW), with_orders=w)),
+            tainted_any,
+        )
+        assert ordered == ref_ordered, f"tick {t}: protocol diverged"
+        _assert_decisions_equal(out, ref, context=f"seed {seed} tick {t}")
+        ordered_seen += ordered
+        light_seen += not ordered
+        # the maintained aggregates never drift (the refresh audit's claim,
+        # checked every tick here rather than on a cadence)
+        fresh = kernel.compute_aggregates_jit(cache.cluster)
+        _assert_aggs_equal(inc.aggregates, fresh, context=f"tick {t}")
+
+    # phase 1: adversarial random churn — drains, taints, deletes, group
+    # add/remove; nearly every tick takes the ordered path
+    for t in range(25):
+        _random_churn(rng, store, groups, t, G)
+        one_tick(t)
+    # phase 2: drive the cluster to a CONVERGED steady state (balanced
+    # round-robin load inside the (45, 70) band, every node untainted) so
+    # the lazy LIGHT path — the delta_decide program — is exercised too
+    store.upsert_nodes_batch(
+        [f"n{i}" for i in range(40)], np.arange(40) % G,
+        np.full(40, 4000), np.full(40, 16 * 10**9),
+    )
+    store.upsert_pods_batch(
+        [f"p{i}" for i in range(180)], np.arange(180) % G,
+        np.full(180, 500), np.full(180, 10**9),
+    )
+    groups.valid[:] = True
+    groups.locked[:] = False
+    for t in range(25, 30):
+        # in-band churn: same-size re-upserts keep every group steady
+        idx = (t * 7 + np.arange(7)) % 180
+        store.upsert_pods_batch([f"p{i}" for i in idx], idx % G,
+                                np.full(7, 500), np.full(7, 10**9))
+        one_tick(t)
+    # the sequence must have exercised BOTH protocol paths or the soak
+    # proves less than it claims
+    assert ordered_seen and light_seen, (ordered_seen, light_seen)
+    assert inc.refresh() is True
+
+
+def test_dirty_compaction_is_selective():
+    """A tick that churns one group dirties (and re-decides) only the groups
+    its lanes touched — the O(dirty) claim, observed via the mask."""
+    rng, store, groups, cache = _store_world(seed=5)
+    inc = IncrementalDecider(cache, refresh_every=0)
+    store.drain_dirty()
+    inc.decide(NOW, False)  # bootstrap full decide
+    # churn three pods, all in group 2
+    store.upsert_pods_batch(["p2", "p10", "p18"], np.full(3, 2),
+                            np.full(3, 777), np.full(3, 10**9))
+    pod_dirty, node_dirty = store.drain_dirty()
+    inc.apply_gathered(cache.gather_deltas(pod_dirty, node_dirty))
+    dirty = np.asarray(inc.aggregates.dirty)
+    # the three pods' OLD groups plus their new group 2 — nothing else
+    assert dirty[2]
+    assert 0 < dirty.sum() <= 4
+    out, ordered = inc.decide(NOW, False)
+    # the light delta dispatch ran on exactly the dirty rows (a negative
+    # delta may then re-dispatch ordered — the protocol's call, not ours)
+    assert inc.last_dirty_count == int(dirty.sum())
+    assert not np.asarray(inc.aggregates.dirty).any()
+    ref, ref_ordered = kernel.lazy_orders_decide(
+        lambda w: jax.block_until_ready(kernel.decide_jit(
+            cache.cluster, np.int64(NOW), with_orders=w)), False)
+    assert ordered == ref_ordered
+    _assert_decisions_equal(out, ref)
+
+
+def test_refresh_audit_detects_corruption():
+    """The periodic refresh re-derives the aggregates and asserts
+    bit-equality: corrupted maintained state raises (mode="raise") or is
+    repaired with every group marked dirty (mode="repair")."""
+    _, store, groups, cache = _store_world(seed=9)
+    inc = IncrementalDecider(cache, refresh_every=0)
+    assert inc.refresh() is True
+    inc._aggs = dataclasses.replace(
+        inc._aggs, cpu_req=inc._aggs.cpu_req + 1)  # simulate drift
+    with pytest.raises(AggregateParityError, match="cpu_req"):
+        inc.refresh()
+
+    inc._on_mismatch = "repair"
+    assert inc.refresh() is False
+    assert np.asarray(inc.aggregates.dirty).all()
+    # post-repair state is the recomputed truth
+    assert inc.refresh() is True
+
+
+def test_refresh_cadence_fires():
+    _, store, groups, cache = _store_world(seed=13)
+    inc = IncrementalDecider(cache, refresh_every=2)
+    for _ in range(6):
+        inc.decide(NOW, False)
+    assert inc.refreshes == 3
+
+
+def test_delta_decide_zero_dirty_tick():
+    """A tick with nothing dirty still refreshes the [N] elementwise tail
+    (reap ages against now) and stays bit-exact."""
+    cluster = representative_cluster(seed=21)
+    aggs = kernel.compute_aggregates_jit(cluster)
+    light = kernel.decide_jit(cluster, np.int64(NOW), with_orders=False)
+    prev = tuple(getattr(light, f) for f in kernel.GROUP_DECISION_FIELDS)
+    idx = kernel.dirty_indices(np.zeros(6, bool))
+    later = NOW + 10_000
+    out, aggs2 = kernel.delta_decide_jit(cluster, aggs, prev, idx,
+                                         np.int64(later))
+    ref = kernel.decide_jit(cluster, np.int64(later), with_orders=False)
+    _assert_decisions_equal(out, ref)
+
+
+def _group_input(pods=11, nodes=2):
+    from escalator_tpu.core import semantics as sem
+    from escalator_tpu.testsupport.builders import (
+        NodeOpts,
+        PodOpts,
+        build_test_nodes,
+        build_test_pods,
+    )
+
+    cfg = sem.GroupConfig(
+        min_nodes=0, max_nodes=100, taint_lower_percent=30,
+        taint_upper_percent=45, scale_up_percent=70, slow_removal_rate=1,
+        fast_removal_rate=2, soft_delete_grace_sec=300,
+        hard_delete_grace_sec=900)
+    return (build_test_pods(pods, PodOpts(cpu=[500], mem=[10**9])),
+            build_test_nodes(nodes, NodeOpts(cpu=4000, mem=16 * 10**9)),
+            cfg, sem.GroupState())
+
+
+def test_incremental_backends_survive_group_pad_growth():
+    """A 9th nodegroup grows pack_groups' power-of-two pad 8 -> 16 while the
+    pod/node pads stand still: the [G]-shaped incremental state (aggregates,
+    persistent columns) must REBUILD with it, not broadcast-crash against
+    the resident shapes — on both incremental backends, both directions
+    across the boundary, with decisions matching a fresh full-recompute
+    backend."""
+    from escalator_tpu.controller.backend import IncrementalJaxBackend, JaxBackend
+    from escalator_tpu.controller.native_backend import NativeJaxBackend
+    from escalator_tpu.k8s.cache import EventfulClient
+
+    eights = [_group_input() for _ in range(8)]
+    nines = eights + [_group_input()]
+
+    backend = IncrementalJaxBackend(refresh_every=0)
+    for group_inputs in (eights, nines, eights):
+        got = backend.decide(group_inputs, now_sec=0)
+        want = JaxBackend().decide(group_inputs, now_sec=0)
+        assert [r.decision for r in got] == [w.decision for w in want]
+
+    # native flavor: the store/bridge see only their configured filters (the
+    # extra groups decide over empty lanes), but the [G] pack shape still
+    # crosses the pad boundary and must rebuild the incremental state
+    native = NativeJaxBackend(
+        EventfulClient(nodes=[], pods=[]), [], incremental=True,
+        refresh_every=0)
+    for group_inputs in (eights, nines, eights):
+        got = native.decide(group_inputs, now_sec=0)
+        assert len(got) == len(group_inputs)
+
+
+# ---------------------------------------------------------------------------
+# Sharded variants
+# ---------------------------------------------------------------------------
+
+
+def test_grid_delta_decider_matches_per_block_kernel():
+    """The grid's per-block delta decider is literally the kernel delta core
+    per mesh row: bit-identical to the single-device light decide per block,
+    zero collectives, dirty masks per shard."""
+    from escalator_tpu.parallel import grid as gridlib
+
+    mesh = gridlib.make_grid_mesh(num_group_shards=4)
+    stacked = stacked_cluster(4, seed=7)
+    Gb = stacked.groups.valid.shape[1]
+    vaggs = jax.vmap(lambda c: kernel.compute_aggregates(c))(stacked)
+    rng = np.random.default_rng(2)
+    dirty = rng.random((4, Gb)) < 0.7
+    vaggs = dataclasses.replace(vaggs, dirty=jnp.asarray(dirty))
+    buckets = [kernel.dirty_indices(dirty[s]) for s in range(4)]
+    D = max(b.shape[0] for b in buckets)
+    idx = np.stack([
+        np.pad(b, (0, D - b.shape[0]), constant_values=Gb) for b in buckets
+    ])
+    ref = jax.vmap(
+        lambda c: kernel.decide(c, np.int64(NOW), with_orders=False)
+    )(stacked)
+    # stale persistent columns on the dirty rows: the delta scatter must
+    # overwrite exactly those and keep the clean rows' values
+    prev = tuple(
+        jnp.where(jnp.asarray(dirty), jnp.zeros_like(getattr(ref, f)),
+                  getattr(ref, f))
+        if np.asarray(getattr(ref, f)).shape == dirty.shape
+        else getattr(ref, f)
+        for f in kernel.GROUP_DECISION_FIELDS
+    )
+    out, aggs2 = gridlib.make_grid_delta_decider(mesh)(
+        stacked.groups, stacked.nodes, vaggs, prev, jnp.asarray(idx),
+        np.int64(NOW))
+    _assert_decisions_equal(out, ref, context="grid delta")
+    assert not np.asarray(aggs2.dirty).any()
+
+
+def _soa_take(soa, idx, oob, B):
+    out = {}
+    for f in soa.__dataclass_fields__:
+        a = np.asarray(getattr(soa, f))
+        v = np.zeros(B, a.dtype)
+        sel = idx < oob
+        v[sel] = a[idx[sel]]
+        out[f] = v
+    return type(soa)(**out)
+
+
+def test_podaxis_delta_scatter_maintains_sharded_residency():
+    """The pod-axis delta scatter updates the SHARDED resident cluster from
+    a replicated (idx, old, new) batch with zero collectives, and the
+    replicated aggregates stay bit-equal to a from-scratch recompute of the
+    updated cluster; a node group move raises the exact-correction flag."""
+    from escalator_tpu.parallel import mesh as meshlib, podaxis
+
+    mesh = meshlib.make_mesh()
+    cluster = podaxis.pad_pods_for_mesh(representative_cluster(seed=4), mesh)
+    placed = podaxis.place(cluster, mesh)
+    aggs = kernel.compute_aggregates_jit(placed)
+    scat = podaxis.make_delta_scatter(mesh)
+    P_ = cluster.pods.valid.shape[0]
+    N_ = cluster.nodes.valid.shape[0]
+    B = 8
+    pidx = np.full(B, P_, np.int32)
+    pidx[:5] = [0, 7, 33, 100, 161]        # lanes spread across shards
+    pod_old = _soa_take(cluster.pods, pidx, P_, B)
+    pn = {f: np.array(getattr(pod_old, f)) for f in pod_old.__dataclass_fields__}
+    pn["cpu_milli"][:5] += 111
+    pn["group"][1] = 2
+    pn["valid"][2] = False                  # a delete
+    pod_new = type(pod_old)(**pn)
+    nidx = np.full(B, N_, np.int32)
+    nidx[:2] = [3, 9]
+    node_old = _soa_take(cluster.nodes, nidx, N_, B)
+    nn = {f: np.array(getattr(node_old, f)) for f in node_old.__dataclass_fields__}
+    nn["tainted"][0] = ~nn["tainted"][0]
+    node_new = type(node_old)(**nn)
+    out_cluster, aggs2, ng_changed = scat(
+        placed.pods, placed.nodes, placed.groups, placed.groups,
+        pidx, pod_old, pod_new, nidx, node_old, node_new, aggs)
+    assert not bool(ng_changed)
+    _assert_aggs_equal(aggs2, kernel.compute_aggregates_jit(out_cluster),
+                       context="podaxis scatter")
+    assert np.asarray(aggs2.dirty).any()
+    # the resident pod columns took exactly the new values
+    got_cpu = np.asarray(out_cluster.pods.cpu_milli)
+    for b in range(5):
+        assert got_cpu[pidx[b]] == pn["cpu_milli"][b]
+    # delta decide on the sharded resident cluster: bit-exact vs full light.
+    # (fresh aggregates: delta_decide_jit DONATES its aggs, and aggs2's
+    # buffers are still needed by the second scatter below)
+    G = cluster.groups.valid.shape[0]
+    ref = kernel.decide_jit(out_cluster, np.int64(NOW), with_orders=False)
+    prev = tuple(jnp.zeros_like(getattr(ref, f))
+                 for f in kernel.GROUP_DECISION_FIELDS)
+    alld = dataclasses.replace(kernel.compute_aggregates_jit(out_cluster),
+                               dirty=jnp.ones(G, bool))
+    out, _ = kernel.delta_decide_jit(
+        out_cluster, alld, prev, kernel.dirty_indices(np.ones(G, bool)),
+        np.int64(NOW))
+    _assert_decisions_equal(out, ref, context="podaxis delta decide")
+
+    # a node group move must raise the correction flag (pods outside the
+    # batch change their pods-remaining contribution)
+    node_old2 = _soa_take(out_cluster.nodes, nidx, N_, B)
+    nn2 = {f: np.array(getattr(node_old2, f))
+           for f in node_old2.__dataclass_fields__}
+    nn2["group"][1] = (nn2["group"][1] + 1) % G
+    out_cluster2, _, ng_changed2 = scat(
+        out_cluster.pods, out_cluster.nodes, out_cluster.groups,
+        out_cluster.groups, np.full(B, P_, np.int32), pod_new, pod_new,
+        nidx, node_old2, type(node_old2)(**nn2), aggs2)
+    assert bool(ng_changed2)

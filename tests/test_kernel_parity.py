@@ -276,7 +276,12 @@ def test_scale_up_delta_float_order_parity():
 def test_native_tick_impl_selection(monkeypatch):
     """The native tick defaults to the Pallas sweep on an accelerator (its
     slot-reused layout is the sorted path's measured win) and to XLA scatter
-    on CPU; ESCALATOR_TPU_KERNEL_IMPL overrides both ways."""
+    on CPU. ESCALATOR_TPU_KERNEL_IMPL overrides — except that a stale
+    ``pallas`` config on a platform without compiled Pallas (the CPU
+    fallback) auto-selects xla with a one-time log (round 8: cfg9 measured
+    interpreter Pallas losing 5.8-120x on every row); ``pallas-force`` is
+    the explicit escape hatch that always means interpreter-or-compiled
+    Pallas."""
     monkeypatch.delenv("ESCALATOR_TPU_KERNEL_IMPL", raising=False)
     assert kernel.native_tick_impl("tpu") == "pallas"
     assert kernel.native_tick_impl("axon") == "pallas"  # tunnel platform name
@@ -296,8 +301,37 @@ def test_native_tick_impl_selection(monkeypatch):
     assert kernel.native_tick_impl("tpu") == ""
     monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "xla")
     assert kernel.native_tick_impl("tpu") == "xla"
+    # the round-8 CPU-fallback guard: a stale pallas config on a
+    # non-Pallas-compiled platform degrades to xla instead of silently
+    # running the interpreter on the hot path; on TPU it is honored
     monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas")
+    assert kernel.native_tick_impl("cpu") == "xla"
+    assert kernel.native_tick_impl("gpu") == "xla"
+    assert kernel.native_tick_impl("tpu") == "pallas"
+    assert kernel.default_impl(platform="cpu") == "xla"
+    assert kernel.default_impl(platform="tpu") == "pallas"
+    # the explicit escape hatch (tests/debug want interpreter Pallas)
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "pallas-force")
     assert kernel.native_tick_impl("cpu") == "pallas"
+    assert kernel.default_impl(platform="cpu") == "pallas"
+    # misconfiguration still fails fast downstream: invalid values pass
+    # through untouched for decide()'s ValueError
+    monkeypatch.setenv("ESCALATOR_TPU_KERNEL_IMPL", "palas")
+    assert kernel.native_tick_impl("cpu") == "palas"
+
+
+def test_impl_autoselect_logs_once(monkeypatch, caplog):
+    """The CPU-fallback auto-select names its measured reason ONCE per
+    platform per process, not per tick."""
+    import logging
+
+    monkeypatch.setattr(kernel, "_AUTOSELECT_LOGGED", set())
+    with caplog.at_level(logging.WARNING, logger="escalator_tpu.kernel"):
+        assert kernel._resolve_impl_env("pallas", "cpu") == "xla"
+        assert kernel._resolve_impl_env("pallas", "cpu") == "xla"
+    msgs = [r for r in caplog.records if "auto-selecting" in r.getMessage()]
+    assert len(msgs) == 1
+    assert "cfg9" in msgs[0].getMessage()  # the measured reason, named
 
 
 def test_make_backend_probes_accelerator(monkeypatch):

@@ -66,6 +66,45 @@ def test_podaxis_decider_compiles_once_across_block_rebalance():
     )
 
 
+def test_delta_decide_compiles_once_per_dirty_bucket():
+    """The incremental decide's jit cache keys on the dirty BUCKET width
+    (kernel.dirty_indices: power-of-two, min 8, capped at G), not the dirty
+    set itself: two ticks with different dirty rows in the same bucket hit
+    the cache; crossing a bucket boundary compiles exactly once more. Uses
+    file-unique prime shapes (G=23 so buckets 8 and 16 are both reachable).
+    """
+    DG, DP, DN = 23, 206, 59
+    cluster = representative_cluster(G=DG, P=DP, N=DN, seed=131)
+    aggs = kernel.compute_aggregates_jit(cluster)
+    light = kernel._decide_jit_raw(cluster, NOW, with_orders=False)
+    prev = tuple(getattr(light, f) for f in kernel.GROUP_DECISION_FIELDS)
+
+    def tick(dirty_rows):
+        nonlocal aggs, prev
+        mask = np.zeros(DG, bool)
+        mask[dirty_rows] = True
+        idx = kernel.dirty_indices(mask)
+        out, aggs = kernel._delta_decide_raw(cluster, aggs, prev, idx, NOW)
+        jax.block_until_ready(out)
+        prev = tuple(getattr(out, f) for f in kernel.GROUP_DECISION_FIELDS)
+        return idx.shape[0]
+
+    before = kernel._delta_decide_raw._cache_size()
+    assert tick([1, 2, 3]) == 8          # bucket 8
+    assert tick([5, 9]) == 8             # same bucket, different rows
+    compiles = kernel._delta_decide_raw._cache_size() - before
+    assert compiles == 1, (
+        f"expected exactly 1 compile for two same-bucket delta ticks, got "
+        f"{compiles}: the dirty-row CONTENTS must not be a cache key"
+    )
+    assert tick(list(range(11))) == 16   # bucket 16: one more compile
+    assert tick(list(range(9))) == 16    # back in bucket 16: cached
+    compiles = kernel._delta_decide_raw._cache_size() - before
+    assert compiles == 2, (
+        f"expected exactly 2 compiles across buckets 8 and 16, got {compiles}"
+    )
+
+
 def test_grid_decider_compiles_once():
     m = grid.make_grid_mesh(num_group_shards=4)
 
