@@ -57,6 +57,40 @@ from escalator_tpu.utils.tracing import TickTracer, start_profiler_server
 log = logging.getLogger("escalator_tpu")
 
 
+def debug_dump_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-dump``: pull the flight-recorder ring from a
+    running compute plugin (the ``Dump`` RPC) and print/write it — the
+    on-demand end of the tick flight recorder (docs/observability.md). The
+    controller process itself dumps automatically on wedge/audit incidents;
+    this subcommand is for a live look without waiting for one."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-dump",
+        description="dump the flight recorder of a running compute plugin",
+    )
+    p.add_argument("--plugin-address", default="127.0.0.1:50551",
+                   help="compute plugin address (same as --plugin-address"
+                        " on the controller)")
+    p.add_argument("--output", default="-",
+                   help="file path for the JSON dump, or - for stdout")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    from escalator_tpu.plugin.client import ComputeClient
+
+    client = ComputeClient(args.plugin_address, timeout_sec=args.timeout)
+    try:
+        doc = client.dump()
+    finally:
+        client.close()
+    text = json.dumps(doc, indent=1)
+    if args.output == "-":
+        print(text)
+    else:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        print(f"flight record ({doc.get('depth', 0)} ticks) -> {args.output}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="escalator-tpu",
@@ -243,6 +277,12 @@ def setup_cloud_provider(args, node_groups, client) -> MockBuilder:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # subcommand dispatch ahead of the flag parser (the controller surface
+    # keeps its reference-mirroring flags-only shape; debug tooling hangs off
+    # a leading verb)
+    if argv and argv[0] == "debug-dump":
+        return debug_dump_main(argv[1:])
     args = build_parser().parse_args(argv)
     setup_logging(args.loglevel, args.logfmt)
 
@@ -451,6 +491,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     log.critical(
                         "no tick completed for %.0fs (limit %.0fs); exiting "
                         "so a standby can take over", age, exit_limit)
+                    # the ticks leading up to the wedge are exactly what the
+                    # post-mortem needs — dump before the crash-to-restart
+                    from escalator_tpu.observability import dump_on_incident
+
+                    dump_path = dump_on_incident("wedge")
+                    if dump_path:
+                        log.critical("flight record dumped to %s", dump_path)
                     try:
                         if elector is not None:
                             elector.stop()  # stop renewing; Lease lapses
@@ -462,6 +509,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             # thread) long before the grace elapses.
             time.sleep(60)
             log.critical("shutdown did not complete within 60s; forcing exit")
+            from escalator_tpu.observability import dump_on_incident
+
+            dump_on_incident("shutdown-wedge")
             os._exit(70)
 
         threading.Thread(target=tick_watchdog, daemon=True).start()

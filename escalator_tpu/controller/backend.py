@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from escalator_tpu import observability as obs
 from escalator_tpu.core import semantics
 from escalator_tpu.k8s import types as k8s
 from escalator_tpu.metrics import metrics
@@ -78,19 +79,34 @@ class GoldenBackend(ComputeBackend):
     name = "golden"
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        with obs.span(self.name):
+            obs.annotate(backend=self.name, impl="python")
+            return self._decide_timed(
+                group_inputs, now_sec, dry_mode_flags, taint_trackers)
+
+    def _decide_timed(self, group_inputs, now_sec, dry_mode_flags,
+                      taint_trackers):
+        # sub-step times accumulate ACROSS the group loop and land as four
+        # aggregate phases (a span per group per step would be G*4 phases);
+        # everything is host compute, so the phases are fenced by construction
+        t_eval = t_filter = t_reap = t_orders = 0.0
         out: List[GroupDecision] = []
         for gi, (pods, nodes, config, state) in enumerate(group_inputs):
             dry = bool(dry_mode_flags[gi]) if dry_mode_flags else False
             tracker = taint_trackers[gi] if taint_trackers else None
+            t0 = time.perf_counter()
             decision = semantics.evaluate_node_group(
                 pods, nodes, config, state, dry, tracker
             )
+            t1 = time.perf_counter()
             untainted, tainted, cordoned = semantics.filter_nodes(nodes, dry, tracker)
             info = k8s.create_node_name_to_info_map(list(pods), list(nodes))
+            t2 = time.perf_counter()
             reap_idx = semantics.reap_eligible(
                 tainted, info, config.soft_delete_grace_sec,
                 config.hard_delete_grace_sec, now_sec,
             )
+            t3 = time.perf_counter()
             if config.scale_down_selection == "emptiest_first":
                 remaining = [
                     k8s.node_pods_remaining(nd, info)[0] for nd in untainted
@@ -115,7 +131,43 @@ class GoldenBackend(ComputeBackend):
                     },
                 )
             )
+            t4 = time.perf_counter()
+            t_eval += t1 - t0
+            t_filter += t2 - t1
+            t_reap += t3 - t2
+            t_orders += t4 - t3
+        obs.add_phase("evaluate", t_eval)
+        obs.add_phase("filter", t_filter)
+        obs.add_phase("reap", t_reap)
+        obs.add_phase("orders_assemble", t_orders)
+        obs.annotate(digest=_decision_digest_objects(out))
         return out
+
+
+def _decision_digest(out) -> str:
+    """crc32 over the decision-defining columns (status + delta), as a short
+    hex token in every flight-recorder entry: two ticks with equal digests
+    decided the same thing, so an operator reading a dump can spot the tick
+    where behavior changed without diffing arrays. Device->host copies are
+    two [G] arrays — negligible."""
+    import zlib
+
+    s = np.ascontiguousarray(np.asarray(out.status))
+    d = np.ascontiguousarray(np.asarray(out.nodes_delta))
+    return format(zlib.crc32(s.tobytes() + d.tobytes()), "08x")
+
+
+def _decision_digest_objects(results: "List[GroupDecision]") -> str:
+    """Object-level digest (golden/grpc post-unpack): same role as
+    :func:`_decision_digest`, over the unpadded per-group (status, delta)
+    pairs — not comparable across the two forms, stable within one."""
+    import zlib
+
+    arr = np.array(
+        [(int(r.decision.status), r.decision.nodes_delta) for r in results],
+        np.int64,
+    )
+    return format(zlib.crc32(np.ascontiguousarray(arr).tobytes()), "08x")
 
 
 def _round_up(n: int, minimum: int = 64) -> int:
@@ -416,12 +468,21 @@ def _lazy_decide(nodes, dispatch):
     and ``dispatch(with_orders) -> DecisionArrays`` runs one blocking decide
     on whichever program variant the caller owns. Returns ``(out, ordered)``
     for :func:`_unpack`. One implementation so the gate condition can never
-    drift between backends."""
+    drift between backends — and the shared span site, so every array
+    backend's flight record names its decide variant the same way
+    (``decide_ordered`` = the program with the node-ordering tail,
+    ``decide_light`` = the lazy steady-state program)."""
     from escalator_tpu.ops.kernel import lazy_orders_decide
 
     tainted_any = bool(
         (np.asarray(nodes.valid) & np.asarray(nodes.tainted)).any())
-    return lazy_orders_decide(dispatch, tainted_any)
+
+    def instrumented(w):
+        with obs.span("decide_ordered" if w else "decide_light",
+                      kind="device"):
+            return obs.fence(dispatch(w))
+
+    return lazy_orders_decide(instrumented, tainted_any)
 
 
 class JaxBackend(ComputeBackend):
@@ -437,27 +498,39 @@ class JaxBackend(ComputeBackend):
         self._packer = PaddedPacker()
         self._impl = impl if impl is not None else _kernel_impl()
         self._packing = PackingPostPass()
+        obs.jaxmon.install()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
         import jax
 
-        t0 = time.perf_counter()
-        cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
-        t1 = time.perf_counter()
-        # lazy-orders protocol: same economics as the native backend — no
-        # node-ordering sort on steady ticks (gate shared via _lazy_decide)
-        out, ordered = _lazy_decide(
-            cluster.nodes,
-            lambda w: jax.block_until_ready(self._kernel.decide_jit(
-                cluster, np.int64(now_sec), impl=self._impl, with_orders=w)),
-        )
-        t2 = time.perf_counter()
-        metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
-        metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = _unpack(out, group_inputs, ordered=ordered,
-                          node_masks=cluster.nodes)
-        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
-        return results
+        with obs.span(self.name):
+            obs.annotate(backend=self.name, impl=self._impl)
+            t0 = time.perf_counter()
+            with obs.span("pack"):
+                cluster = self._packer.pack(
+                    group_inputs, dry_mode_flags, taint_trackers)
+            t1 = time.perf_counter()
+            # lazy-orders protocol: same economics as the native backend — no
+            # node-ordering sort on steady ticks (gate shared via _lazy_decide)
+            with obs.span("decide", kind="device"):
+                out, ordered = _lazy_decide(
+                    cluster.nodes,
+                    lambda w: jax.block_until_ready(self._kernel.decide_jit(
+                        cluster, np.int64(now_sec), impl=self._impl,
+                        with_orders=w)),
+                )
+                obs.fence(out)
+            t2 = time.perf_counter()
+            metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
+            metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+            obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+            with obs.span("unpack"):
+                results = _unpack(out, group_inputs, ordered=ordered,
+                                  node_masks=cluster.nodes)
+            with obs.span("packing_post"):
+                self._packing.apply(
+                    results, group_inputs, dry_mode_flags, taint_trackers)
+            return results
 
 
 def _changed_slots(old_soa, new_soa) -> np.ndarray:
@@ -510,15 +583,25 @@ class IncrementalJaxBackend(ComputeBackend):
         self._cache = None
         self._inc = None
         self._host_prev = None   # (PodArrays, NodeArrays) of the last pack
+        obs.jaxmon.install()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        with obs.span(self.name):
+            obs.annotate(backend=self.name, impl=self._impl)
+            return self._decide_inner(
+                group_inputs, now_sec, dry_mode_flags, taint_trackers)
+
+    def _decide_inner(self, group_inputs, now_sec, dry_mode_flags,
+                      taint_trackers):
         from escalator_tpu.ops.device_state import (
             DeviceClusterCache,
             IncrementalDecider,
         )
 
         t0 = time.perf_counter()
-        cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
+        with obs.span("pack"):
+            cluster = self._packer.pack(
+                group_inputs, dry_mode_flags, taint_trackers)
         P = int(cluster.pods.valid.shape[0])
         N = int(cluster.nodes.valid.shape[0])
         rebuild = (
@@ -533,18 +616,24 @@ class IncrementalJaxBackend(ComputeBackend):
             != int(cluster.groups.valid.shape[0])
         )
         if rebuild:
-            self._cache = DeviceClusterCache(cluster)
-            self._inc = IncrementalDecider(
-                self._cache, impl=self._impl,
-                refresh_every=self._refresh_every, on_mismatch="repair")
+            with obs.span("rebuild_residency", kind="device"):
+                self._cache = DeviceClusterCache(cluster)
+                self._inc = IncrementalDecider(
+                    self._cache, impl=self._impl,
+                    refresh_every=self._refresh_every, on_mismatch="repair")
+                obs.fence(self._cache.cluster)
         else:
-            pod_slots = _changed_slots(self._host_prev[0], cluster.pods)
-            node_slots = _changed_slots(self._host_prev[1], cluster.nodes)
-            self._cache.set_host(cluster.pods, cluster.nodes)
-            self._inc.apply_gathered(
-                self._cache.gather_deltas(pod_slots, node_slots),
-                cluster.groups,
-            )
+            with obs.span("host_diff"):
+                pod_slots = _changed_slots(self._host_prev[0], cluster.pods)
+                node_slots = _changed_slots(self._host_prev[1], cluster.nodes)
+                self._cache.set_host(cluster.pods, cluster.nodes)
+                gathered = self._cache.gather_deltas(pod_slots, node_slots)
+            with obs.span("scatter", kind="device"):
+                # NOT fenced: the scatter dispatch pipelines into the decide
+                # dispatch (the whole point of the incremental path); a host
+                # sync here would regress the tick to measure it. The decide
+                # span absorbs the scatter tail; this phase is dispatch-only.
+                self._inc.apply_gathered(gathered, cluster.groups)
         # pack_cluster allocates fresh arrays every call, so keeping the
         # references IS the snapshot — no copy
         self._host_prev = (cluster.pods, cluster.nodes)
@@ -552,13 +641,19 @@ class IncrementalJaxBackend(ComputeBackend):
         tainted_any = bool(
             (np.asarray(cluster.nodes.valid)
              & np.asarray(cluster.nodes.tainted)).any())
-        out, ordered = self._inc.decide(now_sec, tainted_any)
+        with obs.span("decide", kind="device"):
+            out, ordered = self._inc.decide(now_sec, tainted_any)
+            obs.fence(out)
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = _unpack(out, group_inputs, ordered=ordered,
-                          node_masks=cluster.nodes)
-        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
+        obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+        with obs.span("unpack"):
+            results = _unpack(out, group_inputs, ordered=ordered,
+                              node_masks=cluster.nodes)
+        with obs.span("packing_post"):
+            self._packing.apply(
+                results, group_inputs, dry_mode_flags, taint_trackers)
         return results
 
 
@@ -587,6 +682,7 @@ class ShardedJaxBackend(ComputeBackend):
         self._pad_pods = 0
         self._pad_nodes = 0
         self._pad_groups = 0
+        obs.jaxmon.install()
 
     def _place(self, sharded):
         """Placement hook: how the stacked [S, ...] cluster lands on the mesh
@@ -594,67 +690,84 @@ class ShardedJaxBackend(ComputeBackend):
         return self._meshlib.shard_cluster_arrays(sharded, self._mesh)
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        with obs.span(self.name):
+            obs.annotate(backend=self.name, impl=self._impl)
+            return self._decide_inner(
+                group_inputs, now_sec, dry_mode_flags, taint_trackers)
+
+    def _decide_inner(self, group_inputs, now_sec, dry_mode_flags,
+                      taint_trackers):
         import jax
 
         t0 = time.perf_counter()
-        assignment = self._meshlib.assign_shards(group_inputs, self._num_shards)
-        max_pods, max_nodes, max_groups = self._meshlib.shard_capacity(
-            group_inputs, assignment
-        )
-        self._pad_pods = max(self._pad_pods, _round_up(max_pods))
-        self._pad_nodes = max(self._pad_nodes, _round_up(max_nodes))
-        self._pad_groups = max(self._pad_groups, _round_up(max_groups, 8))
-        sharded, assignment = self._meshlib.pack_cluster_sharded(
-            group_inputs,
-            num_shards=self._num_shards,
-            pad_pods_per_shard=self._pad_pods,
-            pad_nodes_per_shard=self._pad_nodes,
-            pad_groups_per_shard=self._pad_groups,
-            dry_mode_flags=dry_mode_flags,
-            taint_trackers=taint_trackers,
-        )
-        placed = self._place(sharded)
+        with obs.span("pack"):
+            assignment = self._meshlib.assign_shards(
+                group_inputs, self._num_shards)
+            max_pods, max_nodes, max_groups = self._meshlib.shard_capacity(
+                group_inputs, assignment
+            )
+            self._pad_pods = max(self._pad_pods, _round_up(max_pods))
+            self._pad_nodes = max(self._pad_nodes, _round_up(max_nodes))
+            self._pad_groups = max(self._pad_groups, _round_up(max_groups, 8))
+            sharded, assignment = self._meshlib.pack_cluster_sharded(
+                group_inputs,
+                num_shards=self._num_shards,
+                pad_pods_per_shard=self._pad_pods,
+                pad_nodes_per_shard=self._pad_nodes,
+                pad_groups_per_shard=self._pad_groups,
+                dry_mode_flags=dry_mode_flags,
+                taint_trackers=taint_trackers,
+            )
+        with obs.span("place", kind="device"):
+            placed = obs.fence(self._place(sharded))
         t1 = time.perf_counter()
         # lazy-orders protocol across the mesh: under vmap the ordered
         # variant can never skip its sorts dynamically (cond lowers to
         # select), so the static light decider is the only sort-free
         # steady-state path on sharded backends (gate shared: _lazy_decide)
-        out, ordered = _lazy_decide(
-            sharded.nodes,
-            lambda w: jax.block_until_ready(
-                (self._decider if w else self._decider_light)(
-                    placed, np.int64(now_sec))),
-        )
+        with obs.span("decide", kind="device"):
+            out, ordered = _lazy_decide(
+                sharded.nodes,
+                lambda w: jax.block_until_ready(
+                    (self._decider if w else self._decider_light)(
+                        placed, np.int64(now_sec))),
+            )
+            obs.fence(out)
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+        obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
 
         # Reassemble per-shard outputs back to the caller's group order.
-        results: List[Optional[GroupDecision]] = [None] * len(group_inputs)
-        leaves, aux = out.tree_flatten()
-        nodes_t = type(sharded.nodes)
-        for s, shard_groups in enumerate(assignment):
-            shard_out = type(out).tree_unflatten(
-                aux, [np.asarray(leaf[s]) for leaf in leaves]
-            )
-            shard_inputs = [group_inputs[gi] for gi in shard_groups]
-            # mask views are only read on the light path (_unpack ignores
-            # them when ordered); skip building the per-shard SoA otherwise
-            shard_masks = nodes_t(**{
-                f: np.asarray(getattr(sharded.nodes, f))[s]
-                for f in nodes_t.__dataclass_fields__
-            }) if not ordered else None
-            shard_results = _unpack(shard_out, shard_inputs, ordered=ordered,
-                                    node_masks=shard_masks)
-            for local, gi in enumerate(shard_groups):
-                results[gi] = shard_results[local]
+        with obs.span("unpack"):
+            results: List[Optional[GroupDecision]] = [None] * len(group_inputs)
+            leaves, aux = out.tree_flatten()
+            nodes_t = type(sharded.nodes)
+            for s, shard_groups in enumerate(assignment):
+                shard_out = type(out).tree_unflatten(
+                    aux, [np.asarray(leaf[s]) for leaf in leaves]
+                )
+                shard_inputs = [group_inputs[gi] for gi in shard_groups]
+                # mask views are only read on the light path (_unpack ignores
+                # them when ordered); skip building the per-shard SoA otherwise
+                shard_masks = nodes_t(**{
+                    f: np.asarray(getattr(sharded.nodes, f))[s]
+                    for f in nodes_t.__dataclass_fields__
+                }) if not ordered else None
+                shard_results = _unpack(shard_out, shard_inputs,
+                                        ordered=ordered,
+                                        node_masks=shard_masks)
+                for local, gi in enumerate(shard_groups):
+                    results[gi] = shard_results[local]
         # PackingPostPass.select indexes results[gi] by group_inputs position,
         # so it must see the UNfiltered list — a partial assignment filtered
         # first would silently repack the wrong groups' deltas
         assert all(r is not None for r in results), (
             "assign_shards must cover every group"
         )
-        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
+        with obs.span("packing_post"):
+            self._packing.apply(
+                results, group_inputs, dry_mode_flags, taint_trackers)
         return results
 
 
@@ -758,6 +871,7 @@ class PodAxisJaxBackend(ComputeBackend):
         self._packer = PaddedPacker()
         self._packing = PackingPostPass()
         self._block_pad = 0
+        obs.jaxmon.install()
 
     def _node_blocks(self, cluster):
         """Per-tick contiguous-group block map for the sharded ordering tail,
@@ -773,32 +887,44 @@ class PodAxisJaxBackend(ComputeBackend):
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
         import jax
 
-        t0 = time.perf_counter()
-        cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
-        placed = self._podaxis.place(
-            self._podaxis.pad_pods_for_mesh(cluster, self._mesh), self._mesh
-        )
-        t1 = time.perf_counter()
-        # lazy-orders protocol: this path's replicated decide tail IS the
-        # node sort (podaxis.py cost model), so the light variant removes
-        # the dominant replicated term on steady ticks (gate: _lazy_decide);
-        # a busy tick pays the BLOCK-SHARDED sort, not the replicated one.
-        # The block map is built inside the dispatch, ordered branch only —
-        # steady ticks (the common case) never pay its O(N) host argsort
-        out, ordered = _lazy_decide(
-            cluster.nodes,
-            lambda w: jax.block_until_ready(
-                self._decider(placed, np.int64(now_sec),
-                              self._node_blocks(cluster))
-                if w else self._decider_light(placed, np.int64(now_sec))),
-        )
-        t2 = time.perf_counter()
-        metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
-        metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = _unpack(out, group_inputs, ordered=ordered,
-                          node_masks=cluster.nodes)
-        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
-        return results
+        with obs.span(self.name):
+            obs.annotate(backend=self.name, impl=self._impl)
+            t0 = time.perf_counter()
+            with obs.span("pack"):
+                cluster = self._packer.pack(
+                    group_inputs, dry_mode_flags, taint_trackers)
+            with obs.span("place", kind="device"):
+                placed = obs.fence(self._podaxis.place(
+                    self._podaxis.pad_pods_for_mesh(cluster, self._mesh),
+                    self._mesh,
+                ))
+            t1 = time.perf_counter()
+            # lazy-orders protocol: this path's replicated decide tail IS the
+            # node sort (podaxis.py cost model), so the light variant removes
+            # the dominant replicated term on steady ticks (gate: _lazy_decide);
+            # a busy tick pays the BLOCK-SHARDED sort, not the replicated one.
+            # The block map is built inside the dispatch, ordered branch only —
+            # steady ticks (the common case) never pay its O(N) host argsort
+            with obs.span("decide", kind="device"):
+                out, ordered = _lazy_decide(
+                    cluster.nodes,
+                    lambda w: jax.block_until_ready(
+                        self._decider(placed, np.int64(now_sec),
+                                      self._node_blocks(cluster))
+                        if w else self._decider_light(placed, np.int64(now_sec))),
+                )
+                obs.fence(out)
+            t2 = time.perf_counter()
+            metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
+            metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
+            obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+            with obs.span("unpack"):
+                results = _unpack(out, group_inputs, ordered=ordered,
+                                  node_masks=cluster.nodes)
+            with obs.span("packing_post"):
+                self._packing.apply(
+                    results, group_inputs, dry_mode_flags, taint_trackers)
+            return results
 
 
 def make_backend(kind: str = "auto") -> ComputeBackend:

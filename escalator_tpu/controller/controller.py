@@ -17,6 +17,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from escalator_tpu import observability as obs
 from escalator_tpu.cloudprovider import interface as cp
 from escalator_tpu.cloudprovider.errors import NodeNotInNodeGroupError
 from escalator_tpu.controller import node_group as ngmod
@@ -159,37 +160,80 @@ class Controller:
 
     # ------------------------------------------------------------------ tick
     def run_once(self) -> None:
-        """One tick over all nodegroups (reference: controller.go:400-451)."""
-        with self.opts.tracer.tick():
+        """One tick over all nodegroups (reference: controller.go:400-451).
+
+        The whole tick is one flight-recorder timeline (root span ``tick``):
+        the controller's own phases (provider_refresh / group_scan / decide /
+        act) plus whatever device phases the backend nests under ``decide``
+        — so a dump reads as a single end-to-end per-tick trace."""
+        with self.opts.tracer.tick(), obs.span("tick"):
+            obs.annotate(backend=self.backend.name)
             self._run_once_inner()
 
     def _run_once_inner(self) -> None:
         start = self.clock.now()
 
         # Provider refresh with stale-credential retries (controller.go:403-414).
-        try:
-            self.cloud_provider.refresh()
-        except Exception as first_err:
-            err: Optional[Exception] = first_err
-            for i in range(2):
-                log.warning(
-                    "cloud provider failed to refresh; re-fetching credentials"
-                    " (try %d): %s", i + 1, err,
-                )
-                self.clock.sleep(5)
-                self.cloud_provider = self.opts.cloud_provider_builder.build()
-                try:
-                    self.cloud_provider.refresh()
-                    err = None
-                    break
-                except Exception as e:  # noqa: PERF203
-                    err = e
-            if err is not None:
-                # the retry loop already logged each failure; the implicit
-                # first_err context adds nothing (err may BE first_err)
-                raise err from None
+        with obs.span("provider_refresh"):
+            try:
+                self.cloud_provider.refresh()
+            except Exception as first_err:
+                err: Optional[Exception] = first_err
+                for i in range(2):
+                    log.warning(
+                        "cloud provider failed to refresh; re-fetching"
+                        " credentials (try %d): %s", i + 1, err,
+                    )
+                    self.clock.sleep(5)
+                    self.cloud_provider = (
+                        self.opts.cloud_provider_builder.build())
+                    try:
+                        self.cloud_provider.refresh()
+                        err = None
+                        break
+                    except Exception as e:  # noqa: PERF203
+                        err = e
+                if err is not None:
+                    # the retry loop already logged each failure; the implicit
+                    # first_err context adds nothing (err may BE first_err)
+                    raise err from None
 
         # Phase 1: per-group provider checks + lister reads (object level).
+        with obs.span("group_scan"):
+            batch = self._scan_groups()
+
+        # Phase 2: one batched decision for all groups. The backend opens its
+        # own named span under this one, so the flight record nests e.g.
+        # tick/decide/native-jax/delta_decide.
+        now_sec = int(self.clock.now())
+        group_inputs = [
+            (pods, nodes, st.opts.to_group_config(), st.kernel_state)
+            for (_, st, pods, nodes) in batch
+        ]
+        with obs.span("decide"):
+            decisions = self.backend.decide(
+                group_inputs,
+                now_sec,
+                dry_mode_flags=[self._dry_mode(st) for (_, st, _, _) in batch],
+                taint_trackers=[st.taint_tracker for (_, st, _, _) in batch],
+            )
+
+        # Phase 3: per-group side effects.
+        with obs.span("act"):
+            for (name, state, pods, nodes), gd in zip(
+                    batch, decisions, strict=True):
+                delta = self._act_on_decision(name, state, pods, nodes, gd)
+                metrics.node_group_scale_delta.labels(name).set(delta)
+                state.scale_delta = delta
+
+        metrics.run_count.inc()
+        self.last_tick_completed_sec = self.clock.now()
+        log.debug("scaling took a total of %.3fs", self.clock.now() - start)
+
+    def _scan_groups(
+        self,
+    ) -> List[Tuple[str, NodeGroupState, List[k8s.Pod], List[k8s.Node]]]:
+        """Tick phase 1: provider size checks + lister reads per group."""
         batch: List[Tuple[str, NodeGroupState, List[k8s.Pod], List[k8s.Node]]] = []
         for ng_opts in self.opts.node_groups:
             state = self.node_groups[ng_opts.name]
@@ -233,29 +277,7 @@ class Controller:
             state.kernel_state.locked = state.scale_lock.locked()
             state.kernel_state.requested_nodes = state.scale_lock.requested_nodes
             batch.append((ng_opts.name, state, pods, nodes))
-
-        # Phase 2: one batched decision for all groups.
-        now_sec = int(self.clock.now())
-        group_inputs = [
-            (pods, nodes, st.opts.to_group_config(), st.kernel_state)
-            for (_, st, pods, nodes) in batch
-        ]
-        decisions = self.backend.decide(
-            group_inputs,
-            now_sec,
-            dry_mode_flags=[self._dry_mode(st) for (_, st, _, _) in batch],
-            taint_trackers=[st.taint_tracker for (_, st, _, _) in batch],
-        )
-
-        # Phase 3: per-group side effects.
-        for (name, state, pods, nodes), gd in zip(batch, decisions, strict=True):
-            delta = self._act_on_decision(name, state, pods, nodes, gd)
-            metrics.node_group_scale_delta.labels(name).set(delta)
-            state.scale_delta = delta
-
-        metrics.run_count.inc()
-        self.last_tick_completed_sec = self.clock.now()
-        log.debug("scaling took a total of %.3fs", self.clock.now() - start)
+        return batch
 
     def run_forever(self, run_immediately: bool = False) -> None:
         """Reference: controller.go:455-480."""

@@ -24,10 +24,12 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
+from escalator_tpu import observability as obs
 from escalator_tpu.controller.backend import (
     ComputeBackend,
     GroupDecision,
     PackingPostPass,
+    _decision_digest,
     _round_up,
 )
 from escalator_tpu.core import semantics
@@ -99,6 +101,7 @@ class NativeJaxBackend(ComputeBackend):
         self._pallas_failures = 0
         self._ticks_since_fallback = 0
         self._dispatches_this_tick = 0
+        obs.jaxmon.install()
 
     def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
         """First live node per group -> GroupState cached capacity
@@ -142,6 +145,15 @@ class NativeJaxBackend(ComputeBackend):
     # -- decide ------------------------------------------------------------------
     def decide(self, group_inputs, now_sec, dry_mode_flags=None,
                taint_trackers=None):
+        with obs.span(self.name):
+            obs.annotate(backend=self.name,
+                         impl="xla" if self._incremental else
+                         (self._impl_fallback or "native"))
+            return self._decide_inner(
+                group_inputs, now_sec, dry_mode_flags, taint_trackers)
+
+    def _decide_inner(self, group_inputs, now_sec, dry_mode_flags=None,
+                      taint_trackers=None):
         import jax
 
         from escalator_tpu.ops.device_state import DeviceClusterCache
@@ -153,7 +165,9 @@ class NativeJaxBackend(ComputeBackend):
         # dirty-list drain. The long device decide below runs OUTSIDE the
         # lock — ingestion overlaps compute, the -race-analog soak test
         # (tests/test_concurrency_soak.py) exercises exactly this interleaving.
-        with self.store.lock:
+        # The host_snapshot span covers exactly the locked section — its
+        # duration is also "how long watch ingestion was stalled this tick".
+        with obs.span("host_snapshot"), self.store.lock:
             pods, nodes_raw = self.store.as_pod_node_arrays()
             self._refresh_cached_capacity(group_inputs, nodes_raw)
             nodes = self._dry_mode_view(
@@ -224,47 +238,65 @@ class NativeJaxBackend(ComputeBackend):
                 # size triggers — happens after release, so watch ingestion
                 # never convoys behind a transfer or compile
                 gathered = self._cache.gather_deltas(pod_dirty, node_dirty)
-        if rebuild:
-            # outside the lock: upload the snapshot copies. The cache's host
-            # views rebind on the next tick's set_host before any gather, so
-            # no live-view binding is needed (or safe) here.
-            self._cache = DeviceClusterCache(
-                ClusterArrays(groups=groups, pods=pods_snap, nodes=nodes_snap)
-            )
-            if self._incremental:
-                from escalator_tpu.ops.device_state import IncrementalDecider
+        with obs.span("scatter", kind="device"):
+            if rebuild:
+                # outside the lock: upload the snapshot copies. The cache's host
+                # views rebind on the next tick's set_host before any gather, so
+                # no live-view binding is needed (or safe) here.
+                self._cache = DeviceClusterCache(
+                    ClusterArrays(groups=groups, pods=pods_snap,
+                                  nodes=nodes_snap)
+                )
+                if self._incremental:
+                    from escalator_tpu.ops.device_state import IncrementalDecider
 
-                # a production controller must not crash-loop on an audit
-                # mismatch: repair (recompute + full dirty) and log loudly
-                self._inc = IncrementalDecider(
-                    self._cache, impl="xla",
-                    refresh_every=self._refresh_every, on_mismatch="repair")
-        elif self._inc is not None:
-            # incremental: same scatter batch, but the device program also
-            # folds the exact aggregate deltas + dirty marks (one dispatch)
-            self._inc.apply_gathered(gathered, groups)
-        else:
-            # two async dispatches (scatter, then decide) pipeline back-to-back;
-            # measured faster than the fused single-program alternative
-            # (DeviceClusterCache.apply_dirty_and_decide) on the v5e tunnel
-            self._cache.apply_gathered(gathered, groups)
+                    # a production controller must not crash-loop on an audit
+                    # mismatch: repair (recompute + full dirty) and log loudly
+                    self._inc = IncrementalDecider(
+                        self._cache, impl="xla",
+                        refresh_every=self._refresh_every, on_mismatch="repair")
+                obs.fence(self._cache.cluster)
+            elif self._inc is not None:
+                # incremental: same scatter batch, but the device program also
+                # folds the exact aggregate deltas + dirty marks (one
+                # dispatch). NOT fenced, same as the legacy branch below: the
+                # scatter->decide dispatch pipelining is the steady-tick
+                # optimization, and a fence here would buy phase precision by
+                # inserting a host sync the production path never had — the
+                # decide span absorbs any scatter tail, keeping the tick
+                # total honest while this phase reads as dispatch-only.
+                self._inc.apply_gathered(gathered, groups)
+            else:
+                # two async dispatches (scatter, then decide) pipeline
+                # back-to-back; measured faster than the fused single-program
+                # alternative (apply_dirty_and_decide) on the v5e tunnel.
+                # NOT fenced: the pipelining IS the optimization — the decide
+                # span below absorbs any scatter tail, so the tick total
+                # stays honest while this phase reads as dispatch-only.
+                self._cache.apply_gathered(gathered, groups)
         self._overridden_slots = overridden
         t1 = time.perf_counter()
         if self._inc is not None:
             # incremental dispatch pair (delta_decide light / aggregate-fed
             # ordered) with the same lazy-orders gate semantics
-            out, ordered = self._inc.decide(now_sec, tainted_any)
+            with obs.span("decide", kind="device"):
+                out, ordered = self._inc.decide(now_sec, tainted_any)
+                obs.fence(out)
             t2 = time.perf_counter()
             metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
             metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-            results = self._unpack(out, group_inputs, unpack_group,
-                                   unpack_cordoned, ordered=ordered,
-                                   untainted_mask=unpack_untainted)
-            if packing_rows:
-                sel = set(PackingPostPass.select(results, group_inputs))
-                self._packing.apply_arrays(
-                    results, [row for row in packing_rows if row[0] in sel]
-                )
+            obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+            with obs.span("unpack"):
+                results = self._unpack(out, group_inputs, unpack_group,
+                                       unpack_cordoned, ordered=ordered,
+                                       untainted_mask=unpack_untainted)
+            with obs.span("packing_post"):
+                if packing_rows:
+                    sel = set(PackingPostPass.select(results, group_inputs))
+                    self._packing.apply_arrays(
+                        results,
+                        [row for row in packing_rows if row[0] in sel]
+                    )
             return results
         # blocks on the result itself: an async device failure must surface
         # inside the resilient wrapper, not here. The lazy protocol sorts
@@ -277,20 +309,30 @@ class NativeJaxBackend(ComputeBackend):
         # a drain-start tick dispatches twice; the pallas cool-off counter
         # must still advance once per TICK (see _decide_resilient)
         self._dispatches_this_tick = 0
-        out, ordered = lazy_orders_decide(
-            lambda w: self._decide_resilient(np.int64(now_sec), with_orders=w),
-            tainted_any,
-        )
+
+        def dispatch(w):
+            with obs.span("decide_ordered" if w else "decide_light",
+                          kind="device"):
+                return obs.fence(
+                    self._decide_resilient(np.int64(now_sec), with_orders=w))
+
+        with obs.span("decide", kind="device"):
+            out, ordered = lazy_orders_decide(dispatch, tainted_any)
+            obs.fence(out)
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        results = self._unpack(out, group_inputs, unpack_group, unpack_cordoned,
-                               ordered=ordered, untainted_mask=unpack_untainted)
-        if packing_rows:
-            sel = set(PackingPostPass.select(results, group_inputs))
-            self._packing.apply_arrays(
-                results, [row for row in packing_rows if row[0] in sel]
-            )
+        obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+        with obs.span("unpack"):
+            results = self._unpack(out, group_inputs, unpack_group,
+                                   unpack_cordoned, ordered=ordered,
+                                   untainted_mask=unpack_untainted)
+        with obs.span("packing_post"):
+            if packing_rows:
+                sel = set(PackingPostPass.select(results, group_inputs))
+                self._packing.apply_arrays(
+                    results, [row for row in packing_rows if row[0] in sel]
+                )
         return results
 
     def _decide_resilient(self, now_sec, with_orders: bool = True):
@@ -333,6 +375,9 @@ class NativeJaxBackend(ComputeBackend):
         # invariant) — only genuine lowering/device failures degrade
         if impl not in ("xla", "pallas"):
             raise ValueError(f"unknown aggregation impl {impl!r}")
+        # the flight record carries the impl that actually RAN this tick
+        # (the fallback/retry machinery can differ from the construction one)
+        obs.annotate(impl=impl)
         try:
             # block HERE: decide_jit dispatches asynchronously, so a device-
             # side Pallas failure surfaces at block_until_ready, and it must

@@ -166,6 +166,47 @@ solver_packing_latency = Histogram(
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
 )
 
+# --- observability layer (tick flight recorder, escalator_tpu.observability) -
+tick_phase_latency = Histogram(
+    "tick_phase_seconds",
+    "per-phase device-fenced tick latency from the span timeline "
+    "(phase label is the span leaf name: pack, scatter, delta_decide, "
+    "decide_ordered, unpack, ...)",
+    ["backend", "phase"], namespace="escalator_tpu", registry=registry,
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 0.5, 1.0),
+)
+incremental_audit_mismatch = Counter(
+    "incremental_audit_mismatch_total",
+    "refresh audits where the maintained incremental aggregates diverged "
+    "from a from-scratch recompute (each one also triggers a flight-record "
+    "dump); alert on any increase",
+    namespace="escalator_tpu", registry=registry,
+)
+flight_recorder_dumps = Counter(
+    "flight_recorder_dumps_total",
+    "automatic flight-recorder incident dumps, by trigger",
+    ["reason"], namespace="escalator_tpu", registry=registry,
+)
+jax_compile_seconds = Histogram(
+    "jax_compile_seconds",
+    "XLA backend-compile durations observed via jax.monitoring (a warm "
+    "steady state observes none; per-tick compiles mean retrace churn)",
+    namespace="escalator_tpu", registry=registry,
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
+jax_compile_events = Counter(
+    "jax_compile_events_total",
+    "XLA backend compiles observed via jax.monitoring",
+    namespace="escalator_tpu", registry=registry,
+)
+jax_transfer_events = Counter(
+    "jax_transfer_events_total",
+    "host<->device transfer events observed via jax.monitoring (this jax "
+    "version emits none; populated on runtimes that do)",
+    namespace="escalator_tpu", registry=registry,
+)
+
 
 def start(address: str = "0.0.0.0:8080", readiness=None) -> WSGIServer:
     """Serve /metrics on a background thread (reference: metrics.go:260-268),

@@ -1,0 +1,33 @@
+"""Observability: tick span timelines, the flight recorder, jax.monitoring
+counters. See docs/observability.md for the operator view.
+
+Importing this package wires the flight recorder into the span layer; the
+import itself is cheap (stdlib + prometheus metrics — **no jax**), so every
+backend imports it unconditionally. jax.monitoring subscription happens
+lazily at the first tick of a process that already loaded jax.
+"""
+
+from escalator_tpu.observability import flightrecorder, jaxmon, spans
+from escalator_tpu.observability.flightrecorder import (
+    RECORDER,
+    dump_on_incident,
+)
+from escalator_tpu.observability.spans import (
+    add_phase,
+    annotate,
+    current_path,
+    current_timeline,
+    enabled,
+    fence,
+    graft,
+    set_enabled,
+    span,
+)
+
+flightrecorder.install()
+
+__all__ = [
+    "RECORDER", "add_phase", "annotate", "current_path", "current_timeline",
+    "dump_on_incident", "enabled", "fence", "flightrecorder", "graft",
+    "jaxmon", "set_enabled", "span", "spans",
+]

@@ -1,0 +1,216 @@
+"""Tick flight recorder: a fixed-size ring of the last N tick timelines.
+
+The span layer (spans.py) produces one :class:`~escalator_tpu.observability.
+spans.Timeline` per tick root; this module keeps the last N of them as
+structured records — phase durations, backend/impl, dirty-group count,
+refresh-audit outcome, decision digest, and the jax.monitoring compile /
+transfer deltas that happened inside the tick — so the moments *before* an
+incident are always reconstructible:
+
+- **automatic dumps** on incidents: the tick watchdog dumps before its
+  crash-to-restart exit (cli.py), and the incremental refresh audit dumps on
+  a mismatch (ops/device_state.py) — the ring then carries exactly the ticks
+  whose deltas diverged;
+- **on-demand dumps**: ``escalator-tpu debug-dump`` (CLI) and the plugin's
+  ``Dump`` method pull the same JSON from a live process.
+
+The recorder is process-global and always on (a record is a small dict; the
+ring is bounded by ``ESCALATOR_TPU_FLIGHT_RECORDER_SIZE``, default 256).
+Recording happens in the root-complete hook, i.e. on the tick thread but
+after all timed phases closed — it adds nothing to any phase duration.
+:func:`install` also feeds the Prometheus per-phase histograms
+(``escalator_tpu_tick_phase_seconds{backend,phase}``) from the same
+completed timelines, so the metrics and the recorder can never disagree
+about what a phase cost.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from escalator_tpu.observability import jaxmon, spans
+
+DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_FLIGHT_RECORDER_SIZE",
+                                      "256"))
+
+#: timeline meta keys lifted verbatim into the tick record when present
+_META_KEYS = ("backend", "impl", "ordered", "digest", "dirty_groups",
+              "refresh_audit", "caller", "trace_id", "fallback")
+
+#: stash key for the tick-open jaxmon snapshot (private to this module)
+_MON0 = "_jaxmon_t0"
+
+
+class FlightRecorder:
+    """Bounded ring of tick records (thread-safe appends/snapshots)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=self.capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    # -- recording ---------------------------------------------------------
+    def record_timeline(self, tl: spans.Timeline) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "root": tl.name,
+            "time_unix": round(tl.wall_time, 3),
+            "duration_ms": round(tl.duration_sec * 1e3, 4),
+            "phases": [p.as_dict() for p in tl.phases],
+        }
+        for k in _META_KEYS:
+            if tl.meta.get(k) is not None:
+                rec[k] = tl.meta[k]
+        mon0 = tl.meta.get(_MON0)
+        if mon0 is not None:
+            mon1 = jaxmon.snapshot()
+            rec["compile_events"] = int(
+                mon1["compile_events"] - mon0["compile_events"])
+            rec["compile_seconds"] = round(
+                mon1["compile_seconds"] - mon0["compile_seconds"], 6)
+            rec["transfer_events"] = int(
+                mon1["transfer_events"] - mon0["transfer_events"])
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+        return rec
+
+    # -- reading -----------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def total_recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def last(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -- dumping -----------------------------------------------------------
+    def as_dump(self, reason: str = "on-demand") -> Dict[str, Any]:
+        return {
+            "flight_recorder": True,
+            "reason": reason,
+            "dumped_at_unix": round(time.time(), 3),
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "depth": self.depth,
+            "total_recorded": self.total_recorded,
+            "jaxmon": jaxmon.snapshot(),
+            "ticks": self.snapshot(),
+        }
+
+    def dump(self, path: str, reason: str = "on-demand") -> str:
+        """Write the dump JSON atomically (tmp + rename: an incident dump
+        racing a SIGKILL must not strand a truncated artifact)."""
+        doc = self.as_dump(reason)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+
+#: the process-wide recorder every instrumented layer records into
+RECORDER = FlightRecorder()
+
+_installed = False
+
+
+def _on_root_start(tl: spans.Timeline) -> None:
+    # lazy jaxmon attach: only when jax is already in this process — a
+    # golden-only controller must never import jax for its tick records
+    import sys
+
+    if "jax" in sys.modules and not jaxmon.installed():
+        jaxmon.install()
+    if jaxmon.installed():
+        tl.meta[_MON0] = jaxmon.snapshot()
+
+
+def _on_root_complete(tl: spans.Timeline) -> None:
+    rec = RECORDER.record_timeline(tl)
+    try:
+        from escalator_tpu.metrics import metrics
+
+        backend = str(rec.get("backend") or rec.get("root") or "unknown")
+        # LEAF phases only: composite spans (the root, a backend's wrapper,
+        # the controller's decide envelope) share leaf names with the spans
+        # they contain ("decide" nests "decide"), and labeling both would
+        # double-count the same wall time under one {backend, phase} series.
+        # Composites stay in the recorder, where paths disambiguate them.
+        # GRAFTED phases are skipped too: they are remote time already inside
+        # the local rpc phase (counting both over-reports the tick), and the
+        # remote process exports its own per-phase series for them.
+        parents = {p["path"].rsplit("/", 1)[0] for p in rec["phases"]
+                   if "/" in p["path"]}
+        for p in rec["phases"]:
+            if p["path"] in parents or p.get("remote"):
+                continue
+            metrics.tick_phase_latency.labels(backend, p["name"]).observe(
+                p["ms"] / 1e3)
+    except Exception:  # noqa: BLE001 - metrics must never break the tick
+        pass
+
+
+def install() -> None:
+    """Hook the recorder into the span layer (idempotent; done at
+    ``escalator_tpu.observability`` import)."""
+    global _installed
+    if _installed:
+        return
+    spans.on_root_start(_on_root_start)
+    spans.on_root_complete(_on_root_complete)
+    _installed = True
+
+
+_incident_seq = 0
+
+
+def dump_on_incident(reason: str) -> Optional[str]:
+    """Best-effort incident dump (wedge watchdog, audit mismatch): write
+    the ring to ``ESCALATOR_TPU_FLIGHT_DUMP_DIR`` (default cwd) under a
+    reason+pid+timestamp+seq name (seq disambiguates incidents landing in
+    the same second — two same-named dumps would silently overwrite), bump
+    the dump counter, and NEVER raise — an observability failure must not
+    compound the incident. Returns the path, or None when the write
+    failed."""
+    global _incident_seq
+    try:
+        _incident_seq += 1
+        out_dir = os.environ.get("ESCALATOR_TPU_FLIGHT_DUMP_DIR", ".")
+        path = os.path.join(
+            out_dir,
+            f"escalator-tpu-flight-{reason}-{os.getpid()}-"
+            f"{int(time.time())}-{_incident_seq}.json",
+        )
+        RECORDER.dump(path, reason=reason)
+    except Exception:  # noqa: BLE001
+        return None
+    try:
+        from escalator_tpu.metrics import metrics
+
+        metrics.flight_recorder_dumps.labels(reason).inc()
+    except Exception:  # noqa: BLE001
+        pass
+    return path

@@ -1,0 +1,122 @@
+"""jax.monitoring bridge: compile and transfer telemetry as counters.
+
+JAX announces its internal lifecycle through ``jax.monitoring`` — on this
+jax (0.4.x) a compile emits ``/jax/core/compile/jaxpr_trace_duration``,
+``.../jaxpr_to_mlir_module_duration`` and ``.../backend_compile_duration``
+duration events plus compilation-cache count events. :func:`install`
+subscribes once per process and folds them into:
+
+- a process-local snapshot (:func:`snapshot`) the flight recorder diffs
+  per tick, so every tick record says how many compiles (and how much
+  compile time) happened inside it — a recompilation storm is then visible
+  as a per-tick anomaly, not a vibe;
+- Prometheus series: ``escalator_tpu_jax_compile_seconds`` (histogram of
+  per-program backend-compile durations), ``..._jax_compile_events_total``
+  and ``..._jax_transfer_events_total``.
+
+Event classification is by key substring, deliberately version-tolerant:
+any duration key containing ``compile`` adds to compile seconds (trace +
+MLIR lowering + backend compile are disjoint stages of one compile, so the
+sum is "total time spent compiling"); the ``backend_compile`` key counts
+the compile event. Keys containing ``transfer`` or ``device_put`` count as
+host<->device transfers — this jax version emits none (the counter stays
+0 and docs/observability.md says so), but newer runtimes that do are
+picked up without a code change.
+
+Listeners cannot be unregistered on this jax; install is process-lifetime
+and idempotent. Callbacks are tolerant (``**kwargs``) so jax versions that
+add metadata keep working, and they never raise into jax internals.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_installed = False
+_install_failed: str = ""
+
+_counts: Dict[str, float] = {
+    "compile_events": 0,
+    "compile_seconds": 0.0,
+    "transfer_events": 0,
+    "monitored_events": 0,
+}
+
+#: the per-program compile event (one per XLA backend compile on jax 0.4.x)
+_BACKEND_COMPILE = "backend_compile"
+
+
+def _classify(event: str) -> str:
+    e = event.lower()
+    if "compil" in e:
+        return "compile"
+    if "transfer" in e or "device_put" in e:
+        return "transfer"
+    return "other"
+
+
+def _on_event(event: str, **kwargs) -> None:  # noqa: ANN003
+    try:
+        with _lock:
+            _counts["monitored_events"] += 1
+            if _classify(event) == "transfer":
+                _counts["transfer_events"] += 1
+                _metrics().jax_transfer_events.inc()
+    except Exception:  # noqa: BLE001 - never raise into jax internals
+        pass
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:  # noqa: ANN003
+    try:
+        kind = _classify(event)
+        with _lock:
+            _counts["monitored_events"] += 1
+            if kind == "compile":
+                _counts["compile_seconds"] += float(duration)
+                if _BACKEND_COMPILE in event:
+                    _counts["compile_events"] += 1
+                    m = _metrics()
+                    m.jax_compile_events.inc()
+                    m.jax_compile_seconds.observe(float(duration))
+            elif kind == "transfer":
+                _counts["transfer_events"] += 1
+                _metrics().jax_transfer_events.inc()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _metrics():
+    from escalator_tpu.metrics import metrics
+
+    return metrics
+
+
+def install() -> bool:
+    """Subscribe to jax.monitoring (idempotent; once per process). Returns
+    True when listening. Safe without jax installed — the import failure is
+    recorded and the counters simply stay at zero."""
+    global _installed, _install_failed
+    with _lock:
+        if _installed:
+            return True
+        try:
+            import jax.monitoring as mon
+        except Exception as e:  # noqa: BLE001 - jax-less deployment
+            _install_failed = str(e)
+            return False
+        mon.register_event_listener(_on_event)
+        mon.register_event_duration_secs_listener(_on_duration)
+        _installed = True
+        return True
+
+
+def installed() -> bool:
+    return _installed
+
+
+def snapshot() -> Dict[str, float]:
+    """Copy of the monotonic counters (diff two snapshots for a window)."""
+    with _lock:
+        return dict(_counts)

@@ -1,0 +1,272 @@
+"""Per-tick span timelines: the flight recorder's measurement substrate.
+
+The reference's only timing signal is a per-run wall-time debug log
+(pkg/controller/controller.go:448); this module is the Dapper-style
+(Sigelman et al., 2010) replacement adapted to a single-process JAX control
+loop: every tick is one *timeline* of named, nestable spans, and device
+phases are **explicitly fenced** (``fence()`` calls ``jax.block_until_ready``
+on the phase's output before the span closes) so a span's duration is device
+time, not async-dispatch time.
+
+Design constraints, in order:
+
+- **Zero dependencies.** This module imports only the stdlib. ``fence``
+  reaches jax through ``sys.modules`` — a golden-only deployment never pays
+  a jax import for its timeline.
+- **Strictly outside traced code.** Spans wrap jit *dispatch sites*; nothing
+  here may run under a trace (no host callbacks, no primitives — the R4 ban
+  and the jaxpr-byte-identity assertion in tests/test_observability.py lock
+  this).
+- **Negligible overhead.** A span is two ``perf_counter`` calls, a string
+  join and a list append (~1-2 us); a steady tick carries < 10 spans. The
+  measured bound (< 1% of a cfg14 steady tick) ships in bench.py's
+  observability-overhead row. ``set_enabled(False)`` is the bench's
+  control arm — spans become no-ops and no timeline is recorded.
+
+Model: the first span opened on a thread with an empty stack becomes the
+**root** of a new timeline (``Timeline``); nested ``span()`` calls record
+phases whose ``path`` is the slash-joined name chain. When the root closes,
+the timeline is handed to the registered completion hooks (the flight
+recorder and the Prometheus per-phase histograms — see flightrecorder.py).
+State is thread-local: concurrent ticks (a plugin server thread under a
+client thread in-process, the concurrency soak) never interleave timelines.
+
+Phases carry a ``fenced`` flag: True when the phase's duration is accurate —
+either a host-only phase (``kind="host"``/``"rpc"``: the work is synchronous
+by construction) or a device phase whose owner called :func:`fence` before
+the span closed. An unfenced device phase measured only the async dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+__all__ = [
+    "Phase", "Timeline", "span", "fence", "annotate", "add_phase", "graft",
+    "current_path", "current_timeline", "on_root_start", "on_root_complete",
+    "set_enabled", "enabled",
+]
+
+#: kinds whose phases are synchronous by construction (duration is accurate
+#: without an explicit fence): host compute and blocking RPCs
+_SYNC_KINDS = ("host", "rpc")
+
+
+@dataclass
+class Phase:
+    """One completed span: a named slice of a tick's timeline."""
+
+    name: str            # leaf name ("pack", "decide_light", ...)
+    path: str            # slash-joined chain from the root ("jax/decide/pack")
+    duration_sec: float
+    kind: str = "host"   # "host" | "device" | "rpc"
+    fenced: bool = True  # duration is device-accurate (see module docstring)
+    #: start offset from the timeline root (None for grafted remote phases)
+    offset_sec: Optional[float] = None
+    #: grafted from another process's timeline (that process exports its own
+    #: Prometheus series for these — the local histograms skip them)
+    remote: bool = False
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {
+            "name": self.name,
+            "path": self.path,
+            "ms": round(self.duration_sec * 1e3, 4),
+            "kind": self.kind,
+            "fenced": self.fenced,
+        }
+        if self.offset_sec is not None:
+            d["offset_ms"] = round(self.offset_sec * 1e3, 4)
+        if self.remote:
+            d["remote"] = True
+        return d
+
+
+@dataclass
+class Timeline:
+    """All phases of one root span (one tick), plus caller annotations."""
+
+    name: str
+    wall_time: float                      # time.time() at root open
+    t0: float                             # perf_counter at root open
+    phases: List[Phase] = field(default_factory=list)
+    meta: Dict[str, Any] = field(default_factory=dict)
+    duration_sec: float = 0.0             # set when the root closes
+
+
+class _Frame:
+    __slots__ = ("name", "t0", "kind", "fenced")
+
+    def __init__(self, name: str, t0: float, kind: str):
+        self.name = name
+        self.t0 = t0
+        self.kind = kind
+        self.fenced = kind in _SYNC_KINDS
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.stack: List[_Frame] = []
+        self.timeline: Optional[Timeline] = None
+
+
+_state = _State()
+_enabled = True
+_root_start_hooks: List[Callable[[Timeline], None]] = []
+_root_complete_hooks: List[Callable[[Timeline], None]] = []
+
+
+def set_enabled(value: bool) -> None:
+    """Globally enable/disable recording (the bench's overhead control arm;
+    production leaves it on). Disabled spans are no-ops."""
+    global _enabled
+    _enabled = bool(value)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def on_root_start(cb: Callable[[Timeline], None]) -> None:
+    if cb not in _root_start_hooks:
+        _root_start_hooks.append(cb)
+
+
+def on_root_complete(cb: Callable[[Timeline], None]) -> None:
+    if cb not in _root_complete_hooks:
+        _root_complete_hooks.append(cb)
+
+
+def _run_hooks(hooks: List[Callable[[Timeline], None]], tl: Timeline) -> None:
+    for cb in hooks:
+        try:
+            cb(tl)
+        except Exception:  # noqa: BLE001 - observability must never break ticks
+            pass
+
+
+def _path(upto: Optional[int] = None) -> str:
+    frames = _state.stack if upto is None else _state.stack[:upto]
+    return "/".join(f.name for f in frames)
+
+
+def current_path() -> str:
+    """Slash-joined path of the innermost open span ("" outside any span)."""
+    return _path()
+
+
+def current_timeline() -> Optional[Timeline]:
+    return _state.timeline
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "host") -> Iterator[None]:
+    """Record ``name`` as a phase of the current timeline. Opening a span
+    with an empty stack starts a new timeline (this span is the root; closing
+    it emits the timeline to the completion hooks). ``kind="device"`` marks
+    an async-dispatching phase — call :func:`fence` on its output before the
+    block ends, or the phase is flagged unfenced."""
+    if not _enabled:
+        yield
+        return
+    st = _state
+    is_root = not st.stack
+    now = time.perf_counter()
+    if is_root:
+        st.timeline = Timeline(name=name, wall_time=time.time(), t0=now)
+        _run_hooks(_root_start_hooks, st.timeline)
+    frame = _Frame(name, now, kind)
+    st.stack.append(frame)
+    try:
+        yield
+    finally:
+        end = time.perf_counter()
+        tl = st.timeline
+        path = _path()
+        st.stack.pop()
+        if tl is not None:
+            tl.phases.append(Phase(
+                name=name, path=path, duration_sec=end - frame.t0,
+                kind=kind, fenced=frame.fenced,
+                offset_sec=frame.t0 - tl.t0,
+            ))
+            if is_root:
+                tl.duration_sec = end - tl.t0
+                st.timeline = None
+                _run_hooks(_root_complete_hooks, tl)
+
+
+def fence(value: Any) -> Any:
+    """Block until ``value``'s device computation completes (when jax is
+    loaded) and mark the innermost open span device-fenced. Returns
+    ``value`` so dispatch sites stay one-liners:
+    ``out = fence(decide_jit(...))``. Never imports jax: a process that
+    never loaded it has nothing to fence.
+
+    Only non-blockable *inputs* (non-array pytrees: TypeError/ValueError)
+    are tolerated — a runtime DEVICE failure surfacing at the block must
+    propagate exactly as a bare ``block_until_ready`` would, or sites where
+    fence is the only blocking call (the plugin server's decide) would
+    record a bogus success and resurface the error later with a misleading
+    traceback."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            jax.block_until_ready(value)
+        except (TypeError, ValueError):
+            pass
+    if _enabled and _state.stack:
+        _state.stack[-1].fenced = True
+    return value
+
+
+def annotate(**kw: Any) -> None:
+    """Attach key/value metadata to the current timeline (backend name,
+    impl, dirty-group count, refresh-audit outcome, decision digest...).
+    No-op outside a span."""
+    if _enabled and _state.timeline is not None:
+        _state.timeline.meta.update(kw)
+
+
+def add_phase(name: str, duration_sec: float, kind: str = "host",
+              fenced: bool = True) -> None:
+    """Append a pre-measured phase under the current path — for callers that
+    accumulate sub-step timings across a loop (the golden backend) or know a
+    duration from elsewhere. No-op outside a span."""
+    tl = _state.timeline
+    if not _enabled or tl is None:
+        return
+    base = _path()
+    tl.phases.append(Phase(
+        name=name, path=(base + "/" + name) if base else name,
+        duration_sec=float(duration_sec), kind=kind, fenced=fenced,
+    ))
+
+
+def graft(phase_dicts: List[Dict[str, Any]], under: Optional[str] = None) -> None:
+    """Splice remote phases (a plugin server's shipped timeline, in
+    ``Phase.as_dict`` form) into the current timeline, path-prefixed so they
+    nest under the caller's span: the cross-process analog of a child span.
+    ``under`` defaults to the current path. No-op outside a span."""
+    tl = _state.timeline
+    if not _enabled or tl is None:
+        return
+    prefix = _path() if under is None else under
+    for p in phase_dicts:
+        try:
+            path = str(p.get("path") or p.get("name") or "remote")
+            tl.phases.append(Phase(
+                name=str(p.get("name") or path.rsplit("/", 1)[-1]),
+                path=(prefix + "/" + path) if prefix else path,
+                duration_sec=float(p.get("ms", 0.0)) / 1e3,
+                kind=str(p.get("kind", "host")),
+                fenced=bool(p.get("fenced", False)),
+                remote=True,
+            ))
+        except Exception:  # noqa: BLE001 - a malformed remote phase is dropped
+            continue

@@ -628,31 +628,38 @@ class IncrementalDecider:
         with the protocol's exact semantics: when ``ordered`` is False the
         order fields are input-order placeholders and no window may be
         read."""
-        import jax
-
         self._ticks += 1
         if self._refresh_every and self._ticks % self._refresh_every == 0:
             self.refresh()
         now = np.int64(now_sec)
+
+        from escalator_tpu import observability as obs
 
         def dispatch(with_orders):
             if with_orders or self._prev_cols is None:
                 # full decide, fed the persistent aggregates: the O(P)/O(N)
                 # sweeps are skipped; every [G] row recomputes (cheap), so
                 # the persistent columns refresh wholesale
-                out = jax.block_until_ready(_kernel.decide_jit(
-                    self._cache.cluster, now, impl=self._impl,
-                    aggregates=_kernel.aggregates_tuple(self._aggs),
-                    with_orders=with_orders,
-                ))
+                with obs.span(
+                        "decide_ordered" if with_orders else "decide_full",
+                        kind="device"):
+                    # fence blocks (and propagates device failures) — one
+                    # synchronization, not a redundant block_until_ready pair
+                    out = obs.fence(_kernel.decide_jit(
+                        self._cache.cluster, now, impl=self._impl,
+                        aggregates=_kernel.aggregates_tuple(self._aggs),
+                        with_orders=with_orders,
+                    ))
                 self._set_prev(out)
                 return out
             dirty = np.asarray(self._aggs.dirty)
             self.last_dirty_count = int(dirty.sum())
-            idx = _kernel.dirty_indices(dirty)
-            out, self._aggs = _kernel.delta_decide_jit(
-                self._cache.cluster, self._aggs, self._prev_cols, idx, now)
-            out = jax.block_until_ready(out)
+            obs.annotate(dirty_groups=self.last_dirty_count)
+            with obs.span("delta_decide", kind="device"):
+                idx = _kernel.dirty_indices(dirty)
+                out, self._aggs = _kernel.delta_decide_jit(
+                    self._cache.cluster, self._aggs, self._prev_cols, idx, now)
+                out = obs.fence(out)
             self._set_prev(out)
             return out
 
@@ -661,28 +668,43 @@ class IncrementalDecider:
     def refresh(self) -> bool:
         """Re-derive the aggregates from the resident cluster and assert
         bit-equality against the incrementally maintained state (the
-        self-audit). Returns True when the audit passed."""
-        import jax
+        self-audit). Returns True when the audit passed.
+
+        A mismatch — in BOTH modes — increments
+        ``escalator_tpu_incremental_audit_mismatch_total`` (the alertable
+        counter the silent backend-mode "repair+log" lacked) and dumps the
+        flight recorder, so the ticks whose deltas diverged are captured at
+        the moment of detection, not reconstructed from memory."""
+        from escalator_tpu import observability as obs
 
         self.refreshes += 1
-        fresh = jax.block_until_ready(
-            _kernel.compute_aggregates_jit(self._cache.cluster,
-                                           impl=self._impl))
-        mismatched = [
-            f.name for f in fields(_kernel.GroupAggregates)
-            if f.name != "dirty"
-            and not np.array_equal(np.asarray(getattr(self._aggs, f.name)),
-                                   np.asarray(getattr(fresh, f.name)))
-        ]
+        with obs.span("refresh_audit", kind="device"):
+            fresh = obs.fence(
+                _kernel.compute_aggregates_jit(self._cache.cluster,
+                                               impl=self._impl))
+            mismatched = [
+                f.name for f in fields(_kernel.GroupAggregates)
+                if f.name != "dirty"
+                and not np.array_equal(np.asarray(getattr(self._aggs, f.name)),
+                                       np.asarray(getattr(fresh, f.name)))
+            ]
         if not mismatched:
+            obs.annotate(refresh_audit="ok")
             return True
+        from escalator_tpu.metrics import metrics
+
+        metrics.incremental_audit_mismatch.inc()
+        dump_path = obs.dump_on_incident("audit-mismatch")
         msg = (
             "incremental aggregate refresh mismatch on columns "
             f"{mismatched} after {self._ticks} ticks — the maintained "
             "state diverged from a from-scratch recompute"
+            f" (flight record: {dump_path or 'dump failed'})"
         )
         if self._on_mismatch == "raise":
+            obs.annotate(refresh_audit="mismatch-raised")
             raise AggregateParityError(msg)
+        obs.annotate(refresh_audit="mismatch-repaired")
         logging.getLogger("escalator_tpu.device_state").error(
             "%s; repairing: adopting the recompute and marking every group "
             "dirty", msg)

@@ -14,11 +14,13 @@ from typing import Optional
 import grpc
 import msgpack
 
+from escalator_tpu import observability as obs
 from escalator_tpu.controller.backend import (
     ComputeBackend,
     GoldenBackend,
     PackingPostPass,
     PaddedPacker,
+    _decision_digest,
     _unpack,
 )
 from escalator_tpu.plugin import codec
@@ -51,14 +53,34 @@ class ComputeClient:
             request_serializer=lambda x: x,
             response_deserializer=lambda x: x,
         )
+        self._dump = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Dump",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x,
+        )
 
     def health(self) -> dict:
         return msgpack.unpackb(self._health(b"", timeout=self.timeout_sec))
 
+    def dump(self) -> dict:
+        """The server's flight-recorder ring (the debug-dump CLI's source)."""
+        import json
+
+        return json.loads(self._dump(b"", timeout=self.timeout_sec))
+
     def decide_arrays(self, cluster, now_sec: int):
-        frame = codec.encode_cluster(cluster, now_sec)
+        out, _phases = self.decide_arrays_traced(cluster, now_sec)
+        return out
+
+    def decide_arrays_traced(self, cluster, now_sec: int,
+                             span_ctx: Optional[dict] = None):
+        """:meth:`decide_arrays` with span propagation: sends the caller's
+        span context in the cluster frame and returns
+        ``(decision, server_phases)`` — the server's timeline in
+        ``Phase.as_dict`` form (None from a pre-tracing peer)."""
+        frame = codec.encode_cluster(cluster, now_sec, span_ctx=span_ctx)
         resp = self._decide(frame, timeout=self.timeout_sec)
-        return codec.decode_decision(resp)
+        return codec.decode_decision_traced(resp)
 
     def close(self) -> None:
         self._channel.close()
@@ -79,22 +101,46 @@ class GrpcBackend(ComputeBackend):
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None,
                taint_trackers=None):
-        cluster = self._packer.pack(group_inputs, dry_mode_flags, taint_trackers)
-        try:
-            out = self.client.decide_arrays(cluster, now_sec)
-        except grpc.RpcError as e:
-            log.warning(
-                "compute plugin unavailable (%s); falling back to %s backend",
-                e.code() if hasattr(e, "code") else e, self.fallback.name,
-            )
-            return self.fallback.decide(
-                group_inputs, now_sec, dry_mode_flags, taint_trackers
-            )
-        results = _unpack(out, group_inputs)
-        # packing-aware override runs client-side: it needs only the object
-        # inputs already in hand, keeping the wire format untouched. On a
-        # jax-less client it degrades to the pure-Python FFD (same math);
-        # packing_aware groups therefore do NOT offload this step to the
-        # plugin — a deliberate trade against a wire-format revision.
-        self._packing.apply(results, group_inputs, dry_mode_flags, taint_trackers)
-        return results
+        with obs.span(self.name):
+            obs.annotate(backend=self.name, impl="remote")
+            with obs.span("pack"):
+                cluster = self._packer.pack(
+                    group_inputs, dry_mode_flags, taint_trackers)
+            try:
+                with obs.span("rpc", kind="rpc"):
+                    out, server_phases = self.client.decide_arrays_traced(
+                        cluster, now_sec,
+                        span_ctx={"path": obs.current_path()})
+                if server_phases:
+                    # nest the plugin-side phases under this tick's rpc span:
+                    # the flight record then reads e.g.
+                    # grpc/rpc/plugin_decide/decide across the process boundary
+                    obs.graft(server_phases, under=obs.current_path() + "/rpc")
+            except grpc.RpcError as e:
+                log.warning(
+                    "compute plugin unavailable (%s); falling back to %s"
+                    " backend",
+                    e.code() if hasattr(e, "code") else e, self.fallback.name,
+                )
+                results = self.fallback.decide(
+                    group_inputs, now_sec, dry_mode_flags, taint_trackers
+                )
+                # AFTER the fallback ran: its own span re-annotated
+                # backend=<fallback.name>, which would file this tick's
+                # record (and phase series) under the wrong backend — the
+                # operator greps the 'grpc' label for exactly these degraded
+                # ticks. Re-assert the configured identity + the fallback tag.
+                obs.annotate(backend=self.name, fallback=self.fallback.name)
+                return results
+            obs.annotate(digest=_decision_digest(out))
+            with obs.span("unpack"):
+                results = _unpack(out, group_inputs)
+            # packing-aware override runs client-side: it needs only the object
+            # inputs already in hand, keeping the wire format untouched. On a
+            # jax-less client it degrades to the pure-Python FFD (same math);
+            # packing_aware groups therefore do NOT offload this step to the
+            # plugin — a deliberate trade against a wire-format revision.
+            with obs.span("packing_post"):
+                self._packing.apply(
+                    results, group_inputs, dry_mode_flags, taint_trackers)
+            return results

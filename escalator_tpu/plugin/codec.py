@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import struct
 from dataclasses import fields
-from typing import Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
 import numpy as np
@@ -22,6 +22,16 @@ from escalator_tpu.core.arrays import ClusterArrays, GroupArrays, NodeArrays, Po
 
 _MAGIC = b"ESCT"
 _VERSION = 1
+
+#: Span-context / span-timeline sidecars ride in the SAME columnar frame as
+#: msgpack-bytes pseudo-arrays under these names. Both directions are
+#: OPTIONAL and version-tolerant by construction: a decoder that predates
+#: them never looks the names up (section decoding pulls only its dataclass
+#: fields), and a new decoder treats their absence as "peer sent none" —
+#: so tracing interoperates across mixed-version peers without a _VERSION
+#: bump, exactly like the _OPTIONAL_DEFAULTS columns.
+_SPAN_CTX_KEY = "__spanctx__"
+_SPANS_KEY = "__spans__"
 
 #: Fields added to the wire format after v1 frames shipped, with the default a
 #: decoder must assume when a peer's frame predates them. Keyed by frame array
@@ -85,8 +95,20 @@ def _decode_arrays(data: bytes) -> Dict[str, np.ndarray]:
     return out
 
 
-def encode_cluster(cluster: ClusterArrays, now_sec: int) -> bytes:
+def _msgpack_array(obj: Any) -> np.ndarray:
+    """A msgpack document as a uint8 pseudo-array frame entry."""
+    return np.frombuffer(msgpack.packb(obj), np.uint8)
+
+
+def encode_cluster(cluster: ClusterArrays, now_sec: int,
+                   span_ctx: Optional[Dict[str, Any]] = None) -> bytes:
+    """``span_ctx`` (optional) propagates the caller's span context across
+    the process boundary — a small msgpack dict (caller span path, trace
+    id) the server annotates its own tick record with, so a plugin-side
+    flight record names which remote tick asked for it."""
     named = [("__now__", np.array([now_sec], np.int64))]
+    if span_ctx:
+        named.append((_SPAN_CTX_KEY, _msgpack_array(span_ctx)))
     for prefix, section in (
         ("g.", cluster.groups),
         ("p.", cluster.pods),
@@ -121,26 +143,58 @@ def _section(arrays: Dict[str, np.ndarray], prefix: str, cls):
     return cls(**out)
 
 
+def _unpack_sidecar(arrays: Dict[str, np.ndarray], key: str) -> Optional[Any]:
+    raw = arrays.get(key)
+    if raw is None:
+        return None
+    try:
+        return msgpack.unpackb(raw.tobytes())
+    except Exception:  # noqa: BLE001 - a torn sidecar must not fail a decide
+        return None
+
+
 def decode_cluster(data: bytes) -> Tuple[ClusterArrays, int]:
+    cluster, now_sec, _ctx = decode_cluster_ctx(data)
+    return cluster, now_sec
+
+
+def decode_cluster_ctx(
+    data: bytes,
+) -> Tuple[ClusterArrays, int, Optional[Dict[str, Any]]]:
+    """:func:`decode_cluster` plus the caller's span context (None when the
+    peer sent none / predates tracing)."""
     arrays = _decode_arrays(data)
     now_sec = int(arrays.pop("__now__")[0])
+    span_ctx = _unpack_sidecar(arrays, _SPAN_CTX_KEY)
     g = _section(arrays, "g.", GroupArrays)
     p = _section(arrays, "p.", PodArrays)
     n = _section(arrays, "n.", NodeArrays)
-    return ClusterArrays(groups=g, pods=p, nodes=n), now_sec
+    return ClusterArrays(groups=g, pods=p, nodes=n), now_sec, span_ctx
 
 
-def encode_decision(out) -> bytes:
-    """Encode DecisionArrays (device or numpy) to a frame."""
+def encode_decision(out, span_phases: Optional[List[Dict[str, Any]]] = None) -> bytes:
+    """Encode DecisionArrays (device or numpy) to a frame. ``span_phases``
+    (optional, ``spans.Phase.as_dict`` form) ships the server-side timeline
+    back so the caller can graft it under its own tick span."""
     named = [(f.name, np.asarray(getattr(out, f.name))) for f in fields(out)]
+    if span_phases:
+        named.append((_SPANS_KEY, _msgpack_array(span_phases)))
     return _encode_arrays(named)
 
 
 def decode_decision(data: bytes):
     """Decode to a namespace with the DecisionArrays field names as numpy arrays."""
+    out, _phases = decode_decision_traced(data)
+    return out
+
+
+def decode_decision_traced(data: bytes):
+    """:func:`decode_decision` plus the server's span phases (None when the
+    peer sent none / predates tracing)."""
     from escalator_tpu.ops.kernel import DecisionArrays
 
     arrays = _decode_arrays(data)
+    phases = _unpack_sidecar(arrays, _SPANS_KEY)
     return DecisionArrays(**{
         f.name: arrays[f.name] for f in fields(DecisionArrays)
-    })
+    }), phases

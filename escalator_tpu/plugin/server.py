@@ -7,13 +7,23 @@ generically with bytes-level serializers — no protoc codegen, no per-pod messa
 overhead.
 
 Methods:
-- ``Decide``: cluster frame -> decision frame (batched kernel on the server's device)
-- ``Health``: empty -> msgpack {device, backend, version}
+- ``Decide``: cluster frame -> decision frame (batched kernel on the server's
+  device). The frame may carry the caller's span context; the response then
+  carries the server-side span timeline so the caller's flight record nests
+  the remote phases under its own tick.
+- ``Health``: empty -> msgpack {device, backend, version, last_decide_age_sec,
+  flight_recorder_depth, ticks_served} — the age/depth pair lets a remote
+  health check tell a stale-but-alive controller (socket answers, no decide
+  traffic) from a live one.
+- ``Dump``: empty -> JSON bytes of the server's flight-recorder ring (the
+  ``escalator-tpu debug-dump`` CLI's wire target).
 """
 
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from concurrent import futures
 
 import grpc
@@ -21,6 +31,7 @@ import msgpack
 import numpy as np
 
 from escalator_tpu import __version__
+from escalator_tpu import observability as obs
 from escalator_tpu.metrics import metrics
 from escalator_tpu.plugin import codec
 
@@ -40,25 +51,65 @@ class _ComputeService:
         import jax
 
         self._device = str(jax.devices()[0])
+        obs.jaxmon.install()
+        # handlers run on the gRPC worker pool: the served-tick stats are
+        # read-modify-written under this lock so concurrent Decides (two
+        # controllers, or controller + bench) never lose an increment
+        self._stats_lock = threading.Lock()
+        self._last_decide_unix: "float | None" = None
+        self._ticks_served = 0
 
     def decide(self, request: bytes, context) -> bytes:
-        import time
-
-        cluster, now_sec = codec.decode_cluster(request)
         t0 = time.perf_counter()
-        out = self._kernel.decide_jit(cluster, np.int64(now_sec))
-        import jax
-
-        jax.block_until_ready(out)
-        metrics.solver_decide_latency.labels("grpc-server").observe(
-            time.perf_counter() - t0
-        )
-        return codec.encode_decision(out)
+        cluster, now_sec, span_ctx = codec.decode_cluster_ctx(request)
+        t_decode = time.perf_counter() - t0
+        with obs.span("plugin_decide"):
+            obs.annotate(backend="grpc-server", impl="xla")
+            if span_ctx:
+                # name the remote tick that asked, so server-side dumps
+                # correlate with the caller's flight record
+                obs.annotate(caller=span_ctx.get("path"),
+                             trace_id=span_ctx.get("trace_id"))
+            obs.add_phase("decode", t_decode)
+            with obs.span("decide", kind="device"):
+                out = obs.fence(
+                    self._kernel.decide_jit(cluster, np.int64(now_sec)))
+            metrics.solver_decide_latency.labels("grpc-server").observe(
+                time.perf_counter() - t0 - t_decode
+            )
+            # ship the phases measured so far (decode + decide) back to the
+            # caller; the encode phase below cannot serialize itself, so it
+            # lands only in the server-local flight record. None when span
+            # recording is disabled in this process (timeline absent).
+            tl = obs.current_timeline()
+            shipped = [p.as_dict() for p in tl.phases] if tl else None
+            with obs.span("encode"):
+                resp = codec.encode_decision(out, span_phases=shipped)
+            with self._stats_lock:
+                self._last_decide_unix = time.time()
+                self._ticks_served += 1
+            return resp
 
     def health(self, request: bytes, context) -> bytes:
-        return msgpack.packb(
-            {"device": self._device, "version": __version__, "ok": True}
-        )
+        with self._stats_lock:
+            last = self._last_decide_unix
+            ticks = self._ticks_served
+        age = -1.0 if last is None else time.time() - last
+        return msgpack.packb({
+            "device": self._device,
+            "version": __version__,
+            "ok": True,
+            # stale-but-alive detection: a controller whose plugin answers
+            # health but whose decide traffic stopped shows a growing age
+            "last_decide_age_sec": round(age, 3),
+            "ticks_served": ticks,
+            "flight_recorder_depth": obs.RECORDER.depth,
+        })
+
+    def dump(self, request: bytes, context) -> bytes:
+        import json
+
+        return json.dumps(obs.RECORDER.as_dump("plugin-dump")).encode()
 
 
 def _identity(x: bytes) -> bytes:
@@ -86,6 +137,11 @@ def make_server(
         ),
         "Health": grpc.unary_unary_rpc_method_handler(
             service.health,
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "Dump": grpc.unary_unary_rpc_method_handler(
+            service.dump,
             request_deserializer=_identity,
             response_serializer=_identity,
         ),

@@ -85,6 +85,43 @@ def test_fused_tick_tiny(bench):
         assert set(phases) == {"upsert", "drain", "scatter", "decide", "total"}
 
 
+def test_observability_overhead_and_recorder_summary_tiny(bench):
+    """The cfg14 observability-overhead row helper and the recorder phase
+    summarizer at tiny scale: enabled/disabled arms both measured, overhead
+    clamped non-negative, and the summarizer medians the right root."""
+    from escalator_tpu.core.arrays import ClusterArrays
+    from escalator_tpu.native.statestore import NativeStateStore
+    from escalator_tpu.observability import spans
+    from escalator_tpu.ops.device_state import DeviceClusterCache, IncrementalDecider
+
+    rng = np.random.default_rng(8)
+    store = NativeStateStore(pod_capacity=1 << 9, node_capacity=1 << 7)
+    store.upsert_pods_batch([f"p{i}" for i in range(100)],
+                            np.arange(100) % 4,
+                            np.full(100, 500), np.full(100, 10**9))
+    store.upsert_nodes_batch([f"n{i}" for i in range(20)],
+                             np.arange(20) % 4,
+                             np.full(20, 4000), np.full(20, 16 * 10**9))
+    pods_v, nodes_v = store.as_pod_node_arrays()
+    base = bench._rng_cluster_arrays(rng, 4, 1, 1)
+    store.drain_dirty()
+    cache = DeviceClusterCache(
+        ClusterArrays(groups=base.groups, pods=pods_v, nodes=nodes_v))
+    inc = IncrementalDecider(cache, refresh_every=0)
+    inc.decide(np.int64(0), False)
+    row = bench._observability_overhead(
+        store, cache, inc, np.int64(0), 100, 4, 500, iters=3, n_churn=8)
+    assert row["enabled_ms"] > 0 and row["disabled_ms"] > 0
+    assert row["overhead_ms"] >= 0 and row["overhead_pct"] is not None
+    assert spans.enabled()   # the helper must re-enable recording
+    # recorder summary keyed by root name, per-phase medians in ms
+    with spans.span("tiny_root"):
+        inc.decide(np.int64(0), False)
+    summary = bench._recorder_phase_medians("tiny_root")
+    assert summary["_ticks"] >= 1
+    assert "delta_decide" in summary and summary["delta_decide"] >= 0
+
+
 def test_plugin_roundtrip_tiny(bench):
     rng = np.random.default_rng(6)
     host = bench._rng_cluster_arrays(rng, 2, 100, 20)
@@ -162,10 +199,13 @@ def test_partial_flush_and_salvage_summary(bench, tmp_path, monkeypatch):
                    if "file" in r)
 
 
-def test_smoke_mode_parity(bench):
+def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
     """`python bench.py --smoke` (tier-1-safe): the round-6 hot paths — the
     group-block-sharded ordering tail and both blocked-FFD scan programs —
     run at tiny shapes with parity asserted inside run_smoke itself."""
+    # keep the smoke flight dump out of the repo root during tests
+    monkeypatch.setenv("ESCALATOR_TPU_FLIGHT_DUMP",
+                       str(tmp_path / "flight-smoke.json"))
     out = bench.run_smoke()
     assert out["smoke_cfg8_parity"] == "ok"
     assert out["smoke_cfg10_parity"] == "ok"
@@ -176,6 +216,16 @@ def test_smoke_mode_parity(bench):
     # rows bit-exact vs full recompute, both lazy paths) is tier-1-locked
     assert out["smoke_cfg14_parity"] == "ok"
     assert any(c > 0 for c in out["smoke_cfg14_dirty_counts"])
+    # round 9: the flight recorder saw the smoke ticks (run_smoke asserts
+    # the phase names + fencing + overhead bound internally; here we lock
+    # the artifact surface CI uploads)
+    assert out["smoke_flight_recorder_depth"] > 0
+    assert out["smoke_observability_overhead_ms"] < 0.75
+    dump = json.loads((tmp_path / "flight-smoke.json").read_text())
+    assert dump["flight_recorder"] is True and dump["reason"] == "smoke"
+    assert dump["ticks"], "smoke dump carries no tick records"
+    assert any(p["name"] == "delta_decide"
+               for t in dump["ticks"] for p in t["phases"])
 
 
 def test_archived_e2e_filter(bench):

@@ -107,6 +107,122 @@ def test_health(plugin):
     h = plugin.health()
     assert h["ok"] is True
     assert "device" in h and "version" in h
+    # stale-but-alive detection surface: decide-traffic age + recorder depth
+    assert "last_decide_age_sec" in h and "flight_recorder_depth" in h
+    assert "ticks_served" in h
+
+
+def test_health_last_decide_age_tracks_traffic(plugin):
+    rng = random.Random(21)
+    before = plugin.health()
+    cluster = pack_cluster([random_group(rng, 0)],
+                           pad_pods=64, pad_nodes=32, pad_groups=8)
+    plugin.decide_arrays(cluster, NOW)
+    after = plugin.health()
+    assert after["ticks_served"] == before["ticks_served"] + 1
+    # fresh decide -> small age; -1 only before the first decide ever
+    assert 0 <= after["last_decide_age_sec"] < 60
+    assert after["flight_recorder_depth"] >= 1
+
+
+def test_plugin_dump_returns_server_flight_record(plugin):
+    rng = random.Random(22)
+    cluster = pack_cluster([random_group(rng, 1)],
+                           pad_pods=64, pad_nodes=32, pad_groups=8)
+    plugin.decide_arrays(cluster, NOW)
+    doc = plugin.dump()
+    assert doc["flight_recorder"] is True and doc["reason"] == "plugin-dump"
+    assert doc["depth"] >= 1
+    server_ticks = [t for t in doc["ticks"] if t["root"] == "plugin_decide"]
+    assert server_ticks, [t["root"] for t in doc["ticks"]]
+    names = {p["name"] for p in server_ticks[-1]["phases"]}
+    assert {"decode", "decide", "encode"} <= names
+
+
+def test_debug_dump_cli_fetches_plugin_ring(plugin, tmp_path, capsys):
+    """``escalator-tpu debug-dump`` pulls the plugin's flight record over
+    the Dump RPC — to a file, and to stdout with --output -."""
+    from escalator_tpu.cli import main as cli_main
+    import json
+
+    rng = random.Random(24)
+    cluster = pack_cluster([random_group(rng, 2)],
+                           pad_pods=64, pad_nodes=32, pad_groups=8)
+    plugin.decide_arrays(cluster, NOW)
+    out_file = tmp_path / "flight.json"
+    rc = cli_main(["debug-dump", "--plugin-address", plugin.address,
+                   "--output", str(out_file)])
+    assert rc == 0
+    doc = json.loads(out_file.read_text())
+    assert doc["flight_recorder"] is True and doc["depth"] >= 1
+    capsys.readouterr()
+    rc = cli_main(["debug-dump", "--plugin-address", plugin.address,
+                   "--output", "-"])
+    assert rc == 0
+    stdout_doc = json.loads(capsys.readouterr().out)
+    assert stdout_doc["reason"] == "plugin-dump"
+
+
+def test_remote_decide_nests_server_phases_under_caller_tick(plugin):
+    """The cross-boundary contract: a plugin-routed decide grafts the
+    server-side phases under the caller's span context, so ONE flight
+    record reads end-to-end across the process boundary."""
+    from escalator_tpu import observability as obs
+
+    rng = random.Random(23)
+    groups = [random_group(rng, gi) for gi in range(3)]
+    cluster = pack_cluster(groups, pad_pods=256, pad_nodes=128, pad_groups=8)
+    with obs.span("caller_tick"):
+        with obs.span("rpc", kind="rpc"):
+            out, server_phases = plugin.decide_arrays_traced(
+                cluster, NOW, span_ctx={"path": obs.current_path()})
+        obs.graft(server_phases, under="caller_tick/rpc")
+    assert server_phases, "server shipped no span timeline"
+    rec = obs.RECORDER.last()
+    assert rec["root"] == "caller_tick"
+    paths = {p["path"] for p in rec["phases"]}
+    assert "caller_tick/rpc/plugin_decide/decide" in paths, sorted(paths)
+    assert "caller_tick/rpc/plugin_decide/decode" in paths
+    # the server-side record carries the caller's span context (in-process
+    # server here, so the shared RECORDER holds both sides)
+    server_rec = next(r for r in reversed(obs.RECORDER.snapshot())
+                      if r["root"] == "plugin_decide")
+    assert server_rec.get("caller") == "caller_tick/rpc"
+    # decide phase is device-fenced on the server
+    decide = next(p for p in server_rec["phases"] if p["name"] == "decide")
+    assert decide["fenced"] is True
+
+
+def test_controller_over_grpc_records_nested_tick():
+    """A full controller tick over GrpcBackend produces one timeline with
+    controller, client and (grafted) server phases."""
+    from escalator_tpu import observability as obs
+    from tests.test_controller import World, make_opts
+    from escalator_tpu.testsupport.builders import (
+        NodeOpts, PodOpts, build_test_nodes, build_test_pods,
+    )
+
+    server = make_server("127.0.0.1:0")
+    server.start()
+    try:
+        backend = GrpcBackend(f"127.0.0.1:{server._escalator_bound_port}")
+        pods = build_test_pods(10, PodOpts(
+            cpu=[500], mem=[10**9],
+            node_selector_key="customer", node_selector_value="buildeng"))
+        nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+        w = World(make_opts(), nodes=nodes, pods=pods, backend=backend)
+        w.tick()
+        rec = obs.RECORDER.last()
+        assert rec["root"] == "tick" and rec["backend"] == "grpc"
+        paths = {p["path"] for p in rec["phases"]}
+        assert "tick/decide/grpc/rpc/plugin_decide/decide" in paths, sorted(paths)
+        fenced_client = {
+            p["name"] for p in rec["phases"]
+            if p["path"].startswith("tick/decide/grpc/") and p["fenced"]
+        }
+        assert {"pack", "rpc", "unpack", "packing_post"} <= fenced_client
+    finally:
+        server.stop(grace=None)
 
 
 def test_remote_decide_matches_local(plugin):
