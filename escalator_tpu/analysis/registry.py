@@ -563,6 +563,145 @@ def _build_grid_delta_decider() -> TracedEntry:
     return TracedEntry(fn=decider, args=args, jitted=decider)
 
 
+def _order_state_fixture(seed: int = 19):
+    """Concrete round-10 order-state columns: keys + permutation from a real
+    cluster (emptiest groups populated so victim_primary is non-trivial)."""
+    from escalator_tpu.ops import kernel, order_tail
+
+    cluster = representative_cluster(seed=seed)
+    aggs = kernel.compute_aggregates_jit(cluster)
+    cols = (
+        jnp.asarray(cluster.groups.emptiest),
+        jnp.asarray(cluster.nodes.valid),
+        jnp.asarray(cluster.nodes.group),
+        jnp.asarray(cluster.nodes.tainted),
+        jnp.asarray(cluster.nodes.cordoned),
+        jnp.asarray(cluster.nodes.creation_ns),
+        aggs.node_pods_remaining,
+    )
+    major, k1, k2 = order_tail.order_keys_jit(*cols)
+    perm = order_tail.order_sort_jit(major, k1, k2)
+    return order_tail, cols, major, k1, k2, perm
+
+
+def _order_dirty_bucket(n_dirty: int = 3):
+    from escalator_tpu.ops import kernel
+
+    mask = np.zeros(NODES, bool)
+    mask[np.arange(n_dirty) * 7 % NODES] = True
+    return kernel.dirty_indices(mask)
+
+
+def _build_order_repair() -> TracedEntry:
+    from escalator_tpu.ops import order_tail
+
+    _, _, major, k1, k2, perm = _order_state_fixture()
+    args = (np.asarray(perm).copy(), major, k1, k2, major, k1, k2,
+            _order_dirty_bucket())
+    return TracedEntry(fn=order_tail.order_repair_jit, args=args,
+                       jitted=order_tail.order_repair_jit)
+
+
+def _order_update_args(shift: int = 0):
+    from escalator_tpu.ops import order_tail  # noqa: F401 (fixture import)
+
+    _, cols, major, k1, k2, perm = _order_state_fixture(seed=23)
+    offs = np.zeros(GROUPS + 1, np.int32)
+    offs[-1] = shift
+    return (*cols[:3], np.asarray(cols[3]) ^ (np.arange(NODES) % 13 == shift),
+            *cols[4:], np.asarray(major).copy(), np.asarray(k1).copy(),
+            np.asarray(k2).copy(), np.asarray(perm).copy(), offs, 8)
+
+
+def _build_order_update() -> TracedEntry:
+    from escalator_tpu.ops import order_tail
+
+    *traced, bucket = _order_update_args()
+    fn = lambda *a: order_tail.order_update_jit(*a, bucket)  # noqa: E731
+    return TracedEntry(
+        fn=fn, args=tuple(traced), jitted=order_tail.order_update_jit,
+        lower=lambda: order_tail.order_update_jit.lower(*traced, bucket))
+
+
+def _probe_order_update_retraces() -> int:
+    """Two fused order updates in the SAME static bucket (different taint
+    flips -> different dirty lanes): the dirty CONTENTS must not be a cache
+    key — exactly one compile."""
+    from escalator_tpu.ops import order_tail
+
+    before = order_tail.order_update_jit._cache_size()
+    for shift in (0, 1):
+        jax.block_until_ready(
+            order_tail.order_update_jit(*_order_update_args(shift)))
+    return order_tail.order_update_jit._cache_size() - before
+
+
+def _ordered_delta_fixture(seed: int = 31, dirty_rows=(1, 4)):
+    """Delta fixture + a seeded order state over the SAME cluster — the
+    fused ordered-incremental tick's full persistent-state surface."""
+    from escalator_tpu.ops import order_tail
+
+    cluster, aggs, prev, idx = _delta_fixture(seed=seed,
+                                              dirty_rows=dirty_rows)
+    major, k1, k2 = order_tail.order_keys_jit(
+        jnp.asarray(cluster.groups.emptiest),
+        jnp.asarray(cluster.nodes.valid), jnp.asarray(cluster.nodes.group),
+        jnp.asarray(cluster.nodes.tainted),
+        jnp.asarray(cluster.nodes.cordoned),
+        jnp.asarray(cluster.nodes.creation_ns), aggs.node_pods_remaining)
+    perm = order_tail.order_sort_jit(major, k1, k2)
+    # device-resident COPIES (as production: the state lives on device and
+    # is donated every tick — np inputs here would both alias the jit
+    # outputs and flip the cache key's committed-ness, a spurious retrace)
+    return (cluster, aggs, prev, idx,
+            *(jnp.asarray(np.asarray(a).copy())
+              for a in (major, k1, k2, perm)))
+
+
+def _build_ordered_delta_decide() -> TracedEntry:
+    from escalator_tpu.ops import kernel
+
+    cluster, aggs, prev, idx, major, k1, k2, perm = _ordered_delta_fixture()
+    args = (cluster, aggs, prev, idx, NOW, major, k1, k2, perm)
+    fn = lambda c, a, p, i, t, m, x, y, q: (  # noqa: E731
+        kernel._ordered_delta_decide_raw(c, a, p, i, t, m, x, y, q, 8))
+    return TracedEntry(
+        fn=fn, args=args, jitted=kernel._ordered_delta_decide_raw,
+        lower=lambda: kernel._ordered_delta_decide_raw.lower(*args, 8))
+
+
+def _probe_ordered_delta_retraces() -> int:
+    """Two fused ordered ticks in the SAME statics (dirty bucket, order
+    bucket) with different dirty rows: neither the dirty-row contents nor
+    the order-state values may be a cache key — exactly one compile."""
+    import jax
+
+    from escalator_tpu.ops import kernel
+
+    cluster, aggs, prev, idx, major, k1, k2, perm = _ordered_delta_fixture(
+        seed=43, dirty_rows=(1, 2))
+    before = kernel._ordered_delta_decide_raw._cache_size()
+    for rows in ((1, 2), (3, 5)):
+        mask = np.zeros(GROUPS, bool)
+        mask[list(rows)] = True
+        out, aggs, ostate = kernel._ordered_delta_decide_raw(
+            cluster, aggs, prev, kernel.dirty_indices(mask), NOW,
+            major, k1, k2, perm, 8)
+        jax.block_until_ready(out)
+        major, k1, k2, perm = ostate[:4]
+        prev = tuple(getattr(out, f) for f in kernel.GROUP_DECISION_FIELDS)
+    return kernel._ordered_delta_decide_raw._cache_size() - before
+
+
+def _build_audit_snapshot() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds, kernel
+
+    cluster = representative_cluster(seed=27)
+    aggs = kernel.compute_aggregates_jit(cluster)
+    return TracedEntry(fn=ds._audit_snapshot, args=(cluster, aggs),
+                       jitted=ds._audit_snapshot)
+
+
 def _build_simulate_sweep() -> TracedEntry:
     from escalator_tpu.ops import simulate
 
@@ -849,6 +988,54 @@ def default_registry() -> List[KernelEntry]:
             output_select=lambda out: out[0],
             collective_budget=0,   # per-block math, dirty masks per shard
             donate_expected=True,
+        ),
+        e(
+            name="order_tail.order_repair",
+            module="escalator_tpu.ops.order_tail",
+            kind="jit",
+            build=_build_order_repair,
+            global_axes={"nodes": NODES},
+            output_dtypes={"out": "int32"},  # a single leaf: the permutation
+            collective_budget=0,   # rank merge: searches + gathers, no psum
+            donate_expected=True,  # the replaced permutation
+        ),
+        e(
+            name="order_tail.order_update",
+            module="escalator_tpu.ops.order_tail",
+            kind="jit",
+            build=_build_order_update,
+            global_axes={"nodes": NODES},
+            output_dtypes={"0": "int64", "1": "int64", "2": "int64",
+                           "3": "int32", "4": "int32", "5": "int32"},
+            collective_budget=0,   # keys + diff + compaction + merge + roll
+            donate_expected=True,  # old key columns + replaced permutation
+            retrace_budget=1,      # dirty-lane CONTENTS are not a cache key
+            retrace_probe=_probe_order_update_retraces,
+        ),
+        e(
+            name="kernel.ordered_delta_decide",
+            module="escalator_tpu.ops.kernel",
+            kind="jit",
+            build=_build_ordered_delta_decide,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            output_select=lambda out: out[0],
+            collective_budget=0,   # delta math + rank merge: zero psums
+            donate_expected=True,  # aggs + decision columns + order state
+            retrace_budget=1,      # dirty/order CONTENTS are not cache keys
+            retrace_probe=_probe_ordered_delta_retraces,
+        ),
+        e(
+            name="device_state.audit_snapshot",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_audit_snapshot,
+            output_dtypes=AGGREGATE_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,
+            # donation deliberately ABSENT (donate_expected=False): aliasing
+            # an input here would let a later tick's scatter corrupt the
+            # frozen double buffer the background audit reads
         ),
         e(
             name="simulate.sweep_deltas",

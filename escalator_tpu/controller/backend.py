@@ -205,8 +205,28 @@ class PaddedPacker:
         )
 
 
+def _annotate_overlap(dispatch_end: float, sync_start: float,
+                      sync_wait_sec: float, pre_synced: bool = False) -> None:
+    """Timeline annotations for an OVERLAPPED decide (round 10): the host
+    work executed between the unfenced dispatch returning and the first
+    blocking device read, plus the residual sync wait. ``overlap_saved_ms``
+    is the latency a fenced tick would have added back — exactly the host
+    window when the device was still busy at the sync (wait > 0); an upper
+    bound when the device finished first inside the window. ``pre_synced``
+    means the decide path itself already synchronized before returning
+    (e.g. the ordered-incremental repair's changed-lane-count readback), so
+    the device was idle for the whole window and nothing was saved."""
+    host_ms = max(0.0, (sync_start - dispatch_end) * 1e3)
+    obs.annotate(
+        overlap_host_ms=round(host_ms, 3),
+        overlap_sync_wait_ms=round(sync_wait_sec * 1e3, 3),
+        overlap_saved_ms=0.0 if pre_synced else round(host_ms, 3),
+    )
+
+
 def _unpack(out, group_inputs, ordered: bool = True,
-            node_masks=None) -> List[GroupDecision]:
+            node_masks=None, dispatch_end=None,
+            pre_synced: bool = False) -> List[GroupDecision]:
     """Shared kernel-output -> GroupDecision conversion for array backends.
 
     ordered=False means the decide ran the lazy-orders light program
@@ -221,8 +241,26 @@ def _unpack(out, group_inputs, ordered: bool = True,
     there logged a spurious "expected new nodes: N actual: 0" after every
     scale-up (ADVICE r5). Without masks they stay empty (legacy callers).
     reap_nodes and node_pods_remaining come from flat (non-order) outputs
-    and stay exact either way."""
-    status = np.asarray(out.status)
+    and stay exact either way.
+
+    ``dispatch_end`` marks an OVERLAPPED tick (the decide was dispatched
+    unfenced at that perf_counter time): the device-independent host
+    assembly below — the flat node-object list — runs FIRST, while the
+    device program may still be in flight, and the first ``np.asarray``
+    read then absorbs whatever tail remains (measured and annotated)."""
+    # flat node index -> object, in pack order: pure host work, independent
+    # of the decide output — ordered before the first device read so an
+    # overlapped tick hides it under the in-flight device program
+    flat_nodes: List[k8s.Node] = []
+    for _, nodes, _, _ in group_inputs:
+        flat_nodes.extend(nodes)
+
+    sync_start = time.perf_counter()
+    status = np.asarray(out.status)       # first device read: blocks here
+    if dispatch_end is not None:
+        _annotate_overlap(dispatch_end, sync_start,
+                          time.perf_counter() - sync_start,
+                          pre_synced=pre_synced)
     delta = np.asarray(out.nodes_delta)
     cpu_pct = np.asarray(out.cpu_percent)
     mem_pct = np.asarray(out.mem_percent)
@@ -252,11 +290,6 @@ def _unpack(out, group_inputs, ordered: bool = True,
         tainted_mask = nvalid & ntainted & ~ncordoned
     reap = np.asarray(out.reap_mask)
     remaining = np.asarray(out.node_pods_remaining)
-
-    # flat node index -> object, in pack order
-    flat_nodes: List[k8s.Node] = []
-    for _, nodes, _, _ in group_inputs:
-        flat_nodes.extend(nodes)
 
     results: List[GroupDecision] = []
     for gi, (_pods, _nodes, _config, _state) in enumerate(group_inputs):
@@ -461,17 +494,35 @@ class PackingPostPass:
         metrics.solver_packing_latency.observe(time.perf_counter() - t0)
 
 
-def _lazy_decide(nodes, dispatch):
+def _overlap_default() -> bool:
+    """Host/device overlap default (round 10): on unless
+    ESCALATOR_TPU_TICK_OVERLAP disables it. Overlap changes NO decision —
+    only where the tick blocks: an ordered decide's dispatch returns
+    unfenced and the unpack's first device read absorbs the tail, so the
+    host-side result prep runs while the device still sorts."""
+    import os
+
+    return os.environ.get("ESCALATOR_TPU_TICK_OVERLAP", "1").lower() in (
+        "1", "true", "yes")
+
+
+def _lazy_decide(nodes, dispatch, overlap: bool = False):
     """The lazy-orders gate shared by every array backend
     (kernel.lazy_orders_decide): ``nodes`` is the packed/stacked host-side
     node section carrying the dry-mode taint view — the decided snapshot —
-    and ``dispatch(with_orders) -> DecisionArrays`` runs one blocking decide
-    on whichever program variant the caller owns. Returns ``(out, ordered)``
+    and ``dispatch(with_orders) -> DecisionArrays`` runs one decide on
+    whichever program variant the caller owns. Returns ``(out, ordered)``
     for :func:`_unpack`. One implementation so the gate condition can never
     drift between backends — and the shared span site, so every array
     backend's flight record names its decide variant the same way
     (``decide_ordered`` = the program with the node-ordering tail,
-    ``decide_light`` = the lazy steady-state program)."""
+    ``decide_light`` = the lazy steady-state program).
+
+    ``overlap=True`` leaves ORDERED dispatches unfenced (phase recorded
+    ``fenced=False`` — dispatch time only): no gate read follows them, so
+    the caller's unpack can overlap its host assembly with the in-flight
+    device program. The light dispatch stays fenced — the protocol's
+    nodes_delta gate synchronizes on the program immediately anyway."""
     from escalator_tpu.ops.kernel import lazy_orders_decide
 
     tainted_any = bool(
@@ -480,7 +531,10 @@ def _lazy_decide(nodes, dispatch):
     def instrumented(w):
         with obs.span("decide_ordered" if w else "decide_light",
                       kind="device"):
-            return obs.fence(dispatch(w))
+            out = dispatch(w)
+            if not (overlap and w):
+                out = obs.fence(out)
+            return out
 
     return lazy_orders_decide(instrumented, tainted_any)
 
@@ -491,18 +545,18 @@ class JaxBackend(ComputeBackend):
 
     name = "jax"
 
-    def __init__(self, impl: Optional[str] = None):
+    def __init__(self, impl: Optional[str] = None,
+                 overlap: Optional[bool] = None):
         from escalator_tpu.ops import kernel  # defers jax import
 
         self._kernel = kernel
         self._packer = PaddedPacker()
         self._impl = impl if impl is not None else _kernel_impl()
         self._packing = PackingPostPass()
+        self._overlap = overlap if overlap is not None else _overlap_default()
         obs.jaxmon.install()
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
-        import jax
-
         with obs.span(self.name):
             obs.annotate(backend=self.name, impl=self._impl)
             t0 = time.perf_counter()
@@ -515,18 +569,25 @@ class JaxBackend(ComputeBackend):
             with obs.span("decide", kind="device"):
                 out, ordered = _lazy_decide(
                     cluster.nodes,
-                    lambda w: jax.block_until_ready(self._kernel.decide_jit(
+                    lambda w: self._kernel.decide_jit(
                         cluster, np.int64(now_sec), impl=self._impl,
-                        with_orders=w)),
+                        with_orders=w),
+                    overlap=self._overlap,
                 )
-                obs.fence(out)
+                if not (self._overlap and ordered):
+                    obs.fence(out)
             t2 = time.perf_counter()
             metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
             metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-            obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+            obs.annotate(ordered=bool(ordered))
             with obs.span("unpack"):
-                results = _unpack(out, group_inputs, ordered=ordered,
-                                  node_masks=cluster.nodes)
+                results = _unpack(
+                    out, group_inputs, ordered=ordered,
+                    node_masks=cluster.nodes,
+                    dispatch_end=t2 if self._overlap and ordered else None)
+            # digest reads force a device sync, so on an overlapped tick it
+            # runs after unpack's first read (arrays are host-ready by then)
+            obs.annotate(digest=_decision_digest(out))
             with obs.span("packing_post"):
                 self._packing.apply(
                     results, group_inputs, dry_mode_flags, taint_trackers)
@@ -572,7 +633,8 @@ class IncrementalJaxBackend(ComputeBackend):
     name = "incremental-jax"
 
     def __init__(self, impl: Optional[str] = None,
-                 refresh_every: Optional[int] = None):
+                 refresh_every: "Optional[int | str]" = None,
+                 overlap: Optional[bool] = None):
         from escalator_tpu.ops import kernel  # defers jax import
 
         self._kernel = kernel
@@ -580,6 +642,7 @@ class IncrementalJaxBackend(ComputeBackend):
         self._impl = impl if impl is not None else _kernel_impl()
         self._packing = PackingPostPass()
         self._refresh_every = refresh_every
+        self._overlap = overlap if overlap is not None else _overlap_default()
         self._cache = None
         self._inc = None
         self._host_prev = None   # (PodArrays, NodeArrays) of the last pack
@@ -620,7 +683,8 @@ class IncrementalJaxBackend(ComputeBackend):
                 self._cache = DeviceClusterCache(cluster)
                 self._inc = IncrementalDecider(
                     self._cache, impl=self._impl,
-                    refresh_every=self._refresh_every, on_mismatch="repair")
+                    refresh_every=self._refresh_every, on_mismatch="repair",
+                    overlap=self._overlap)
                 obs.fence(self._cache.cluster)
         else:
             with obs.span("host_diff"):
@@ -643,14 +707,18 @@ class IncrementalJaxBackend(ComputeBackend):
              & np.asarray(cluster.nodes.tainted)).any())
         with obs.span("decide", kind="device"):
             out, ordered = self._inc.decide(now_sec, tainted_any)
-            obs.fence(out)
+            if not (self._overlap and ordered):
+                obs.fence(out)
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+        obs.annotate(ordered=bool(ordered))
         with obs.span("unpack"):
-            results = _unpack(out, group_inputs, ordered=ordered,
-                              node_masks=cluster.nodes)
+            results = _unpack(
+                out, group_inputs, ordered=ordered, node_masks=cluster.nodes,
+                dispatch_end=t2 if self._overlap and ordered else None,
+                pre_synced=self._inc.last_decide_synced)
+        obs.annotate(digest=_decision_digest(out))
         with obs.span("packing_post"):
             self._packing.apply(
                 results, group_inputs, dry_mode_flags, taint_trackers)

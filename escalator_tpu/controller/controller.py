@@ -217,6 +217,16 @@ class Controller:
                 dry_mode_flags=[self._dry_mode(st) for (_, st, _, _) in batch],
                 taint_trackers=[st.taint_tracker for (_, st, _, _) in batch],
             )
+        # host/device overlap (round 10): an overlapped backend annotated the
+        # timeline with the host work it hid under the in-flight decide; the
+        # estimate lands root-level on the tick record (flight recorder) and
+        # here on the per-backend Prometheus histogram
+        tl = obs.current_timeline()
+        saved_ms = (tl.meta.get("overlap_saved_ms")
+                    if tl is not None else None)
+        if saved_ms is not None:
+            metrics.tick_overlap_saved.labels(self.backend.name).observe(
+                float(saved_ms) / 1e3)
 
         # Phase 3: per-group side effects.
         with obs.span("act"):

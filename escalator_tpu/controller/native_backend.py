@@ -55,7 +55,8 @@ class NativeJaxBackend(ComputeBackend):
     def __init__(self, client: EventfulClient, groups: Sequence[GroupFilters],
                  pod_capacity: int = 1 << 17, node_capacity: int = 1 << 15,
                  incremental: "bool | None" = None,
-                 refresh_every: "int | None" = None):
+                 refresh_every: "int | str | None" = None,
+                 overlap: "bool | None" = None):
         import os
 
         from escalator_tpu.native.statestore import NativeStateStore
@@ -85,6 +86,14 @@ class NativeJaxBackend(ComputeBackend):
             ).lower() in ("1", "true", "yes")
         self._incremental = bool(incremental)
         self._refresh_every = refresh_every
+        # host/device overlap (round 10): incremental ordered ticks return
+        # unfenced and the unpack's first device read absorbs the tail. The
+        # legacy (non-incremental) path keeps its fences — its Pallas
+        # resilience machinery NEEDS the block inside _decide_resilient so a
+        # device failure surfaces where the fallback can catch it.
+        from escalator_tpu.controller.backend import _overlap_default
+
+        self._overlap = overlap if overlap is not None else _overlap_default()
         self._inc = None
         # node slots whose device lanes were overridden by last tick's dry-mode
         # view — they must be re-scattered (possibly back to raw) this tick
@@ -254,7 +263,8 @@ class NativeJaxBackend(ComputeBackend):
                     # mismatch: repair (recompute + full dirty) and log loudly
                     self._inc = IncrementalDecider(
                         self._cache, impl="xla",
-                        refresh_every=self._refresh_every, on_mismatch="repair")
+                        refresh_every=self._refresh_every,
+                        on_mismatch="repair", overlap=self._overlap)
                 obs.fence(self._cache.cluster)
             elif self._inc is not None:
                 # incremental: same scatter batch, but the device program also
@@ -277,19 +287,24 @@ class NativeJaxBackend(ComputeBackend):
         self._overridden_slots = overridden
         t1 = time.perf_counter()
         if self._inc is not None:
-            # incremental dispatch pair (delta_decide light / aggregate-fed
+            # incremental dispatch pair (delta_decide light / incremental
             # ordered) with the same lazy-orders gate semantics
             with obs.span("decide", kind="device"):
                 out, ordered = self._inc.decide(now_sec, tainted_any)
-                obs.fence(out)
+                if not (self._overlap and ordered):
+                    obs.fence(out)
             t2 = time.perf_counter()
             metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
             metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-            obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+            obs.annotate(ordered=bool(ordered))
             with obs.span("unpack"):
-                results = self._unpack(out, group_inputs, unpack_group,
-                                       unpack_cordoned, ordered=ordered,
-                                       untainted_mask=unpack_untainted)
+                results = self._unpack(
+                    out, group_inputs, unpack_group,
+                    unpack_cordoned, ordered=ordered,
+                    untainted_mask=unpack_untainted,
+                    dispatch_end=t2 if self._overlap and ordered else None,
+                    pre_synced=self._inc.last_decide_synced)
+            obs.annotate(digest=_decision_digest(out))
             with obs.span("packing_post"):
                 if packing_rows:
                     sel = set(PackingPostPass.select(results, group_inputs))
@@ -453,6 +468,8 @@ class NativeJaxBackend(ComputeBackend):
                 cordoned_mask: np.ndarray,
                 ordered: bool = True,
                 untainted_mask: "np.ndarray | None" = None,
+                dispatch_end: "float | None" = None,
+                pre_synced: bool = False,
                 ) -> List[GroupDecision]:
         """Slot-order-agnostic unpack: node indices resolve through the bridge.
 
@@ -467,8 +484,28 @@ class NativeJaxBackend(ComputeBackend):
         (controller.py:348), and leaving them empty logged a spurious
         "expected new nodes: N actual: 0" after every scale-up (ADVICE r5).
         untaint_order stays empty — the light gate guarantees no tainted
-        node exists in the decided snapshot."""
-        status = np.asarray(out.status)
+        node exists in the decided snapshot.
+
+        ``dispatch_end`` marks an overlapped tick (round 10): the decide
+        came back unfenced at that time. The host-only prep below — slot
+        scans over the LOCKED COPIES captured at decide time, no device
+        data, no lock — runs first, hidden under the in-flight device
+        program; the first device read then absorbs whatever tail remains
+        (measured + annotated)."""
+        from escalator_tpu.controller.backend import _annotate_overlap
+
+        cordoned_slots = np.nonzero(cordoned_mask)[0]
+        membership_slots = (
+            np.nonzero(untainted_mask)[0]
+            if not ordered and untainted_mask is not None else ()
+        )
+
+        sync_start = time.perf_counter()
+        status = np.asarray(out.status)        # first device read: blocks
+        if dispatch_end is not None:
+            _annotate_overlap(dispatch_end, sync_start,
+                              time.perf_counter() - sync_start,
+                              pre_synced=pre_synced)
         delta = np.asarray(out.nodes_delta)
         cpu_pct = np.asarray(out.cpu_percent)
         mem_pct = np.asarray(out.mem_percent)
@@ -503,18 +540,16 @@ class NativeJaxBackend(ComputeBackend):
                 reap_by_group.setdefault(int(node_group[slot]), []).append(
                     node_at(int(slot))
                 )
-            cordoned_slots = np.nonzero(cordoned_mask)[0]
             cordoned_by_group: Dict[int, list] = {}
             for slot in cordoned_slots:
                 cordoned_by_group.setdefault(int(node_group[slot]), []).append(
                     node_at(int(slot))
                 )
             membership_by_group: Dict[int, list] = {}
-            if not ordered and untainted_mask is not None:
-                for slot in np.nonzero(untainted_mask)[0]:
-                    membership_by_group.setdefault(
-                        int(node_group[slot]), []
-                    ).append((int(slot), node_at(int(slot))))
+            for slot in membership_slots:
+                membership_by_group.setdefault(
+                    int(node_group[slot]), []
+                ).append((int(slot), node_at(int(slot))))
 
             results = []
             for gi, (_pods, _nodes, _config, _state) in enumerate(group_inputs):

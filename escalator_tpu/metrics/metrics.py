@@ -176,6 +176,15 @@ tick_phase_latency = Histogram(
     buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
              0.05, 0.1, 0.25, 0.5, 1.0),
 )
+tick_overlap_saved = Histogram(
+    "tick_overlap_saved_seconds",
+    "host work hidden under an in-flight (overlapped, unfenced) decide "
+    "dispatch per tick — the latency a fully-fenced tick would have added "
+    "back; an upper bound when the device finished inside the host window",
+    ["backend"], namespace="escalator_tpu", registry=registry,
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25),
+)
 incremental_audit_mismatch = Counter(
     "incremental_audit_mismatch_total",
     "refresh audits where the maintained incremental aggregates diverged "

@@ -40,7 +40,9 @@ DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_FLIGHT_RECORDER_SIZE",
 
 #: timeline meta keys lifted verbatim into the tick record when present
 _META_KEYS = ("backend", "impl", "ordered", "digest", "dirty_groups",
-              "refresh_audit", "caller", "trace_id", "fallback")
+              "refresh_audit", "caller", "trace_id", "fallback",
+              "order_path", "order_dirty_lanes",
+              "overlap_host_ms", "overlap_sync_wait_ms", "overlap_saved_ms")
 
 #: stash key for the tick-open jaxmon snapshot (private to this module)
 _MON0 = "_jaxmon_t0"
@@ -189,16 +191,20 @@ _incident_seq = 0
 
 def dump_on_incident(reason: str) -> Optional[str]:
     """Best-effort incident dump (wedge watchdog, audit mismatch): write
-    the ring to ``ESCALATOR_TPU_FLIGHT_DUMP_DIR`` (default cwd) under a
-    reason+pid+timestamp+seq name (seq disambiguates incidents landing in
-    the same second — two same-named dumps would silently overwrite), bump
-    the dump counter, and NEVER raise — an observability failure must not
-    compound the incident. Returns the path, or None when the write
-    failed."""
+    the ring to ``ESCALATOR_TPU_DUMP_DIR`` (falling back to the legacy
+    ``ESCALATOR_TPU_FLIGHT_DUMP_DIR`` spelling, default cwd for compat)
+    under a reason+pid+timestamp+seq name (seq disambiguates incidents
+    landing in the same second — two same-named dumps would silently
+    overwrite), bump the dump counter, and NEVER raise — an observability
+    failure must not compound the incident. Returns the path, or None when
+    the write failed. bench.py and the test suite point the env at a
+    tmpdir so local runs stop littering the tree with
+    ``escalator-tpu-flight-*.json`` debris."""
     global _incident_seq
     try:
         _incident_seq += 1
-        out_dir = os.environ.get("ESCALATOR_TPU_FLIGHT_DUMP_DIR", ".")
+        out_dir = (os.environ.get("ESCALATOR_TPU_DUMP_DIR")
+                   or os.environ.get("ESCALATOR_TPU_FLIGHT_DUMP_DIR", "."))
         path = os.path.join(
             out_dir,
             f"escalator-tpu-flight-{reason}-{os.getpid()}-"

@@ -555,6 +555,58 @@ class AggregateParityError(AssertionError):
     incremental scatter path)."""
 
 
+_DEFAULT_REFRESH_EVERY = 256
+
+
+def parse_refresh_every(value, source: str = "refresh_every") -> int:
+    """Validate a refresh-audit cadence: a positive integer, or ``"off"``
+    to disable the audit explicitly. Rejects 0 / negative / non-integer
+    values with a clear error — the old ``int(env)`` accepted ``"0"`` as a
+    silent disable and crashed opaquely on anything else. Returns the
+    cadence in ticks (0 = disabled, only ever via ``"off"``)."""
+    bad = ValueError(
+        f"{source} must be a positive integer number of ticks or 'off' "
+        f"(disable the audit), got {value!r}"
+    )
+    if isinstance(value, str):
+        if value.strip().lower() == "off":
+            return 0
+        try:
+            parsed = int(value.strip())
+        except ValueError:
+            raise bad from None
+    elif isinstance(value, bool) or not isinstance(value, int):
+        raise bad
+    else:
+        parsed = value
+    if parsed <= 0:
+        raise bad
+    return parsed
+
+
+def _fresh_buffer(x):
+    """An op XLA cannot alias back into the input buffer (no donation is
+    declared): the double-buffer snapshot's per-leaf copy."""
+    if x.dtype == jnp.bool_:
+        return x ^ False
+    return x + jnp.zeros((), x.dtype)
+
+
+@jax.jit
+def _audit_snapshot(cluster: ClusterArrays, aggs):
+    """Freeze the audit's inputs into a double buffer: one device program of
+    pure on-device copies (no host sync, no donation — the live buffers stay
+    valid and keep mutating under subsequent ticks while the background
+    audit reads the frozen snapshot). Registered with jaxlint as
+    ``device_state.audit_snapshot``: zero collectives, zero host callbacks,
+    donation explicitly ABSENT (aliasing an input here would let a later
+    tick's scatter corrupt the frozen state)."""
+    return (
+        tree_util.tree_map(_fresh_buffer, cluster),
+        tree_util.tree_map(_fresh_buffer, aggs),
+    )
+
+
 class IncrementalDecider:
     """Owns the persistent incremental-decide state for one
     :class:`DeviceClusterCache`: the :class:`kernel.GroupAggregates`
@@ -573,13 +625,44 @@ class IncrementalDecider:
     fed the persistent aggregates (so even drain ticks skip the O(cluster)
     aggregation; the ordering tail already runs only there).
 
-    ``refresh_every`` (default env ESCALATOR_TPU_REFRESH_EVERY, else 256)
-    periodically re-derives the aggregates from scratch and asserts
-    BIT-equality against the maintained state, so correctness is
-    self-auditing in production; ``on_mismatch`` is "raise"
+    ``refresh_every`` (default env ESCALATOR_TPU_REFRESH_EVERY, else 256;
+    ``"off"`` disables — 0/negative/non-int are rejected, see
+    :func:`parse_refresh_every`) periodically re-derives the aggregates from
+    scratch and asserts BIT-equality against the maintained state, so
+    correctness is self-auditing in production; ``on_mismatch`` is "raise"
     (:class:`AggregateParityError`) or "repair" (log an error, adopt the
     recomputed truth, mark every group dirty). The audit is O(cluster) —
     same cost as one pre-round-8 decide — amortized over the cadence.
+
+    **Background audit** (round 10, default on; ``background=False`` or env
+    ESCALATOR_TPU_REFRESH_BACKGROUND=0 restores the synchronous form): the
+    audit tick no longer blocks on the O(cluster) recompute — nor on the
+    double-buffer snapshot copy. The audit tick hands the live refs to a
+    worker thread, which freezes them into a double buffer (one on-device
+    copy program — ``_audit_snapshot``) and runs the recompute +
+    bit-compare against the FROZEN snapshot, while subsequent ticks keep
+    mutating the live buffers; the only tick-thread coupling left is a
+    donation gate (the next scatter/delta dispatch waits until the
+    snapshot has materialized — normally already true by then). The
+    verdict is reconciled at the next tick boundary (or :meth:`drain_audit`)
+    with the synchronous semantics preserved exactly: same mismatch counter,
+    same flight-recorder dump, same raise/repair behavior — "raise" simply
+    surfaces one tick later, and "repair" re-derives from the CURRENT
+    resident cluster (the snapshot's truth is already stale by then). The
+    verdict itself is equivalent to the synchronous audit's at the same
+    tick: the snapshot freezes exactly the inputs the blocking audit would
+    have read (locked by the lockstep soak in tests/test_incremental_decide).
+
+    **Incremental ordered ticks** (round 10, default on;
+    ``incremental_orders=False`` opts out): an ordered dispatch no longer
+    pays the full [N] node sort. Group columns come from the same
+    ``delta_decide`` program the light tick runs, and the ordering
+    permutation from persistent per-lane order state (ops.order_tail:
+    resident sort-key columns + the last full-sort permutation, repaired by
+    a dirty-lane rank merge — O(dirty · log N + N · log dirty), bit-exact
+    vs the full sort). Above ``order_repair_max_dirty_frac`` dirty lanes the
+    repair would approach the sort's cost for nothing, so the tick falls
+    back to the full key sort (which also reseeds the state).
 
     The aggregate sweeps pin ``impl="xla"``-style scatter adds regardless of
     the construction ``impl`` only at delta scale; the bootstrap/refresh
@@ -587,24 +670,55 @@ class IncrementalDecider:
     where it exists — the O(cluster) recompute)."""
 
     def __init__(self, cache: DeviceClusterCache, impl: str = "xla",
-                 refresh_every: Optional[int] = None,
-                 on_mismatch: str = "raise"):
+                 refresh_every: "Optional[int | str]" = None,
+                 on_mismatch: str = "raise",
+                 background: Optional[bool] = None,
+                 incremental_orders: bool = True,
+                 order_repair_max_dirty_frac: float = 0.25,
+                 overlap: bool = False):
         import os
 
         if on_mismatch not in ("raise", "repair"):
             raise ValueError(f"unknown on_mismatch {on_mismatch!r}")
         if refresh_every is None:
-            refresh_every = int(os.environ.get(
-                "ESCALATOR_TPU_REFRESH_EVERY", "256"))
+            env = os.environ.get("ESCALATOR_TPU_REFRESH_EVERY")
+            refresh_every = (
+                parse_refresh_every(env, "ESCALATOR_TPU_REFRESH_EVERY")
+                if env is not None else _DEFAULT_REFRESH_EVERY)
+        elif refresh_every != 0:
+            # 0 stays the legacy programmatic disable; "off" is the
+            # documented spelling (and the only one the env accepts)
+            refresh_every = parse_refresh_every(refresh_every)
+        if background is None:
+            background = os.environ.get(
+                "ESCALATOR_TPU_REFRESH_BACKGROUND", "1"
+            ).lower() in ("1", "true", "yes")
         self._cache = cache
         self._impl = impl
         self._refresh_every = int(refresh_every)
         self._on_mismatch = on_mismatch
+        self._background = bool(background)
+        self._incremental_orders = bool(incremental_orders)
+        self._order_repair_max_dirty_frac = float(order_repair_max_dirty_frac)
+        self._overlap = bool(overlap)
         self._aggs = _kernel.compute_aggregates_jit(cache.cluster, impl=impl)
         self._prev_cols = None   # tuple in kernel.GROUP_DECISION_FIELDS order
+        self._order_state = None  # (major, k1, k2, perm) — ops.order_tail
+        #: order_update_jit's static compaction width: power-of-two growth
+        #: on overflow (same recompile-bounding scheme as the delta buckets)
+        self._order_bucket = 256
+        self._audit_pool = None
+        self._audit_future = None
+        self._snap_ready = None   # Event: in-flight audit's snapshot frozen
         self._ticks = 0
+        self._dirty_counted_tick = -1
         self.last_dirty_count = 0
+        self.last_order_dirty_count = 0
+        self.last_decide_synced = False
         self.refreshes = 0
+        self.last_audit_ok = True
+        #: ordered-tick path counts: bootstrap / repair / clean / full_sort
+        self.order_stats: dict = {}
 
     @property
     def aggregates(self):
@@ -614,6 +728,7 @@ class IncrementalDecider:
         """Scatter a ``cache.gather_deltas`` batch into the resident arrays
         while maintaining the aggregates + dirty mask. Replaces the plain
         ``cache.apply_gathered`` in an incremental tick."""
+        self._await_snapshot()   # the scatter DONATES the live buffers
         cluster, self._aggs = self._cache.apply_gathered_with_aggregates(
             gathered, groups, self._aggs)
         return cluster
@@ -629,13 +744,29 @@ class IncrementalDecider:
         order fields are input-order placeholders and no window may be
         read."""
         self._ticks += 1
-        if self._refresh_every and self._ticks % self._refresh_every == 0:
+        # repaired ordered-incremental ticks read a scalar AFTER the fused
+        # program (see _order_finish) so the device is idle by the time the
+        # caller unpacks — backends consult this to keep overlap_saved_ms
+        # honest (0 on a pre-synced tick)
+        self.last_decide_synced = False
+        # the dispatches below donate the live aggregates (delta_decide) —
+        # an in-flight audit's snapshot must be frozen before they run
+        self._await_snapshot()
+        # pick up a finished background audit first: its verdict (and a
+        # raise/repair) lands at the tick boundary, never mid-dispatch
+        self._reconcile_audit(block=False)
+        audit_due = bool(
+            self._refresh_every and self._ticks % self._refresh_every == 0)
+        if audit_due and not self._background:
             self.refresh()
         now = np.int64(now_sec)
 
         from escalator_tpu import observability as obs
 
         def dispatch(with_orders):
+            if (with_orders and self._incremental_orders
+                    and self._prev_cols is not None):
+                return self._ordered_incremental(now)
             if with_orders or self._prev_cols is None:
                 # full decide, fed the persistent aggregates: the O(P)/O(N)
                 # sweeps are skipped; every [G] row recomputes (cheap), so
@@ -643,32 +774,180 @@ class IncrementalDecider:
                 with obs.span(
                         "decide_ordered" if with_orders else "decide_full",
                         kind="device"):
-                    # fence blocks (and propagates device failures) — one
-                    # synchronization, not a redundant block_until_ready pair
-                    out = obs.fence(_kernel.decide_jit(
+                    out = _kernel.decide_jit(
                         self._cache.cluster, now, impl=self._impl,
                         aggregates=_kernel.aggregates_tuple(self._aggs),
                         with_orders=with_orders,
-                    ))
+                    )
+                    if not (self._overlap and with_orders):
+                        # fence blocks (and propagates device failures) —
+                        # one synchronization, not a redundant pair; an
+                        # overlapped ordered tick instead lets the caller's
+                        # unpack absorb the device tail (phase unfenced)
+                        out = obs.fence(out)
                 self._set_prev(out)
                 return out
             dirty = np.asarray(self._aggs.dirty)
-            self.last_dirty_count = int(dirty.sum())
-            obs.annotate(dirty_groups=self.last_dirty_count)
+            self._note_dirty(dirty)
             with obs.span("delta_decide", kind="device"):
                 idx = _kernel.dirty_indices(dirty)
                 out, self._aggs = _kernel.delta_decide_jit(
                     self._cache.cluster, self._aggs, self._prev_cols, idx, now)
+                # always fenced: the lazy gate reads nodes_delta right after
+                # this dispatch anyway, so an overlap here would buy nothing
                 out = obs.fence(out)
             self._set_prev(out)
             return out
 
-        return _kernel.lazy_orders_decide(dispatch, tainted_any)
+        result = _kernel.lazy_orders_decide(dispatch, tainted_any)
+        if audit_due and self._background:
+            # kicked AFTER the dispatch, not before it: the decide mutates
+            # neither the resident cluster nor the aggregate sum columns
+            # (delta_decide only clears `dirty`, which the compare excludes),
+            # so the verdict is identical to an entry-time audit — but the
+            # snapshot copy and the worker's recompute both land in the
+            # inter-tick gap instead of queuing in front of (or under) this
+            # tick's decide
+            self._start_background_audit()
+        return result
+
+    def _note_dirty(self, dirty_mask: np.ndarray) -> None:
+        """Record the tick's consumed dirty-group count ONCE: a lazy-orders
+        re-dispatch (light then ordered) runs two delta programs in one
+        tick, the second over an already-cleared mask — the first dispatch's
+        count is the tick's."""
+        from escalator_tpu import observability as obs
+
+        if self._dirty_counted_tick != self._ticks:
+            self._dirty_counted_tick = self._ticks
+            self.last_dirty_count = int(dirty_mask.sum())
+            obs.annotate(dirty_groups=self.last_dirty_count)
+
+    # -- incremental ordered ticks (round 10) -------------------------------
+
+    def _ordered_incremental(self, now):
+        """An ordered dispatch WITHOUT the full [N] sort: group columns via
+        the same ``delta_decide`` program the light tick runs, the ordering
+        permutation via the persistent order state's rank-repair merge
+        (ops.order_tail). Output contract identical to the full ordered
+        decide: every non-order field bit-exact, the ordering WINDOWS
+        bit-exact vs the full sort (the whole permutation is, in fact —
+        both formulations produce the unique strict 4-key order)."""
+        from escalator_tpu import observability as obs
+
+        with obs.span("decide_ordered_incremental", kind="device"):
+            dirty = np.asarray(self._aggs.dirty)
+            self._note_dirty(dirty)
+            idx = _kernel.dirty_indices(dirty)
+            if self._order_state is None:
+                # bootstrap: no state to repair — separate delta + full-sort
+                # dispatches, seeding the key columns + permutation
+                out, self._aggs = _kernel.delta_decide_jit(
+                    self._cache.cluster, self._aggs, self._prev_cols, idx,
+                    now)
+                perm, scale_down = self._order_bootstrap(out.tainted_offsets)
+            else:
+                # steady state: delta decide + order repair as ONE fused
+                # program (kernel.ordered_delta_decide_jit) — one dispatch,
+                # shared [N] passes; the old state is donated into it
+                om, ok1, ok2, operm = self._order_state
+                self._order_state = None   # donated — refs die here
+                out, self._aggs, ostate = _kernel.ordered_delta_decide_jit(
+                    self._cache.cluster, self._aggs, self._prev_cols, idx,
+                    now, om, ok1, ok2, operm, self._order_bucket)
+                perm, scale_down = self._order_finish(
+                    ostate, out.tainted_offsets)
+            # tainted block first = untaint order; rolled to the tail =
+            # scale-down order (exactly kernel.decide's assembly)
+            out = replace(
+                out, untaint_order=perm, scale_down_order=scale_down)
+            if not self._overlap:
+                out = obs.fence(out)
+        self._set_prev(out)
+        return out
+
+    def _order_bootstrap(self, tainted_offsets):
+        """Seed the persistent order state: full key recompute + full 4-key
+        sort (there is nothing to repair yet). Returns ``(perm,
+        scale_down)`` and stores ``(major, k1, k2, perm)`` for the fused
+        steady path."""
+        from escalator_tpu import observability as obs
+        from escalator_tpu.ops import order_tail as _ot
+
+        nodes = self._cache.cluster.nodes
+        with obs.span("order_repair", kind="device"):
+            major, k1, k2 = _ot.order_keys_jit(
+                self._cache.cluster.groups.emptiest, nodes.valid,
+                nodes.group, nodes.tainted, nodes.cordoned,
+                nodes.creation_ns, self._aggs.node_pods_remaining)
+            perm = _ot.order_sort_jit(major, k1, k2)
+            scale_down = jnp.roll(perm, -tainted_offsets[-1])
+        self.last_order_dirty_count = int(nodes.valid.shape[0])
+        self._order_state = (major, k1, k2, perm)
+        self.order_stats["bootstrap"] = (
+            self.order_stats.get("bootstrap", 0) + 1)
+        obs.annotate(order_path="bootstrap",
+                     order_dirty_lanes=self.last_order_dirty_count)
+        return perm, scale_down
+
+    def _order_finish(self, ostate, tainted_offsets):
+        """Adopt a fused dispatch's order outputs: read back the changed-lane
+        count (the tick's ONE host scalar), consult the bucket-overflow and
+        dirty-fraction fallbacks to the full key sort, replace the state.
+        Returns ``(perm, scale_down)``."""
+        from escalator_tpu import observability as obs
+        from escalator_tpu.ops import order_tail as _ot
+
+        major, k1, k2, perm, scale_down, count = ostate
+        N = int(perm.shape[0])
+        with obs.span("order_repair", kind="device"):
+            D = int(count)     # the path's one host readback: a scalar
+            self.last_order_dirty_count = D
+            if D == 0:
+                path = "clean"
+            elif (D > self._order_repair_max_dirty_frac * N
+                    or D > self._order_bucket):
+                # past the threshold where the merge stops paying — or the
+                # bucket truncated the dirty set, making the merged perm
+                # INVALID: full key sort (also reseeds), then grow the
+                # bucket so next tick's compaction fits
+                perm = _ot.order_sort_jit(major, k1, k2)
+                scale_down = jnp.roll(perm, -tainted_offsets[-1])
+                path = "full_sort"
+                cap = max(1, int(self._order_repair_max_dirty_frac * N))
+                self._order_bucket = 1 << (
+                    min(max(D, 1), cap) - 1).bit_length()
+            else:
+                path = "repair"
+        # clean/repair: the int(count) read above synchronized the fused
+        # program and nothing was dispatched since; full_sort re-dispatched
+        # after the read, so the device is busy again
+        self.last_decide_synced = path != "full_sort"
+        self._order_state = (major, k1, k2, perm)
+        self.order_stats[path] = self.order_stats.get(path, 0) + 1
+        obs.annotate(order_path=path,
+                     order_dirty_lanes=self.last_order_dirty_count)
+        return perm, scale_down
+
+    @staticmethod
+    def _mismatched_columns(aggs, fresh) -> list:
+        """Column names where the maintained aggregates differ bitwise from
+        a recompute — the ONE comparison both audit forms run, so the
+        background verdict cannot drift from the synchronous one."""
+        return [
+            f.name for f in fields(_kernel.GroupAggregates)
+            if f.name != "dirty"
+            and not np.array_equal(np.asarray(getattr(aggs, f.name)),
+                                   np.asarray(getattr(fresh, f.name)))
+        ]
 
     def refresh(self) -> bool:
         """Re-derive the aggregates from the resident cluster and assert
         bit-equality against the incrementally maintained state (the
-        self-audit). Returns True when the audit passed.
+        SYNCHRONOUS self-audit — the background cadence path no longer calls
+        this on the tick thread, but the semantics here remain the reference
+        the background verdict is proven equivalent to). Returns True when
+        the audit passed.
 
         A mismatch — in BOTH modes — increments
         ``escalator_tpu_incremental_audit_mismatch_total`` (the alertable
@@ -682,15 +961,24 @@ class IncrementalDecider:
             fresh = obs.fence(
                 _kernel.compute_aggregates_jit(self._cache.cluster,
                                                impl=self._impl))
-            mismatched = [
-                f.name for f in fields(_kernel.GroupAggregates)
-                if f.name != "dirty"
-                and not np.array_equal(np.asarray(getattr(self._aggs, f.name)),
-                                       np.asarray(getattr(fresh, f.name)))
-            ]
+            mismatched = self._mismatched_columns(self._aggs, fresh)
         if not mismatched:
+            self.last_audit_ok = True
             obs.annotate(refresh_audit="ok")
             return True
+        self.last_audit_ok = False
+        self._raise_or_repair(mismatched, fresh=fresh)
+        return False
+
+    def _raise_or_repair(self, mismatched: list, fresh=None) -> None:
+        """The mismatch tail shared by both audit forms: count, dump,
+        then raise or repair. Repair adopts a recompute of the CURRENT
+        resident cluster and marks every group dirty: the synchronous
+        form passes its already-computed ``fresh`` (the cluster has not
+        moved since the compare); the background form passes None and
+        re-derives, because the snapshot's recompute is one audit-latency
+        stale by reconcile time."""
+        from escalator_tpu import observability as obs
         from escalator_tpu.metrics import metrics
 
         metrics.incremental_audit_mismatch.inc()
@@ -706,10 +994,112 @@ class IncrementalDecider:
             raise AggregateParityError(msg)
         obs.annotate(refresh_audit="mismatch-repaired")
         logging.getLogger("escalator_tpu.device_state").error(
-            "%s; repairing: adopting the recompute and marking every group "
-            "dirty", msg)
+            "%s; repairing: adopting a fresh recompute and marking every "
+            "group dirty", msg)
+        if fresh is None:
+            fresh = _kernel.compute_aggregates_jit(self._cache.cluster,
+                                                   impl=self._impl)
         G = int(np.asarray(fresh.dirty).shape[0])
-        import jax.numpy as jnp
-
         self._aggs = replace(fresh, dirty=jnp.ones(G, bool))
-        return False
+
+    # -- background audit (round 10) ----------------------------------------
+
+    def _await_snapshot(self) -> None:
+        """Gate a device mutation on the in-flight audit's double-buffer
+        copy. The worker freezes the snapshot and signals; until then the
+        live buffers may not be DONATED out from under it (the copy would
+        read reused memory — or a deleted-array error — instead of this
+        tick's state). Nearly always already signalled: the copy runs
+        under the caller's inter-dispatch host work (upsert/drain/gather).
+        A residual wait is real cost, so it runs under a visible span
+        instead of hiding inside the next scatter's dispatch."""
+        evt = self._snap_ready
+        if evt is None:
+            return
+        self._snap_ready = None
+        if evt.is_set():
+            return
+        from escalator_tpu import observability as obs
+
+        with obs.span("audit_snapshot_wait"):
+            evt.wait()
+
+    def _start_background_audit(self) -> None:
+        """The audit tick's on-path cost: a ref capture + thread handoff.
+        Even the double-buffer snapshot copy is dispatched from the WORKER
+        — jax 0.4.x CPU dispatch is synchronous, so dispatching the copy
+        here would put the full O(cluster) memcpy back on the audit tick
+        (~30 ms at 1M pods: the exact spike this mode exists to kill).
+        The next device mutation gates on the frozen snapshot instead
+        (:meth:`_await_snapshot`), where the copy overlaps the caller's
+        inter-dispatch host work. The recompute + bit-compare then run on
+        the worker against the frozen state — the same inputs the
+        synchronous audit would have read this tick."""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        from escalator_tpu import observability as obs
+
+        if self._audit_future is not None:
+            # a previous audit still in flight at the next cadence point
+            # (pathological cadence/duration ratio): settle it first so at
+            # most one audit exists and verdicts stay ordered
+            self._reconcile_audit(block=True)
+        self.refreshes += 1
+        if self._audit_pool is None:
+            self._audit_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="escalator-tpu-audit")
+        # capture the refs NOW: later reassignment (a repair, the next
+        # tick's delta aggs) must not move the audit off this tick's inputs
+        self._snap_ready = snap_ready = threading.Event()
+        self._audit_future = self._audit_pool.submit(
+            self._audit_worker, self._cache.cluster, self._aggs, snap_ready)
+        obs.annotate(refresh_audit="background-started")
+
+    def _audit_worker(self, cluster, aggs, snap_ready) -> list:
+        """Worker-thread body: freeze the double buffer, then recompute +
+        compare against it. Runs under its own span root, so the flight
+        recorder carries one ``refresh_audit_bg`` timeline per background
+        audit (span state is thread-local — no interleaving with tick
+        timelines). ``snap_ready`` is set the moment the snapshot has
+        materialized — set even on failure, so a worker error surfaces at
+        reconcile as the future's exception, never as a deadlocked tick
+        thread."""
+        from escalator_tpu import observability as obs
+
+        with obs.span("refresh_audit_bg", kind="device"):
+            try:
+                with obs.span("audit_snapshot", kind="device"):
+                    snap_cluster, snap_aggs = obs.fence(
+                        _audit_snapshot(cluster, aggs))
+            finally:
+                snap_ready.set()
+            fresh = obs.fence(_kernel.compute_aggregates_jit(
+                snap_cluster, impl=self._impl))
+            mismatched = self._mismatched_columns(snap_aggs, fresh)
+            obs.annotate(refresh_audit="ok" if not mismatched
+                         else f"mismatch:{','.join(mismatched)}")
+        return mismatched
+
+    def _reconcile_audit(self, block: bool) -> None:
+        """Adopt a background audit's verdict on the tick thread. With
+        ``block=False`` (every tick's entry) a still-running audit is left
+        alone; ``block=True`` (:meth:`drain_audit`, or an audit still
+        pending at the next cadence point) waits for it. Mismatch semantics
+        are the synchronous audit's, one tick boundary later."""
+        fut = self._audit_future
+        if fut is None or (not block and not fut.done()):
+            return
+        self._audit_future = None
+        mismatched = fut.result()   # a worker exception propagates here
+        self.last_audit_ok = not mismatched
+        if mismatched:
+            self._raise_or_repair(mismatched)
+
+    def drain_audit(self) -> bool:
+        """Block until any in-flight background audit completes and
+        reconcile its verdict (raising / repairing exactly as the
+        synchronous audit would). Returns the last audit verdict (True =
+        passed, or no audit has ever run)."""
+        self._reconcile_audit(block=True)
+        return self.last_audit_ok

@@ -884,6 +884,51 @@ def delta_decide_jit(cluster: ClusterArrays, aggs: GroupAggregates,
     return _delta_decide_raw(cluster, aggs, prev_cols, dirty_idx, now_sec)
 
 
+@partial(jax.jit, static_argnums=(9,), donate_argnums=(1, 2, 5, 6, 7, 8))
+def _ordered_delta_decide_raw(cluster: ClusterArrays, aggs: GroupAggregates,
+                              prev_cols, dirty_idx, now_sec,
+                              old_major, old_k1, old_k2, perm_old,
+                              bucket: int):
+    from escalator_tpu.ops.order_tail import _order_update_core
+
+    out, aggs_out = _delta_decide_core(cluster.groups, cluster.nodes, aggs,
+                                       prev_cols, dirty_idx, now_sec)
+    order_state = _order_update_core(
+        cluster.groups.emptiest, cluster.nodes.valid, cluster.nodes.group,
+        cluster.nodes.tainted, cluster.nodes.cordoned,
+        cluster.nodes.creation_ns, aggs_out.node_pods_remaining,
+        old_major, old_k1, old_k2, perm_old, out.tainted_offsets, bucket)
+    return out, aggs_out, order_state
+
+
+def ordered_delta_decide_jit(cluster: ClusterArrays, aggs: GroupAggregates,
+                             prev_cols, dirty_idx, now_sec,
+                             old_major, old_k1, old_k2, perm_old,
+                             bucket: int):
+    """The steady ORDERED-incremental tick as ONE program: the
+    :func:`delta_decide_jit` body plus ``order_tail._order_update_core``
+    (key recompute + diff + on-device dirty compaction + rank-repair merge
+    + scale-down roll) fused behind a single dispatch. Beyond dropping a
+    synchronous dispatch from the tick, the fusion lets XLA CSE the [N]
+    passes the two programs share — ``node_selection_masks`` and the
+    pods-remaining cast feed both the decision tail and the sort keys.
+
+    Returns ``(DecisionArrays, GroupAggregates, (major, k1, k2, perm,
+    scale_down, count))`` — the first two exactly :func:`delta_decide_jit`'s
+    (order fields still input-order placeholders; the CALLER grafts
+    ``perm``/``scale_down`` in, after consulting ``count`` for the
+    bucket-overflow / dirty-fraction fallback to the full key sort, see
+    ``order_tail.order_update_jit``). DONATES ``aggs``, ``prev_cols``, and
+    the old order state — all four are persistent device state replaced by
+    the returned values."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _ordered_delta_decide_raw(cluster, aggs, prev_cols, dirty_idx,
+                                     now_sec, old_major, old_k1, old_k2,
+                                     perm_old, bucket)
+
+
 def lazy_orders_decide(dispatch, tainted_any: bool):
     """The lazy-orders tick protocol: pay the node-ordering sort only when a
     consumer exists, mirroring the reference, which sorts exclusively inside

@@ -77,6 +77,33 @@ def node_selection_masks(valid, group, tainted, cordoned):
     return key_group, untainted_sel, tainted_sel
 
 
+def order_sort_keys(
+    group: jnp.ndarray,          # int [L] group id per lane (invalid lanes -> 0)
+    tainted_sel: jnp.ndarray,    # bool [L]
+    untainted_sel: jnp.ndarray,  # bool [L]
+    victim_primary: jnp.ndarray,  # int64 [L] pods-remaining for emptiest_first, else 0
+    creation_ns: jnp.ndarray,    # int64 [L]
+    num_groups: int,
+    pad_mask: Optional[jnp.ndarray] = None,  # bool [L] lanes beyond the real set
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The combined ordering's per-lane sort keys ``(major, k1, k2)`` — THE
+    single definition, shared by :func:`combined_order_sort` (the full sort)
+    and the incremental order-state path (:func:`order_repair`), so the two
+    formulations cannot drift: same keys in, bit-identical permutation out.
+    ``major = class * G + group`` (class recoverable as ``major // G``);
+    ``pad_mask`` lanes get class 3 and sink below every real lane."""
+    lane_class = jnp.where(
+        tainted_sel, jnp.int64(0),
+        jnp.where(untainted_sel, jnp.int64(1), jnp.int64(2)),
+    )
+    if pad_mask is not None:
+        lane_class = jnp.where(pad_mask, jnp.int64(3), lane_class)
+    major = lane_class * jnp.int64(num_groups) + group.astype(_I64)
+    k1 = jnp.where(tainted_sel, -creation_ns, victim_primary)
+    k2 = jnp.where(tainted_sel, jnp.int64(0), creation_ns)
+    return major, k1, k2
+
+
 def combined_order_sort(
     group: jnp.ndarray,          # int [L] group id per lane (invalid lanes -> 0)
     tainted_sel: jnp.ndarray,    # bool [L]
@@ -93,15 +120,10 @@ def combined_order_sort(
     which the selection class is recoverable as ``major // G``. ``pad_mask``
     lanes get class 3 and sink below every real lane (the sharded tail's
     block padding)."""
-    lane_class = jnp.where(
-        tainted_sel, jnp.int64(0),
-        jnp.where(untainted_sel, jnp.int64(1), jnp.int64(2)),
+    major, k1, k2 = order_sort_keys(
+        group, tainted_sel, untainted_sel, victim_primary, creation_ns,
+        num_groups, pad_mask=pad_mask,
     )
-    if pad_mask is not None:
-        lane_class = jnp.where(pad_mask, jnp.int64(3), lane_class)
-    major = lane_class * jnp.int64(num_groups) + group.astype(_I64)
-    k1 = jnp.where(tainted_sel, -creation_ns, victim_primary)
-    k2 = jnp.where(tainted_sel, jnp.int64(0), creation_ns)
     out = jax.lax.sort((major, k1, k2, lane_key), num_keys=4, is_stable=False)
     return out[0], out[-1]
 
@@ -315,9 +337,299 @@ def make_sharded_order_tail(mesh: Mesh):
     return tail
 
 
+# ---------------------------------------------------------------------------
+# Incremental ordered ticks (round 10): persistent per-lane order state +
+# dirty-lane rank-repair merge, so "ordered" stops meaning "full resort".
+# ---------------------------------------------------------------------------
+#
+# The ordered decide's dominant cost is the full [N] 4-key sort (~12 ms per
+# 50k lanes on the CPU fallback; cfg6_drain_start_decide_ms 182 vs 72 light).
+# But tick-to-tick only the lanes whose KEYS changed can move: a taint flip,
+# a node add/remove, a pods-remaining change in an emptiest_first group. The
+# incremental path therefore keeps the last ordered tick's keys and
+# permutation resident on device and, per ordered tick:
+#
+# 1. recomputes every lane's keys (O(N) elementwise — the cheap part of the
+#    sort) and diffs them against the stored keys -> the dirty-lane set;
+# 2. compacts the dirty lanes into a [Db] power-of-two bucket, Db << N
+#    (rank-via-binary-search over the dirty cumsum — gathers, no scatter);
+# 3. sorts just the dirty lanes by their new keys (Db log Db);
+# 4. merges the dirty bucket back against the unchanged remainder of the
+#    stored permutation by rank arithmetic: each dirty lane
+#    binary-searches perm_old under the OLD keys (Db * log2 N tuple
+#    compares) and subtracts the dirty lanes below it; each clean lane's
+#    dirty-before count then falls out of the dirty lanes' OWN insertion
+#    points (a histogram + cumsum — no per-clean search), and final
+#    position = clean index + cross-count — the classic two-way merge,
+#    branch-free, fixed-shape, and gather-shaped except for the single
+#    [N] scatter that materializes the new permutation.
+#
+# The whole step — keys, diff, compaction, merge, scale-down roll — is one
+# jit program (order_update_jit): the ordered tick dispatches it once and
+# reads back ONE scalar (the changed-lane count, for the bucket-overflow /
+# dirty-fraction fallback), where the first formulation serialized four
+# dispatches around an [N]-bool mask readback and a host-side compaction.
+#
+# Exactness: the 4-key order is STRICT (the lane index is the last key), so
+# the full sort's output is the unique sorted sequence — and a merge of two
+# strictly-sorted subsequences under the same comparator reproduces it
+# bit-for-bit, over ALL lanes (class-2 region included; the bootstrap sort
+# is unconditional, unlike kernel.decide's lax.cond skip, so the invariant
+# "perm IS the full sort" holds from the first ordered tick on). When the
+# dirty fraction is large the dirty bucket's own sort approaches the full
+# sort's cost for nothing — callers fall back to the full key sort above a
+# dirty-fraction threshold (ops.device_state.IncrementalDecider owns that
+# policy).
+
+
+def node_order_keys(group_emptiest, node_valid, node_group, node_tainted,
+                    node_cordoned, creation_ns, node_pods_remaining):
+    """Per-lane combined-order keys from resident cluster columns — exactly
+    the inputs ``kernel.decide`` feeds its sort: selection masks from
+    :func:`node_selection_masks`, ``victim_primary`` from the emptiest_first
+    config, creation time. ``node_pods_remaining`` is the int64 ``[N]``
+    aggregate (the incremental path's maintained column). Raw columns, not
+    the SoA dataclasses, so this module needs no pytree registrations."""
+    ngroup, untainted_sel, tainted_sel = node_selection_masks(
+        node_valid, node_group, node_tainted, node_cordoned
+    )
+    G = group_emptiest.shape[0]
+    victim_primary = jnp.where(
+        group_emptiest[ngroup], node_pods_remaining, jnp.int64(0)
+    )
+    return order_sort_keys(
+        ngroup, tainted_sel, untainted_sel, victim_primary, creation_ns, G,
+    )
+
+
+order_keys_jit = jax.jit(node_order_keys)
+
+
+@jax.jit
+def order_sort_jit(major, k1, k2):
+    """Full 4-key sort from precomputed key columns: the order-state
+    bootstrap / fallback. Bit-identical to ``kernel.decide``'s sorted branch
+    (same keys, same lane-index tie-break; strict order makes stability
+    irrelevant)."""
+    N = major.shape[0]
+    iota = jax.lax.iota(_I64, N)
+    out = jax.lax.sort((major, k1, k2, iota), num_keys=4, is_stable=False)
+    return out[-1].astype(_I32)
+
+
+def _lex_less(am, a1, a2, al, bm, b1, b2, bl):
+    """Strict lexicographic ``a < b`` over 4-key tuples (vectorized)."""
+    return (am < bm) | (
+        (am == bm) & (
+            (a1 < b1) | (
+                (a1 == b1) & (
+                    (a2 < b2) | ((a2 == b2) & (al < bl))
+                )
+            )
+        )
+    )
+
+
+def _sorted_dirty_tuples(keys3, dirty_idx, N):
+    """The dirty lanes' 4-key tuples under ``keys3``, sorted; pads (bucket
+    entries >= N) get +inf-class keys and lane ``N``, so they sink below
+    every real lane (real majors are < 4G, far below i64max)."""
+    i64max = jnp.iinfo(jnp.int64).max
+    pad = dirty_idx >= N
+    safe_d = jnp.clip(dirty_idx, 0, N - 1)
+    cols = [jnp.where(pad, i64max, k[safe_d]) for k in keys3]
+    lane = jnp.where(pad, jnp.int32(N), safe_d).astype(_I32)
+    out = jax.lax.sort((*cols, lane), num_keys=4, is_stable=False)
+    return out[0], out[1], out[2], out[3]
+
+
+def _rank_repair_merge(perm_old, old_major, old_k1, old_k2,
+                       major, k1, k2, dirty_idx):
+    """The rank-repair merge body (shared by :func:`order_repair_jit` and
+    the fused :func:`order_update_jit` — ONE implementation, so the two
+    entry points cannot drift): given the previous full-sort permutation,
+    the key columns it was sorted under, the CURRENT key columns, and the
+    compacted dirty-lane batch ``dirty_idx`` (``[Db]`` int32, pad entries
+    ``N``), produce the permutation the full 4-key sort would. O(N +
+    Db log N), and — deliberately — GATHER-shaped: the only [N]-payload
+    scatter is the final permutation build. XLA:CPU lowers scatters to a
+    scalar update loop an order of magnitude slower than its vectorized
+    gathers, and the first formulation of this kernel (compacted
+    clean-subsequence scatter + two output scatters) spent most of its
+    ~7 ms there; positions are int32 (lane counts < 2^31) to halve the
+    traffic of the O(N) passes.
+
+    The old key columns replace the clean-subsequence compaction: perm_old
+    is strictly sorted under them, so "insertion point among the CLEAN
+    lanes" = (# lanes with old key < the dirty lane's new key, a binary
+    search over perm_old) - (# DIRTY lanes with old key below it, a search
+    over the Db-sized old-key-sorted bucket). Keys are strict (lane index
+    last), so every count is unambiguous and the merge reproduces the
+    unique full-sort permutation bit-for-bit."""
+    N = perm_old.shape[0]
+    Db = dirty_idx.shape[0]
+    dmaj, dk1, dk2, dlane = _sorted_dirty_tuples((major, k1, k2),
+                                                 dirty_idx, N)
+    omaj, ok1_, ok2_, olane = _sorted_dirty_tuples(
+        (old_major, old_k1, old_k2), dirty_idx, N)
+    dpad = dlane >= N
+
+    # (1) per dirty lane, # of ALL lanes whose OLD key sorts below its NEW
+    # key: branchless binary search over perm_old, log2(N) fixed rounds of
+    # a 4-key tuple compare (Db-sized gathers per round)
+    lo = jnp.zeros(Db, _I32)
+    hi = jnp.full(Db, N, _I32)
+    for _ in range(max(1, int(N).bit_length())):
+        mid = (lo + hi) >> 1
+        lane_c = perm_old[jnp.clip(mid, 0, N - 1)]
+        lc = jnp.clip(lane_c, 0, N - 1)
+        less = _lex_less(old_major[lc], old_k1[lc], old_k2[lc], lane_c,
+                         dmaj, dk1, dk2, dlane)          # old[mid] < dirty
+        take = lo < hi
+        lo = jnp.where(take & less, mid + 1, lo)
+        hi = jnp.where(take & ~less, mid, hi)
+    # (2) minus the DIRTY lanes among them (their old keys left the order):
+    # the same search over the old-key-sorted dirty bucket
+    lo2 = jnp.zeros(Db, _I32)
+    hi2 = jnp.full(Db, Db, _I32)
+    for _ in range(max(1, int(Db).bit_length())):
+        mid = (lo2 + hi2) >> 1
+        m = jnp.clip(mid, 0, Db - 1)
+        less = _lex_less(omaj[m], ok1_[m], ok2_[m], olane[m],
+                         dmaj, dk1, dk2, dlane)
+        take = lo2 < hi2
+        lo2 = jnp.where(take & less, mid + 1, lo2)
+        hi2 = jnp.where(take & ~less, mid, hi2)
+    # insertion point among the CLEAN lanes; pads forced past every real
+    # clean index so the histogram below can never count them
+    lo = jnp.where(dpad, jnp.int32(N), lo - lo2)
+    # final dirty position = clean-before + dirty-before (own index in the
+    # new-key-sorted bucket; pads sort last, so real indices are exact)
+    fd = jnp.where(dpad, jnp.int32(N), lo + jnp.arange(Db, dtype=_I32))
+
+    # -- assembly, fully GATHER-shaped (zero [N]-payload scatters: XLA:CPU
+    # lowers an [N] int32 scatter to a ~3.7 ms scalar loop at 50k lanes,
+    # where one more log N round of [N] gathers costs ~1 ms): the dirty
+    # lanes land via a Db-sized scatter of their final positions, and each
+    # remaining slot's lane is recovered DIRECTLY — clean slot j holds the
+    # (j - #dirty-slots<=j)-th clean lane of perm_old (clean lanes keep
+    # their relative order), found by binary search over the clean-lane
+    # cumsum. This inverts the old formulation's clean-index -> slot map
+    # (fc(r) = r + #dirty-insertions<=r, strictly increasing), so the
+    # output permutation is unchanged bit-for-bit.
+    dirty_mask = jnp.zeros(N, bool).at[dirty_idx].set(True, mode="drop")
+    is_clean = ~dirty_mask[jnp.clip(perm_old, 0, N - 1)]
+    cum_clean = jnp.cumsum(is_clean.astype(_I32))
+    slot_lane = jnp.full(N, N, _I32).at[fd].set(dlane, mode="drop")
+    cum_dirty = jnp.cumsum((slot_lane < N).astype(_I32))
+    want = jnp.arange(1, N + 1, dtype=_I32) - cum_dirty  # clean rank + 1
+    lo3 = jnp.zeros(N, _I32)
+    hi3 = jnp.full(N, N, _I32)
+    for _ in range(max(1, int(N).bit_length())):
+        mid = (lo3 + hi3) >> 1
+        less = cum_clean[jnp.clip(mid, 0, N - 1)] < want
+        take = lo3 < hi3
+        lo3 = jnp.where(take & less, mid + 1, lo3)
+        hi3 = jnp.where(take & ~less, mid, hi3)
+    clean_lane = perm_old[jnp.clip(lo3, 0, N - 1)]
+    return jnp.where(slot_lane < N, slot_lane, clean_lane)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def order_repair_jit(perm_old, old_major, old_k1, old_k2,
+                     major, k1, k2, dirty_idx):
+    """Standalone rank-repair merge (see :func:`_rank_repair_merge`):
+    ``perm_old`` (donated — the new permutation replaces it) was produced
+    by the full 4-key sort under the OLD key columns; returns the
+    permutation the full sort would produce under the CURRENT columns.
+    Locked bit-for-bit against :func:`order_sort_jit` by
+    tests/test_order_tail.py across sizes, dirty fractions, and key-tie
+    pressure."""
+    return _rank_repair_merge(perm_old, old_major, old_k1, old_k2,
+                              major, k1, k2, dirty_idx)
+
+
+def _order_update_core(group_emptiest, node_valid, node_group, node_tainted,
+                       node_cordoned, creation_ns, node_pods_remaining,
+                       old_major, old_k1, old_k2, perm_old, tainted_offsets,
+                       bucket: int):
+    """Trace-time body of :func:`order_update_jit` — also inlined by
+    ``kernel.ordered_delta_decide_jit``, which fuses it with the delta
+    decide into the ordered-incremental tick's SINGLE program (the
+    selection masks and [N] elementwise passes CSE across the two, and the
+    tick drops from two synchronous dispatches to one)."""
+    N = perm_old.shape[0]
+    major, k1, k2 = node_order_keys(
+        group_emptiest, node_valid, node_group, node_tainted, node_cordoned,
+        creation_ns, node_pods_remaining)
+    dirty = (major != old_major) | (k1 != old_k1) | (k2 != old_k2)
+    # compacted dirty-lane batch, gather-shaped: slot j holds the lane with
+    # dirty-rank j, found by binary-searching the inclusive dirty cumsum
+    # (first position with cum == j+1); slots past the count read N = pad
+    cum = jnp.cumsum(dirty.astype(_I32))
+    count = cum[N - 1].astype(_I32)
+    slot = jnp.arange(bucket, dtype=_I32) + 1
+    lo = jnp.zeros(bucket, _I32)
+    hi = jnp.full(bucket, N, _I32)
+    for _ in range(max(1, int(N).bit_length())):
+        mid = (lo + hi) >> 1
+        less = cum[jnp.clip(mid, 0, N - 1)] < slot
+        take = lo < hi
+        lo = jnp.where(take & less, mid + 1, lo)
+        hi = jnp.where(take & ~less, mid, hi)
+    dirty_idx = jnp.where(lo < N, lo, jnp.int32(N))
+
+    perm = _rank_repair_merge(perm_old, old_major, old_k1, old_k2,
+                              major, k1, k2, dirty_idx)
+    scale_down = jnp.roll(perm, -tainted_offsets[-1])
+    return major, k1, k2, perm, scale_down, count
+
+
+@partial(jax.jit, static_argnums=(12,), donate_argnums=(7, 8, 9, 10))
+def order_update_jit(group_emptiest, node_valid, node_group, node_tainted,
+                     node_cordoned, creation_ns, node_pods_remaining,
+                     old_major, old_k1, old_k2, perm_old, tainted_offsets,
+                     bucket: int):
+    """The ordered-incremental ORDER-STATE step, fused into one program
+    (one dispatch, no mid-tick host round-trip — the separate keys/diff ->
+    host mask readback -> host compaction -> repair -> roll chain
+    serialized four dispatches and an [N]-bool transfer on the ordered
+    tick's critical path): recompute every lane's keys, diff them against
+    the stored columns, compact the changed lanes into a ``bucket``-sized
+    batch ON DEVICE (rank-via-binary-search over the dirty cumsum —
+    gathers, not an [N] scatter), run the rank-repair merge, and roll the
+    repaired permutation into the scale-down order (``kernel.decide``'s
+    exact assembly: tainted block first, rolled to the tail by the total
+    tainted count). The steady ordered tick goes one step further and runs
+    this body INSIDE its delta-decide program
+    (``kernel.ordered_delta_decide_jit``); this standalone entry remains
+    the kernel's unit-testable/lintable form and the direct consumer for
+    callers that maintain order state without the incremental decide.
+
+    Returns ``(major, k1, k2, perm, scale_down, count)``. ``count`` is the
+    TRUE changed-lane total: when it exceeds ``bucket`` the compaction
+    truncated and ``perm`` is INVALID — the caller must fall back to
+    :func:`order_sort_jit` on the returned key columns (and grow the
+    bucket; ops.device_state.IncrementalDecider owns that policy, plus the
+    dirty-fraction threshold above which the merge stops paying). The old
+    key columns and permutation are donated — replaced by the returned
+    state either way. ``bucket`` is static: power-of-two growth bounds
+    recompiles exactly like kernel.dirty_indices' delta buckets."""
+    return _order_update_core(
+        group_emptiest, node_valid, node_group, node_tainted, node_cordoned,
+        creation_ns, node_pods_remaining, old_major, old_k1, old_k2,
+        perm_old, tainted_offsets, bucket)
+
+
 __all__: Sequence[str] = (
+    "order_sort_keys",
     "combined_order_sort",
     "assign_order_blocks",
     "pad_order_blocks",
     "make_sharded_order_tail",
+    "node_order_keys",
+    "order_keys_jit",
+    "order_sort_jit",
+    "order_repair_jit",
+    "order_update_jit",
 )

@@ -15,3 +15,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _flight_dump_dir_hygiene(tmp_path, monkeypatch):
+    """Flight-recorder incident dumps land in ESCALATOR_TPU_DUMP_DIR
+    (default CWD) — point every test at its tmpdir so suite runs stop
+    littering the repo root with escalator-tpu-flight-*.json debris. Tests
+    that probe the env contract monkeypatch over this (later patch wins)."""
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))

@@ -425,3 +425,202 @@ def test_podaxis_delta_scatter_maintains_sharded_residency():
         out_cluster.groups, np.full(B, P_, np.int32), pod_new, pod_new,
         nidx, node_old2, type(node_old2)(**nn2), aggs2)
     assert bool(ng_changed2)
+
+
+# ---------------------------------------------------------------------------
+# Round 10: refresh-cadence parsing, background audit, incremental orders,
+# host/device overlap
+# ---------------------------------------------------------------------------
+
+def test_parse_refresh_every():
+    from escalator_tpu.ops.device_state import parse_refresh_every
+
+    assert parse_refresh_every(8) == 8
+    assert parse_refresh_every("8") == 8
+    assert parse_refresh_every("  256 ") == 256
+    assert parse_refresh_every("off") == 0
+    assert parse_refresh_every(" OFF ") == 0
+    for bad in ("0", "-3", "1.5", "abc", "", 0, -1, 2.5, True, None):
+        with pytest.raises(ValueError, match="positive integer"):
+            parse_refresh_every(bad)
+
+
+def test_refresh_every_env_validation(monkeypatch):
+    """The env spelling goes through the same validator: 0/negative/garbage
+    fail LOUDLY at construction (the old int() accepted "0" as a silent
+    disable), "off" is the documented disable."""
+    _, store, groups, cache = _store_world(seed=23)
+    monkeypatch.setenv("ESCALATOR_TPU_REFRESH_EVERY", "0")
+    with pytest.raises(ValueError, match="ESCALATOR_TPU_REFRESH_EVERY"):
+        IncrementalDecider(cache)
+    monkeypatch.setenv("ESCALATOR_TPU_REFRESH_EVERY", "nope")
+    with pytest.raises(ValueError, match="ESCALATOR_TPU_REFRESH_EVERY"):
+        IncrementalDecider(cache)
+    monkeypatch.setenv("ESCALATOR_TPU_REFRESH_EVERY", "off")
+    assert IncrementalDecider(cache)._refresh_every == 0
+    monkeypatch.setenv("ESCALATOR_TPU_REFRESH_EVERY", "7")
+    assert IncrementalDecider(cache)._refresh_every == 7
+    # programmatic: 0 stays the legacy disable, negatives reject
+    assert IncrementalDecider(cache, refresh_every=0)._refresh_every == 0
+    assert IncrementalDecider(cache, refresh_every="off")._refresh_every == 0
+    with pytest.raises(ValueError, match="refresh_every"):
+        IncrementalDecider(cache, refresh_every=-2)
+
+
+@pytest.mark.parametrize("seed", [7])
+def test_audit_lockstep_background_vs_sync(seed):
+    """The ISSUE-5 equivalence proof: at every audited tick of a churn soak,
+    the BACKGROUND audit's verdict (recompute + bit-compare against the
+    frozen double-buffer snapshot, on a worker thread) equals the
+    SYNCHRONOUS audit's verdict on the same tick's inputs — including one
+    injected-drift tick where both must name the same mismatched columns."""
+    G = 8
+    rng, store, groups, cache = _store_world(seed, G)
+    inc = IncrementalDecider(cache, refresh_every=0)  # cadence driven below
+    for t in range(12):
+        _random_churn(rng, store, groups, t, G)
+        pd, nd = store.drain_dirty()
+        inc.apply_gathered(cache.gather_deltas(pd, nd), groups)
+        inc.decide(NOW, True)
+        if t == 7:
+            # inject drift so one lockstep point exercises the mismatch arm
+            inc._aggs = dataclasses.replace(
+                inc._aggs, mem_req=inc._aggs.mem_req + 1)
+        # synchronous verdict on this tick's inputs (the reference)
+        fresh = kernel.compute_aggregates_jit(cache.cluster)
+        mm_sync = inc._mismatched_columns(inc._aggs, fresh)
+        # background verdict on the SAME tick's inputs, adjudicated raw
+        # (bypassing reconcile so the raise doesn't end the soak)
+        inc._start_background_audit()
+        fut = inc._audit_future
+        inc._audit_future = None
+        mm_bg = fut.result()
+        assert mm_bg == mm_sync, f"tick {t}: {mm_bg} != {mm_sync}"
+        assert (t == 7) == bool(mm_bg), f"tick {t}"
+        if t == 7:
+            assert "mem_req" in mm_bg
+            inc._on_mismatch = "repair"
+            inc._raise_or_repair(mm_bg)   # adopt truth, continue the soak
+            inc._on_mismatch = "raise"
+
+
+def test_background_audit_snapshot_is_frozen():
+    """The double buffer's guarantee: mutations AFTER the snapshot — live
+    aggregate drift, later-tick scatters — cannot change an in-flight
+    audit's verdict. (No donation on the snapshot program; jaxlint pins
+    that via the device_state.audit_snapshot entry.)"""
+    _, store, groups, cache = _store_world(seed=31)
+    inc = IncrementalDecider(cache, refresh_every=0)
+    inc.decide(NOW, False)
+    inc._start_background_audit()          # freezes a CLEAN state
+    # corrupt the live aggregates and scatter a later tick while in flight
+    inc._aggs = dataclasses.replace(inc._aggs, cpu_req=inc._aggs.cpu_req + 5)
+    store.upsert_pods_batch(["p1"], [1], [999], [10**9])
+    pd, nd = store.drain_dirty()
+    inc.apply_gathered(cache.gather_deltas(pd, nd))
+    assert inc.drain_audit() is True       # verdict is snapshot-time clean
+    # whereas a synchronous audit of the LIVE state sees the drift
+    with pytest.raises(AggregateParityError, match="cpu_req"):
+        inc.refresh()
+
+
+def test_background_audit_mismatch_raises_at_reconcile():
+    """mode="raise" semantics survive the move off-path: the parity error
+    surfaces at the next reconcile point (drain or next tick) with the
+    mismatch counter bumped — not swallowed by the worker."""
+    from escalator_tpu.metrics.metrics import registry
+
+    def counter():
+        v = registry.get_sample_value(
+            "escalator_tpu_incremental_audit_mismatch_total")
+        return 0.0 if v is None else v
+
+    _, store, groups, cache = _store_world(seed=33)
+    inc = IncrementalDecider(cache, refresh_every=2)  # background default on
+    inc.decide(NOW, False)                 # tick 1
+    inc._aggs = dataclasses.replace(
+        inc._aggs, num_pods=inc._aggs.num_pods + 1)
+    before = counter()
+    inc.decide(NOW, False)                 # tick 2: audit starts, corrupted
+    with pytest.raises(AggregateParityError, match="num_pods"):
+        inc.drain_audit()
+    assert counter() == before + 1
+    assert inc.last_audit_ok is False
+
+
+def test_background_audit_mismatch_repairs():
+    """mode="repair" in background form: reconcile adopts a fresh recompute
+    of the CURRENT resident cluster and marks every group dirty — after
+    which decisions are bit-exact again."""
+    _, store, groups, cache = _store_world(seed=35)
+    inc = IncrementalDecider(cache, refresh_every=0, on_mismatch="repair")
+    inc.decide(NOW, False)
+    inc._aggs = dataclasses.replace(
+        inc._aggs, num_nodes=inc._aggs.num_nodes + 1)
+    inc._start_background_audit()
+    assert inc.drain_audit() is False
+    assert np.asarray(inc.aggregates.dirty).all()
+    assert inc.refresh() is True           # repaired state IS the truth
+    out, _ = inc.decide(NOW, False)
+    ref, _ = kernel.lazy_orders_decide(
+        lambda w: jax.block_until_ready(kernel.decide_jit(
+            cache.cluster, np.int64(NOW), with_orders=w)), False)
+    _assert_decisions_equal(out, ref, context="post-repair")
+
+
+def _taint_tick(store, t):
+    """Taint one node (fresh creation_ns: its sort keys move) — keeps every
+    tick on the ordered path with a non-empty order-dirty set."""
+    store.upsert_node(f"n{t % 40}", t % 8, 4000, 16 * 10**9,
+                      creation_ns=10**9 + t, tainted=True,
+                      taint_time_sec=NOW - 100)
+
+
+@pytest.mark.parametrize("kwargs, forbidden, required", [
+    ({}, (), ("bootstrap", "repair")),
+    ({"order_repair_max_dirty_frac": -1.0}, ("repair",), ("full_sort",)),
+    ({"incremental_orders": False}, ("repair", "bootstrap", "full_sort"), ()),
+])
+def test_ordered_incremental_paths_and_fallback(kwargs, forbidden, required):
+    """Ordered ticks stay bit-exact on every order-state path: the repair
+    merge (default), the forced full-sort fallback (threshold exceeded on
+    every dirty tick), and the round-8 full ordered dispatch (opt-out).
+    order_stats proves which path actually ran."""
+    _, store, groups, cache = _store_world(seed=41)
+    inc = IncrementalDecider(cache, refresh_every=0, **kwargs)
+    inc.decide(NOW, False)                 # bootstrap decide: seeds prev_cols
+    for t in range(4):
+        _taint_tick(store, t)
+        pd, nd = store.drain_dirty()
+        inc.apply_gathered(cache.gather_deltas(pd, nd))
+        out, ordered = inc.decide(NOW, True)
+        assert ordered, f"tick {t} expected ordered"
+        ref, _ = kernel.lazy_orders_decide(
+            lambda w: jax.block_until_ready(kernel.decide_jit(
+                cache.cluster, np.int64(NOW), with_orders=w)), True)
+        _assert_decisions_equal(out, ref, context=f"tick {t} {kwargs}")
+    for path in forbidden:
+        assert path not in inc.order_stats, inc.order_stats
+    for path in required:
+        assert inc.order_stats.get(path, 0) >= 1, inc.order_stats
+
+
+def test_overlap_mode_stays_bit_exact():
+    """overlap=True changes only WHERE the tick blocks (ordered dispatches
+    return unfenced; the caller's first device read absorbs the tail) —
+    never the decision."""
+    rng, store, groups, cache = _store_world(seed=47)
+    inc = IncrementalDecider(cache, refresh_every=0, overlap=True)
+    for t in range(8):
+        _random_churn(rng, store, groups, t, 8)
+        pd, nd = store.drain_dirty()
+        inc.apply_gathered(cache.gather_deltas(pd, nd), groups)
+        nv = store.as_pod_node_arrays()[1]
+        tainted_any = bool(
+            (np.asarray(nv.valid) & np.asarray(nv.tainted)).any())
+        out, ordered = inc.decide(NOW, tainted_any)
+        ref, ref_ordered = kernel.lazy_orders_decide(
+            lambda w: jax.block_until_ready(kernel.decide_jit(
+                cache.cluster, np.int64(NOW), with_orders=w)), tainted_any)
+        assert ordered == ref_ordered
+        _assert_decisions_equal(out, ref, context=f"overlap tick {t}")

@@ -349,14 +349,34 @@ def test_instrumented_jaxprs_byte_identical():
 
 # ------------------------------------------------------------- incident dump
 def test_dump_on_incident_writes_and_counts(tmp_path, monkeypatch):
-    monkeypatch.setenv("ESCALATOR_TPU_FLIGHT_DUMP_DIR", str(tmp_path))
+    target = tmp_path / "dumps"
+    target.mkdir()
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(target))
     before = _counter("escalator_tpu_flight_recorder_dumps_total",
                       {"reason": "wedge"})
     path = obs.dump_on_incident("wedge")
     assert path is not None and json.loads(open(path).read())["reason"] == "wedge"
+    assert path.startswith(str(target)), path
     assert _counter("escalator_tpu_flight_recorder_dumps_total",
                     {"reason": "wedge"}) == before + 1
     # unwritable dir: returns None, never raises (incident path safety)
-    monkeypatch.setenv("ESCALATOR_TPU_FLIGHT_DUMP_DIR",
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR",
                        str(tmp_path / "missing" / "deeper"))
     assert obs.dump_on_incident("wedge") is None
+
+
+def test_dump_dir_legacy_alias_still_honored(tmp_path, monkeypatch):
+    """The pre-round-10 ESCALATOR_TPU_FLIGHT_DUMP_DIR spelling keeps working
+    when the new ESCALATOR_TPU_DUMP_DIR is unset (compat contract)."""
+    legacy = tmp_path / "legacy"
+    legacy.mkdir()
+    monkeypatch.delenv("ESCALATOR_TPU_DUMP_DIR", raising=False)
+    monkeypatch.setenv("ESCALATOR_TPU_FLIGHT_DUMP_DIR", str(legacy))
+    path = obs.dump_on_incident("wedge")
+    assert path is not None and path.startswith(str(legacy)), path
+    # and the new env takes precedence over the legacy one when both are set
+    newer = tmp_path / "newer"
+    newer.mkdir()
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(newer))
+    path2 = obs.dump_on_incident("wedge")
+    assert path2 is not None and path2.startswith(str(newer)), path2

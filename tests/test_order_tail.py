@@ -13,6 +13,8 @@ the lax.cond skip path), emptiest-first victim keys, and high-water-padded
 block maps.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -205,3 +207,131 @@ def test_sharded_tail_is_block_sized_in_the_lowering():
     light = podaxis.make_podaxis_decider(mesh, with_orders=False)
     txt_light = light.lower(placed, NOW).as_text()
     assert len(re.findall(r"stablehlo\.sort", txt_light)) == 0
+
+
+# ---------------------------------------------------------------------------
+# Incremental order state (round 10): key recompute + rank-repair merge
+# ---------------------------------------------------------------------------
+
+def _repair_world(rng, N, G=8):
+    """Random key columns with heavy tie pressure (small value ranges force
+    the lane-index tie-break to matter) — the repair merge must reproduce
+    the full sort under maximal ambiguity, not just on distinct keys."""
+    major = rng.integers(0, 3 * G, N).astype(np.int64)
+    k1 = rng.integers(-4, 4, N).astype(np.int64)
+    k2 = rng.integers(0, 3, N).astype(np.int64)
+    return major, k1, k2
+
+
+@pytest.mark.parametrize("N", [5, 64, 257])
+@pytest.mark.parametrize("dirty_frac", [0.0, 0.02, 0.3, 1.0])
+def test_order_repair_matches_full_sort(N, dirty_frac):
+    """order_repair_jit == order_sort_jit bit-for-bit, across sizes and
+    dirty fractions (0 = an all-pad bucket, 1.0 = every lane dirty — the
+    clean subsequence is empty), under key-tie pressure."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(N * 1000 + int(dirty_frac * 100))
+    major, k1, k2 = _repair_world(rng, N)
+    perm_old = np.asarray(order_tail.order_sort_jit(
+        jnp.asarray(major), jnp.asarray(k1), jnp.asarray(k2)))
+
+    dirty = rng.random(N) < dirty_frac
+    nm, n1, n2 = major.copy(), k1.copy(), k2.copy()
+    nm[dirty] = rng.integers(0, 24, int(dirty.sum()))
+    n1[dirty] = rng.integers(-4, 4, int(dirty.sum()))
+    # mask from the ACTUAL key diff (a mutated lane may land on its old
+    # keys — then it is NOT dirty, exactly as order_update_jit's diff
+    # computes)
+    changed = (nm != major) | (n1 != k1) | (n2 != k2)
+    idx = kernel.dirty_indices(changed)
+
+    got = np.asarray(order_tail.order_repair_jit(
+        jnp.asarray(perm_old), jnp.asarray(major), jnp.asarray(k1),
+        jnp.asarray(k2), jnp.asarray(nm), jnp.asarray(n1),
+        jnp.asarray(n2), jnp.asarray(idx)))
+    want = np.asarray(order_tail.order_sort_jit(
+        jnp.asarray(nm), jnp.asarray(n1), jnp.asarray(n2)))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("bucket", [4, 64])
+def test_order_update_fused_program(bucket):
+    """order_update_jit — the fused keys + diff + compaction + merge + roll
+    program — returns the recomputed keys, the TRUE changed-lane count, and
+    (when the bucket holds every changed lane) the exact full-sort
+    permutation with its scale-down roll; on bucket overflow the count
+    exceeds ``bucket``, the caller's contract for discarding the perm."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    G, P, N = 8, 128, 96
+    cluster = _random_cluster(rng, G, P, N)
+    cluster.groups.emptiest[:3] = True
+    aggs = kernel.compute_aggregates_jit(jax.device_put(cluster))
+    cols = (jnp.asarray(cluster.groups.emptiest),
+            jnp.asarray(cluster.nodes.valid),
+            jnp.asarray(cluster.nodes.group),
+            jnp.asarray(cluster.nodes.tainted),
+            jnp.asarray(cluster.nodes.cordoned),
+            jnp.asarray(cluster.nodes.creation_ns),
+            aggs.node_pods_remaining)
+    m0, k10, k20 = order_tail.order_keys_jit(*cols)
+    m0n, k10n, k20n = (np.asarray(m0), np.asarray(k10), np.asarray(k20))
+    perm0 = np.asarray(order_tail.order_sort_jit(m0, k10, k20))
+
+    # flip a spread of taints: exactly those (valid) lanes' keys change —
+    # enough of them that the small parametrized bucket overflows
+    nodes2 = dataclasses.replace(
+        cluster.nodes,
+        tainted=cluster.nodes.tainted ^ (np.arange(N) % 16 == 1))
+    cols2 = (cols[0], jnp.asarray(nodes2.valid), jnp.asarray(nodes2.group),
+             jnp.asarray(nodes2.tainted), jnp.asarray(nodes2.cordoned),
+             jnp.asarray(nodes2.creation_ns), aggs.node_pods_remaining)
+    offs = np.zeros(G + 1, np.int32)
+    offs[-1] = 3
+    m1, k11, k21, perm, scale_down, count = order_tail.order_update_jit(
+        *cols2, jnp.asarray(m0n.copy()), jnp.asarray(k10n.copy()),
+        jnp.asarray(k20n.copy()), jnp.asarray(perm0.copy()),
+        jnp.asarray(offs), bucket)
+    want_m, want_k1, want_k2 = order_tail.order_keys_jit(*cols2)
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(want_m))
+    np.testing.assert_array_equal(np.asarray(k11), np.asarray(want_k1))
+    np.testing.assert_array_equal(np.asarray(k21), np.asarray(want_k2))
+    want_dirty = ((np.asarray(want_m) != m0n)
+                  | (np.asarray(want_k1) != k10n)
+                  | (np.asarray(want_k2) != k20n))
+    assert want_dirty.any(), "taint flips must move keys"
+    assert int(count) == int(want_dirty.sum())
+    if int(count) <= bucket:
+        want_perm = np.asarray(order_tail.order_sort_jit(
+            want_m, want_k1, want_k2))
+        np.testing.assert_array_equal(np.asarray(perm), want_perm)
+        np.testing.assert_array_equal(np.asarray(scale_down),
+                                      np.roll(want_perm, -3))
+
+
+def test_order_keys_reproduce_decide_permutation():
+    """The order-state formulation (node_order_keys -> order_sort_jit) is
+    bit-identical to the ordered decide's own permutation — the contract
+    that lets an incremental ordered tick substitute its repaired
+    permutation for the kernel's sort output."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    G, P, N = 8, 256, 128
+    cluster = _random_cluster(rng, G, P, N)
+    cluster.groups.emptiest[::2] = True
+    dev = jax.device_put(cluster)
+    out = jax.block_until_ready(kernel.decide_jit(dev, NOW))
+    aggs = kernel.compute_aggregates_jit(dev)
+    perm = order_tail.order_sort_jit(*order_tail.order_keys_jit(
+        jnp.asarray(cluster.groups.emptiest), jnp.asarray(cluster.nodes.valid),
+        jnp.asarray(cluster.nodes.group), jnp.asarray(cluster.nodes.tainted),
+        jnp.asarray(cluster.nodes.cordoned),
+        jnp.asarray(cluster.nodes.creation_ns), aggs.node_pods_remaining))
+    np.testing.assert_array_equal(np.asarray(out.untaint_order),
+                                  np.asarray(perm))
+    np.testing.assert_array_equal(
+        np.asarray(out.scale_down_order),
+        np.roll(np.asarray(perm), -int(np.asarray(out.tainted_offsets)[-1])))
