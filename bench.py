@@ -1854,6 +1854,74 @@ def run_smoke() -> dict:
     out["smoke_cfg14_dirty_counts"] = dirty_counts
     out["smoke_order_paths"] = dict(inc.order_stats)
 
+    # ---- replay smoke (round 11): snapshot -> record -> dump -> debug-replay
+    # The failover/replay acceptance loop at smoke scale, driven through the
+    # REAL artifact path: checkpoint the decider, record four more churn
+    # ticks' inputs, dump the ring (now a self-contained replay bundle), and
+    # re-execute it via the actual `escalator-tpu debug-replay` verb —
+    # asserting identical per-tick crc32 decision digests. The report ships
+    # as REPLAY_SMOKE_LATEST.json, uploaded by CI next to the jaxlint
+    # report.
+    import tempfile
+
+    from escalator_tpu.observability import RECORDER
+    from escalator_tpu.observability import replay as replaymod
+    from escalator_tpu.ops import snapshot as snaplib
+
+    replay_dir = tempfile.mkdtemp(prefix="escalator-replay-smoke-")
+    try:
+        leaves, snap_meta = inc.snapshot_state()
+        snap_path = snaplib.write_snapshot(
+            snaplib.latest_path(replay_dir), leaves, snap_meta)
+        replaymod.INPUT_LOG.clear()
+        replaymod.INPUT_LOG.set_enabled(True)
+        want_digests = []
+        for t in range(6, 10):
+            n_churn, cpu = 5, 400 + 10 * t
+            idx = (t * 12 + np.arange(n_churn)) % 160
+            store.upsert_pods_batch([f"sp{i}" for i in idx], idx % Gi,
+                                    np.full(n_churn, cpu),
+                                    np.full(n_churn, 10**9))
+            pd, nd = store.drain_dirty()
+            inc.apply_gathered(cache.gather_deltas(pd, nd))
+            out_r, _ordered_r = inc.decide(now, False)
+            want_digests.append(replaymod.decision_digest(out_r))
+        replaymod.INPUT_LOG.set_enabled(False)
+        ring_path = os.path.join(replay_dir, "ring.json")
+        RECORDER.dump(ring_path, reason="replay-smoke")
+        from escalator_tpu.cli import main as cli_main
+
+        report_path = os.path.join(replay_dir, "report.json")
+        rc = cli_main(["debug-replay", "--dump", ring_path,
+                       "--snapshot", snap_path, "--output", report_path])
+        assert rc == 0, f"debug-replay exited {rc}"
+        with open(report_path) as f:
+            replay_report = json.load(f)
+        assert replay_report["ok"] and replay_report["replayed"] == 4, (
+            replay_report)
+        got_digests = [r["digest"] for r in replay_report["ticks"]]
+        assert got_digests == want_digests, (got_digests, want_digests)
+    finally:
+        import shutil
+
+        replaymod.INPUT_LOG.set_enabled(False)
+        replaymod.INPUT_LOG.clear()
+        shutil.rmtree(replay_dir, ignore_errors=True)
+    out["smoke_replay_digests"] = want_digests
+    out["smoke_replay"] = "ok"
+    replay_artifact = os.environ.get(
+        "ESCALATOR_TPU_REPLAY_SMOKE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "REPLAY_SMOKE_LATEST.json"),
+    )
+    try:
+        with open(replay_artifact, "w") as f:
+            json.dump(replay_report, f, indent=1)
+            f.write("\n")
+        out["replay_smoke_report"] = replay_artifact
+    except OSError:   # read-only checkout: the in-memory asserts still ran
+        out["replay_smoke_report"] = "(write failed)"
+
     # ---- flight recorder: populated, named phases, bounded overhead ------
     # The 6 incremental ticks above ran through the instrumented
     # IncrementalDecider, so the recorder must hold their records with the

@@ -702,6 +702,55 @@ def _build_audit_snapshot() -> TracedEntry:
                        jitted=ds._audit_snapshot)
 
 
+def _snapshot_state_fixture(seed: int = 31):
+    """A full persisted-state tuple (cluster, aggs, decision columns, order
+    state) — the snapshot freeze/restore programs' representative input."""
+    from escalator_tpu.ops import kernel, order_tail
+
+    cluster = representative_cluster(seed=seed)
+    aggs = kernel.compute_aggregates_jit(cluster)
+    out = kernel.decide_jit(cluster, NOW)
+    cols = tuple(getattr(out, f) for f in kernel.GROUP_DECISION_FIELDS)
+    n = cluster.nodes
+    major, k1, k2 = order_tail.order_keys_jit(
+        cluster.groups.emptiest, n.valid, n.group, n.tainted, n.cordoned,
+        n.creation_ns, aggs.node_pods_remaining)
+    perm = order_tail.order_sort_jit(major, k1, k2)
+    return (cluster, aggs, cols, (major, k1, k2, perm))
+
+
+def _build_snapshot_freeze() -> TracedEntry:
+    from escalator_tpu.ops import snapshot as snaplib
+
+    state = _snapshot_state_fixture()
+    return TracedEntry(fn=snaplib._freeze_state, args=(state,),
+                       jitted=snaplib._freeze_state)
+
+
+def _build_snapshot_restore() -> TracedEntry:
+    from escalator_tpu.ops import snapshot as snaplib
+
+    state = _snapshot_state_fixture(seed=32)
+    return TracedEntry(fn=snaplib._adopt_body, args=(state,),
+                       jitted=snaplib._restore_adopt)
+
+
+def _probe_snapshot_restore_retraces() -> int:
+    """Two restores of same-shaped (different-valued) state trees: the leaf
+    VALUES are never a cache key — a standby restoring repeatedly (restarts,
+    replay runs) must hit the jit cache after the first adopt."""
+    import jax
+
+    from escalator_tpu.ops import snapshot as snaplib
+
+    before = snaplib._restore_adopt._cache_size()
+    for seed in (33, 34):
+        state = jax.tree_util.tree_map(
+            np.asarray, _snapshot_state_fixture(seed=seed))
+        jax.block_until_ready(snaplib.restore_adopt(state))
+    return snaplib._restore_adopt._cache_size() - before
+
+
 def _build_simulate_sweep() -> TracedEntry:
     from escalator_tpu.ops import simulate
 
@@ -1036,6 +1085,32 @@ def default_registry() -> List[KernelEntry]:
             # donation deliberately ABSENT (donate_expected=False): aliasing
             # an input here would let a later tick's scatter corrupt the
             # frozen double buffer the background audit reads
+        ),
+        e(
+            name="snapshot.freeze",
+            module="escalator_tpu.ops.snapshot",
+            kind="jit",
+            build=_build_snapshot_freeze,
+            output_dtypes=AGGREGATE_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,
+            # donation deliberately ABSENT (donate_expected=False): the
+            # freeze copies persisted state OUT of the live buffers, which
+            # must stay valid for the ticks that keep mutating them — the
+            # same contract as device_state.audit_snapshot
+        ),
+        e(
+            name="snapshot.restore_adopt",
+            module="escalator_tpu.ops.snapshot",
+            kind="jit",
+            build=_build_snapshot_restore,
+            output_dtypes=AGGREGATE_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,
+            donate_expected=True,  # the uploaded staging buffers BECOME the
+                                   # resident state: zero-copy adoption
+            retrace_budget=1,      # restored VALUES are never a cache key
+            retrace_probe=_probe_snapshot_restore_retraces,
         ),
         e(
             name="simulate.sweep_deltas",

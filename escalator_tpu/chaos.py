@@ -1,0 +1,215 @@
+"""Fault injection for the chaos soak suite: forced failures in the REAL stack.
+
+The round-11 robustness work (snapshot/restore, replay, plugin retries,
+audit-worker hardening) is only trustworthy if the failure paths are driven
+through the production code, not through mocks of it. This module is the
+injection layer: a handful of named *sites* compiled into the hot code
+(``plugin/client.py`` RPC attempts, the incremental decider's audit kick and
+audit worker, the controller tick, the election renew loop), each a single
+dictionary lookup when disarmed — measured sub-100 ns, invisible next to the
+spans already on those paths.
+
+Arming is programmatic (``CHAOS.arm("plugin_rpc", times=3)`` — the soak
+tests) or env-driven for subprocess scenarios::
+
+    ESCALATOR_TPU_CHAOS="tick_wedge:times=1,delay=30;plugin_rpc:every=2"
+
+Rule knobs: ``times`` (fire at most N times; default unlimited), ``every``
+(fire on every K-th eligible call), ``after`` (skip the first N calls),
+``delay`` (sleep seconds when firing — the wedge injector), plus free-form
+params the site interprets (e.g. ``code=unavailable`` for the RPC site).
+
+Every firing increments ``escalator_tpu_chaos_injections_total{site}`` and
+annotates the current flight-recorder timeline (``chaos=<site>``), so an
+injected fault is always visible in metrics AND in the tick record — the
+soak's "every injected fault visible" acceptance bar is checked against
+exactly these two surfaces.
+
+Sites in the production tree (grep ``CHAOS.`` to enumerate):
+
+- ``plugin_rpc``      — raise a synthetic retryable RpcError before an RPC
+  attempt (plugin/client.ComputeClient); ``code=`` picks the status.
+- ``audit_mismatch``  — corrupt one maintained aggregate column right before
+  the cadence audit kicks (ops/device_state.IncrementalDecider.decide), so
+  the audit must detect + raise/repair a REAL divergence.
+- ``audit_worker``    — raise inside the background-audit worker thread
+  after the snapshot gate is released (worker-death path; reconcile must
+  degrade to the synchronous audit, never deadlock or crash the tick).
+- ``tick_wedge``      — sleep ``delay`` seconds at tick start
+  (controller.Controller.run_once): drives the watchdog's
+  crash-to-restart + flight dump end to end.
+- ``lease_renew``     — raise from the renew loop's CAS
+  (k8s/election.LeaderElector): lease loss mid-tick; deposition after the
+  renew deadline.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+log = logging.getLogger("escalator_tpu.chaos")
+
+
+class ChaosInjected(RuntimeError):
+    """Default exception an armed site raises (sites that need a typed
+    error — the RPC hook — construct their own from the rule params)."""
+
+    def __init__(self, site: str):
+        super().__init__(f"chaos: injected fault at site {site!r}")
+        self.site = site
+
+
+@dataclass
+class ChaosRule:
+    """One armed site. Counters mutate under the monkey's lock."""
+
+    site: str
+    times: Optional[int] = None    # fire at most N times (None = unlimited)
+    every: int = 1                 # fire on every K-th eligible call
+    after: int = 0                 # skip the first N calls entirely
+    delay_sec: float = 0.0         # sleep when firing (the wedge injector)
+    params: Dict[str, str] = field(default_factory=dict)
+    calls: int = 0
+    fired: int = 0
+
+
+class ChaosMonkey:
+    """Process-global registry of armed fault sites (thread-safe: hooks run
+    on tick, gRPC worker, audit worker and renew threads alike)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rules: Dict[str, ChaosRule] = {}
+        self._armed = False   # lock-free fast path for the disarmed case
+
+    # -- configuration ------------------------------------------------------
+    def arm(self, site: str, *, times: Optional[int] = None, every: int = 1,
+            after: int = 0, delay_sec: float = 0.0,
+            **params: str) -> ChaosRule:
+        rule = ChaosRule(site=site, times=times, every=max(1, int(every)),
+                         after=max(0, int(after)), delay_sec=float(delay_sec),
+                         params={k: str(v) for k, v in params.items()})
+        with self._lock:
+            self._rules[site] = rule
+            self._armed = True
+        log.warning("chaos: armed site %r (%s)", site, rule)
+        return rule
+
+    def disarm(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            if site is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(site, None)
+            self._armed = bool(self._rules)
+
+    def fired(self, site: str) -> int:
+        with self._lock:
+            rule = self._rules.get(site)
+            return rule.fired if rule else 0
+
+    def params(self, site: str) -> Dict[str, str]:
+        with self._lock:
+            rule = self._rules.get(site)
+            return dict(rule.params) if rule else {}
+
+    # -- firing -------------------------------------------------------------
+    def should_fire(self, site: str) -> bool:
+        """One eligible call at ``site``: True when the armed rule elects to
+        fire now. Counts the firing, emits the metric and the flight-record
+        annotation — callers then fail however the site fails (raise, sleep,
+        corrupt). The disarmed fast path is one attribute read."""
+        if not self._armed:
+            return False
+        with self._lock:
+            rule = self._rules.get(site)
+            if rule is None:
+                return False
+            rule.calls += 1
+            if rule.calls <= rule.after:
+                return False
+            if (rule.calls - rule.after) % rule.every != 0:
+                return False
+            if rule.times is not None and rule.fired >= rule.times:
+                return False
+            rule.fired += 1
+            delay = rule.delay_sec
+        self._note_fired(site)
+        if delay > 0:
+            log.warning("chaos: site %r sleeping %.1fs", site, delay)
+            time.sleep(delay)
+        return True
+
+    def inject(self, site: str) -> None:
+        """The raise-form hook: fire (sleep included) and raise
+        :class:`ChaosInjected`. Sites that need a typed error call
+        :meth:`should_fire` and construct their own."""
+        if self.should_fire(site):
+            raise ChaosInjected(site)
+
+    @staticmethod
+    def _note_fired(site: str) -> None:
+        # both surfaces are best-effort: a broken metrics registry must not
+        # turn an injected fault into a DIFFERENT fault
+        try:
+            from escalator_tpu.metrics import metrics
+
+            metrics.chaos_injections.labels(site).inc()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from escalator_tpu import observability as obs
+
+            obs.annotate(chaos=site)
+        except Exception:  # noqa: BLE001
+            pass
+        log.warning("chaos: fired site %r", site)
+
+
+#: the process-wide monkey every hook site consults
+CHAOS = ChaosMonkey()
+
+
+def install_from_env(env: Optional[str] = None) -> int:
+    """Parse ``ESCALATOR_TPU_CHAOS`` (``site:k=v,k=v;site2:...``) and arm the
+    monkey. Returns the number of rules armed; malformed specs fail fast
+    (a chaos run silently doing nothing is worse than a crash)."""
+    spec = env if env is not None else os.environ.get("ESCALATOR_TPU_CHAOS", "")
+    spec = spec.strip()
+    if not spec:
+        return 0
+    count = 0
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, raw = part.partition(":")
+        site = site.strip()
+        if not site:
+            raise ValueError(f"ESCALATOR_TPU_CHAOS: empty site in {part!r}")
+        kwargs: Dict[str, str] = {}
+        if raw.strip():
+            for kv in raw.split(","):
+                k, sep, v = kv.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"ESCALATOR_TPU_CHAOS: expected k=v, got {kv!r}")
+                kwargs[k.strip()] = v.strip()
+        times = int(kwargs.pop("times")) if "times" in kwargs else None
+        every = int(kwargs.pop("every", "1"))
+        after = int(kwargs.pop("after", "0"))
+        delay = float(kwargs.pop("delay", "0"))
+        CHAOS.arm(site, times=times, every=every, after=after,
+                  delay_sec=delay, **kwargs)
+        count += 1
+    return count
+
+
+# arm from the environment at import: the subprocess scenarios (watchdog
+# wedge under chaos) configure the monkey before any controller code runs
+install_from_env()

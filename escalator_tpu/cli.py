@@ -91,6 +91,71 @@ def debug_dump_main(argv: List[str]) -> int:
     return 0
 
 
+def debug_replay_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-replay``: re-execute a dumped flight-recorder
+    ring OFFLINE, bit-exactly, against a device-state snapshot — the
+    post-incident half of deterministic record/replay (docs/ha.md). The
+    dump must carry recorded tick inputs (run the controller with
+    ESCALATOR_TPU_RECORD_INPUTS=1), and the snapshot must be a checkpoint
+    at or before the ring's first recorded tick (the cadence checkpoints
+    from --snapshot-dir qualify). Exit status: 0 when every replayed tick
+    reproduced its recorded crc32 decision digest, 1 on any divergence,
+    2 when the bundle cannot be replayed at all."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-replay",
+        description="re-execute a dumped tick ring bit-exactly offline",
+    )
+    p.add_argument("--dump", required=True,
+                   help="flight-recorder dump JSON carrying tick_inputs "
+                        "(debug-dump output, or an incident dump)")
+    p.add_argument("--snapshot", required=True,
+                   help="device-state snapshot file (.snap) at or before "
+                        "the ring's first recorded tick")
+    p.add_argument("--output", default="-",
+                   help="file path for the JSON replay report, or - for"
+                        " stdout")
+    args = p.parse_args(argv)
+    from escalator_tpu.observability import replay
+    from escalator_tpu.ops.snapshot import SnapshotCorruptError
+
+    try:
+        with open(args.dump) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        # a missing/truncated dump is "bundle not replayable" (exit 2),
+        # exactly like a corrupt snapshot below — never exit 1, which is
+        # reserved for a tick that replayed and DIVERGED
+        print(f"cannot read dump: {e}", file=sys.stderr)
+        return 2
+    entries = doc.get("tick_inputs")
+    if not entries:
+        print("dump carries no tick_inputs — record with "
+              "ESCALATOR_TPU_RECORD_INPUTS=1 and re-dump", file=sys.stderr)
+        return 2
+    try:
+        report = replay.replay_ring(entries, snapshot_path=args.snapshot)
+    except (ValueError, OSError, SnapshotCorruptError) as e:
+        # a corrupt snapshot / missing file / ring gap is "bundle not
+        # replayable" (exit 2) — exit 1 is reserved for a tick that
+        # replayed but DIVERGED, and the two must never be conflated
+        print(f"replay failed: {e}", file=sys.stderr)
+        return 2
+    text = json.dumps(report, indent=1)
+    if args.output == "-":
+        print(text)
+    else:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        print(f"replay report ({report['replayed']} ticks, "
+              f"{len(report['divergent'])} divergent) -> {args.output}")
+    if not report["ok"]:
+        print(f"DIVERGENCE: {len(report['divergent'])} of "
+              f"{report['replayed']} replayed ticks did not reproduce their "
+              "recorded digest", file=sys.stderr)
+        return 1
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="escalator-tpu",
@@ -138,6 +203,12 @@ def build_parser() -> argparse.ArgumentParser:
                         " groups)")
     p.add_argument("--plugin-address", default="127.0.0.1:50551",
                    help="compute plugin address for --backend grpc")
+    p.add_argument("--snapshot-dir", default="",
+                   help="directory for rolling device-state checkpoints; a"
+                        " restarted/promoted controller warm-starts from the"
+                        " latest one (incremental backends; docs/ha.md)")
+    p.add_argument("--snapshot-every", type=int, default=64,
+                   help="checkpoint cadence in ticks for --snapshot-dir")
     p.add_argument("--once", action="store_true",
                    help="run a single tick and exit (prints per-group deltas)")
     p.add_argument("--profile-dir", default="",
@@ -283,8 +354,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     # a leading verb)
     if argv and argv[0] == "debug-dump":
         return debug_dump_main(argv[1:])
+    if argv and argv[0] == "debug-replay":
+        return debug_replay_main(argv[1:])
     args = build_parser().parse_args(argv)
     setup_logging(args.loglevel, args.logfmt)
+
+    if args.snapshot_dir:
+        # the env pair is how backends (constructed behind make_backend's
+        # parameterless kinds) discover the checkpoint config; the native
+        # path below also receives it explicitly
+        os.environ["ESCALATOR_TPU_SNAPSHOT_DIR"] = args.snapshot_dir
+        os.environ["ESCALATOR_TPU_SNAPSHOT_EVERY"] = str(args.snapshot_every)
 
     node_groups = setup_node_groups(args.nodegroups)
 
@@ -441,7 +521,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         ensure_responsive_accelerator()
         from escalator_tpu.controller.native_backend import make_native_backend
 
-        backend = make_native_backend(client, node_groups)
+        backend = make_native_backend(
+            client, node_groups,
+            snapshot_dir=args.snapshot_dir or None,
+            snapshot_every=args.snapshot_every)
     elif args.backend == "grpc":
         from escalator_tpu.plugin.client import GrpcBackend
 

@@ -149,12 +149,12 @@ def _decision_digest(out) -> str:
     hex token in every flight-recorder entry: two ticks with equal digests
     decided the same thing, so an operator reading a dump can spot the tick
     where behavior changed without diffing arrays. Device->host copies are
-    two [G] arrays — negligible."""
-    import zlib
+    two [G] arrays — negligible. Round 11: the single implementation lives
+    in observability/replay.py, because `debug-replay` asserts a re-executed
+    tick against exactly this token — the two may never drift."""
+    from escalator_tpu.observability.replay import decision_digest
 
-    s = np.ascontiguousarray(np.asarray(out.status))
-    d = np.ascontiguousarray(np.asarray(out.nodes_delta))
-    return format(zlib.crc32(s.tobytes() + d.tobytes()), "08x")
+    return decision_digest(out)
 
 
 def _decision_digest_objects(results: "List[GroupDecision]") -> str:
@@ -186,6 +186,15 @@ class PaddedPacker:
         self._pad_pods = 0
         self._pad_nodes = 0
         self._pad_groups = 0
+
+    def seed(self, pad_pods: int, pad_nodes: int, pad_groups: int) -> None:
+        """Pre-seed the high-water pads (the snapshot warm-start path: the
+        next pack must reproduce the checkpoint's shapes or the resident
+        state would be discarded for a pad mismatch). Seeds are floors —
+        a bigger live world still grows them as usual."""
+        self._pad_pods = max(self._pad_pods, int(pad_pods))
+        self._pad_nodes = max(self._pad_nodes, int(pad_nodes))
+        self._pad_groups = max(self._pad_groups, int(pad_groups))
 
     def pack(self, group_inputs, dry_mode_flags=None, taint_trackers=None):
         from escalator_tpu.core.arrays import pack_cluster
@@ -594,6 +603,20 @@ class JaxBackend(ComputeBackend):
             return results
 
 
+def _snapshot_config(snapshot_dir, snapshot_every):
+    """Resolve the checkpoint knobs: explicit params win, else the env pair
+    (ESCALATOR_TPU_SNAPSHOT_DIR / ESCALATOR_TPU_SNAPSHOT_EVERY) the CLI and
+    deployments set. ``(None, n)`` means checkpointing is off."""
+    import os
+
+    if snapshot_dir is None:
+        snapshot_dir = os.environ.get("ESCALATOR_TPU_SNAPSHOT_DIR") or None
+    if snapshot_every is None:
+        snapshot_every = int(os.environ.get(
+            "ESCALATOR_TPU_SNAPSHOT_EVERY", "64"))
+    return snapshot_dir, int(snapshot_every)
+
+
 def _changed_slots(old_soa, new_soa) -> np.ndarray:
     """Lane indices where ANY column differs between two packed SoA views —
     the host-diff delta extraction IncrementalJaxBackend feeds the scatter
@@ -634,7 +657,9 @@ class IncrementalJaxBackend(ComputeBackend):
 
     def __init__(self, impl: Optional[str] = None,
                  refresh_every: "Optional[int | str]" = None,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every: Optional[int] = None):
         from escalator_tpu.ops import kernel  # defers jax import
 
         self._kernel = kernel
@@ -646,7 +671,70 @@ class IncrementalJaxBackend(ComputeBackend):
         self._cache = None
         self._inc = None
         self._host_prev = None   # (PodArrays, NodeArrays) of the last pack
+        # failover-grade state (round 11): periodic async checkpoints of the
+        # device-resident state, and a warm start from the latest checkpoint
+        # at the first decide — the standby-leader path (docs/ha.md)
+        snapshot_dir, snapshot_every = _snapshot_config(
+            snapshot_dir, snapshot_every)
+        self._writer = None
+        if snapshot_dir:
+            from escalator_tpu.ops.snapshot import SnapshotWriter
+
+            self._writer = SnapshotWriter(snapshot_dir, every=snapshot_every)
+        self._restore_attempted = False
+        self._restored_fresh = False
         obs.jaxmon.install()
+
+    def _try_restore(self) -> bool:
+        """Warm start from the rolling checkpoint (ops/snapshot.py): adopt
+        the snapshot's resident state + seed the packer pads and the diff
+        baseline, so the FIRST tick host-diffs the live world against the
+        snapshot and folds everything that changed while no leader ran into
+        one delta batch — O(changes since checkpoint) device work, no full
+        decide. A corrupt/truncated snapshot falls back to the cold start
+        with a flight-recorder dump; a missing one is just the first boot."""
+        from escalator_tpu.ops import snapshot as snaplib
+        from escalator_tpu.ops.device_state import restore_decider
+
+        path = self._writer.path
+        with obs.span("snapshot_load"):
+            try:
+                leaves, meta = snaplib.read_snapshot(path)
+            except FileNotFoundError:
+                return False
+            except snaplib.SnapshotCorruptError as e:
+                self._note_corrupt_snapshot(path, e)
+                return False
+        try:
+            cache, inc = restore_decider(
+                leaves, meta, impl=self._impl,
+                refresh_every=self._refresh_every, on_mismatch="repair",
+                overlap=self._overlap)
+        except snaplib.SnapshotCorruptError as e:
+            self._note_corrupt_snapshot(path, e)
+            return False
+        self._cache, self._inc = cache, inc
+        self._host_prev = cache.host_views
+        self._packer.seed(cache.pod_capacity, cache.node_capacity,
+                          int(meta["num_groups"]))
+        self._restored_fresh = True
+        metrics.snapshot_restores.labels("warm").inc()
+        import logging
+
+        logging.getLogger("escalator_tpu.backend").info(
+            "warm start: restored device state from %s (tick %s)",
+            path, meta.get("tick"))
+        return True
+
+    @staticmethod
+    def _note_corrupt_snapshot(path: str, err: Exception) -> None:
+        import logging
+
+        metrics.snapshot_restores.labels("corrupt").inc()
+        dump = obs.dump_on_incident("snapshot-corrupt")
+        logging.getLogger("escalator_tpu.backend").error(
+            "snapshot %s failed validation (%s); cold-starting instead "
+            "(flight record: %s)", path, err, dump or "dump failed")
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
         with obs.span(self.name):
@@ -662,6 +750,13 @@ class IncrementalJaxBackend(ComputeBackend):
         )
 
         t0 = time.perf_counter()
+        if (self._cache is None and self._writer is not None
+                and not self._restore_attempted):
+            # first decide of this process: probe the rolling checkpoint
+            # BEFORE packing, so a warm start can seed the packer pads to
+            # the snapshot's shapes (a pad mismatch would force a rebuild)
+            self._restore_attempted = True
+            self._try_restore()
         with obs.span("pack"):
             cluster = self._packer.pack(
                 group_inputs, dry_mode_flags, taint_trackers)
@@ -678,6 +773,18 @@ class IncrementalJaxBackend(ComputeBackend):
             or int(self._cache.cluster.groups.valid.shape[0])
             != int(cluster.groups.valid.shape[0])
         )
+        if rebuild and self._restored_fresh:
+            # the restored snapshot's shapes no longer fit the live world
+            # (cluster outgrew the checkpoint capacities): discard it and
+            # cold-start — correctness never depended on the warm path
+            metrics.snapshot_restores.labels("stale").inc()
+            import logging
+
+            logging.getLogger("escalator_tpu.backend").warning(
+                "restored snapshot is stale for the current cluster shapes "
+                "(pods %d nodes %d groups %d); cold-starting",
+                P, N, int(cluster.groups.valid.shape[0]))
+        self._restored_fresh = False
         if rebuild:
             with obs.span("rebuild_residency", kind="device"):
                 self._cache = DeviceClusterCache(cluster)
@@ -722,6 +829,11 @@ class IncrementalJaxBackend(ComputeBackend):
         with obs.span("packing_post"):
             self._packing.apply(
                 results, group_inputs, dry_mode_flags, taint_trackers)
+        if self._writer is not None and self._inc is not None:
+            # cadence checkpoint: freeze + D2H on the tick thread (cheap,
+            # amortized), serialization + atomic write on the writer thread
+            with obs.span("checkpoint"):
+                self._writer.maybe_checkpoint(self._inc)
         return results
 
 

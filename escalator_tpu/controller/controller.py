@@ -166,8 +166,14 @@ class Controller:
         the controller's own phases (provider_refresh / group_scan / decide /
         act) plus whatever device phases the backend nests under ``decide``
         — so a dump reads as a single end-to-end per-tick trace."""
+        from escalator_tpu.chaos import CHAOS
+
         with self.opts.tracer.tick(), obs.span("tick"):
             obs.annotate(backend=self.backend.name)
+            # chaos: a wedged tick (site sleeps per its armed delay) — the
+            # watchdog's crash-to-restart + flight dump is the remediation
+            # under test; disarmed this is one attribute read
+            CHAOS.should_fire("tick_wedge")
             self._run_once_inner()
 
     def _run_once_inner(self) -> None:

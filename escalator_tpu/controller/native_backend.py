@@ -56,7 +56,9 @@ class NativeJaxBackend(ComputeBackend):
                  pod_capacity: int = 1 << 17, node_capacity: int = 1 << 15,
                  incremental: "bool | None" = None,
                  refresh_every: "int | str | None" = None,
-                 overlap: "bool | None" = None):
+                 overlap: "bool | None" = None,
+                 snapshot_dir: "str | None" = None,
+                 snapshot_every: "int | None" = None):
         import os
 
         from escalator_tpu.native.statestore import NativeStateStore
@@ -110,6 +112,22 @@ class NativeJaxBackend(ComputeBackend):
         self._pallas_failures = 0
         self._ticks_since_fallback = 0
         self._dispatches_this_tick = 0
+        # failover checkpoints (round 11): the incremental decider's state
+        # checkpoints to disk on a cadence. Warm RESTORE is not wired for
+        # this backend — the C++ store assigns slots by ingestion order, so
+        # a restarted process's slot layout need not match the snapshot's
+        # (docs/ha.md: the repack incremental backend owns warm starts; a
+        # native snapshot still powers offline debug-replay of that
+        # process's own recorded ring).
+        from escalator_tpu.controller.backend import _snapshot_config
+
+        snapshot_dir, snapshot_every = _snapshot_config(
+            snapshot_dir, snapshot_every)
+        self._writer = None
+        if snapshot_dir and self._incremental:
+            from escalator_tpu.ops.snapshot import SnapshotWriter
+
+            self._writer = SnapshotWriter(snapshot_dir, every=snapshot_every)
         obs.jaxmon.install()
 
     def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
@@ -312,6 +330,9 @@ class NativeJaxBackend(ComputeBackend):
                         results,
                         [row for row in packing_rows if row[0] in sel]
                     )
+            if self._writer is not None:
+                with obs.span("checkpoint"):
+                    self._writer.maybe_checkpoint(self._inc)
             return results
         # blocks on the result itself: an async device failure must surface
         # inside the resilient wrapper, not here. The lazy protocol sorts
@@ -607,6 +628,8 @@ def make_native_backend(
     node_capacity: int = 1 << 10,
     incremental: "bool | None" = None,
     refresh_every: "int | None" = None,
+    snapshot_dir: "str | None" = None,
+    snapshot_every: "int | None" = None,
 ) -> NativeJaxBackend:
     """Wire group filters from NodeGroupOptions (same filters the listers use).
 
@@ -635,5 +658,6 @@ def make_native_backend(
     return NativeJaxBackend(
         client, filters, pod_capacity=pod_capacity,
         node_capacity=node_capacity, incremental=incremental,
-        refresh_every=refresh_every,
+        refresh_every=refresh_every, snapshot_dir=snapshot_dir,
+        snapshot_every=snapshot_every,
     )

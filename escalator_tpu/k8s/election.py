@@ -93,10 +93,18 @@ class FileResourceLock:
                 holder = current.holder if current else None
                 if holder != expected_holder:
                     return False
-                tmp = f"{self.path}.tmp.{os.getpid()}"
-                with open(tmp, "w") as f:
-                    json.dump(record.__dict__, f)
-                os.replace(tmp, self.path)
+                # crash-consistent write (round 11, the ONE shared recipe —
+                # utils.atomicio — also used by the flight recorder's dumps
+                # and ops/snapshot.py): flush + fsync BEFORE the atomic
+                # rename, so a host crash can never leave a zero-length or
+                # half-written lease where a standby would read "no holder"
+                # and split-brain past a live leader whose renewal simply
+                # hadn't re-materialized yet
+                from escalator_tpu.utils.atomicio import atomic_write
+
+                atomic_write(self.path,
+                             lambda f: json.dump(record.__dict__, f),
+                             mode="w")
                 return True
             finally:
                 fcntl.flock(guard, fcntl.LOCK_UN)
@@ -153,10 +161,16 @@ class LeaderElector:
         """Renew every retry period; transient CAS failures are retried until the
         renew deadline expires (client-go semantics). Deposition is immediate only
         when another holder demonstrably owns the lease."""
+        from escalator_tpu.chaos import CHAOS
+
         last_renew = self.clock.now()
         while not self._stop.wait(self.config.retry_period_sec):
             now = self.clock.now()
             try:
+                # chaos: lease-loss-mid-tick — renewals fail while the tick
+                # loop keeps running; after the renew deadline the elector
+                # must depose (and the CLI's watcher crash-to-restart)
+                CHAOS.inject("lease_renew")
                 ok = self.lock.create_or_update(
                     LeaderRecord(self.identity, now, now), self.identity
                 )

@@ -197,6 +197,46 @@ flight_recorder_dumps = Counter(
     "automatic flight-recorder incident dumps, by trigger",
     ["reason"], namespace="escalator_tpu", registry=registry,
 )
+audit_worker_failures = Counter(
+    "audit_worker_failures_total",
+    "background refresh-audit worker threads that died with an exception "
+    "(each one degrades that audit to the synchronous form and dumps the "
+    "flight recorder); alert on any increase",
+    namespace="escalator_tpu", registry=registry,
+)
+
+# --- failover-grade state (round 11: snapshot/restore, replay, chaos) --------
+plugin_fallback = Counter(
+    "plugin_fallback_total",
+    "remote-plugin decides that fell back to the local backend, by gRPC "
+    "status code (circuit-open = served from the pinned fallback without "
+    "attempting the RPC)",
+    ["code"], namespace="escalator_tpu", registry=registry,
+)
+plugin_rpc_retries = Counter(
+    "plugin_rpc_retries_total",
+    "individual plugin RPC attempts retried after a retryable failure "
+    "(each decide may contribute several; fallbacks count separately)",
+    namespace="escalator_tpu", registry=registry,
+)
+snapshot_checkpoints = Counter(
+    "snapshot_checkpoints_total",
+    "device-state snapshots checkpointed to disk (atomic write completed)",
+    namespace="escalator_tpu", registry=registry,
+)
+snapshot_restores = Counter(
+    "snapshot_restores_total",
+    "device-state restore attempts by outcome: warm (snapshot adopted), "
+    "corrupt (validation failed, cold start + flight dump), stale "
+    "(incompatible shapes/meta, cold start)",
+    ["outcome"], namespace="escalator_tpu", registry=registry,
+)
+chaos_injections = Counter(
+    "chaos_injections_total",
+    "faults fired by the chaos injection layer (escalator_tpu.chaos), by "
+    "site — nonzero only in fault-injection runs",
+    ["site"], namespace="escalator_tpu", registry=registry,
+)
 jax_compile_seconds = Histogram(
     "jax_compile_seconds",
     "XLA backend-compile durations observed via jax.monitoring (a warm "

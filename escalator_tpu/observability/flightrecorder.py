@@ -41,6 +41,7 @@ DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_FLIGHT_RECORDER_SIZE",
 #: timeline meta keys lifted verbatim into the tick record when present
 _META_KEYS = ("backend", "impl", "ordered", "digest", "dirty_groups",
               "refresh_audit", "caller", "trace_id", "fallback",
+              "fallback_code", "chaos", "restored", "restored_tick",
               "order_path", "order_dirty_lanes",
               "overlap_host_ms", "overlap_sync_wait_ms", "overlap_saved_ms")
 
@@ -109,7 +110,7 @@ class FlightRecorder:
 
     # -- dumping -----------------------------------------------------------
     def as_dump(self, reason: str = "on-demand") -> Dict[str, Any]:
-        return {
+        doc = {
             "flight_recorder": True,
             "reason": reason,
             "dumped_at_unix": round(time.time(), 3),
@@ -120,17 +121,30 @@ class FlightRecorder:
             "jaxmon": jaxmon.snapshot(),
             "ticks": self.snapshot(),
         }
+        # deterministic replay (round 11): when tick-input recording is on,
+        # every dump is a self-contained replay bundle — the recorded
+        # (idx, old→new) batches ride along under "tick_inputs" and
+        # `escalator-tpu debug-replay` re-executes them from a snapshot
+        from escalator_tpu.observability import replay
+
+        if replay.INPUT_LOG.depth:
+            doc["tick_inputs"] = replay.INPUT_LOG.snapshot()
+        return doc
 
     def dump(self, path: str, reason: str = "on-demand") -> str:
-        """Write the dump JSON atomically (tmp + rename: an incident dump
-        racing a SIGKILL must not strand a truncated artifact)."""
+        """Write the dump JSON crash-consistently (the shared
+        ``utils.atomicio.atomic_write`` recipe: an incident dump racing a
+        SIGKILL — or a power cut, now that dumps are part of the failover
+        story — must not strand a truncated or non-durable artifact)."""
+        from escalator_tpu.utils.atomicio import atomic_write
+
         doc = self.as_dump(reason)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+
+        def emit(f):
             json.dump(doc, f, indent=1)
             f.write("\n")
-        os.replace(tmp, path)
-        return path
+
+        return atomic_write(path, emit, mode="w")
 
 
 #: the process-wide recorder every instrumented layer records into

@@ -1,0 +1,222 @@
+"""Deterministic tick record/replay: re-execute a dumped ring bit-exactly.
+
+The flight recorder (flightrecorder.py) answers *what happened* — phases,
+digests, dirty counts. It cannot answer *why tick 417 decided what it did*,
+because the inputs are gone. This module closes that gap: when input
+recording is on, every incremental tick's **inputs** — the gathered
+``(idx, old→new)`` delta batches, the repacked group rows, ``now_sec`` and
+the lazy-orders gate — land in a bounded ring next to the flight recorder's
+records, and any dump that carries the ring can be re-executed offline
+(``escalator-tpu debug-replay``) against a device-state snapshot
+(ops/snapshot.py), asserting per-tick crc32 decision-digest equality.
+
+Determinism argument: the incremental decide is a pure function of
+``(resident state, delta batch, now_sec, tainted_any)`` — integer/float64
+ops with no RNG, no wall clock, no iteration-order dependence — and the
+persistent state evolves only through the recorded scatter batches (the
+donation protocol makes any other mutation a bug jaxlint's R5 would flag).
+So replaying the batches from the snapshot's state reproduces every
+decision bit-exactly, on any host, any time later. The one nondeterminism
+in the live path — the background refresh audit's *timing* — is
+bit-neutral by the PR-5 lockstep proof and is disabled during replay
+anyway.
+
+Recording is OFF by default (``ESCALATOR_TPU_RECORD_INPUTS=1`` or
+``INPUT_LOG.set_enabled(True)``): a delta batch at production churn is a
+few KB per tick, which is cheap but not free, and the ring is most useful
+armed around an investigation. The flight recorder's dumps automatically
+embed the ring (``tick_inputs``) whenever it is non-empty, so an incident
+dump taken while recording is a self-contained replay bundle (modulo the
+base snapshot, which the checkpoint cadence provides).
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import os
+import threading
+import zlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_INPUT_LOG_SIZE", "256"))
+
+
+def decision_digest(out) -> str:
+    """crc32 over the decision-defining columns (status + nodes_delta) — the
+    SAME token ``controller.backend._decision_digest`` stamps into flight
+    records (that function delegates here), so a replayed tick's digest is
+    directly comparable to the recorded one."""
+    s = np.ascontiguousarray(np.asarray(out.status))
+    d = np.ascontiguousarray(np.asarray(out.nodes_delta))
+    return format(zlib.crc32(s.tobytes() + d.tobytes()), "08x")
+
+
+def encode_array(arr) -> Dict[str, Any]:
+    """JSON-safe exact encoding: dtype + shape + base64 raw bytes. Integer,
+    bool and float64 columns all round-trip bit-exactly."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    return {
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def decode_array(spec: Dict[str, Any]) -> np.ndarray:
+    raw = base64.b64decode(spec["b64"])
+    return np.frombuffer(raw, dtype=np.dtype(spec["dtype"])).reshape(
+        spec["shape"]).copy()
+
+
+class TickInputLog:
+    """Bounded ring of per-tick input records (thread-safe; the decider's
+    tick thread appends, dump/CLI threads snapshot)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._enabled = os.environ.get(
+            "ESCALATOR_TPU_RECORD_INPUTS", "0").lower() in ("1", "true", "yes")
+
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, value: bool) -> None:
+        self._enabled = bool(value)
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def record(self, entry: Dict[str, Any]) -> None:
+        with self._lock:
+            self._ring.append(entry)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+#: the process-wide input log the incremental decider records into
+INPUT_LOG = TickInputLog()
+
+
+def encode_batch(gathered, groups) -> Dict[str, Any]:
+    """One ``apply_gathered`` call's inputs: the padded (idx, values) pod and
+    node batches plus the (tiny, [G]) group rows when the caller re-uploaded
+    them. SoA values encode field by field, iterating dataclass fields — the
+    decode side mirrors this exactly."""
+    pidx, pvals, nidx, nvals = gathered
+    enc: Dict[str, Any] = {
+        "pod_idx": encode_array(pidx),
+        "pod_vals": {f: encode_array(getattr(pvals, f))
+                     for f in pvals.__dataclass_fields__},
+        "node_idx": encode_array(nidx),
+        "node_vals": {f: encode_array(getattr(nvals, f))
+                      for f in nvals.__dataclass_fields__},
+    }
+    if groups is not None:
+        enc["groups"] = {f: encode_array(getattr(groups, f))
+                         for f in groups.__dataclass_fields__}
+    return enc
+
+
+def decode_batch(enc: Dict[str, Any]):
+    """Inverse of :func:`encode_batch` → ``(gathered, groups)``."""
+    from escalator_tpu.core.arrays import GroupArrays, NodeArrays, PodArrays
+
+    gathered = (
+        decode_array(enc["pod_idx"]),
+        PodArrays(**{f: decode_array(v) for f, v in enc["pod_vals"].items()}),
+        decode_array(enc["node_idx"]),
+        NodeArrays(**{f: decode_array(v) for f, v in enc["node_vals"].items()}),
+    )
+    groups = None
+    if enc.get("groups") is not None:
+        groups = GroupArrays(
+            **{f: decode_array(v) for f, v in enc["groups"].items()})
+    return gathered, groups
+
+
+# ---------------------------------------------------------------------------
+# Replay executor
+# ---------------------------------------------------------------------------
+
+
+def replay_ring(entries: List[Dict[str, Any]],
+                snapshot_path: Optional[str] = None,
+                leaves=None, meta=None) -> Dict[str, Any]:
+    """Re-execute a recorded input ring from a device-state snapshot and
+    compare each tick's decision digest (and lazy-orders outcome) against
+    the recording. Returns a report dict::
+
+        {"ok": bool, "base_tick": int, "replayed": N,
+         "skipped_older": M, "divergent": [per-tick mismatches],
+         "ticks": [{"tick", "digest", "recorded_digest", "ok"}, ...]}
+
+    The refresh audit and input recording are disabled inside the replay
+    decider — both are bit-neutral, but replay must not re-record itself or
+    spend O(cluster) audits re-verifying state it just adopted. Entries at
+    or before the snapshot's tick are skipped (the ring may be longer than
+    the checkpoint gap); a gap in the remaining tick sequence is a hard
+    error — a replay over missing inputs would diverge for boring reasons
+    and mask real ones."""
+    from escalator_tpu.ops import device_state as ds
+    from escalator_tpu.ops import snapshot as snaplib
+
+    if leaves is None:
+        leaves, meta = snaplib.read_snapshot(snapshot_path)
+    base_tick = int(meta.get("tick", 0))
+    todo = sorted(
+        (e for e in entries if int(e["tick"]) > base_tick),
+        key=lambda e: int(e["tick"]))
+    skipped = len(entries) - len(todo)
+    for i, e in enumerate(todo):
+        if int(e["tick"]) != base_tick + 1 + i:
+            raise ValueError(
+                f"input ring has a gap: expected tick {base_tick + 1 + i}, "
+                f"found {e['tick']} — the ring no longer covers the span "
+                "from this snapshot (take dumps closer to a checkpoint)")
+
+    _cache, inc = ds.restore_decider(
+        leaves, meta, refresh_every=0, background=False,
+        post_restore_audit=False)
+    ticks: List[Dict[str, Any]] = []
+    divergent: List[Dict[str, Any]] = []
+    for e in todo:
+        for enc in e.get("batches", ()):
+            gathered, groups = decode_batch(enc)
+            inc.apply_gathered(gathered, groups)
+        out, ordered = inc.decide(
+            int(e["now_sec"]), bool(e["tainted_any"]), _record=False)
+        digest = decision_digest(out)
+        row = {
+            "tick": int(e["tick"]),
+            "digest": digest,
+            "recorded_digest": e.get("digest"),
+            "ordered": bool(ordered),
+            "recorded_ordered": bool(e.get("ordered")),
+            "ok": (digest == e.get("digest")
+                   and bool(ordered) == bool(e.get("ordered"))),
+        }
+        ticks.append(row)
+        if not row["ok"]:
+            divergent.append(row)
+    return {
+        "ok": not divergent,
+        "base_tick": base_tick,
+        "replayed": len(ticks),
+        "skipped_older": skipped,
+        "divergent": divergent,
+        "ticks": ticks,
+    }

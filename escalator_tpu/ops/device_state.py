@@ -411,6 +411,14 @@ class DeviceClusterCache:
         platform — see ops.kernel.native_tick_impl)."""
         return self._device
 
+    @property
+    def host_views(self):
+        """The current host-side gather views ``(pods, nodes)`` — after a
+        snapshot restore these are the snapshot's own columns, which the
+        repack backend adopts as its diff baseline (the warm start's
+        'changed since checkpoint' comparison point)."""
+        return self._host_pods, self._host_nodes
+
     def set_host(self, pods: PodArrays, nodes: NodeArrays) -> None:
         """Rebind the host-side views gathers read from. Needed when the store
         re-views its buffers (growth) or a per-tick corrected view (dry mode)
@@ -547,6 +555,33 @@ class DeviceClusterCache:
         self.__init__(host, self._device)
         return self._cluster
 
+    @classmethod
+    def adopt_resident(cls, resident: ClusterArrays,
+                       host_pods: PodArrays, host_nodes: NodeArrays,
+                       device=None) -> "DeviceClusterCache":
+        """Construct around ALREADY-RESIDENT arrays (the snapshot restore
+        path, ops/snapshot.py): the arrays carry their scratch lane and live
+        on device — no padding, no upload. ``host_pods``/``host_nodes`` seed
+        the host-side gather views (the snapshot's unpadded columns; callers
+        rebind per tick via :meth:`set_host` exactly as after ``__init__``)."""
+        self = cls.__new__(cls)
+        if device is None:
+            from escalator_tpu.jaxconfig import guarded_devices
+
+            device = guarded_devices()[0]
+        self._device = device
+        self._host_pods = host_pods
+        self._host_nodes = host_nodes
+        self.pod_capacity = int(host_pods.valid.shape[0])
+        self.node_capacity = int(host_nodes.valid.shape[0])
+        if (int(resident.pods.valid.shape[0]) != self.pod_capacity + 1
+                or int(resident.nodes.valid.shape[0]) != self.node_capacity + 1):
+            raise ValueError(
+                "adopt_resident: resident arrays must carry exactly one "
+                "scratch lane over the host capacity")
+        self._cluster = resident
+        return self
+
 
 class AggregateParityError(AssertionError):
     """The incrementally maintained aggregates diverged from a from-scratch
@@ -675,7 +710,8 @@ class IncrementalDecider:
                  background: Optional[bool] = None,
                  incremental_orders: bool = True,
                  order_repair_max_dirty_frac: float = 0.25,
-                 overlap: bool = False):
+                 overlap: bool = False,
+                 aggregates=None):
         import os
 
         if on_mismatch not in ("raise", "repair"):
@@ -701,7 +737,12 @@ class IncrementalDecider:
         self._incremental_orders = bool(incremental_orders)
         self._order_repair_max_dirty_frac = float(order_repair_max_dirty_frac)
         self._overlap = bool(overlap)
-        self._aggs = _kernel.compute_aggregates_jit(cache.cluster, impl=impl)
+        # restore path (ops/snapshot.py): inject the snapshot's maintained
+        # aggregates instead of paying the O(cluster) bootstrap recompute —
+        # the whole point of a warm start
+        self._aggs = (aggregates if aggregates is not None
+                      else _kernel.compute_aggregates_jit(cache.cluster,
+                                                          impl=impl))
         self._prev_cols = None   # tuple in kernel.GROUP_DECISION_FIELDS order
         self._order_state = None  # (major, k1, k2, perm) — ops.order_tail
         #: order_update_jit's static compaction width: power-of-two growth
@@ -712,6 +753,22 @@ class IncrementalDecider:
         self._snap_ready = None   # Event: in-flight audit's snapshot frozen
         self._ticks = 0
         self._dirty_counted_tick = -1
+        #: apply_gathered batches pending attachment to this tick's input
+        #: record (observability/replay.py; empty unless recording is on)
+        self._replay_pending: list = []
+        # a NEW decider is a new replay epoch: its tick counter restarts
+        # (cold/rebuild) or rewinds to a snapshot (restore), so entries
+        # recorded by a previous decider in this process would mix two
+        # epochs with overlapping tick numbers into one ring — a dump of
+        # that is unreplayable at best, silently divergent at worst. The
+        # ring describes exactly ONE decider's history.
+        from escalator_tpu.observability import replay as _replay
+
+        if _replay.INPUT_LOG.enabled():
+            _replay.INPUT_LOG.clear()
+        #: True when this decider warm-started from a snapshot (flight
+        #: records carry it; the failover soak asserts on it)
+        self.restored = False
         self.last_dirty_count = 0
         self.last_order_dirty_count = 0
         self.last_decide_synced = False
@@ -728,6 +785,13 @@ class IncrementalDecider:
         """Scatter a ``cache.gather_deltas`` batch into the resident arrays
         while maintaining the aggregates + dirty mask. Replaces the plain
         ``cache.apply_gathered`` in an incremental tick."""
+        from escalator_tpu.observability import replay as _replay
+
+        if _replay.INPUT_LOG.enabled():
+            # capture BEFORE the dispatch: the scatter donates the resident
+            # buffers, but the gathered batch itself is host numpy — encode
+            # is a pure copy (a few KB at production churn)
+            self._replay_pending.append(_replay.encode_batch(gathered, groups))
         self._await_snapshot()   # the scatter DONATES the live buffers
         cluster, self._aggs = self._cache.apply_gathered_with_aggregates(
             gathered, groups, self._aggs)
@@ -737,12 +801,16 @@ class IncrementalDecider:
         self._prev_cols = tuple(
             getattr(out, f) for f in _kernel.GROUP_DECISION_FIELDS)
 
-    def decide(self, now_sec, tainted_any: bool):
+    def decide(self, now_sec, tainted_any: bool, _record: bool = True):
         """One lazy-orders tick (``kernel.lazy_orders_decide``) over the
         incremental dispatch pair. Returns ``(DecisionArrays, ordered)``
         with the protocol's exact semantics: when ``ordered`` is False the
         order fields are input-order placeholders and no window may be
-        read."""
+        read.
+
+        ``_record=False`` suppresses input recording for this tick — the
+        replay executor's own decides must not re-record themselves into
+        the ring they are replaying."""
         self._ticks += 1
         # repaired ordered-incremental ticks read a scalar AFTER the fused
         # program (see _order_finish) so the device is idle by the time the
@@ -755,6 +823,16 @@ class IncrementalDecider:
         # pick up a finished background audit first: its verdict (and a
         # raise/repair) lands at the tick boundary, never mid-dispatch
         self._reconcile_audit(block=False)
+        from escalator_tpu.chaos import CHAOS
+
+        if CHAOS.should_fire("audit_mismatch"):
+            # chaos: corrupt ONE maintained aggregate lane on device so the
+            # next cadence audit must detect (and raise/repair) a REAL
+            # divergence between the maintained state and the recompute
+            self._aggs = replace(
+                self._aggs,
+                node_pods_remaining=self._aggs.node_pods_remaining.at[0].add(1),
+            )
         audit_due = bool(
             self._refresh_every and self._ticks % self._refresh_every == 0)
         if audit_due and not self._background:
@@ -800,6 +878,10 @@ class IncrementalDecider:
             return out
 
         result = _kernel.lazy_orders_decide(dispatch, tainted_any)
+        if _record:
+            self._record_tick_inputs(result, now, tainted_any)
+        else:
+            self._replay_pending = []
         if audit_due and self._background:
             # kicked AFTER the dispatch, not before it: the decide mutates
             # neither the resident cluster nor the aggregate sum columns
@@ -810,6 +892,27 @@ class IncrementalDecider:
             # tick's decide
             self._start_background_audit()
         return result
+
+    def _record_tick_inputs(self, result, now, tainted_any: bool) -> None:
+        """Attach this tick's inputs (the pending scatter batches) + outcome
+        (lazy-orders flag, crc32 decision digest) to the input log — the
+        record/replay half of the round-11 tentpole. No-op (and O(1)) when
+        recording is off; when on, the digest read synchronizes on the
+        decide output, which the documented debug mode accepts."""
+        from escalator_tpu.observability import replay as _replay
+
+        pending, self._replay_pending = self._replay_pending, []
+        if not _replay.INPUT_LOG.enabled():
+            return
+        out, ordered = result
+        _replay.INPUT_LOG.record({
+            "tick": self._ticks,
+            "now_sec": int(now),
+            "tainted_any": bool(tainted_any),
+            "ordered": bool(ordered),
+            "digest": _replay.decision_digest(out),
+            "batches": pending,
+        })
 
     def _note_dirty(self, dirty_mask: np.ndarray) -> None:
         """Record the tick's consumed dirty-group count ONCE: a lazy-orders
@@ -1067,6 +1170,8 @@ class IncrementalDecider:
         thread."""
         from escalator_tpu import observability as obs
 
+        from escalator_tpu.chaos import CHAOS
+
         with obs.span("refresh_audit_bg", kind="device"):
             try:
                 with obs.span("audit_snapshot", kind="device"):
@@ -1074,6 +1179,10 @@ class IncrementalDecider:
                         _audit_snapshot(cluster, aggs))
             finally:
                 snap_ready.set()
+            # chaos: worker-thread death AFTER the snapshot gate released —
+            # the tick thread must never deadlock on a dead worker, and the
+            # reconcile path must degrade to the synchronous audit
+            CHAOS.inject("audit_worker")
             fresh = obs.fence(_kernel.compute_aggregates_jit(
                 snap_cluster, impl=self._impl))
             mismatched = self._mismatched_columns(snap_aggs, fresh)
@@ -1091,7 +1200,31 @@ class IncrementalDecider:
         if fut is None or (not block and not fut.done()):
             return
         self._audit_future = None
-        mismatched = fut.result()   # a worker exception propagates here
+        try:
+            mismatched = fut.result()
+        except Exception:
+            # worker-thread death (round 11 hardening): before this, a dead
+            # audit worker crashed the NEXT tick with the worker's traceback
+            # — an observability thread taking down the control loop. Now it
+            # degrades: count it, dump the ring (the ticks around the death
+            # are the post-mortem), and re-run the audit SYNCHRONOUSLY so
+            # the verdict this cadence point owed still lands with the exact
+            # raise/repair semantics. The sync form reads the CURRENT
+            # resident cluster — one audit-latency later than the dead
+            # worker's snapshot, which the cadence contract permits.
+            from escalator_tpu.metrics import metrics
+
+            metrics.audit_worker_failures.inc()
+            from escalator_tpu import observability as obs
+
+            dump_path = obs.dump_on_incident("audit-worker-death")
+            logging.getLogger("escalator_tpu.device_state").error(
+                "background refresh-audit worker died; degrading to the "
+                "synchronous audit (flight record: %s)",
+                dump_path or "dump failed", exc_info=True)
+            obs.annotate(refresh_audit="worker-died")
+            self.refresh()
+            return
         self.last_audit_ok = not mismatched
         if mismatched:
             self._raise_or_repair(mismatched)
@@ -1103,3 +1236,120 @@ class IncrementalDecider:
         passed, or no audit has ever run)."""
         self._reconcile_audit(block=True)
         return self.last_audit_ok
+
+    # -- snapshot / restore (round 11) --------------------------------------
+
+    def snapshot_state(self):
+        """Freeze the persistent device state — resident cluster, maintained
+        aggregates, the 13 decision columns, the order state — into host
+        arrays ready for :func:`escalator_tpu.ops.snapshot.write_snapshot`.
+        Returns ``(leaves, meta)``, or None before the first decide (there
+        is no decision state worth persisting yet).
+
+        The freeze is the audit double buffer's construction generalized
+        (``snapshot._freeze_state``): one device program of pure on-device
+        copies, no donation — safe to run concurrently with an in-flight
+        background audit (neither donates) and consistent by construction
+        when called at a tick boundary, which every caller
+        (:class:`~escalator_tpu.ops.snapshot.SnapshotWriter` per tick,
+        tests) does. The host copy of the frozen buffers is the method's
+        only blocking cost."""
+        from escalator_tpu.ops import snapshot as snaplib
+
+        if self._prev_cols is None:
+            return None
+        from escalator_tpu import observability as obs
+
+        with obs.span("snapshot_freeze", kind="device"):
+            frozen = obs.fence(snaplib.freeze_state(
+                (self._cache.cluster, self._aggs, self._prev_cols,
+                 self._order_state)))
+        cluster_f, aggs_f, cols_f, order_f = frozen
+        leaves = snaplib.state_to_leaves(cluster_f, aggs_f, cols_f, order_f)
+        meta = {
+            "tick": self._ticks,
+            "order_bucket": self._order_bucket,
+            "pod_capacity": self._cache.pod_capacity,
+            "node_capacity": self._cache.node_capacity,
+            "num_groups": int(np.asarray(aggs_f.dirty).shape[0]),
+            "impl": self._impl,
+        }
+        return leaves, meta
+
+
+def restore_decider(leaves, meta, device=None, impl: "str | None" = None,
+                    refresh_every: "Optional[int | str]" = None,
+                    on_mismatch: str = "repair",
+                    background: Optional[bool] = None,
+                    incremental_orders: bool = True,
+                    overlap: bool = False,
+                    post_restore_audit: bool = True):
+    """Warm-start a ``(DeviceClusterCache, IncrementalDecider)`` pair from a
+    snapshot's ``(leaves, meta)`` (ops/snapshot.py) — the standby leader's
+    O(1)-tick restore path. Costs ONE H2D upload of the state (the donated
+    ``snapshot.restore_adopt`` makes the device-side handover copy-free);
+    performs NO re-list, NO aggregate recompute, NO decide.
+
+    ``post_restore_audit=True`` (the default everywhere but replay) kicks
+    the background refresh audit immediately: the worker recomputes the
+    aggregates from the restored cluster and bit-compares against the
+    restored maintained state, so a corrupted-but-crc-valid snapshot (or a
+    serializer bug) is detected within one audit latency with the standard
+    raise/repair semantics — the restore's bit-exactness is self-checking,
+    not assumed.
+
+    Raises :class:`~escalator_tpu.ops.snapshot.SnapshotCorruptError` on
+    structural violations the crc pass cannot see (missing leaves, shape
+    inconsistencies, an order state that is not a permutation)."""
+    from escalator_tpu import observability as obs
+    from escalator_tpu.ops import snapshot as snaplib
+
+    with obs.span("restore", kind="device"):
+        cluster, aggs, prev_cols, order_state = snaplib.leaves_to_state(leaves)
+        P1 = int(cluster.pods.valid.shape[0])
+        N1 = int(cluster.nodes.valid.shape[0])
+        G = int(cluster.groups.valid.shape[0])
+        if (int(meta.get("pod_capacity", -1)) != P1 - 1
+                or int(meta.get("node_capacity", -1)) != N1 - 1
+                or int(meta.get("num_groups", -1)) != G):
+            raise snaplib.SnapshotCorruptError(
+                "snapshot meta capacities disagree with its array shapes: "
+                f"meta={meta!r} vs pods[{P1}] nodes[{N1}] groups[{G}]")
+        if order_state is not None:
+            from escalator_tpu.ops.order_tail import validate_order_state
+
+            try:
+                validate_order_state(*order_state, num_lanes=N1)
+            except ValueError as e:
+                raise snaplib.SnapshotCorruptError(
+                    f"snapshot order state invalid: {e}") from e
+        # host gather views: the unpadded leading lanes of the snapshot's
+        # own columns (callers rebind live views via set_host per tick)
+        host_pods = type(cluster.pods)(**{
+            f.name: getattr(cluster.pods, f.name)[:P1 - 1]
+            for f in fields(type(cluster.pods))})
+        host_nodes = type(cluster.nodes)(**{
+            f.name: getattr(cluster.nodes, f.name)[:N1 - 1]
+            for f in fields(type(cluster.nodes))})
+        with obs.span("restore_upload", kind="device"):
+            resident = obs.fence(snaplib.restore_adopt(
+                (cluster, aggs, prev_cols, order_state), device=device))
+        r_cluster, r_aggs, r_cols, r_order = resident
+        cache = DeviceClusterCache.adopt_resident(
+            r_cluster, host_pods, host_nodes, device=device)
+        inc = IncrementalDecider(
+            cache, impl=impl if impl is not None else meta.get("impl", "xla"),
+            refresh_every=refresh_every, on_mismatch=on_mismatch,
+            background=background, incremental_orders=incremental_orders,
+            overlap=overlap, aggregates=r_aggs)
+        inc._prev_cols = tuple(r_cols)
+        inc._order_state = tuple(r_order) if r_order is not None else None
+        inc._order_bucket = int(meta.get("order_bucket", inc._order_bucket))
+        inc._ticks = int(meta.get("tick", 0))
+        inc.restored = True
+        obs.annotate(restored=True, restored_tick=inc._ticks)
+        if post_restore_audit:
+            # bit-exactness of the restored aggregates vs a recompute of the
+            # restored cluster, verified off the critical path
+            inc._start_background_audit()
+    return cache, inc

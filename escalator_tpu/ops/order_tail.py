@@ -621,6 +621,41 @@ def order_update_jit(group_emptiest, node_valid, node_group, node_tainted,
         perm_old, tainted_offsets, bucket)
 
 
+#: The persistent order-state tuple's field names, in tuple order — the
+#: serialization contract ops/snapshot.py persists a decider's order state
+#: under (``order.major`` ... ``order.perm``). Everything that packs or
+#: unpacks the ``(major, k1, k2, perm)`` tuple by position iterates THIS,
+#: so a field added to the order state breaks loudly at the snapshot layer
+#: instead of silently truncating a restore.
+ORDER_STATE_FIELDS = ("major", "k1", "k2", "perm")
+
+
+def validate_order_state(major, k1, k2, perm, num_lanes: int) -> None:
+    """Host-side structural validation of a DESERIALIZED order state (the
+    snapshot restore path): per-column shape/dtype against the resident
+    contract, and ``perm`` must actually be a permutation of the lane
+    indices — a corrupted-but-crc-valid permutation would otherwise gather
+    garbage lanes into every ordered window until the next full-sort
+    fallback. O(N log N) host work, paid once per restore. Raises
+    ``ValueError`` naming the violation."""
+    cols = {"major": (major, np.int64), "k1": (k1, np.int64),
+            "k2": (k2, np.int64), "perm": (perm, np.int32)}
+    for name, (col, want_dtype) in cols.items():
+        arr = np.asarray(col)
+        if arr.shape != (num_lanes,):
+            raise ValueError(
+                f"order state column {name!r} has shape {arr.shape}, "
+                f"expected ({num_lanes},)")
+        if arr.dtype != want_dtype:
+            raise ValueError(
+                f"order state column {name!r} has dtype {arr.dtype}, "
+                f"expected {np.dtype(want_dtype)}")
+    if not np.array_equal(np.sort(np.asarray(perm)),
+                          np.arange(num_lanes, dtype=np.int32)):
+        raise ValueError("order state perm is not a permutation of the "
+                         f"{num_lanes} lane indices")
+
+
 __all__: Sequence[str] = (
     "order_sort_keys",
     "combined_order_sort",
@@ -632,4 +667,6 @@ __all__: Sequence[str] = (
     "order_sort_jit",
     "order_repair_jit",
     "order_update_jit",
+    "ORDER_STATE_FIELDS",
+    "validate_order_state",
 )

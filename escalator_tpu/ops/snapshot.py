@@ -1,0 +1,401 @@
+"""Device-state snapshot/restore: failover-grade persistence of the
+incremental decide's truth.
+
+Since round 8 the system of record for a running controller lives in device
+HBM — the resident :class:`~escalator_tpu.core.arrays.ClusterArrays`, the
+delta-maintained :class:`~escalator_tpu.ops.kernel.GroupAggregates`, the 13
+persistent ``[G]`` decision columns, and (round 10) the persistent order
+state. Until round 11 nothing survived a process death except a full
+re-list + full recompute. This module is the persistence layer:
+
+- **Freeze** (:func:`freeze_state`): ONE jitted device program of pure
+  on-device copies — the same ``_fresh_buffer`` construction as the PR-5
+  audit double buffer (``device_state._audit_snapshot``), extended to the
+  decision columns and order state. No donation, no collectives, no host
+  callbacks (jaxlint entry ``snapshot.freeze``): the live buffers stay
+  valid and keep mutating under subsequent ticks while the frozen copy is
+  serialized.
+- **File format** (:func:`write_snapshot` / :func:`read_snapshot`): a
+  single self-describing binary — JSON header (version, meta, per-leaf
+  dtype/shape/offset/crc32) + raw column payload — written tmp + fsync +
+  atomic rename, so a checkpoint racing a SIGKILL can never strand a
+  half-written file where the standby will look. Every read validates
+  magic, version, payload length and per-leaf crc32; any violation raises
+  :class:`SnapshotCorruptError` and the caller falls back to a cold start.
+- **Adopt** (:func:`restore_adopt`): the restore side's device program — a
+  donated identity over the uploaded leaves. The donation is the point:
+  the host-staged upload buffers become the resident state with zero extra
+  HBM copies (jaxlint entry ``snapshot.restore_adopt`` verifies the
+  aliasing survived lowering), so restore costs one H2D transfer, never a
+  recompute.
+- **Checkpoint cadence** (:class:`SnapshotWriter`): the tick thread pays
+  only the freeze (an on-device copy program) and the D2H read of the
+  frozen buffers; serialization + disk I/O run on a single worker thread,
+  so a checkpoint tick never blocks on the filesystem.
+
+The snapshot's *consistency* is inherited from the freeze point: callers
+snapshot at a tick boundary (after reconcile, before the next scatter), so
+the file is exactly the state a standby needs to warm-start in O(1) ticks —
+adopt the resident state, then let the normal delta path fold in whatever
+changed while the leader was dead. docs/ha.md carries the operator view.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import zlib
+from dataclasses import fields
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+from escalator_tpu.jaxconfig import ensure_x64
+from escalator_tpu.utils.atomicio import atomic_write
+
+ensure_x64()
+
+import jax
+from jax import tree_util
+
+log = logging.getLogger("escalator_tpu.snapshot")
+
+#: file magic + format version. Version bumps whenever the leaf naming or
+#: header schema changes incompatibly; readers reject unknown versions
+#: (a standby must never adopt state it can misinterpret).
+SNAPSHOT_MAGIC = b"ESCSNAP\n"
+SNAPSHOT_VERSION = 1
+
+#: the rolling checkpoint name a standby looks for (atomic-replace target)
+LATEST_NAME = "state-latest.snap"
+
+
+class SnapshotCorruptError(RuntimeError):
+    """The snapshot file failed validation (bad magic/version, truncated
+    payload, or a leaf whose bytes no longer match their recorded crc32).
+    Callers treat this as 'no snapshot': cold start + flight dump."""
+
+
+# ---------------------------------------------------------------------------
+# Device programs
+# ---------------------------------------------------------------------------
+
+
+def _fresh_buffer(x):
+    """An op XLA cannot alias back into the input buffer (no donation is
+    declared) — shared construction with the audit double buffer
+    (``device_state._audit_snapshot``)."""
+    import jax.numpy as jnp
+
+    if x.dtype == jnp.bool_:
+        return x ^ False
+    return x + jnp.zeros((), x.dtype)
+
+
+@jax.jit
+def _freeze_state(state_tree):
+    """Freeze an arbitrary pytree of device arrays into fresh buffers: one
+    device program, no host sync, no donation — the snapshot analog of the
+    audit double buffer, generalized to (cluster, aggs, cols, order).
+    Registered with jaxlint as ``snapshot.freeze``: zero collectives, zero
+    host callbacks, donation explicitly ABSENT (aliasing an input would let
+    the next tick's donating scatter corrupt the frozen copy mid-write)."""
+    return tree_util.tree_map(_fresh_buffer, state_tree)
+
+
+def freeze_state(state_tree):
+    """Public freeze entry: dispatches :func:`_freeze_state` (async). The
+    caller owns fencing — :meth:`SnapshotWriter.checkpoint` reads the frozen
+    leaves back to host, which synchronizes naturally."""
+    return _freeze_state(state_tree)
+
+
+def _adopt_body(state_tree):
+    """Adopt uploaded host buffers as the resident device state: a DONATED
+    identity. XLA aliases every output to its donated input
+    (``tf.aliasing_output`` — jaxlint entry ``snapshot.restore_adopt``
+    verifies it survives lowering), so adoption moves zero bytes in HBM;
+    the restore's only real cost is the H2D upload that staged the leaves.
+    The donation also makes the handover explicit: after this call the
+    staging references are dead and the returned tree is the single owner —
+    exactly the protocol every other persistent-state program in
+    ops/device_state.py follows."""
+    return state_tree
+
+
+_restore_adopt = jax.jit(_adopt_body, donate_argnums=(0,))
+
+
+def restore_adopt(state_tree, device=None):
+    """Device-put + adopt a host-side state tree; returns resident arrays.
+    One H2D transfer, zero device-side copies (see :func:`_restore_adopt`)."""
+    staged = (jax.device_put(state_tree, device) if device is not None
+              else jax.device_put(state_tree))
+    return _restore_adopt(staged)
+
+
+# ---------------------------------------------------------------------------
+# Serialization: one self-describing binary file
+# ---------------------------------------------------------------------------
+
+
+def _leaf_bytes(arr: np.ndarray) -> bytes:
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def write_snapshot(path: str, leaves: Mapping[str, np.ndarray],
+                   meta: Optional[Dict[str, Any]] = None) -> str:
+    """Serialize named leaves + meta to ``path`` atomically (tmp in the same
+    directory + flush + fsync + rename — the crash-consistency recipe the
+    flight recorder and the election lease share after round 11). Layout::
+
+        ESCSNAP\\n  [8-byte big-endian header length]  [header JSON]  [payload]
+
+    The header carries version, meta, and per-leaf (dtype, shape, offset,
+    nbytes, crc32); the payload is the concatenated raw column bytes.
+    Integer/bool round-trips are exact by construction; there are no float
+    leaves anywhere in the persisted state except the two [G] percent
+    columns, whose float64 bytes round-trip bit-exactly too."""
+    meta = dict(meta or {})
+    header: Dict[str, Any] = {
+        "version": SNAPSHOT_VERSION,
+        "created_unix": round(time.time(), 3),
+        "meta": meta,
+        "leaves": [],
+    }
+    payload_parts = []
+    offset = 0
+    for key in sorted(leaves):
+        arr = np.asarray(leaves[key])
+        raw = _leaf_bytes(arr)
+        header["leaves"].append({
+            "key": key,
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "offset": offset,
+            "nbytes": len(raw),
+            "crc32": zlib.crc32(raw),
+        })
+        payload_parts.append(raw)
+        offset += len(raw)
+    header["payload_bytes"] = offset
+    header_raw = json.dumps(header).encode()
+
+    def emit(f):
+        f.write(SNAPSHOT_MAGIC)
+        f.write(len(header_raw).to_bytes(8, "big"))
+        f.write(header_raw)
+        for raw in payload_parts:
+            f.write(raw)
+
+    return atomic_write(path, emit)
+
+
+def read_snapshot(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Load + validate a snapshot file. Returns ``(leaves, meta)``; raises
+    :class:`SnapshotCorruptError` on ANY integrity violation (bad magic,
+    unknown version, truncated header/payload, per-leaf crc mismatch) and
+    ``FileNotFoundError`` when the file simply is not there — the two cases
+    callers handle differently (corrupt dumps a flight record; absent is
+    the normal first boot)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(SNAPSHOT_MAGIC):
+        raise SnapshotCorruptError(f"{path}: bad magic")
+    off = len(SNAPSHOT_MAGIC)
+    if len(blob) < off + 8:
+        raise SnapshotCorruptError(f"{path}: truncated header length")
+    hlen = int.from_bytes(blob[off:off + 8], "big")
+    off += 8
+    if len(blob) < off + hlen:
+        raise SnapshotCorruptError(f"{path}: truncated header")
+    try:
+        header = json.loads(blob[off:off + hlen])
+    except ValueError as e:
+        raise SnapshotCorruptError(f"{path}: unparseable header: {e}") from e
+    if header.get("version") != SNAPSHOT_VERSION:
+        raise SnapshotCorruptError(
+            f"{path}: unsupported snapshot version {header.get('version')!r}"
+            f" (reader supports {SNAPSHOT_VERSION})")
+    payload = blob[off + hlen:]
+    if len(payload) != int(header.get("payload_bytes", -1)):
+        raise SnapshotCorruptError(
+            f"{path}: payload is {len(payload)} bytes, header declares "
+            f"{header.get('payload_bytes')} — truncated or overlong")
+    leaves: Dict[str, np.ndarray] = {}
+    for spec in header["leaves"]:
+        raw = payload[spec["offset"]:spec["offset"] + spec["nbytes"]]
+        if len(raw) != spec["nbytes"]:
+            raise SnapshotCorruptError(
+                f"{path}: leaf {spec['key']!r} truncated")
+        if zlib.crc32(raw) != spec["crc32"]:
+            raise SnapshotCorruptError(
+                f"{path}: leaf {spec['key']!r} failed its crc32 check")
+        leaves[spec["key"]] = np.frombuffer(
+            raw, dtype=np.dtype(spec["dtype"])).reshape(spec["shape"]).copy()
+    return leaves, dict(header.get("meta", {}))
+
+
+def latest_path(directory: str) -> str:
+    """The rolling checkpoint path a standby probes at warm start."""
+    return os.path.join(directory, LATEST_NAME)
+
+
+# ---------------------------------------------------------------------------
+# Leaf naming: the (cluster, aggs, cols, order) <-> flat-dict contract
+# ---------------------------------------------------------------------------
+
+
+def state_to_leaves(cluster, aggs, prev_cols, order_state) -> Dict[str, np.ndarray]:
+    """Flatten host-side (or frozen device) state into the named-leaf dict
+    the file format serializes. Naming is THE restore contract:
+    ``cluster.<section>.<field>``, ``aggs.<field>``, ``col.<name>`` (in
+    ``kernel.GROUP_DECISION_FIELDS``), ``order.<major|k1|k2|perm>``
+    (absent when no order state exists yet)."""
+    from escalator_tpu.ops import kernel as _kernel
+
+    leaves: Dict[str, np.ndarray] = {}
+    for section in ("groups", "pods", "nodes"):
+        soa = getattr(cluster, section)
+        for f in fields(type(soa)):
+            leaves[f"cluster.{section}.{f.name}"] = np.asarray(
+                getattr(soa, f.name))
+    for f in fields(type(aggs)):
+        leaves[f"aggs.{f.name}"] = np.asarray(getattr(aggs, f.name))
+    for name, col in zip(_kernel.GROUP_DECISION_FIELDS, prev_cols,
+                         strict=True):
+        leaves[f"col.{name}"] = np.asarray(col)
+    if order_state is not None:
+        from escalator_tpu.ops.order_tail import ORDER_STATE_FIELDS
+
+        for name, col in zip(ORDER_STATE_FIELDS, order_state, strict=True):
+            leaves[f"order.{name}"] = np.asarray(col)
+    return leaves
+
+
+def leaves_to_state(leaves: Mapping[str, np.ndarray]):
+    """Inverse of :func:`state_to_leaves`: host-side ``(ClusterArrays,
+    GroupAggregates, prev_cols tuple, order_state or None)``. A missing
+    required leaf raises :class:`SnapshotCorruptError` with its name —
+    mixed-version drift must be a named error, not a KeyError deep in jit."""
+    from escalator_tpu.core.arrays import (
+        ClusterArrays,
+        GroupArrays,
+        NodeArrays,
+        PodArrays,
+    )
+    from escalator_tpu.ops import kernel as _kernel
+    from escalator_tpu.ops.order_tail import ORDER_STATE_FIELDS
+
+    def need(key: str) -> np.ndarray:
+        try:
+            return np.asarray(leaves[key])
+        except KeyError:
+            raise SnapshotCorruptError(
+                f"snapshot is missing required leaf {key!r}") from None
+
+    def soa(cls, section: str):
+        return cls(**{f.name: need(f"cluster.{section}.{f.name}")
+                      for f in fields(cls)})
+
+    cluster = ClusterArrays(
+        groups=soa(GroupArrays, "groups"),
+        pods=soa(PodArrays, "pods"),
+        nodes=soa(NodeArrays, "nodes"),
+    )
+    aggs = _kernel.GroupAggregates(
+        **{f.name: need(f"aggs.{f.name}")
+           for f in fields(_kernel.GroupAggregates)})
+    prev_cols = tuple(
+        need(f"col.{name}") for name in _kernel.GROUP_DECISION_FIELDS)
+    order_state = None
+    if any(k.startswith("order.") for k in leaves):
+        order_state = tuple(
+            need(f"order.{name}") for name in ORDER_STATE_FIELDS)
+    return cluster, aggs, prev_cols, order_state
+
+
+# ---------------------------------------------------------------------------
+# Periodic async checkpoints
+# ---------------------------------------------------------------------------
+
+
+class SnapshotWriter:
+    """Rolling checkpoint writer for one :class:`IncrementalDecider`.
+
+    ``maybe_checkpoint(inc)`` is called once per tick (backends do this
+    right after the decide): on the cadence tick it freezes the decider's
+    persistent state (an on-device copy program + the D2H read — the only
+    on-path cost) and hands serialization + the atomic file write to a
+    single worker thread, so the tick never blocks on disk. The write
+    target is always :data:`LATEST_NAME` in ``directory`` via atomic
+    replace: a standby probes exactly one path, and a kill at any moment
+    leaves either the previous or the new checkpoint — never a torn one.
+
+    ``every`` is a tick cadence (``0`` disables). The writer never raises
+    into the tick: a failed write logs + counts, and the previous
+    checkpoint stays valid."""
+
+    def __init__(self, directory: str, every: int = 64):
+        self.directory = directory
+        self.every = int(every)
+        self.path = latest_path(directory)
+        self.checkpoints = 0
+        self.failures = 0
+        self._pool = None
+        self._pending = None
+        self._ticks_seen = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def maybe_checkpoint(self, inc, force: bool = False) -> bool:
+        """Checkpoint when the cadence says so (or ``force``). Returns True
+        when a checkpoint was STARTED this call."""
+        self._ticks_seen += 1
+        if not force and (
+                self.every <= 0 or self._ticks_seen % self.every != 0):
+            return False
+        state = inc.snapshot_state()
+        if state is None:   # nothing decided yet: nothing worth persisting
+            return False
+        leaves, meta = state
+        self._submit(leaves, meta)
+        return True
+
+    def _submit(self, leaves: Dict[str, np.ndarray],
+                meta: Dict[str, Any]) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="escalator-tpu-snapshot")
+        if self._pending is not None and not self._pending.done():
+            # a previous write still in flight at the next cadence point
+            # (slow disk): finish it first so writes stay ordered and at
+            # most one serialized copy of the state exists at a time
+            self._drain_pending()
+        self._pending = self._pool.submit(self._write, leaves, meta)
+
+    def _write(self, leaves, meta) -> Optional[str]:
+        from escalator_tpu.metrics import metrics
+
+        try:
+            path = write_snapshot(self.path, leaves, meta)
+        except OSError as e:
+            self.failures += 1
+            log.error("snapshot checkpoint write failed: %s", e)
+            return None
+        self.checkpoints += 1
+        metrics.snapshot_checkpoints.inc()
+        log.debug("snapshot checkpoint -> %s", path)
+        return path
+
+    def _drain_pending(self) -> None:
+        if self._pending is not None:
+            self._pending.result()
+            self._pending = None
+
+    def drain(self) -> None:
+        """Block until any in-flight write lands (tests, clean shutdown)."""
+        self._drain_pending()

@@ -4,17 +4,41 @@
 ships one columnar frame to the plugin service, and unpacks the decision frame. When
 the service is unreachable (or a call fails), it falls back to a local backend —
 the north-star requirement ("controller calls the TPU solver over a local gRPC shim
-and falls back to the existing CPU path when no device is present")."""
+and falls back to the existing CPU path when no device is present").
+
+Round 11 hardened the degradation ladder (previously: one flat 10 s timeout
+and an immediate per-call fallback on any ``grpc.RpcError``):
+
+1. **Bounded retries** (:class:`RetryPolicy`): each decide gets up to
+   ``max_attempts`` RPC tries with a per-attempt deadline and exponential
+   backoff + jitter between them, all under one total budget — a transient
+   server restart no longer costs a whole degraded tick, and a herd of
+   controllers retrying a recovering plugin doesn't resynchronize into it.
+2. **Fallback with attribution**: only after retries exhaust does the local
+   fallback run, counted per status code in
+   ``escalator_tpu_plugin_fallback_total{code}`` (the alertable signal the
+   silent log line lacked).
+3. **Circuit breaker**: ``breaker_threshold`` consecutive decide failures
+   pin the backend to the fallback — no RPC attempt, no retry latency on
+   every tick of an extended outage — until a probe tick
+   (every ``breaker_probe_after`` ticks) finds the plugin answering again
+   and closes the circuit. Probes use a single attempt so a still-dead
+   plugin costs one deadline, not a full retry ladder.
+"""
 
 from __future__ import annotations
 
 import logging
+import random
+import time
+from dataclasses import dataclass
 from typing import Optional
 
 import grpc
 import msgpack
 
 from escalator_tpu import observability as obs
+from escalator_tpu.chaos import CHAOS
 from escalator_tpu.controller.backend import (
     ComputeBackend,
     GoldenBackend,
@@ -23,19 +47,84 @@ from escalator_tpu.controller.backend import (
     _decision_digest,
     _unpack,
 )
+from escalator_tpu.metrics import metrics
 from escalator_tpu.plugin import codec
 from escalator_tpu.plugin.server import SERVICE_NAME
 
 log = logging.getLogger("escalator_tpu.plugin")
+
+#: status codes worth retrying: the server may be restarting (UNAVAILABLE),
+#: momentarily slow (DEADLINE_EXCEEDED), or shedding load (RESOURCE_EXHAUSTED).
+#: Anything else — a codec error, an application failure — would fail the
+#: same way again, so it goes straight to the fallback.
+RETRYABLE_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+)
+
+
+@dataclass
+class RetryPolicy:
+    """Per-decide RPC retry envelope. A worst-case decide is bounded at
+    roughly ``total_deadline_sec`` — comfortably inside a scan interval —
+    while a transient blip costs one backoff step (~50 ms). The default
+    per-attempt deadline equals the total budget, so a SLOW server (cold
+    jit compile on its first decide) behaves exactly like the pre-round-11
+    flat timeout — one attempt, then fallback — and the ladder engages on
+    fast failures (UNAVAILABLE during a restart). Deployments that prefer
+    retrying timeouts too set ``rpc_timeout_sec`` below the total."""
+
+    max_attempts: int = 3
+    rpc_timeout_sec: float = 10.0       # per-attempt deadline
+    total_deadline_sec: float = 10.0    # whole-decide budget incl. backoffs
+    base_backoff_sec: float = 0.05
+    backoff_multiplier: float = 2.0
+    max_backoff_sec: float = 1.0
+    jitter_frac: float = 0.5            # uniform [0, frac] added per sleep
+
+
+class _InjectedRpcError(grpc.RpcError):
+    """Chaos-injected RPC failure: carries a code like the real thing so the
+    retry/breaker ladder treats it identically."""
+
+    def __init__(self, code: grpc.StatusCode):
+        super().__init__(f"chaos-injected {code.name}")
+        self._code = code
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+
+def _rpc_code(err) -> "grpc.StatusCode | None":
+    code = getattr(err, "code", None)
+    if callable(code):
+        try:
+            return code()
+        except Exception:  # noqa: BLE001 - a broken stub error has no code
+            return None
+    return None
+
+
+def _chaos_rpc_attempt() -> None:
+    """The plugin_rpc chaos site: raise a synthetic retryable error before
+    the real RPC goes out (``code=`` rule param picks the status)."""
+    if CHAOS.should_fire("plugin_rpc"):
+        name = CHAOS.params("plugin_rpc").get("code", "unavailable").upper()
+        raise _InjectedRpcError(getattr(grpc.StatusCode, name,
+                                        grpc.StatusCode.UNAVAILABLE))
 
 
 class ComputeClient:
     """Thin RPC wrapper. bytes in / bytes out, codec at the edges."""
 
     def __init__(self, address: str = "127.0.0.1:50551",
-                 timeout_sec: float = 10.0):
+                 timeout_sec: float = 10.0,
+                 retry: Optional[RetryPolicy] = None):
         self.address = address
         self.timeout_sec = timeout_sec
+        self.retry = retry or RetryPolicy(rpc_timeout_sec=timeout_sec,
+                                          total_deadline_sec=timeout_sec)
         self._channel = grpc.insecure_channel(
             address,
             options=[
@@ -68,18 +157,68 @@ class ComputeClient:
 
         return json.loads(self._dump(b"", timeout=self.timeout_sec))
 
+    def _decide_with_retry(self, frame: bytes,
+                           max_attempts: Optional[int] = None) -> bytes:
+        """One decide's RPC ladder: per-attempt deadlines, exponential
+        backoff + jitter between retryable failures, all bounded by the
+        policy's total budget. Raises the LAST error when the ladder
+        exhausts — the caller's fallback owns what happens next."""
+        policy = self.retry
+        attempts = max_attempts if max_attempts is not None else policy.max_attempts
+        deadline = time.monotonic() + policy.total_deadline_sec
+        backoff = policy.base_backoff_sec
+        last_err: Optional[grpc.RpcError] = None
+        for attempt in range(attempts):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                _chaos_rpc_attempt()
+                return self._decide(
+                    frame, timeout=min(policy.rpc_timeout_sec, remaining))
+            except grpc.RpcError as e:
+                last_err = e
+                code = _rpc_code(e)
+                if code not in RETRYABLE_CODES or attempt + 1 >= attempts:
+                    raise
+                budget_left = deadline - time.monotonic()
+                if budget_left <= 0:
+                    # no retry will actually run (the guaranteed case when a
+                    # single attempt consumed the whole budget, e.g. a
+                    # DEADLINE_EXCEEDED under the default per-attempt ==
+                    # total policy): don't count a phantom retry
+                    raise
+                metrics.plugin_rpc_retries.inc()
+                sleep = min(
+                    backoff * (1.0 + random.uniform(0, policy.jitter_frac)),
+                    budget_left,
+                )
+                log.warning(
+                    "plugin decide attempt %d/%d failed (%s); retrying in "
+                    "%.0f ms", attempt + 1, attempts,
+                    code.name if code else e, sleep * 1e3)
+                if sleep > 0:
+                    time.sleep(sleep)
+                backoff = min(backoff * policy.backoff_multiplier,
+                              policy.max_backoff_sec)
+        # total budget exhausted between attempts
+        if last_err is not None:
+            raise last_err
+        raise _InjectedRpcError(grpc.StatusCode.DEADLINE_EXCEEDED)
+
     def decide_arrays(self, cluster, now_sec: int):
         out, _phases = self.decide_arrays_traced(cluster, now_sec)
         return out
 
     def decide_arrays_traced(self, cluster, now_sec: int,
-                             span_ctx: Optional[dict] = None):
+                             span_ctx: Optional[dict] = None,
+                             max_attempts: Optional[int] = None):
         """:meth:`decide_arrays` with span propagation: sends the caller's
         span context in the cluster frame and returns
         ``(decision, server_phases)`` — the server's timeline in
         ``Phase.as_dict`` form (None from a pre-tracing peer)."""
         frame = codec.encode_cluster(cluster, now_sec, span_ctx=span_ctx)
-        resp = self._decide(frame, timeout=self.timeout_sec)
+        resp = self._decide_with_retry(frame, max_attempts=max_attempts)
         return codec.decode_decision_traced(resp)
 
     def close(self) -> None:
@@ -87,22 +226,62 @@ class ComputeClient:
 
 
 class GrpcBackend(ComputeBackend):
-    """ComputeBackend over the plugin service, with automatic local fallback."""
+    """ComputeBackend over the plugin service, with automatic local fallback
+    behind the retry ladder and a consecutive-failure circuit breaker."""
 
     name = "grpc"
 
     def __init__(self, address: str = "127.0.0.1:50551",
                  fallback: Optional[ComputeBackend] = None,
-                 timeout_sec: float = 10.0):
-        self.client = ComputeClient(address, timeout_sec)
+                 timeout_sec: float = 10.0,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker_threshold: int = 3,
+                 breaker_probe_after: int = 5):
+        self.client = ComputeClient(address, timeout_sec, retry=retry)
         self.fallback = fallback or GoldenBackend()
         self._packer = PaddedPacker()
         self._packing = PackingPostPass()
+        #: consecutive decide failures (post-retry) that open the breaker
+        self.breaker_threshold = int(breaker_threshold)
+        #: fallback-served ticks between recovery probes while open
+        self.breaker_probe_after = int(breaker_probe_after)
+        self._consecutive_failures = 0
+        self._breaker_open = False
+        self._ticks_since_open = 0
+
+    @property
+    def breaker_open(self) -> bool:
+        return self._breaker_open
+
+    def _serve_fallback(self, group_inputs, now_sec, dry_mode_flags,
+                        taint_trackers, code: str):
+        metrics.plugin_fallback.labels(code).inc()
+        results = self.fallback.decide(
+            group_inputs, now_sec, dry_mode_flags, taint_trackers
+        )
+        # AFTER the fallback ran: its own span re-annotated
+        # backend=<fallback.name>, which would file this tick's record (and
+        # phase series) under the wrong backend — the operator greps the
+        # 'grpc' label for exactly these degraded ticks. Re-assert the
+        # configured identity + the fallback tag.
+        obs.annotate(backend=self.name, fallback=self.fallback.name,
+                     fallback_code=code)
+        return results
 
     def decide(self, group_inputs, now_sec, dry_mode_flags=None,
                taint_trackers=None):
         with obs.span(self.name):
             obs.annotate(backend=self.name, impl="remote")
+            probing = False
+            if self._breaker_open:
+                self._ticks_since_open += 1
+                if self._ticks_since_open < self.breaker_probe_after:
+                    # pinned to the fallback: an extended outage must not
+                    # pay the retry ladder's latency on every single tick
+                    return self._serve_fallback(
+                        group_inputs, now_sec, dry_mode_flags,
+                        taint_trackers, code="circuit-open")
+                probing = True
             with obs.span("pack"):
                 cluster = self._packer.pack(
                     group_inputs, dry_mode_flags, taint_trackers)
@@ -110,28 +289,48 @@ class GrpcBackend(ComputeBackend):
                 with obs.span("rpc", kind="rpc"):
                     out, server_phases = self.client.decide_arrays_traced(
                         cluster, now_sec,
-                        span_ctx={"path": obs.current_path()})
+                        span_ctx={"path": obs.current_path()},
+                        # a probe pays one deadline, never the full ladder:
+                        # a still-dead plugin must not stall the probe tick
+                        max_attempts=1 if probing else None)
                 if server_phases:
                     # nest the plugin-side phases under this tick's rpc span:
                     # the flight record then reads e.g.
                     # grpc/rpc/plugin_decide/decide across the process boundary
                     obs.graft(server_phases, under=obs.current_path() + "/rpc")
             except grpc.RpcError as e:
-                log.warning(
-                    "compute plugin unavailable (%s); falling back to %s"
-                    " backend",
-                    e.code() if hasattr(e, "code") else e, self.fallback.name,
-                )
-                results = self.fallback.decide(
-                    group_inputs, now_sec, dry_mode_flags, taint_trackers
-                )
-                # AFTER the fallback ran: its own span re-annotated
-                # backend=<fallback.name>, which would file this tick's
-                # record (and phase series) under the wrong backend — the
-                # operator greps the 'grpc' label for exactly these degraded
-                # ticks. Re-assert the configured identity + the fallback tag.
-                obs.annotate(backend=self.name, fallback=self.fallback.name)
-                return results
+                code = _rpc_code(e)
+                code_name = code.name if code else "UNKNOWN"
+                self._consecutive_failures += 1
+                if probing:
+                    # probe failed: stay open, restart the probe countdown
+                    self._ticks_since_open = 0
+                    log.warning(
+                        "compute plugin still down at recovery probe (%s); "
+                        "circuit stays open", code_name)
+                elif (not self._breaker_open
+                        and self._consecutive_failures >= self.breaker_threshold):
+                    self._breaker_open = True
+                    self._ticks_since_open = 0
+                    log.error(
+                        "compute plugin failed %d consecutive decides; "
+                        "opening circuit — serving from %s backend, probing "
+                        "every %d ticks", self._consecutive_failures,
+                        self.fallback.name, self.breaker_probe_after)
+                else:
+                    log.warning(
+                        "compute plugin unavailable (%s); falling back to %s"
+                        " backend", code_name, self.fallback.name,
+                    )
+                return self._serve_fallback(
+                    group_inputs, now_sec, dry_mode_flags, taint_trackers,
+                    code=code_name)
+            if self._breaker_open:
+                log.warning("compute plugin answered the recovery probe; "
+                            "closing circuit")
+            self._breaker_open = False
+            self._ticks_since_open = 0
+            self._consecutive_failures = 0
             obs.annotate(digest=_decision_digest(out))
             with obs.span("unpack"):
                 results = _unpack(out, group_inputs)

@@ -1,0 +1,60 @@
+"""Crash-consistent file writes: THE one copy of the tmp + flush + fsync +
+atomic-rename recipe (round 11). Three durability-bearing writers share it —
+the device-state snapshot (`ops/snapshot.py`), the flight recorder's
+incident dumps, and the election lease (`k8s/election.py`) — so a fix to
+the recipe (the directory fsync, tmp cleanup on failure) lands everywhere
+at once instead of drifting per copy. Stdlib only: the observability layer
+imports this and must stay jax-free and cheap.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, IO
+
+
+def atomic_write(path: str, write_fn: Callable[[IO], None],
+                 mode: str = "wb") -> str:
+    """Write ``path`` via a same-directory temp file: ``write_fn(f)`` fills
+    it, then flush + fsync + atomic ``os.replace``. A crash (or SIGKILL, or
+    power cut) at any instant leaves either the previous file or the new
+    one — never a torn or zero-length artifact — and the temp file is
+    unlinked on any write failure. The rename is followed by a best-effort
+    directory fsync so it is durable, not just atomic (best-effort because
+    a failure there still leaves a VALID file — at worst the previous one
+    resurrects after a crash). Returns ``path``."""
+    out_dir = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp.",
+                               dir=out_dir)
+    # mkstemp creates 0600; the pre-round-11 writers used plain open() and
+    # produced umask-based modes (typically 0644). Restore that: a standby,
+    # sidecar exporter, or artifact collector under a different uid must
+    # keep reading the lease / dumps / snapshots after this refactor.
+    cur_umask = os.umask(0)
+    os.umask(cur_umask)
+    try:
+        os.fchmod(fd, 0o666 & ~cur_umask)
+    except OSError:
+        pass
+    try:
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        dfd = os.open(out_dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    return path

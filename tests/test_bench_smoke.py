@@ -203,9 +203,11 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
     """`python bench.py --smoke` (tier-1-safe): the round-6 hot paths — the
     group-block-sharded ordering tail and both blocked-FFD scan programs —
     run at tiny shapes with parity asserted inside run_smoke itself."""
-    # keep the smoke flight dump out of the repo root during tests
+    # keep the smoke flight dump + replay report out of the repo root
     monkeypatch.setenv("ESCALATOR_TPU_FLIGHT_DUMP",
                        str(tmp_path / "flight-smoke.json"))
+    monkeypatch.setenv("ESCALATOR_TPU_REPLAY_SMOKE",
+                       str(tmp_path / "replay-smoke.json"))
     out = bench.run_smoke()
     assert out["smoke_cfg8_parity"] == "ok"
     assert out["smoke_cfg10_parity"] == "ok"
@@ -221,6 +223,13 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
     # the artifact surface CI uploads)
     assert out["smoke_flight_recorder_depth"] > 0
     assert out["smoke_observability_overhead_ms"] < 0.75
+    # round 11: the replay smoke re-executed a dumped ring through the real
+    # debug-replay verb to identical per-tick digests, and wrote the report
+    # artifact CI uploads
+    assert out["smoke_replay"] == "ok"
+    replay_report = json.loads(
+        (tmp_path / "replay-smoke.json").read_text())
+    assert replay_report["ok"] and replay_report["replayed"] == 4
     dump = json.loads((tmp_path / "flight-smoke.json").read_text())
     assert dump["flight_recorder"] is True and dump["reason"] == "smoke"
     assert dump["ticks"], "smoke dump carries no tick records"

@@ -1,0 +1,365 @@
+"""Fault-injection chaos suite: forced failures through the REAL stack.
+
+Each scenario arms the injection layer (escalator_tpu.chaos) at a site
+compiled into production code, runs the genuine controller/backend path,
+and asserts the three-part acceptance bar from ROADMAP item 5 / ISSUE 6:
+
+1. graceful degradation — the documented fallback is taken (retry ladder →
+   local backend, dead audit worker → synchronous audit, wedged tick →
+   watchdog crash-to-restart, lost lease → deposition);
+2. state reconciled — decisions stay semantically identical to the
+   non-faulted run (or converge back after the repair the fault forces);
+3. every injected fault visible — in the chaos metric AND in flight
+   records/dumps.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from escalator_tpu.chaos import CHAOS, ChaosInjected, ChaosMonkey, install_from_env
+from escalator_tpu.metrics import metrics
+
+NOW = 1_700_000_000
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    CHAOS.disarm()
+    yield
+    CHAOS.disarm()
+
+
+def counter_value(counter, *labels):
+    c = counter.labels(*labels) if labels else counter
+    return c._value.get()
+
+
+class TestChaosMonkey:
+    def test_disarmed_is_inert(self):
+        m = ChaosMonkey()
+        assert not m.should_fire("anything")
+        m.inject("anything")   # no raise
+
+    def test_times_and_every_and_after(self):
+        m = ChaosMonkey()
+        m.arm("s", times=2, every=2, after=1)
+        # call 1 skipped (after); of the eligible calls 2,3,4,... every
+        # SECOND one fires (calls 3 and 5); then times=2 exhausts the rule
+        fired = [m.should_fire("s") for _ in range(8)]
+        assert fired == [False, False, True, False, True, False, False,
+                         False]
+        assert m.fired("s") == 2
+
+    def test_inject_raises_typed_error(self):
+        m = ChaosMonkey()
+        m.arm("s")
+        with pytest.raises(ChaosInjected, match="'s'"):
+            m.inject("s")
+
+    def test_env_spec_parsing(self):
+        m_rules = install_from_env(
+            "tick_wedge:times=1,delay=0 ; plugin_rpc:every=3,code=unavailable")
+        try:
+            assert m_rules == 2
+            assert CHAOS.params("plugin_rpc")["code"] == "unavailable"
+        finally:
+            CHAOS.disarm()
+
+    def test_env_spec_malformed_fails_fast(self):
+        with pytest.raises(ValueError, match="k=v"):
+            install_from_env("plugin_rpc:nonsense")
+
+    def test_firing_increments_metric(self):
+        before = counter_value(metrics.chaos_injections, "unit-test-site")
+        CHAOS.arm("unit-test-site", times=1)
+        assert CHAOS.should_fire("unit-test-site")
+        assert counter_value(
+            metrics.chaos_injections, "unit-test-site") == before + 1
+
+
+@pytest.fixture(scope="module")
+def plugin():
+    from escalator_tpu.plugin.client import ComputeClient
+    from escalator_tpu.plugin.server import make_server
+
+    server = make_server("127.0.0.1:0")
+    port = server._escalator_bound_port
+    server.start()
+    client = ComputeClient(f"127.0.0.1:{port}")
+    yield client
+    client.close()
+    server.stop(grace=None)
+
+
+def _group_inputs():
+    from escalator_tpu.core import semantics as sem
+    from escalator_tpu.testsupport.builders import (
+        NodeOpts,
+        PodOpts,
+        build_test_nodes,
+        build_test_pods,
+    )
+
+    pods = build_test_pods(4, PodOpts(cpu=[500], mem=[10**8]))
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    cfg = sem.GroupConfig(
+        min_nodes=0, max_nodes=100, taint_lower_percent=30,
+        taint_upper_percent=45, scale_up_percent=70,
+        slow_removal_rate=1, fast_removal_rate=2,
+    )
+    return [(pods, nodes, cfg, sem.GroupState())]
+
+
+class TestPluginRpcChaos:
+    """Injected RPC failures through the real client/server pair: retries
+    absorb transients, fallbacks are counted by code, the breaker pins an
+    outage, a probe recovers — decisions identical throughout."""
+
+    def _backend(self, plugin, **kw):
+        from escalator_tpu.plugin.client import GrpcBackend, RetryPolicy
+
+        kw.setdefault("retry", RetryPolicy(base_backoff_sec=0.005,
+                                           max_backoff_sec=0.02))
+        return GrpcBackend(plugin.address, **kw)
+
+    def test_transient_failure_retried_no_fallback(self, plugin):
+        backend = self._backend(plugin)
+        gi = _group_inputs()
+        want = backend.decide(gi, NOW)[0].decision
+        retries0 = counter_value(metrics.plugin_rpc_retries)
+        CHAOS.arm("plugin_rpc", times=1)
+        got = backend.decide(gi, NOW)[0].decision
+        assert got == want                       # zero semantic divergence
+        assert counter_value(metrics.plugin_rpc_retries) == retries0 + 1
+        assert not backend.breaker_open
+        # the injected fault is visible in the tick's flight record
+        from escalator_tpu.observability import RECORDER
+
+        rec = RECORDER.last()
+        assert rec["backend"] == "grpc" and rec.get("chaos") == "plugin_rpc"
+        assert "fallback" not in rec             # retry succeeded in-band
+
+    def test_outage_opens_breaker_then_probe_recovers(self, plugin):
+        from escalator_tpu.observability import RECORDER
+
+        backend = self._backend(plugin, breaker_threshold=2,
+                                breaker_probe_after=3)
+        gi = _group_inputs()
+        want = backend.decide(gi, NOW)[0].decision
+        fb0 = counter_value(metrics.plugin_fallback, "UNAVAILABLE")
+        co0 = counter_value(metrics.plugin_fallback, "circuit-open")
+
+        CHAOS.arm("plugin_rpc")                  # hard outage
+        for _ in range(2):
+            assert backend.decide(gi, NOW)[0].decision == want
+        assert backend.breaker_open
+        assert counter_value(
+            metrics.plugin_fallback, "UNAVAILABLE") == fb0 + 2
+        # open circuit: served from the fallback WITHOUT touching the RPC
+        fired = CHAOS.fired("plugin_rpc")
+        assert backend.decide(gi, NOW)[0].decision == want
+        assert CHAOS.fired("plugin_rpc") == fired
+        assert counter_value(
+            metrics.plugin_fallback, "circuit-open") == co0 + 1
+        rec = RECORDER.last()
+        assert rec.get("fallback_code") == "circuit-open"
+
+        # plugin recovers: the next probe tick closes the circuit
+        CHAOS.disarm("plugin_rpc")
+        for _ in range(4):
+            assert backend.decide(gi, NOW)[0].decision == want
+        assert not backend.breaker_open
+
+    def test_failed_probe_keeps_circuit_open(self, plugin):
+        backend = self._backend(plugin, breaker_threshold=1,
+                                breaker_probe_after=2)
+        gi = _group_inputs()
+        want = backend.decide(gi, NOW)[0].decision
+        CHAOS.arm("plugin_rpc")
+        for _ in range(5):   # failure + open-serving + failing probes
+            assert backend.decide(gi, NOW)[0].decision == want
+        assert backend.breaker_open
+
+
+def _taintless_decider(refresh_every, **kw):
+    """An incremental decider over a no-taint, no-emptiest cluster: the
+    audit-chaos corruption (node_pods_remaining lane 0) is then provably
+    decision-neutral — npr feeds only reap (needs taints), emptiest
+    ordering (disabled), and its own output column."""
+    from escalator_tpu.analysis.registry import representative_cluster
+    from escalator_tpu.core.arrays import NO_TAINT_TIME
+    from escalator_tpu.ops.device_state import (
+        DeviceClusterCache,
+        IncrementalDecider,
+    )
+
+    host = representative_cluster(seed=41)
+    host.nodes.tainted[:] = False
+    host.nodes.cordoned[:] = False
+    host.nodes.taint_time_sec[:] = NO_TAINT_TIME
+    host.groups.emptiest[:] = False
+    cache = DeviceClusterCache(host)
+    inc = IncrementalDecider(cache, refresh_every=refresh_every, **kw)
+    return host, cache, inc
+
+
+def _churn_tick(host, cache, inc, rng, t):
+    idx = np.unique(rng.integers(0, host.pods.valid.shape[0], 4))
+    host.pods.cpu_milli[idx] = rng.integers(100, 8000, len(idx))
+    inc.apply_gathered(cache.gather_deltas(idx.astype(np.int64),
+                                           np.empty(0, np.int64)))
+    return inc.decide(NOW + t, False)
+
+
+class TestAuditMismatchChaos:
+    def test_corruption_detected_repaired_and_decision_neutral(
+            self, tmp_path, monkeypatch):
+        import jax
+
+        from escalator_tpu.ops.kernel import decide_jit, lazy_orders_decide
+
+        monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+        host, cache, inc = _taintless_decider(
+            refresh_every=3, on_mismatch="repair", background=True)
+        rng = np.random.default_rng(41)
+        mm0 = counter_value(metrics.incremental_audit_mismatch)
+        CHAOS.arm("audit_mismatch", times=1)
+        saw_repair = False
+        for t in range(8):
+            out, ordered = _churn_tick(host, cache, inc, rng, t)
+            ref, ref_ordered = lazy_orders_decide(
+                lambda w, _now=NOW + t: jax.block_until_ready(
+                    decide_jit(cache.cluster, _now, with_orders=w)),
+                False)
+            assert ordered == ref_ordered
+            # zero semantic divergence THROUGHOUT the fault: status, delta
+            # and orders never move (the corrupted lane is decision-neutral
+            # by construction — see _taintless_decider)
+            for f in ("status", "nodes_delta", "scale_down_order",
+                      "untaint_order", "reap_mask"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(out, f)),
+                    np.asarray(getattr(ref, f)), err_msg=f"tick {t}: {f}")
+            if counter_value(metrics.incremental_audit_mismatch) > mm0:
+                saw_repair = True
+        inc.drain_audit()
+        assert CHAOS.fired("audit_mismatch") == 1
+        assert saw_repair or counter_value(
+            metrics.incremental_audit_mismatch) > mm0
+        # repair reconciled: the maintained npr column is exact again
+        fresh = _full_npr(cache)
+        np.testing.assert_array_equal(
+            np.asarray(inc.aggregates.node_pods_remaining), fresh)
+        # and the mismatch dumped a flight record
+        assert glob.glob(os.path.join(str(tmp_path),
+                                      "*audit-mismatch*.json"))
+
+
+def _full_npr(cache):
+    from escalator_tpu.ops.kernel import compute_aggregates_jit
+
+    return np.asarray(
+        compute_aggregates_jit(cache.cluster).node_pods_remaining)
+
+
+class TestAuditWorkerDeathChaos:
+    def test_dead_worker_degrades_to_sync_audit(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+        host, cache, inc = _taintless_decider(
+            refresh_every=2, on_mismatch="raise", background=True)
+        rng = np.random.default_rng(43)
+        wd0 = counter_value(metrics.audit_worker_failures)
+        CHAOS.arm("audit_worker", times=1)
+        for t in range(6):
+            _churn_tick(host, cache, inc, rng, t)
+        inc.drain_audit()
+        assert CHAOS.fired("audit_worker") == 1
+        assert counter_value(metrics.audit_worker_failures) == wd0 + 1
+        # the sync fallback audit ran and passed: state was never corrupted
+        assert inc.last_audit_ok
+        assert glob.glob(os.path.join(str(tmp_path),
+                                      "*audit-worker-death*.json"))
+        # the decider keeps working (and later audits stay background-clean)
+        for t in range(6, 10):
+            _churn_tick(host, cache, inc, rng, t)
+        assert inc.drain_audit()
+
+    def test_dead_worker_never_deadlocks_snapshot_gate(self):
+        """The snap_ready gate is released in a finally: even a worker that
+        dies mid-audit must never wedge the next tick's donation gate."""
+        host, cache, inc = _taintless_decider(
+            refresh_every=1, on_mismatch="raise", background=True)
+        rng = np.random.default_rng(47)
+        CHAOS.arm("audit_worker")   # EVERY audit worker dies
+        done = threading.Event()
+
+        def run():
+            for t in range(4):
+                _churn_tick(host, cache, inc, rng, t)
+            done.set()
+
+        worker = threading.Thread(target=run, daemon=True)
+        worker.start()
+        assert done.wait(60), "tick thread wedged behind a dead audit worker"
+
+
+class TestWedgeChaos:
+    def test_wedged_tick_trips_watchdog_with_dump(self, tmp_path):
+        """ESCALATOR_TPU_CHAOS=tick_wedge through the real CLI: the first
+        tick sleeps past the watchdog limit, the process crash-to-restarts
+        (exit 70) and dumps the flight ring first."""
+        env = dict(os.environ)
+        env["ESCALATOR_TPU_CHAOS"] = "tick_wedge:times=1,delay=60"
+        env["ESCALATOR_TPU_WATCHDOG_LIMIT_SEC"] = "3"
+        env["ESCALATOR_TPU_DUMP_DIR"] = str(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-m", "escalator_tpu",
+             "--nodegroups", "examples/nodegroups.yaml",
+             "--sim-state", "examples/cluster-state.yaml",
+             "--backend", "golden", "--scaninterval", "60s",
+             "--address", "127.0.0.1:0"],
+            env=env, capture_output=True, text=True, timeout=60,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 70, (proc.returncode, proc.stderr[-500:])
+        assert "no tick completed" in proc.stderr
+        assert glob.glob(os.path.join(str(tmp_path), "*flight-wedge*.json"))
+
+
+class TestLeaseLossChaos:
+    def test_renew_failures_depose_after_deadline(self):
+        """Lease loss mid-run: chaos makes every renewal fail; the elector
+        must hold through the deadline (transient-tolerance contract), then
+        depose exactly as a genuine lease loss would."""
+        from escalator_tpu.k8s.election import (
+            InMemoryResourceLock,
+            LeaderElectionConfig,
+            LeaderElector,
+        )
+        from escalator_tpu.utils.clock import MockClock
+        from tests.test_election_and_cli import FakeStopOnce
+
+        cfg = LeaderElectionConfig(
+            lease_duration_sec=5.0, renew_deadline_sec=3.0,
+            retry_period_sec=0.5)
+        clock = MockClock()
+        deposed = threading.Event()
+        e = LeaderElector(InMemoryResourceLock(), cfg, identity="a",
+                          clock=clock, on_deposed=deposed.set)
+        assert e.run(blocking_acquire_timeout=1)
+        CHAOS.arm("lease_renew")
+        # 2 failed rounds (1.0s) < deadline: still leader
+        e._stop = FakeStopOnce(clock, cfg.retry_period_sec, rounds=2)
+        e._renew_loop()
+        assert not deposed.is_set() and e.is_leader
+        # 8 more failed rounds (4.0s) > deadline: deposed
+        e._stop = FakeStopOnce(clock, cfg.retry_period_sec, rounds=8)
+        e._renew_loop()
+        assert deposed.is_set() and not e.is_leader
+        assert CHAOS.fired("lease_renew") >= 3

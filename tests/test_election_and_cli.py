@@ -202,6 +202,86 @@ print(int(won))
         assert not e.is_leader
 
 
+class TestStaleLeaseTakeoverRace:
+    """Round-11 crash-consistency satellite: a standby taking over an
+    EXPIRED lease while the old leader's renew is still in flight (slow
+    renewer: wrote its record read, stalled, writes late). The fcntl-guarded
+    CAS must serialize the pair so exactly one outcome exists: the standby
+    holds, and the stale renewal FAILS (then deposes its elector) — never a
+    silently restored stale leader."""
+
+    def test_slow_renewer_loses_to_takeover(self, tmp_path):
+        lock = FileResourceLock(str(tmp_path / "lease.json"))
+        clock = MockClock()
+        # leader "a" held the lease but stopped renewing long ago
+        assert lock.create_or_update(LeaderRecord("a", 0.0, 0.0), None)
+        clock.advance(100)   # way past FAST.lease_duration_sec
+
+        release = threading.Event()
+        results = {}
+
+        class SlowLock(FileResourceLock):
+            """a's view of the lock: its renew stalls until released —
+            modeling a renewer descheduled between deciding to renew and
+            performing the guarded CAS."""
+
+            def create_or_update(self, record, expected):
+                release.wait(10)
+                return super().create_or_update(record, expected)
+
+        slow = SlowLock(lock.path)
+
+        def renew_a():
+            results["a"] = slow.create_or_update(
+                LeaderRecord("a", clock.now(), clock.now()), "a")
+
+        ta = threading.Thread(target=renew_a)
+        ta.start()
+        # standby b observes the expired lease and takes it over while a's
+        # renewal is in flight
+        b = LeaderElector(lock, FAST, identity="b", clock=clock)
+        assert b._try_acquire()
+        assert lock.get().holder == "b"
+        release.set()
+        ta.join(10)
+        # a's late renewal must FAIL: the CAS re-reads under the guard and
+        # sees holder=b, not the 'a' it expected
+        assert results["a"] is False
+        assert lock.get().holder == "b"
+        # and a's renew loop, seeing the usurper, deposes immediately
+        deposed = threading.Event()
+        a = LeaderElector(lock, FAST, identity="a", clock=clock,
+                          on_deposed=deposed.set)
+        a.is_leader = True
+        a._stop = FakeStopOnce(clock, FAST.retry_period_sec, rounds=2)
+        a._renew_loop()
+        assert deposed.is_set() and not a.is_leader
+        b.stop()
+
+    def test_crash_during_write_leaves_previous_lease_intact(
+            self, tmp_path, monkeypatch):
+        """Crash consistency: a writer dying mid-write (fsync fails — disk
+        gone) must never leave a torn lease — the previous record stays
+        readable (atomic rename never happened) and no tmp debris
+        accumulates where a reader could trip on it."""
+        from escalator_tpu.utils import atomicio
+
+        lock = FileResourceLock(str(tmp_path / "lease.json"))
+        assert lock.create_or_update(LeaderRecord("a", 1.0, 2.0), None)
+
+        def boom(fd):
+            raise OSError("disk gone")
+
+        monkeypatch.setattr(atomicio.os, "fsync", boom)
+        with pytest.raises(OSError, match="disk gone"):
+            lock.create_or_update(LeaderRecord("a", 3.0, 3.0), "a")
+        monkeypatch.undo()
+        got = lock.get()
+        assert got is not None and got.renew_time == 2.0   # old record intact
+        debris = [p for p in tmp_path.iterdir() if ".tmp" in p.name]
+        assert not debris
+
+
 class FakeStopOnce:
     """Stop event that advances a mock clock per wait and stops after N rounds."""
 

@@ -1,0 +1,211 @@
+"""Killed-leader failover soak: a standby warm-starts from the latest
+checkpoint and decides BIT-EXACTLY what an uninterrupted leader would have.
+
+The scenario runs the real ``IncrementalJaxBackend`` (the repack backend
+that owns warm starts — docs/ha.md) over a deterministic scripted world:
+
+- run **A** (uninterrupted reference): one backend decides every tick
+  ``0..T``;
+- run **B** (failover): a *leader* backend with checkpointing decides ticks
+  ``0..k`` and dies (mid-"tick": the world keeps evolving, nobody decides);
+  a *standby* backend pointed at the same snapshot directory picks up at
+  tick ``j > k`` — it must warm-start (flight-recorder phases prove no
+  rebuild / no full decide) and from tick ``j`` on produce decisions equal
+  to run A's.
+
+Equality holds because decisions are pure functions of (cluster state,
+now): the standby's diff-vs-snapshot collapses the missed churn into one
+delta batch whose integer aggregate deltas sum to exactly the uninterrupted
+run's, and decision columns for groups untouched since their last dirty
+tick are identical in both runs by the same argument (locked at the
+device_state layer by tests/test_snapshot_restore.py; this file locks the
+backend wiring: packer-pad seeding, host-diff baseline adoption, corrupt/
+stale fallback).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from escalator_tpu.controller.backend import IncrementalJaxBackend
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.observability import RECORDER
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_nodes,
+    build_test_pods,
+)
+
+NOW = 1_700_000_000
+
+
+def _config(**kw):
+    base = dict(
+        min_nodes=0, max_nodes=100, taint_lower_percent=30,
+        taint_upper_percent=45, scale_up_percent=70,
+        slow_removal_rate=1, fast_removal_rate=2,
+    )
+    base.update(kw)
+    return sem.GroupConfig(**base)
+
+
+def world_at(t: int):
+    """Deterministic scripted world (explicit names — the builders' global
+    name counter would make two runs of the 'same' world incomparable):
+    two groups whose pod load walks through scale-up / steady / scale-down
+    regimes as ``t`` advances, plus taint churn so ordered ticks (and the
+    order-state restore) are exercised."""
+    from escalator_tpu.testsupport.builders import (
+        build_test_node,
+        build_test_pod,
+    )
+
+    rng = np.random.default_rng(1000 + t)
+    # group 0: load ramps up then collapses
+    n_pods0 = 8 + 3 * t if t < 6 else max(2, 40 - 5 * t)
+    pods0 = [build_test_pod(PodOpts(name=f"g0-p{i}", cpu=[400],
+                                    mem=[10**9])) for i in range(n_pods0)]
+    nodes0 = [build_test_node(NodeOpts(name=f"g0-n{i}", cpu=2000,
+                                       mem=8 * 10**9,
+                                       creation_time_ns=(i + 1) * 10**9))
+              for i in range(6)]
+    # a sliding window of tainted nodes: tainted_any flips over the run
+    for i, nd in enumerate(nodes0):
+        if t >= 4 and i in ((t // 2) % 6, (t // 2 + 1) % 6):
+            nd.taints = [sem_taint(NOW + t - 400)]
+    # group 1: steady with small churn in requests
+    pods1 = [build_test_pod(PodOpts(
+        name=f"g1-p{i}", cpu=[300 + 50 * int(rng.integers(0, 3))],
+        mem=[10**9])) for i in range(12)]
+    nodes1 = [build_test_node(NodeOpts(name=f"g1-n{i}", cpu=4000,
+                                       mem=16 * 10**9,
+                                       creation_time_ns=(i + 1) * 10**9))
+              for i in range(4)]
+    return [
+        (pods0, nodes0, _config(), sem.GroupState()),
+        (pods1, nodes1, _config(min_nodes=1), sem.GroupState()),
+    ]
+
+
+def sem_taint(ts: int):
+    from escalator_tpu.k8s import types as k8s
+
+    return k8s.Taint(key=k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY,
+                     value=str(int(ts)))
+
+
+def decisions_of(results):
+    """The comparable decision tuple per group (full Decision + ordered
+    name lists — the object-level contract the controller acts on)."""
+    return [
+        (r.decision,
+         [n.name for n in r.scale_down_order],
+         [n.name for n in r.untaint_order],
+         [n.name for n in r.reap_nodes],
+         sorted(r.node_pods_remaining.items()))
+        for r in results
+    ]
+
+
+def run_ticks(backend, ticks):
+    out = {}
+    for t in ticks:
+        out[t] = decisions_of(backend.decide(world_at(t), NOW + 60 * t))
+    return out
+
+
+@pytest.fixture
+def reference():
+    """Run A: the uninterrupted leader over ticks 0..11."""
+    return run_ticks(IncrementalJaxBackend(refresh_every=0), range(12))
+
+
+class TestKilledLeaderFailover:
+    def test_standby_warm_start_is_bit_exact(self, tmp_path, reference):
+        snap_dir = str(tmp_path / "snaps")
+        leader = IncrementalJaxBackend(refresh_every=0,
+                                       snapshot_dir=snap_dir,
+                                       snapshot_every=1)
+        run_ticks(leader, range(5))          # checkpoints every tick
+        leader._writer.drain()
+        assert leader._writer.checkpoints >= 4
+        # leader dies; world evolves unobserved through ticks 5..7
+
+        standby = IncrementalJaxBackend(refresh_every=0,
+                                        snapshot_dir=snap_dir)
+        depth0 = RECORDER.total_recorded
+        got = run_ticks(standby, range(8, 12))
+        # bit-exact parity with the uninterrupted run from the first
+        # standby tick on — the acceptance bar
+        for t in range(8, 12):
+            assert got[t] == reference[t], f"standby diverged at tick {t}"
+        assert standby._inc is not None and standby._inc.restored
+        # the restored aggregates survive their own background audit
+        assert standby._inc.drain_audit()
+        # flight-recorder proof of the O(1) warm start: the first standby
+        # tick restored (snapshot_load + restore phases), never rebuilt
+        # residency, and never ran the bootstrap full decide
+        first = next(r for r in RECORDER.snapshot()
+                     if r["seq"] > depth0 and r.get("restored"))
+        phases = {p["name"] for p in first["phases"]}
+        assert "snapshot_load" in phases and "restore" in phases
+        assert "rebuild_residency" not in phases
+        assert "decide_full" not in phases
+        assert "host_diff" in phases   # diffed against the snapshot baseline
+
+    def test_corrupt_snapshot_falls_back_cold_with_dump(self, tmp_path,
+                                                        reference,
+                                                        monkeypatch):
+        from escalator_tpu.metrics import metrics
+
+        monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+        snap_dir = str(tmp_path / "snaps")
+        leader = IncrementalJaxBackend(refresh_every=0,
+                                       snapshot_dir=snap_dir,
+                                       snapshot_every=1)
+        run_ticks(leader, range(5))
+        leader._writer.drain()
+        # truncate the checkpoint mid-payload
+        path = leader._writer.path
+        blob = open(path, "rb").read()
+        open(path, "wb").write(blob[: len(blob) // 2])
+
+        before = metrics.snapshot_restores.labels("corrupt")._value.get()
+        standby = IncrementalJaxBackend(refresh_every=0,
+                                        snapshot_dir=snap_dir)
+        got = run_ticks(standby, range(8, 12))
+        # cold start still converges to the reference decisions
+        for t in range(8, 12):
+            assert got[t] == reference[t], f"cold standby diverged at {t}"
+        assert standby._inc is not None and not standby._inc.restored
+        assert metrics.snapshot_restores.labels(
+            "corrupt")._value.get() == before + 1
+        dumps = glob.glob(
+            os.path.join(str(tmp_path), "*snapshot-corrupt*.json"))
+        assert dumps, "corrupt snapshot must dump a flight record"
+
+    def test_outgrown_snapshot_is_discarded_as_stale(self, tmp_path):
+        from escalator_tpu.metrics import metrics
+
+        snap_dir = str(tmp_path / "snaps")
+        leader = IncrementalJaxBackend(refresh_every=0,
+                                       snapshot_dir=snap_dir,
+                                       snapshot_every=1)
+        run_ticks(leader, range(3))
+        leader._writer.drain()
+
+        standby = IncrementalJaxBackend(refresh_every=0,
+                                        snapshot_dir=snap_dir)
+        before = metrics.snapshot_restores.labels("stale")._value.get()
+        # a world that outgrew the checkpoint's pod capacity: the restored
+        # state cannot fit and MUST be discarded for a cold rebuild
+        big = [(build_test_pods(3000, PodOpts(cpu=[100], mem=[10**8])),
+                build_test_nodes(8, NodeOpts(cpu=4000, mem=16 * 10**9)),
+                _config(), sem.GroupState())]
+        results = standby.decide(big, NOW)
+        assert results[0].decision.nodes_delta >= 0   # sane cold decide
+        assert metrics.snapshot_restores.labels(
+            "stale")._value.get() == before + 1

@@ -1,0 +1,362 @@
+"""Device-state snapshot/restore + deterministic replay (round-11 tentpole).
+
+Three layers under test:
+
+- the snapshot FILE (ops/snapshot.py): bit-exact round trip, atomic write,
+  and loud rejection of every corruption class (magic, version, truncation,
+  bit flips, missing leaves);
+- the decider warm start (device_state.restore_decider): a restored
+  ``IncrementalDecider`` continues BIT-EXACTLY from where the snapshot was
+  taken — including ordered ticks off the restored order state — and the
+  post-restore background audit self-checks the adopted aggregates;
+- deterministic replay (observability/replay.py + the debug-replay CLI):
+  a recorded input ring re-executes from a snapshot to identical per-tick
+  crc32 decision digests, and divergence is reported, not swallowed.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from escalator_tpu.analysis.registry import NOW, representative_cluster
+from escalator_tpu.observability import replay
+from escalator_tpu.ops import snapshot as snaplib
+from escalator_tpu.ops.device_state import (
+    DeviceClusterCache,
+    IncrementalDecider,
+    restore_decider,
+)
+from escalator_tpu.ops.order_tail import validate_order_state
+
+
+@pytest.fixture(autouse=True)
+def _input_log_hygiene():
+    """Recording is process-global; every test starts and ends clean."""
+    replay.INPUT_LOG.set_enabled(False)
+    replay.INPUT_LOG.clear()
+    yield
+    replay.INPUT_LOG.set_enabled(False)
+    replay.INPUT_LOG.clear()
+
+
+def make_decider(seed=0, **kw):
+    host = representative_cluster(seed=seed)
+    cache = DeviceClusterCache(host)
+    kw.setdefault("refresh_every", 0)
+    kw.setdefault("background", False)
+    inc = IncrementalDecider(cache, **kw)
+    return host, cache, inc
+
+
+def churn(host, rng, n=4):
+    """Mutate a few pod lanes in the HOST arrays in place; returns the dirty
+    slot lists the gather consumes (the cache's host views alias these)."""
+    P = host.pods.valid.shape[0]
+    idx = np.unique(rng.integers(0, P, n))
+    host.pods.cpu_milli[idx] = rng.integers(100, 8000, len(idx))
+    return idx.astype(np.int64), np.empty(0, np.int64)
+
+
+def run_tick(host, cache, inc, rng, t, tainted_any=True, record=True):
+    ps, ns = churn(host, rng)
+    inc.apply_gathered(cache.gather_deltas(ps, ns))
+    return inc.decide(NOW + t, tainted_any, _record=record)
+
+
+def assert_outputs_equal(a, b, msg=""):
+    for f in a.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+            err_msg=f"{msg}: field {f}")
+
+
+class TestSnapshotFile:
+    def _leaves(self):
+        rng = np.random.default_rng(7)
+        return {
+            "a.int": rng.integers(-5, 5, 17).astype(np.int64),
+            "b.bool": rng.random(9) < 0.5,
+            "c.float": rng.random(6),
+            "d.i32": rng.integers(0, 100, (3, 4)).astype(np.int32),
+        }
+
+    def test_round_trip_bit_exact(self, tmp_path):
+        leaves = self._leaves()
+        meta = {"tick": 12, "pod_capacity": 16}
+        path = snaplib.write_snapshot(
+            str(tmp_path / "s.snap"), leaves, meta)
+        got, got_meta = snaplib.read_snapshot(path)
+        assert got_meta["tick"] == 12 and got_meta["pod_capacity"] == 16
+        assert set(got) == set(leaves)
+        for k, v in leaves.items():
+            assert got[k].dtype == np.asarray(v).dtype
+            np.testing.assert_array_equal(got[k], v, err_msg=k)
+
+    def test_atomic_write_leaves_no_tmp_debris(self, tmp_path):
+        path = str(tmp_path / "s.snap")
+        snaplib.write_snapshot(path, self._leaves(), {})
+        assert os.listdir(tmp_path) == ["s.snap"]
+
+    def test_missing_file_is_file_not_found(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            snaplib.read_snapshot(str(tmp_path / "absent.snap"))
+
+    @pytest.mark.parametrize("mutilate,match", [
+        (lambda b: b"NOPE" + b[4:], "bad magic"),
+        (lambda b: b[: len(b) // 2], "truncated|payload"),
+        (lambda b: b[:-1], "payload"),
+        (lambda b: b + b"x", "payload"),
+    ])
+    def test_structural_corruption_detected(self, tmp_path, mutilate, match):
+        path = str(tmp_path / "s.snap")
+        snaplib.write_snapshot(path, self._leaves(), {})
+        blob = open(path, "rb").read()
+        open(path, "wb").write(mutilate(blob))
+        with pytest.raises(snaplib.SnapshotCorruptError, match=match):
+            snaplib.read_snapshot(path)
+
+    def test_payload_bit_flip_fails_leaf_crc(self, tmp_path):
+        path = str(tmp_path / "s.snap")
+        snaplib.write_snapshot(path, self._leaves(), {})
+        blob = bytearray(open(path, "rb").read())
+        blob[-3] ^= 0x40   # inside the last leaf's payload
+        open(path, "wb").write(bytes(blob))
+        with pytest.raises(snaplib.SnapshotCorruptError, match="crc32"):
+            snaplib.read_snapshot(path)
+
+    def test_unknown_version_rejected(self, tmp_path):
+        path = str(tmp_path / "s.snap")
+        snaplib.write_snapshot(path, self._leaves(), {})
+        blob = open(path, "rb").read()
+        off = len(snaplib.SNAPSHOT_MAGIC)
+        hlen = int.from_bytes(blob[off:off + 8], "big")
+        header = json.loads(blob[off + 8:off + 8 + hlen])
+        header["version"] = 99
+        hraw = json.dumps(header).encode()
+        open(path, "wb").write(
+            snaplib.SNAPSHOT_MAGIC + len(hraw).to_bytes(8, "big") + hraw
+            + blob[off + 8 + hlen:])
+        with pytest.raises(snaplib.SnapshotCorruptError, match="version"):
+            snaplib.read_snapshot(path)
+
+    def test_missing_leaf_is_named(self):
+        host, cache, inc = make_decider(seed=3)
+        rng = np.random.default_rng(3)
+        run_tick(host, cache, inc, rng, 0)
+        leaves, _meta = inc.snapshot_state()
+        del leaves["aggs.cpu_req"]
+        with pytest.raises(snaplib.SnapshotCorruptError,
+                           match="aggs.cpu_req"):
+            snaplib.leaves_to_state(leaves)
+
+
+class TestOrderStateValidation:
+    def _state(self, n=8):
+        rng = np.random.default_rng(0)
+        return (rng.integers(0, 5, n).astype(np.int64),
+                rng.integers(0, 5, n).astype(np.int64),
+                rng.integers(0, 5, n).astype(np.int64),
+                np.random.default_rng(1).permutation(n).astype(np.int32))
+
+    def test_valid_state_passes(self):
+        validate_order_state(*self._state(), num_lanes=8)
+
+    def test_non_permutation_rejected(self):
+        m, k1, k2, perm = self._state()
+        perm[0] = perm[1]
+        with pytest.raises(ValueError, match="permutation"):
+            validate_order_state(m, k1, k2, perm, num_lanes=8)
+
+    def test_wrong_shape_and_dtype_rejected(self):
+        m, k1, k2, perm = self._state()
+        with pytest.raises(ValueError, match="shape"):
+            validate_order_state(m[:-1], k1, k2, perm, num_lanes=8)
+        with pytest.raises(ValueError, match="dtype"):
+            validate_order_state(m.astype(np.int32), k1, k2, perm,
+                                 num_lanes=8)
+
+
+class TestDeciderSnapshotRestore:
+    def test_snapshot_before_first_decide_is_none(self):
+        _host, _cache, inc = make_decider(seed=5)
+        assert inc.snapshot_state() is None
+
+    def test_restored_decider_continues_bit_exactly(self):
+        """The failover core: run, snapshot, keep running; a decider
+        restored from the snapshot and fed the SAME subsequent deltas
+        produces bit-identical outputs on every tick — ordered ticks (off
+        the restored order state) included."""
+        host, cache, inc = make_decider(seed=11)
+        rng = np.random.default_rng(11)
+        for t in range(4):
+            run_tick(host, cache, inc, rng, t)   # tainted: order state seeds
+        assert inc._order_state is not None
+        leaves, meta = inc.snapshot_state()
+        assert meta["tick"] == 4
+
+        _cache2, inc2 = restore_decider(leaves, meta, refresh_every=0,
+                                        background=False)
+        assert inc2.restored and inc2._ticks == 4
+        assert inc2._order_state is not None
+        for t in range(4, 10):
+            ps, ns = churn(host, rng)
+            gathered = cache.gather_deltas(ps, ns)
+            inc.apply_gathered(gathered)
+            o1, r1 = inc.decide(NOW + t, True)
+            inc2.apply_gathered(gathered)
+            o2, r2 = inc2.decide(NOW + t, True)
+            assert r1 == r2
+            assert_outputs_equal(o1, o2, f"tick {t}")
+
+    def test_restore_is_self_checking_post_restore_audit(self):
+        host, cache, inc = make_decider(seed=13)
+        rng = np.random.default_rng(13)
+        run_tick(host, cache, inc, rng, 0)
+        leaves, meta = inc.snapshot_state()
+        # clean restore: background audit reconciles clean
+        _c, inc2 = restore_decider(leaves, meta, refresh_every=0)
+        assert inc2.drain_audit()
+        # tampered-but-crc-valid aggregates: the audit MUST catch it (this
+        # is the corruption class the file-level crc cannot see)
+        bad = dict(leaves)
+        bad["aggs.mem_req"] = bad["aggs.mem_req"].copy()
+        bad["aggs.mem_req"][0] += 1
+        _c, inc3 = restore_decider(bad, meta, refresh_every=0,
+                                   on_mismatch="repair")
+        assert not inc3.drain_audit()
+
+    def test_restore_rejects_inconsistent_meta(self):
+        host, cache, inc = make_decider(seed=17)
+        rng = np.random.default_rng(17)
+        run_tick(host, cache, inc, rng, 0)
+        leaves, meta = inc.snapshot_state()
+        bad_meta = dict(meta, pod_capacity=meta["pod_capacity"] + 1)
+        with pytest.raises(snaplib.SnapshotCorruptError, match="capacit"):
+            restore_decider(leaves, bad_meta)
+
+    def test_restore_rejects_corrupt_order_state(self):
+        host, cache, inc = make_decider(seed=19)
+        rng = np.random.default_rng(19)
+        for t in range(2):
+            run_tick(host, cache, inc, rng, t)
+        leaves, meta = inc.snapshot_state()
+        assert "order.perm" in leaves
+        bad = dict(leaves)
+        bad["order.perm"] = bad["order.perm"].copy()
+        bad["order.perm"][0] = bad["order.perm"][1]   # not a permutation
+        with pytest.raises(snaplib.SnapshotCorruptError, match="order state"):
+            restore_decider(bad, meta)
+
+
+class TestSnapshotWriter:
+    def test_cadence_and_latest_path(self, tmp_path):
+        host, cache, inc = make_decider(seed=23)
+        rng = np.random.default_rng(23)
+        w = snaplib.SnapshotWriter(str(tmp_path / "snaps"), every=2)
+        started = []
+        for t in range(5):
+            run_tick(host, cache, inc, rng, t)
+            started.append(w.maybe_checkpoint(inc))
+        w.drain()
+        assert started == [False, True, False, True, False]
+        assert w.checkpoints == 2
+        leaves, meta = snaplib.read_snapshot(w.path)
+        assert meta["tick"] == 4   # the second cadence point
+        # and the file restores
+        _c, inc2 = restore_decider(leaves, meta, refresh_every=0)
+        assert inc2.drain_audit()
+
+    def test_pre_decide_checkpoint_skipped(self, tmp_path):
+        _host, _cache, inc = make_decider(seed=29)
+        w = snaplib.SnapshotWriter(str(tmp_path), every=1)
+        assert not w.maybe_checkpoint(inc)
+        assert not os.path.exists(w.path)
+
+    def test_disabled_cadence_never_writes(self, tmp_path):
+        host, cache, inc = make_decider(seed=31)
+        rng = np.random.default_rng(31)
+        run_tick(host, cache, inc, rng, 0)
+        w = snaplib.SnapshotWriter(str(tmp_path), every=0)
+        for _ in range(3):
+            assert not w.maybe_checkpoint(inc)
+        assert w.maybe_checkpoint(inc, force=True)
+        w.drain()
+        assert os.path.exists(w.path)
+
+
+class TestDeterministicReplay:
+    def _record_run(self, tmp_path, ticks=6, snap_at=3):
+        host, cache, inc = make_decider(seed=37)
+        rng = np.random.default_rng(37)
+        replay.INPUT_LOG.set_enabled(True)
+        path = None
+        digests = []
+        for t in range(ticks):
+            if t == snap_at:
+                leaves, meta = inc.snapshot_state()
+                path = snaplib.write_snapshot(
+                    str(tmp_path / "base.snap"), leaves, meta)
+            out, _ = run_tick(host, cache, inc, rng, t,
+                              tainted_any=(t % 2 == 0))
+            digests.append(replay.decision_digest(out))
+        replay.INPUT_LOG.set_enabled(False)
+        return path, replay.INPUT_LOG.snapshot(), digests
+
+    def test_replay_reproduces_digests(self, tmp_path):
+        path, entries, digests = self._record_run(tmp_path)
+        assert len(entries) == 6
+        report = replay.replay_ring(entries, snapshot_path=path)
+        assert report["ok"], report["divergent"]
+        assert report["replayed"] == 3 and report["skipped_older"] == 3
+        assert [t["digest"] for t in report["ticks"]] == digests[3:]
+
+    def test_replay_reports_divergence(self, tmp_path):
+        path, entries, _ = self._record_run(tmp_path)
+        entries[-1] = dict(entries[-1], digest="00000000")
+        report = replay.replay_ring(entries, snapshot_path=path)
+        assert not report["ok"]
+        assert [d["tick"] for d in report["divergent"]] == [entries[-1]["tick"]]
+
+    def test_replay_rejects_gaps(self, tmp_path):
+        path, entries, _ = self._record_run(tmp_path)
+        del entries[4]   # a tick after the snapshot goes missing
+        with pytest.raises(ValueError, match="gap"):
+            replay.replay_ring(entries, snapshot_path=path)
+
+    def test_dump_carries_tick_inputs(self, tmp_path):
+        from escalator_tpu.observability import RECORDER
+
+        _path, entries, _ = self._record_run(tmp_path)
+        assert entries
+        doc = RECORDER.as_dump("test")
+        assert "tick_inputs" in doc
+        assert {e["tick"] for e in doc["tick_inputs"]} >= {
+            e["tick"] for e in entries}
+
+    def test_debug_replay_cli_end_to_end(self, tmp_path, capsys):
+        from escalator_tpu.cli import main
+        from escalator_tpu.observability import RECORDER
+
+        path, entries, _ = self._record_run(tmp_path)
+        dump_path = str(tmp_path / "ring.json")
+        RECORDER.dump(dump_path, reason="test")
+        rc = main(["debug-replay", "--dump", dump_path,
+                   "--snapshot", path])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["ok"] and report["replayed"] == 3
+        # divergence -> exit 1
+        doc = json.load(open(dump_path))
+        doc["tick_inputs"][-1]["digest"] = "00000000"
+        json.dump(doc, open(dump_path, "w"))
+        rc = main(["debug-replay", "--dump", dump_path,
+                   "--snapshot", path, "--output",
+                   str(tmp_path / "report.json")])
+        assert rc == 1
+        # a dump without inputs -> exit 2
+        doc.pop("tick_inputs")
+        json.dump(doc, open(dump_path, "w"))
+        assert main(["debug-replay", "--dump", dump_path,
+                     "--snapshot", path]) == 2
