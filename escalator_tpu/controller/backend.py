@@ -651,7 +651,13 @@ class IncrementalJaxBackend(ComputeBackend):
     lister order reshuffles between ticks inflates the delta batch (every
     moved lane reads as changed) — NEVER the results, which depend only on
     the diff being complete. The controller's group-ordered walk is stable
-    in practice; the native backend's slot-keyed store makes it structural."""
+    in practice; the native backend's slot-keyed store makes it structural.
+
+    Round 12: when the cluster client exposes a watch feed,
+    :meth:`attach_event_source` retires the per-tick repack + host-diff
+    entirely — steady ticks then drain watch deltas as packed triples
+    through the streaming engine, and this class's pack/diff path remains
+    as the bootstrap/no-event-source/warm-restore configuration."""
 
     name = "incremental-jax"
 
@@ -671,11 +677,16 @@ class IncrementalJaxBackend(ComputeBackend):
         self._cache = None
         self._inc = None
         self._host_prev = None   # (PodArrays, NodeArrays) of the last pack
+        #: streaming upgrade (round 12): set by attach_event_source — decide
+        #: then routes to an event-driven engine and the repack/diff below
+        #: becomes the bootstrap/audit path only
+        self._stream = None
         # failover-grade state (round 11): periodic async checkpoints of the
         # device-resident state, and a warm start from the latest checkpoint
         # at the first decide — the standby-leader path (docs/ha.md)
         snapshot_dir, snapshot_every = _snapshot_config(
             snapshot_dir, snapshot_every)
+        self._snapshot_dir, self._snapshot_every = snapshot_dir, snapshot_every
         self._writer = None
         if snapshot_dir:
             from escalator_tpu.ops.snapshot import SnapshotWriter
@@ -736,7 +747,54 @@ class IncrementalJaxBackend(ComputeBackend):
             "snapshot %s failed validation (%s); cold-starting instead "
             "(flight record: %s)", path, err, dump or "dump failed")
 
+    def attach_event_source(self, client, node_group_options,
+                            pod_capacity: int = 1 << 12,
+                            node_capacity: int = 1 << 10,
+                            store_kind: str = "auto",
+                            relist_audit_every: "int | str | None" = None
+                            ) -> None:
+        """Upgrade this backend to STREAMING ingestion (the round-12
+        tentpole): subscribe to ``client``'s watch feed and, from the next
+        decide on, source cluster state from the event-maintained store
+        instead of repacking + host-diffing the controller's object lists —
+        the ``pack`` and ``host_diff`` phases disappear from steady ticks
+        (watch deltas drain as packed ``(idx, values)`` triples straight
+        into the same ``IncrementalDecider`` scatter), and the O(cluster)
+        re-list survives only as bootstrap and the optional
+        ``relist_audit_every`` reconciliation cadence.
+
+        Implementation: the event-driven engine IS
+        :class:`~escalator_tpu.controller.native_backend.NativeJaxBackend`
+        with the incremental decide — slot-keyed store, bridge-resolved
+        result objects — so attaching constructs one with this backend's
+        exact decide configuration (refresh cadence, overlap, checkpoint
+        dir) and flips ``needs_objects`` False (the controller then skips
+        its per-tick lister walk). Flight records keep this backend's name.
+        Trade-off inherited from the native engine: checkpoints still
+        write, but warm RESTORE is unavailable (slot layout is
+        ingestion-ordered — docs/ha.md); a standby that must warm-start
+        should stay on the repack path instead."""
+        from escalator_tpu.controller.native_backend import (
+            NativeJaxBackend,
+            group_filters_from_options,
+        )
+
+        stream = NativeJaxBackend(
+            client, group_filters_from_options(node_group_options),
+            pod_capacity=pod_capacity, node_capacity=node_capacity,
+            incremental=True, refresh_every=self._refresh_every,
+            overlap=self._overlap, snapshot_dir=self._snapshot_dir,
+            snapshot_every=self._snapshot_every, store_kind=store_kind,
+            relist_audit_every=relist_audit_every,
+        )
+        stream.name = self.name   # one logical backend in records/metrics
+        self._stream = stream
+        self.needs_objects = False
+
     def decide(self, group_inputs, now_sec, dry_mode_flags=None, taint_trackers=None):
+        if self._stream is not None:
+            return self._stream.decide(
+                group_inputs, now_sec, dry_mode_flags, taint_trackers)
         with obs.span(self.name):
             obs.annotate(backend=self.name, impl=self._impl)
             return self._decide_inner(

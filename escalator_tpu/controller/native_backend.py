@@ -58,18 +58,56 @@ class NativeJaxBackend(ComputeBackend):
                  refresh_every: "int | str | None" = None,
                  overlap: "bool | None" = None,
                  snapshot_dir: "str | None" = None,
-                 snapshot_every: "int | None" = None):
+                 snapshot_every: "int | None" = None,
+                 store_kind: str = "auto",
+                 relist_audit_every: "int | str | None" = None):
         import os
 
-        from escalator_tpu.native.statestore import NativeStateStore
+        from escalator_tpu.native.statestore import make_state_store
         from escalator_tpu.ops import kernel
 
         self._kernel = kernel
-        self.store = NativeStateStore(
-            pod_capacity=pod_capacity, node_capacity=node_capacity
+        # round 12: the store is a factory pick — the C++ statestore when the
+        # toolchain built it, the API/bit-identical numpy fallback otherwise
+        # (statestore.make_state_store logs the degradation once at WARN), so
+        # streaming ingestion is the primary feed on every install
+        self.store = make_state_store(
+            pod_capacity=pod_capacity, node_capacity=node_capacity,
+            kind=store_kind,
         )
+        self._client = client
         self.bridge = WatchBridge(self.store, groups)
         client.subscribe(self.bridge.apply, replay=True)
+        # re-list reconciliation audit (round 12): every N ticks, re-list the
+        # client world through bridge.resync — the O(cluster) walk demoted to
+        # an audit cadence; off by default ("off"/unset/0 via env
+        # ESCALATOR_TPU_RELIST_AUDIT_EVERY). Parsing is STRICT (the
+        # parse_refresh_every lesson): a typo'd cadence must fail loudly,
+        # not silently disable the reconciliation the operator asked for.
+        if relist_audit_every is None:
+            relist_audit_every = os.environ.get(
+                "ESCALATOR_TPU_RELIST_AUDIT_EVERY", "off")
+        if isinstance(relist_audit_every, str):
+            s = relist_audit_every.strip().lower()
+            if s in ("", "off", "0"):
+                relist_audit_every = 0
+            else:
+                from escalator_tpu.ops.device_state import parse_refresh_every
+
+                relist_audit_every = parse_refresh_every(
+                    s, "ESCALATOR_TPU_RELIST_AUDIT_EVERY")
+        elif relist_audit_every != 0:
+            from escalator_tpu.ops.device_state import parse_refresh_every
+
+            relist_audit_every = parse_refresh_every(
+                relist_audit_every, "relist_audit_every")
+        self._relist_audit_every = int(relist_audit_every)
+        self._ticks = 0
+        #: packed delta batches pre-drained during a previous tick's device
+        #: window (the round-12 overlap extension) — applied BEFORE the
+        #: current tick's drain, dropped on rebuild (the full re-upload
+        #: supersedes them)
+        self._pending_batches: list = []
         # Device-resident cluster cache (ops/device_state.py): built on first
         # decide, scatter-updated with the store's dirty slots per tick.
         self._cache = None
@@ -172,12 +210,37 @@ class NativeJaxBackend(ComputeBackend):
     # -- decide ------------------------------------------------------------------
     def decide(self, group_inputs, now_sec, dry_mode_flags=None,
                taint_trackers=None):
+        from escalator_tpu.native.statestore import store_kind
+
         with obs.span(self.name):
             obs.annotate(backend=self.name,
                          impl="xla" if self._incremental else
-                         (self._impl_fallback or "native"))
+                         (self._impl_fallback or "native"),
+                         store=store_kind(self.store))
             return self._decide_inner(
                 group_inputs, now_sec, dry_mode_flags, taint_trackers)
+
+    def _predrain(self) -> None:
+        """Round-12 overlap extension: drain the watch deltas that arrived
+        SINCE this tick's event_drain into a pending packed batch, while
+        the tick's device program is still in flight (IncrementalDecider
+        runs this between its decide dispatch and its first blocking read).
+        The next tick applies the pending batch before its own drain —
+        tick t+1's event-drain work hides under tick t's device time.
+        Host/store state only; never touches device buffers (a donating
+        dispatch is in flight)."""
+        store = self.store
+        if self._cache is None or not hasattr(store, "drain_dirty_packed"):
+            return
+        with store.lock:
+            if store.pod_dirty_count == 0 and store.node_dirty_count == 0:
+                return
+            # a capacity change since the tick's drain means the batch would
+            # target the WRONG scratch lane — leave it for the rebuild path
+            if (store.pod_capacity != self._cache.pod_capacity
+                    or store.node_capacity != self._cache.node_capacity):
+                return
+            self._pending_batches.append(store.drain_dirty_packed())
 
     def _decide_inner(self, group_inputs, now_sec, dry_mode_flags=None,
                       taint_trackers=None):
@@ -186,54 +249,36 @@ class NativeJaxBackend(ComputeBackend):
         from escalator_tpu.ops.device_state import DeviceClusterCache
 
         t0 = time.perf_counter()
+        self._ticks += 1
+        # Re-list audit cadence (O(cluster), default off): reconcile the
+        # store against a full client re-list BEFORE taking the store lock
+        # below (resync acquires client-then-store, the same order the
+        # event path uses — taking it under our store lock would invert
+        # that against a concurrent watch thread). Slots it touches land in
+        # this tick's drain like any other event.
+        if (self._relist_audit_every
+                and self._ticks % self._relist_audit_every == 0):
+            with obs.span("relist_audit"):
+                stats = self.bridge.resync(self._client)
+                obs.annotate(relist_audit=(
+                    f"dropped={stats['pods_dropped']}p/"
+                    f"{stats['nodes_dropped']}n "
+                    f"reapplied={stats['events_reapplied']}"))
         # Hold the store's single-writer lock across the whole host phase
-        # (view -> dirty drain -> gather -> scatter dispatch): a concurrent
-        # watch thread can then never tear the tick's snapshot or race the
-        # dirty-list drain. The long device decide below runs OUTSIDE the
-        # lock — ingestion overlaps compute, the -race-analog soak test
-        # (tests/test_concurrency_soak.py) exercises exactly this interleaving.
-        # The host_snapshot span covers exactly the locked section — its
-        # duration is also "how long watch ingestion was stalled this tick".
-        with obs.span("host_snapshot"), self.store.lock:
+        # (drain/pack -> gather -> snapshot): a concurrent watch thread can
+        # then never tear the tick's snapshot or race the dirty-list drain.
+        # The long device decide below runs OUTSIDE the lock — ingestion
+        # overlaps compute, the -race-analog soak test
+        # (tests/test_concurrency_soak.py) exercises exactly this
+        # interleaving. Phase taxonomy (round 12): ``event_drain`` is the
+        # store's dirty drain + delta-triple gather (ONE native crossing on
+        # the packed fast path), ``triple_build`` the remaining [G]/[N]
+        # host assembly — together they replace the old ``host_snapshot``
+        # composite, so a dump attributes the host tail line by line. Their
+        # combined duration is also "how long watch ingestion was stalled".
+        dry_any = bool(dry_mode_flags and any(dry_mode_flags))
+        with self.store.lock:
             pods, nodes_raw = self.store.as_pod_node_arrays()
-            self._refresh_cached_capacity(group_inputs, nodes_raw)
-            nodes = self._dry_mode_view(
-                nodes_raw, group_inputs, dry_mode_flags, taint_trackers
-            )
-            groups = pack_groups(
-                [(config, state) for _, _, config, state in group_inputs],
-                pad_groups=_round_up(len(group_inputs), 8),
-            )
-            pod_dirty, node_dirty = self.store.drain_dirty()
-            overridden = (
-                np.nonzero(
-                    (nodes.tainted != nodes_raw.tainted)
-                    | (nodes.cordoned != nodes_raw.cordoned)
-                )[0].astype(np.int64)
-                if nodes is not nodes_raw
-                else np.empty(0, np.int64)
-            )
-            # Snapshot the tiny per-node columns _unpack reads after the lock is
-            # released (the SoA views alias the live C++ buffers; result
-            # assembly must group by the DECIDED state, not whatever a watch
-            # thread wrote since).
-            unpack_group = np.array(nodes.group)
-            unpack_valid = np.array(nodes.valid)
-            unpack_tainted_col = np.array(nodes.tainted)
-            unpack_cordoned_col = np.array(nodes.cordoned)
-            unpack_cordoned = unpack_valid & unpack_cordoned_col
-            unpack_untainted = (
-                unpack_valid & ~unpack_tainted_col & ~unpack_cordoned_col
-            )
-            # lazy-orders gate (kernel.lazy_orders_decide): tainted presence in
-            # the DECIDED snapshot (dry-mode view included) — when no node is
-            # tainted and no group scales down, no ordering window is ever
-            # read, and the decide skips its dominant [N]-lane sort
-            tainted_any = bool(
-                (np.asarray(nodes.valid) & np.asarray(nodes.tainted)).any())
-            # Packing-aware groups: gather their pod/bin lanes from the same
-            # locked snapshot; the device FFD runs after decide, outside the lock
-            packing_rows = self._gather_packing_inputs(group_inputs, pods, nodes)
             rebuild = (
                 self._cache is None
                 or self._cache.pod_capacity != self.store.pod_capacity
@@ -247,24 +292,91 @@ class NativeJaxBackend(ComputeBackend):
                 # extra rebuild is scoped to incremental mode.
                 or (self._incremental and self._cache is not None
                     and int(self._cache.cluster.groups.valid.shape[0])
-                    != int(groups.valid.shape[0]))
+                    != int(_round_up(len(group_inputs), 8)))
             )
-            if rebuild:
-                # first tick or store growth: copy the full columns under the
-                # lock; the O(cluster) device upload happens AFTER release so
-                # watch ingestion never stalls behind a transfer/compile
-                pods_snap = _copy_soa(pods)
-                nodes_snap = _copy_soa(nodes)
-            else:
-                node_dirty = np.unique(
-                    np.concatenate([node_dirty, self._overridden_slots, overridden])
+            # Fast path: no dry-mode overrides in play and the store can
+            # emit packed delta triples — the steady-state tick. The drain,
+            # the per-column gather and the pad all happen inside the store
+            # (one ctypes crossing on the native store; vectorized numpy on
+            # the fallback), and the dry-mode/override machinery is
+            # bypassed because raw columns ARE the decided view.
+            fast = (not rebuild and not dry_any
+                    and self._overridden_slots.size == 0
+                    and hasattr(self.store, "drain_dirty_packed"))
+            pending, self._pending_batches = self._pending_batches, []
+            with obs.span("triple_build"):
+                self._refresh_cached_capacity(group_inputs, nodes_raw)
+                nodes = self._dry_mode_view(
+                    nodes_raw, group_inputs, dry_mode_flags, taint_trackers
                 )
-                self._cache.set_host(pods, nodes)
-                # lock covers only the host gather (reads the live views);
-                # the device dispatch — and any jit compile a new delta-bucket
-                # size triggers — happens after release, so watch ingestion
-                # never convoys behind a transfer or compile
-                gathered = self._cache.gather_deltas(pod_dirty, node_dirty)
+                groups = pack_groups(
+                    [(config, state) for _, _, config, state in group_inputs],
+                    pad_groups=_round_up(len(group_inputs), 8),
+                )
+                overridden = (
+                    np.nonzero(
+                        (nodes.tainted != nodes_raw.tainted)
+                        | (nodes.cordoned != nodes_raw.cordoned)
+                    )[0].astype(np.int64)
+                    if nodes is not nodes_raw
+                    else np.empty(0, np.int64)
+                )
+                # Snapshot the tiny per-node columns _unpack reads after the
+                # lock is released (the SoA views alias the live store
+                # buffers; result assembly must group by the DECIDED state,
+                # not whatever a watch thread wrote since).
+                unpack_group = np.array(nodes.group)
+                unpack_valid = np.array(nodes.valid)
+                unpack_tainted_col = np.array(nodes.tainted)
+                unpack_cordoned_col = np.array(nodes.cordoned)
+                unpack_cordoned = unpack_valid & unpack_cordoned_col
+                unpack_untainted = (
+                    unpack_valid & ~unpack_tainted_col & ~unpack_cordoned_col
+                )
+                # lazy-orders gate (kernel.lazy_orders_decide): tainted
+                # presence in the DECIDED snapshot (dry-mode view included) —
+                # when no node is tainted and no group scales down, no
+                # ordering window is ever read, and the decide skips its
+                # dominant [N]-lane sort
+                tainted_any = bool(
+                    (np.asarray(nodes.valid)
+                     & np.asarray(nodes.tainted)).any())
+                # Packing-aware groups: gather their pod/bin lanes from the
+                # same locked snapshot; the device FFD runs after decide,
+                # outside the lock
+                packing_rows = self._gather_packing_inputs(
+                    group_inputs, pods, nodes)
+                if rebuild:
+                    # first tick or store growth: copy the full columns under
+                    # the lock; the O(cluster) device upload happens AFTER
+                    # release so watch ingestion never stalls behind a
+                    # transfer/compile. Pre-drained pending batches are
+                    # superseded by the full upload (the store columns
+                    # already carry their effects) — drop them.
+                    pending = []
+                    pods_snap = _copy_soa(pods)
+                    nodes_snap = _copy_soa(nodes)
+            # event_drain owns the WHOLE diff/pack: the dirty drain plus the
+            # delta-triple gather — one store crossing on the fast path, the
+            # legacy drain + per-column gather (from the dry-mode-corrected
+            # views bound just above) otherwise — so the phase means the same
+            # work whichever path a tick took
+            with obs.span("event_drain"):
+                if fast:
+                    gathered = self.store.drain_dirty_packed()
+                else:
+                    pod_dirty, node_dirty = self.store.drain_dirty()
+                    if not rebuild:
+                        node_dirty = np.unique(np.concatenate(
+                            [node_dirty, self._overridden_slots, overridden]))
+                        self._cache.set_host(pods, nodes)
+                        # lock covers only the host gather (reads the live
+                        # views); the device dispatch — and any jit compile
+                        # a new delta-bucket size triggers — happens after
+                        # release, so watch ingestion never convoys behind a
+                        # transfer or compile
+                        gathered = self._cache.gather_deltas(
+                            pod_dirty, node_dirty)
         with obs.span("scatter", kind="device"):
             if rebuild:
                 # outside the lock: upload the snapshot copies. The cache's host
@@ -293,6 +405,11 @@ class NativeJaxBackend(ComputeBackend):
                 # inserting a host sync the production path never had — the
                 # decide span absorbs any scatter tail, keeping the tick
                 # total honest while this phase reads as dispatch-only.
+                # Pre-drained pending batches (last tick's overlap window)
+                # apply FIRST, in drain order — a slot re-touched since
+                # lands in the fresh batch and overwrites.
+                for batch in pending:
+                    self._inc.apply_gathered(batch)
                 self._inc.apply_gathered(gathered, groups)
             else:
                 # two async dispatches (scatter, then decide) pipeline
@@ -301,14 +418,20 @@ class NativeJaxBackend(ComputeBackend):
                 # NOT fenced: the pipelining IS the optimization — the decide
                 # span below absorbs any scatter tail, so the tick total
                 # stays honest while this phase reads as dispatch-only.
+                for batch in pending:
+                    self._cache.apply_gathered(batch)
                 self._cache.apply_gathered(gathered, groups)
         self._overridden_slots = overridden
         t1 = time.perf_counter()
         if self._inc is not None:
             # incremental dispatch pair (delta_decide light / incremental
-            # ordered) with the same lazy-orders gate semantics
+            # ordered) with the same lazy-orders gate semantics; the decider
+            # runs _predrain in its dispatch-to-first-read window, so next
+            # tick's event drain hides under this tick's device program
             with obs.span("decide", kind="device"):
-                out, ordered = self._inc.decide(now_sec, tainted_any)
+                out, ordered = self._inc.decide(
+                    now_sec, tainted_any,
+                    overlap_work=self._predrain if self._overlap else None)
                 if not (self._overlap and ordered):
                     obs.fence(out)
             t2 = time.perf_counter()
@@ -621,21 +744,12 @@ class NativeJaxBackend(ComputeBackend):
         return results
 
 
-def make_native_backend(
-    client: EventfulClient,
-    node_group_options,
-    pod_capacity: int = 1 << 12,
-    node_capacity: int = 1 << 10,
-    incremental: "bool | None" = None,
-    refresh_every: "int | None" = None,
-    snapshot_dir: "str | None" = None,
-    snapshot_every: "int | None" = None,
-) -> NativeJaxBackend:
-    """Wire group filters from NodeGroupOptions (same filters the listers use).
-
-    Initial capacities start small — kernel shapes equal store capacity, so a modest
-    start keeps the first XLA compile fast; the store doubles (one recompile per
-    tier) as the cluster grows toward the 1<<21/1<<18 lifetime maxima."""
+def group_filters_from_options(node_group_options) -> "list[GroupFilters]":
+    """NodeGroupOptions -> the per-group membership filters the event
+    bridge resolves with (identical predicates to the listers' — one
+    definition, so the event path and the re-list path cannot drift).
+    Shared by :func:`make_native_backend` and
+    ``IncrementalJaxBackend.attach_event_source``."""
     from escalator_tpu.controller import node_group as ngmod
 
     filters = []
@@ -655,9 +769,31 @@ def make_native_backend(
                 ),
             )
         )
+    return filters
+
+
+def make_native_backend(
+    client: EventfulClient,
+    node_group_options,
+    pod_capacity: int = 1 << 12,
+    node_capacity: int = 1 << 10,
+    incremental: "bool | None" = None,
+    refresh_every: "int | None" = None,
+    snapshot_dir: "str | None" = None,
+    snapshot_every: "int | None" = None,
+    store_kind: str = "auto",
+    relist_audit_every: "int | str | None" = None,
+) -> NativeJaxBackend:
+    """Wire group filters from NodeGroupOptions (same filters the listers use).
+
+    Initial capacities start small — kernel shapes equal store capacity, so a modest
+    start keeps the first XLA compile fast; the store doubles (one recompile per
+    tier) as the cluster grows toward the 1<<21/1<<18 lifetime maxima."""
+    filters = group_filters_from_options(node_group_options)
     return NativeJaxBackend(
         client, filters, pod_capacity=pod_capacity,
         node_capacity=node_capacity, incremental=incremental,
         refresh_every=refresh_every, snapshot_dir=snapshot_dir,
-        snapshot_every=snapshot_every,
+        snapshot_every=snapshot_every, store_kind=store_kind,
+        relist_audit_every=relist_audit_every,
     )

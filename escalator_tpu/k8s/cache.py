@@ -30,6 +30,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from escalator_tpu.k8s import types as k8s
 from escalator_tpu.k8s.client import InMemoryKubernetesClient
+from escalator_tpu.native.statestore import NO_TAINT_TIME
 
 log = logging.getLogger("escalator_tpu.k8s.cache")
 
@@ -245,8 +246,6 @@ class WatchBridge:
                 taint_time = int(taint.value)
             except ValueError:
                 taint_time = None
-        from escalator_tpu.native.statestore import NO_TAINT_TIME
-
         slot = self.store.upsert_node(
             node.name, gi, node.cpu_allocatable_milli, node.mem_allocatable_bytes,
             creation_ns=node.creation_time_ns,
@@ -267,3 +266,86 @@ class WatchBridge:
     def node_at_slot(self, slot: int) -> Optional[k8s.Node]:
         name = self._node_slot_names.get(slot)
         return self.node_objects.get(name) if name is not None else None
+
+    # -- re-list reconciliation (round 12) -----------------------------------
+    def set_groups(self, groups: Sequence[GroupFilters],
+                   client=None) -> Optional[dict]:
+        """Replace the nodegroup filter set (a config reload: group added,
+        removed, or re-labelled). Filters decide membership, and the bridge
+        does NOT retain pod objects (only their resolved records), so a
+        filter change must re-resolve membership from a full re-list:
+        when ``client`` is given, :meth:`resync` runs immediately and its
+        stats are returned; otherwise the caller owns scheduling the resync
+        before the next decide (until then, pod group assignments reflect
+        the OLD filter set)."""
+        lock = getattr(self.store, "lock", None) or self._fallback_lock
+        with lock:
+            self.groups = list(groups)
+        # a filter change invalidates every group resolution: full re-apply
+        return self.resync(client, full=True) if client is not None else None
+
+    def resync(self, client, full: bool = False) -> dict:
+        """Full re-list reconciliation — the O(cluster) operation the
+        streaming path demotes re-listing to (bootstrap / audit / filter
+        change). Re-delivers the client's CURRENT state as ADDED events
+        (re-resolving every object's group under the current filters) and
+        deletes store entries for objects that no longer exist — healing
+        any drift a lost/transposed event could have caused, exactly as a
+        k8s informer's relist does. Runs under the client lock so no
+        mutation lands between the list and the reconcile, and under the
+        store lock so a concurrent decide never sees a half-applied
+        resync. Returns ``{"pods_dropped", "nodes_dropped",
+        "events_reapplied"}``.
+
+        ``full=False`` (the cadence audit) re-applies only objects that
+        DIFFER from the bridge's records: an unchanged object skips its
+        store upsert, so a clean audit marks zero slots dirty and the next
+        tick's delta batch stays empty instead of rescattering the whole
+        cluster (at 1M pods an unconditional re-apply would drain a
+        full-capacity packed batch and compile a fresh full-size scatter —
+        the exact spike an audit tick must not have). ``full=True``
+        (:meth:`set_groups`) re-applies everything — a filter change moves
+        membership without changing any object."""
+        import contextlib
+
+        store_lock = getattr(self.store, "lock", None) or self._fallback_lock
+        # the in-memory client exposes its lock; a real apiserver adapter has
+        # no global lock to take (its LIST is a consistent snapshot already)
+        client_lock = getattr(client, "_lock", None) or contextlib.nullcontext()
+        with client_lock, store_lock:
+            live_pods = [p for p in client.list_pods()
+                         if p.phase not in ("Succeeded", "Failed")]
+            live_nodes = client.list_nodes()
+            live_pod_uids = {f"{p.namespace}/{p.name}" for p in live_pods}
+            live_node_names = {n.name for n in live_nodes}
+            # drop what the world no longer has (a DELETED event we missed)
+            stale_pods = [uid for uid in self._pod_records
+                          if uid not in live_pod_uids]
+            for uid in stale_pods:
+                self._forget_pod(uid)
+                self.store.delete_pod(uid)
+            stale_nodes = [name for name in list(self.node_objects)
+                           if name not in live_node_names]
+            for name in stale_nodes:
+                self._drop_node(self.node_objects[name])
+            # re-deliver current state (nodes first: pods bind to slots)
+            before = self.events_applied
+            for node in live_nodes:
+                if not full and self.node_objects.get(node.name) == node:
+                    continue   # identical object, same filters: no drift
+                self._apply_node(WatchEvent("node", ADDED, node))
+            for pod in live_pods:
+                if not full:
+                    uid = f"{pod.namespace}/{pod.name}"
+                    rec = self._pod_records.get(uid)
+                    if rec is not None:
+                        req = k8s.compute_pod_resource_request(pod)
+                        if rec == (self._pod_group(pod), req.cpu_milli,
+                                   req.mem_bytes, pod.node_name):
+                            continue   # record matches: store is current
+                self._apply_pod(WatchEvent("pod", ADDED, pod))
+            return {
+                "pods_dropped": len(stale_pods),
+                "nodes_dropped": len(stale_nodes),
+                "events_reapplied": self.events_applied - before,
+            }

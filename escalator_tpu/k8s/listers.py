@@ -1,10 +1,16 @@
 """Filtered listers over the cluster cache — mirror of
 /root/reference/pkg/k8s/pod_listers.go and node_listers.go. A lister = a list source
-plus a filter predicate; the controller builds one pair per nodegroup."""
+plus a filter predicate; the controller builds one pair per nodegroup.
+
+Round 12: with streaming ingestion primary (watch-event deltas feeding the
+state store, controller/native_backend.py), the per-tick lister walk is
+DEMOTED to bootstrap, the re-list audit, and object-level backends —
+:func:`relist_group_inputs` is that reference path made explicit, shared by
+the digest-parity tests/smoke/bench that hold the event-driven path to it."""
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from escalator_tpu.k8s import types as k8s
 from escalator_tpu.k8s.client import KubernetesClient
@@ -29,6 +35,35 @@ class NodeLister:
 
     def list(self) -> List[k8s.Node]:
         return [n for n in self._client.list_nodes() if self._filter(n)]
+
+
+def relist_group_inputs(
+    client: KubernetesClient,
+    filters: Sequence,                       # GroupFilters (k8s.cache)
+    configs: Sequence,                       # semantics.GroupConfig per group
+    states: Sequence,                        # semantics.GroupState per group
+) -> List[Tuple[list, list, object, object]]:
+    """The RE-LIST path, as one call: walk the client's full object world
+    through each group's membership filters (first match wins — the same
+    disjoint-selector semantics the WatchBridge applies per event, and the
+    same Succeeded/Failed exclusion) and return backend-ready
+    ``group_inputs``. O(groups x cluster) by construction — this is the
+    cost the streaming path exists to avoid, kept as the ground truth the
+    event-maintained store is digest-compared against (bootstrap, audit,
+    parity suites)."""
+    pods = [p for p in client.list_pods()
+            if p.phase not in ("Succeeded", "Failed")]
+    nodes = client.list_nodes()
+    out: List[Tuple[list, list, object, object]] = []
+    for gi, g in enumerate(filters):
+        gpods = [p for p in pods
+                 if g.pod_filter(p)
+                 and not any(h.pod_filter(p) for h in filters[:gi])]
+        gnodes = [n for n in nodes
+                  if g.node_filter(n)
+                  and not any(h.node_filter(n) for h in filters[:gi])]
+        out.append((gpods, gnodes, configs[gi], states[gi]))
+    return out
 
 
 class FakeLister:

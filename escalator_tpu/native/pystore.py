@@ -1,0 +1,381 @@
+"""Pure-numpy state store: the toolchain-free twin of statestore.cpp.
+
+The streaming-ingestion tentpole (round 12) makes the watch-delta store the
+PRIMARY per-tick feed, so it can no longer be optional on a host without a
+C++ toolchain. This module is the API-identical fallback
+``statestore.make_state_store`` returns when the native build is
+unavailable: the same slot registry / freelist semantics, the same
+epoch-stamped deduplicated dirty sets, the same zero-copy column views and
+the same packed dirty drain — implemented over PREALLOCATED numpy columns
+(allocated once at the lifetime maxima, exactly like the C++ side's
+``reserve_max``, so views stay stable across growth) with fully vectorized
+batch paths. Key→slot resolution is a hash-map walk in both stores; every
+column write, dirty mark and drain gather here is a numpy bulk operation.
+
+Bit parity with the native store is test-locked (tests/test_event_ingest_
+parity.py drives both through identical mutation sequences and compares
+columns, dirty order and packed-drain batches bitwise).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+import numpy as np
+
+from escalator_tpu.native.statestore import (
+    NO_TAINT_TIME,
+    _NODE_FIELDS,
+    _POD_FIELDS,
+    delta_bucket,
+)
+
+_POD_DEFAULTS = {"node": -1}
+_NODE_DEFAULTS = {"taint_time_sec": NO_TAINT_TIME}
+
+
+class _Registry:
+    """key -> slot with freelist reuse (statestore.cpp Registry semantics:
+    freelist LIFO first, then high-water growth)."""
+
+    __slots__ = ("index", "free", "capacity", "high_water")
+
+    def __init__(self, capacity: int):
+        self.index: Dict[str, int] = {}
+        self.free: List[int] = []
+        self.capacity = int(capacity)
+        self.high_water = 0
+
+    def acquire(self, key: str) -> int:
+        slot = self.index.get(key)
+        if slot is not None:
+            return slot
+        if self.free:
+            slot = self.free.pop()
+        elif self.high_water < self.capacity:
+            slot = self.high_water
+            self.high_water += 1
+        else:
+            return -1
+        self.index[key] = slot
+        return slot
+
+    def release(self, key: str) -> int:
+        slot = self.index.pop(key, None)
+        if slot is None:
+            return -1
+        self.free.append(slot)
+        return slot
+
+
+class _DirtySet:
+    """Insertion-ordered deduplicated dirty slots via per-slot epoch stamps
+    (statestore.cpp DirtySet): O(1)/vectorized mark, no clearing pass."""
+
+    __slots__ = ("epoch_of", "epoch", "chunks", "count")
+
+    def __init__(self, max_slots: int):
+        self.epoch_of = np.zeros(max_slots, np.uint64)
+        self.epoch = np.uint64(1)
+        self.chunks: List[np.ndarray] = []
+        self.count = 0
+
+    def mark(self, slots: np.ndarray) -> None:
+        """Mark a batch (vectorized). Within-batch duplicates keep their
+        FIRST occurrence's position, as the C++ per-event loop does."""
+        if slots.size == 0:
+            return
+        if slots.size > 1:
+            # first-occurrence order: unique returns sorted values with the
+            # index of each value's first appearance
+            _, first = np.unique(slots, return_index=True)
+            slots = slots[np.sort(first)]
+        fresh = slots[self.epoch_of[slots] != self.epoch]
+        if fresh.size:
+            self.epoch_of[fresh] = self.epoch
+            self.chunks.append(fresh.astype(np.int64, copy=False))
+            self.count += int(fresh.size)
+
+    def drain(self) -> np.ndarray:
+        out = (np.concatenate(self.chunks) if self.chunks
+               else np.empty(0, np.int64))
+        self.chunks = []
+        self.count = 0
+        self.epoch += np.uint64(1)
+        return out
+
+
+class PyStateStore:
+    """Numpy twin of :class:`~escalator_tpu.native.statestore.
+    NativeStateStore` — same public surface, same concurrency contract
+    (``lock`` is the single-writer agreement the WatchBridge and the
+    backends share), same generation counter on growth."""
+
+    def __init__(self, pod_capacity: int = 1 << 17, node_capacity: int = 1 << 15,
+                 max_pods: int = 1 << 21, max_nodes: int = 1 << 18):
+        if pod_capacity > max_pods or node_capacity > max_nodes:
+            raise MemoryError("ess_new failed (capacity > max?)")
+        self._max_pods = int(max_pods)
+        self._max_nodes = int(max_nodes)
+        # preallocate at the lifetime maxima (the numpy analog of the C++
+        # reserve_max): growth only moves the logical capacity, so views
+        # (slices of these buffers) never relocate
+        self._pod_cols = {
+            name: np.full(self._max_pods, _POD_DEFAULTS.get(name, 0), dt)
+            for name, dt in _POD_FIELDS
+        }
+        self._node_cols = {
+            name: np.full(self._max_nodes, _NODE_DEFAULTS.get(name, 0), dt)
+            for name, dt in _NODE_FIELDS
+        }
+        self._pod_reg = _Registry(pod_capacity)
+        self._node_reg = _Registry(node_capacity)
+        self._pod_dirty = _DirtySet(self._max_pods)
+        self._node_dirty = _DirtySet(self._max_nodes)
+        self.generation = 0
+        self.lock = threading.RLock()
+
+    # -- capacities ----------------------------------------------------------
+    @property
+    def pod_capacity(self) -> int:
+        return self._pod_reg.capacity
+
+    @property
+    def node_capacity(self) -> int:
+        return self._node_reg.capacity
+
+    @property
+    def pod_count(self) -> int:
+        return len(self._pod_reg.index)
+
+    @property
+    def node_count(self) -> int:
+        return len(self._node_reg.index)
+
+    def grow(self, pod_capacity: int, node_capacity: int) -> None:
+        if pod_capacity > self._max_pods or node_capacity > self._max_nodes:
+            raise MemoryError(
+                f"grow({pod_capacity}, {node_capacity}) exceeds the store's"
+                " lifetime max capacity"
+            )
+        self._pod_reg.capacity = max(self._pod_reg.capacity, int(pod_capacity))
+        self._node_reg.capacity = max(self._node_reg.capacity,
+                                      int(node_capacity))
+        self.generation += 1
+
+    def _ensure_pod_capacity(self) -> None:
+        if self.pod_count >= self.pod_capacity:
+            self.grow(self.pod_capacity * 2, self.node_capacity)
+
+    def _ensure_node_capacity(self) -> None:
+        if self.node_count >= self.node_capacity:
+            self.grow(self.pod_capacity, self.node_capacity * 2)
+
+    # -- single-object deltas ------------------------------------------------
+    def upsert_pod(self, uid: str, group: int, cpu_milli: int, mem_bytes: int,
+                   node_slot: int = -1) -> int:
+        with self.lock:
+            self._ensure_pod_capacity()
+            slot = self._pod_reg.acquire(uid)
+            if slot < 0:
+                raise MemoryError("pod capacity exhausted")
+            c = self._pod_cols
+            c["group"][slot] = group
+            c["cpu_milli"][slot] = cpu_milli
+            c["mem_bytes"][slot] = mem_bytes
+            c["node"][slot] = node_slot
+            c["valid"][slot] = 1
+            self._pod_dirty.mark(np.array([slot]))
+            return slot
+
+    def delete_pod(self, uid: str) -> int:
+        with self.lock:
+            slot = self._pod_reg.release(uid)
+            if slot < 0:
+                return -1
+            c = self._pod_cols
+            c["valid"][slot] = 0
+            c["cpu_milli"][slot] = 0
+            c["mem_bytes"][slot] = 0
+            c["node"][slot] = -1
+            self._pod_dirty.mark(np.array([slot]))
+            return slot
+
+    def upsert_node(self, name: str, group: int, cpu_milli: int, mem_bytes: int,
+                    creation_ns: int = 0, tainted: bool = False,
+                    cordoned: bool = False, no_delete: bool = False,
+                    taint_time_sec: int = NO_TAINT_TIME) -> int:
+        with self.lock:
+            self._ensure_node_capacity()
+            slot = self._node_reg.acquire(name)
+            if slot < 0:
+                raise MemoryError("node capacity exhausted")
+            c = self._node_cols
+            c["group"][slot] = group
+            c["cpu_milli"][slot] = cpu_milli
+            c["mem_bytes"][slot] = mem_bytes
+            c["creation_ns"][slot] = creation_ns
+            c["tainted"][slot] = int(tainted)
+            c["cordoned"][slot] = int(cordoned)
+            c["no_delete"][slot] = int(no_delete)
+            c["taint_time_sec"][slot] = taint_time_sec
+            c["valid"][slot] = 1
+            self._node_dirty.mark(np.array([slot]))
+            return slot
+
+    def delete_node(self, name: str) -> int:
+        with self.lock:
+            slot = self._node_reg.release(name)
+            if slot < 0:
+                return -1
+            self._node_cols["valid"][slot] = 0
+            self._node_dirty.mark(np.array([slot]))
+            return slot
+
+    def node_slot(self, name: str) -> int:
+        slot = self._node_reg.index.get(name)
+        return -1 if slot is None else slot
+
+    def pod_slot(self, uid: str) -> int:
+        slot = self._pod_reg.index.get(uid)
+        return -1 if slot is None else slot
+
+    # -- batch deltas --------------------------------------------------------
+    def _acquire_batch(self, reg, keys, ensure) -> np.ndarray:
+        slots = np.empty(len(keys), np.int64)
+        acquire = reg.acquire
+        for i, k in enumerate(keys):
+            s = acquire(k)
+            if s < 0:
+                ensure()   # grow (raises past the lifetime max)
+                s = acquire(k)
+            slots[i] = s
+        return slots
+
+    def upsert_pods_batch(self, uids, group, cpu_milli, mem_bytes,
+                          node_slot=None) -> None:
+        n = len(uids)
+        if n == 0:
+            return
+        if node_slot is None:
+            node_slot = np.full(n, -1, np.int32)
+        cols = {
+            "group": np.asarray(group), "cpu_milli": np.asarray(cpu_milli),
+            "mem_bytes": np.asarray(mem_bytes), "node": np.asarray(node_slot),
+        }
+        for name, arr in cols.items():
+            if len(arr) != n:
+                raise ValueError(f"{name} has length {len(arr)}, expected {n}")
+        with self.lock:
+            slots = self._acquire_batch(
+                self._pod_reg, uids, self._ensure_pod_capacity)
+            # numpy integer-array assignment applies in order: a duplicated
+            # uid's LAST row wins, matching the C++ per-row loop
+            for name, arr in cols.items():
+                self._pod_cols[name][slots] = arr
+            self._pod_cols["valid"][slots] = 1
+            self._pod_dirty.mark(slots)
+
+    def upsert_nodes_batch(self, names, group, cpu_milli, mem_bytes,
+                           creation_ns=None, tainted=None, cordoned=None,
+                           no_delete=None, taint_time_sec=None) -> None:
+        n = len(names)
+        if n == 0:
+            return
+        fill = lambda v, d: np.asarray(  # noqa: E731
+            v if v is not None else np.full(n, d))
+        cols = {
+            "group": np.asarray(group), "cpu_milli": np.asarray(cpu_milli),
+            "mem_bytes": np.asarray(mem_bytes),
+            "creation_ns": fill(creation_ns, 0),
+            "tainted": fill(tainted, 0), "cordoned": fill(cordoned, 0),
+            "no_delete": fill(no_delete, 0),
+            "taint_time_sec": fill(taint_time_sec, NO_TAINT_TIME),
+        }
+        for name, arr in cols.items():
+            if len(arr) != n:
+                raise ValueError(f"{name} has length {len(arr)}, expected {n}")
+        with self.lock:
+            slots = self._acquire_batch(
+                self._node_reg, names, self._ensure_node_capacity)
+            for name, arr in cols.items():
+                self._node_cols[name][slots] = arr
+            self._node_cols["valid"][slots] = 1
+            self._node_dirty.mark(slots)
+
+    # -- dirty tracking ------------------------------------------------------
+    @property
+    def pod_dirty_count(self) -> int:
+        return self._pod_dirty.count
+
+    @property
+    def node_dirty_count(self) -> int:
+        return self._node_dirty.count
+
+    def drain_dirty(self):
+        with self.lock:
+            return self._pod_dirty.drain(), self._node_dirty.drain()
+
+    def drain_dirty_packed(self):
+        """Packed delta batch, bit-identical to
+        :meth:`NativeStateStore.drain_dirty_packed` for the same state: one
+        vectorized gather per column into bucket-padded buffers with the
+        scratch-lane pad convention."""
+        from escalator_tpu.core.arrays import NodeArrays, PodArrays
+
+        def packed(dirty, cols, fields, defaults, scratch, cls):
+            slots = dirty.drain()
+            bucket = delta_bucket(slots.size)
+            idx = np.full(bucket, scratch, np.int32)
+            idx[:slots.size] = slots
+            vals = {}
+            for name, dt in fields:
+                v = np.full(bucket, defaults.get(name, 0), dt)
+                if slots.size:
+                    v[:slots.size] = cols[name][slots]
+                # flag columns cross as bool, as the live views do
+                vals[name] = v.view(bool) if dt == np.uint8 else v
+            return idx, cls(**vals)
+
+        with self.lock:
+            pidx, pvals = packed(
+                self._pod_dirty, self._pod_cols, _POD_FIELDS, _POD_DEFAULTS,
+                self.pod_capacity, PodArrays)
+            nidx, nvals = packed(
+                self._node_dirty, self._node_cols, _NODE_FIELDS,
+                _NODE_DEFAULTS, self.node_capacity, NodeArrays)
+        return pidx, pvals, nidx, nvals
+
+    # -- views ---------------------------------------------------------------
+    def pod_views(self) -> Dict[str, np.ndarray]:
+        n = self.pod_capacity
+        return {name: col[:n] for name, col in self._pod_cols.items()}
+
+    def node_views(self) -> Dict[str, np.ndarray]:
+        n = self.node_capacity
+        return {name: col[:n] for name, col in self._node_cols.items()}
+
+    def as_pod_node_arrays(self):
+        """(PodArrays, NodeArrays) viewing the live buffers zero-copy —
+        same contract as the native store (bool columns are views of the
+        uint8 buffers)."""
+        from escalator_tpu.core.arrays import NodeArrays, PodArrays
+
+        pv = self.pod_views()
+        nv = self.node_views()
+        pods = PodArrays(
+            group=pv["group"], cpu_milli=pv["cpu_milli"],
+            mem_bytes=pv["mem_bytes"], node=pv["node"],
+            valid=pv["valid"].view(bool),
+        )
+        nodes = NodeArrays(
+            group=nv["group"], cpu_milli=nv["cpu_milli"],
+            mem_bytes=nv["mem_bytes"], creation_ns=nv["creation_ns"],
+            tainted=nv["tainted"].view(bool),
+            cordoned=nv["cordoned"].view(bool),
+            no_delete=nv["no_delete"].view(bool),
+            taint_time_sec=nv["taint_time_sec"],
+            valid=nv["valid"].view(bool),
+        )
+        return pods, nodes

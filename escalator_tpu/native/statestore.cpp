@@ -343,6 +343,87 @@ int64_t ess_drain_node_dirty(StateStore* s, int64_t* out) {
   return s->node_dirty.drain(out);
 }
 
+// Packed dirty drain (round 12): drain the deduplicated dirty-slot list AND
+// gather each slot's column values into caller-provided buffers in the SAME
+// crossing — the scatter-ready (idx, values) delta batch, padded to `bucket`
+// lanes. Before this, a tick paid one crossing for the drain plus ~14 numpy
+// fancy-indexing gathers in Python (ops/device_state._gather_padded); now the
+// whole "diff/pack" of a steady tick is one C call. Pad lanes [n, bucket)
+// point at the `scratch` lane and carry the scratch lane's invariant values
+// (valid=0, node=-1, taint_time=NO_TAINT_TIME, zeros elsewhere) — exactly
+// the _gather_padded contract, so duplicate-index scatter stays
+// deterministic and the jit sees the same shapes/values either way.
+// Returns the number of real (drained) lanes, or -1 when the dirty count
+// exceeds `bucket` (caller bug: the wrapper sizes the bucket from the count
+// under the store lock). The dirty set is NOT drained on -1.
+int64_t ess_drain_pod_dirty_packed(StateStore* s, int32_t* out_idx,
+                                   int32_t* group, int64_t* cpu_milli,
+                                   int64_t* mem_bytes, int32_t* node,
+                                   uint8_t* valid, int64_t bucket,
+                                   int32_t scratch) {
+  int64_t n = s->pod_dirty.count();
+  if (n > bucket) return -1;
+  const std::vector<int64_t>& slots = s->pod_dirty.slots;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = slots[static_cast<size_t>(i)];
+    out_idx[i] = static_cast<int32_t>(slot);
+    group[i] = s->pods.group[slot];
+    cpu_milli[i] = s->pods.cpu_milli[slot];
+    mem_bytes[i] = s->pods.mem_bytes[slot];
+    node[i] = s->pods.node[slot];
+    valid[i] = s->pods.valid[slot];
+  }
+  for (int64_t i = n; i < bucket; ++i) {
+    out_idx[i] = scratch;
+    group[i] = 0;
+    cpu_milli[i] = 0;
+    mem_bytes[i] = 0;
+    node[i] = -1;
+    valid[i] = 0;
+  }
+  s->pod_dirty.drain(nullptr);
+  return n;
+}
+
+int64_t ess_drain_node_dirty_packed(StateStore* s, int32_t* out_idx,
+                                    int32_t* group, int64_t* cpu_milli,
+                                    int64_t* mem_bytes, int64_t* creation_ns,
+                                    uint8_t* tainted, uint8_t* cordoned,
+                                    uint8_t* no_delete, int64_t* taint_time_sec,
+                                    uint8_t* valid, int64_t bucket,
+                                    int32_t scratch) {
+  int64_t n = s->node_dirty.count();
+  if (n > bucket) return -1;
+  const std::vector<int64_t>& slots = s->node_dirty.slots;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = slots[static_cast<size_t>(i)];
+    out_idx[i] = static_cast<int32_t>(slot);
+    group[i] = s->nodes.group[slot];
+    cpu_milli[i] = s->nodes.cpu_milli[slot];
+    mem_bytes[i] = s->nodes.mem_bytes[slot];
+    creation_ns[i] = s->nodes.creation_ns[slot];
+    tainted[i] = s->nodes.tainted[slot];
+    cordoned[i] = s->nodes.cordoned[slot];
+    no_delete[i] = s->nodes.no_delete[slot];
+    taint_time_sec[i] = s->nodes.taint_time_sec[slot];
+    valid[i] = s->nodes.valid[slot];
+  }
+  for (int64_t i = n; i < bucket; ++i) {
+    out_idx[i] = scratch;
+    group[i] = 0;
+    cpu_milli[i] = 0;
+    mem_bytes[i] = 0;
+    creation_ns[i] = 0;
+    tainted[i] = 0;
+    cordoned[i] = 0;
+    no_delete[i] = 0;
+    taint_time_sec[i] = INT64_C(-4611686018427387904);
+    valid[i] = 0;
+  }
+  s->node_dirty.drain(nullptr);
+  return n;
+}
+
 // Buffer pointer exports, one per column. Field ids keep the ABI append-only.
 void* ess_pod_buffer(StateStore* s, int32_t field) {
   switch (field) {

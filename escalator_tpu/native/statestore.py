@@ -33,9 +33,21 @@ _LIB = os.path.join(_HERE, "libessstate.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+_build_failed_reason: Optional[str] = None
 
 #: must match NO_TAINT_TIME in escalator_tpu.core.arrays
 NO_TAINT_TIME = -(2**62)
+
+_MIN_DELTA_BUCKET = 64
+
+
+def delta_bucket(n: int) -> int:
+    """Power-of-two delta-batch bucket (min 64) — THE padding policy shared
+    by the stores' packed dirty drain and ``ops.device_state``'s host-side
+    gather, so both paths hit the same compiled scatter shapes. Lives here
+    (not in device_state) because the stores must stay importable without
+    jax."""
+    return max(_MIN_DELTA_BUCKET, 1 << (max(n, 1) - 1).bit_length())
 
 _POD_FIELDS = [
     ("group", np.int32), ("cpu_milli", np.int64), ("mem_bytes", np.int64),
@@ -48,8 +60,27 @@ _NODE_FIELDS = [
 ]
 
 
+def _note_build_failure(what: str, err: Exception, stderr: str = "") -> None:
+    """Record WHY the native store is unavailable and say so ONCE at WARN —
+    including the decision the process is taking (the pure-numpy fallback
+    store), so a silently-degraded deployment is visible in the first page
+    of logs instead of only as a latency anomaly. ``unavailable_reason()``
+    exposes the same text to callers (capability-skipping tests, the
+    backend's flight-record annotation)."""
+    global _build_failed, _build_failed_reason
+    _build_failed = True
+    reason = f"{what}: {err}"
+    if stderr:
+        reason += f" | {stderr.strip()[:2000]}"
+    _build_failed_reason = reason
+    log.warning(
+        "native statestore unavailable (%s); event-driven ingestion will "
+        "use the pure-numpy fallback store (same semantics, host diff/pack "
+        "runs in vectorized numpy instead of one C crossing)", reason)
+
+
 def _build() -> Optional[ctypes.CDLL]:
-    global _lib, _build_failed
+    global _lib
     with _build_lock:
         if _lib is not None:
             return _lib
@@ -65,15 +96,13 @@ def _build() -> Optional[ctypes.CDLL]:
             try:
                 subprocess.run(cmd, check=True, capture_output=True, text=True)
             except (subprocess.CalledProcessError, OSError) as e:
-                stderr = getattr(e, "stderr", "")
-                log.warning("native statestore build failed: %s %s", e, stderr)
-                _build_failed = True
+                _note_build_failure(
+                    "compile failed", e, getattr(e, "stderr", "") or "")
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
         except OSError as e:
-            log.warning("native statestore load failed: %s", e)
-            _build_failed = True
+            _note_build_failure("load failed", e)
             return None
         lib.ess_new.restype = ctypes.c_void_p
         lib.ess_new.argtypes = [
@@ -132,12 +161,31 @@ def _build() -> Optional[ctypes.CDLL]:
             getattr(lib, fn).argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)
             ]
+        # packed dirty drain (round 12): drain + gather + pad in ONE crossing
+        lib.ess_drain_pod_dirty_packed.restype = ctypes.c_int64
+        lib.ess_drain_pod_dirty_packed.argtypes = [
+            ctypes.c_void_p, i32p, i32p, i64ptr, i64ptr, i32p, u8p,
+            ctypes.c_int64, ctypes.c_int32,
+        ]
+        lib.ess_drain_node_dirty_packed.restype = ctypes.c_int64
+        lib.ess_drain_node_dirty_packed.argtypes = [
+            ctypes.c_void_p, i32p, i32p, i64ptr, i64ptr, i64ptr, u8p, u8p,
+            u8p, i64ptr, u8p, ctypes.c_int64, ctypes.c_int32,
+        ]
         _lib = lib
         return lib
 
 
 def available() -> bool:
     return _build() is not None
+
+
+def unavailable_reason() -> Optional[str]:
+    """Why :func:`available` is False (compiler error tail, load error) —
+    None while the native store is (or may still prove) available. Probes
+    the build on first call, same as ``available()``."""
+    _build()
+    return _build_failed_reason
 
 
 class NativeStateStore:
@@ -375,6 +423,66 @@ class NativeStateStore:
                 _drain(self.node_dirty_count, self._lib.ess_drain_node_dirty),
             )
 
+    def drain_dirty_packed(self):
+        """Drain the dirty slots as a scatter-ready PACKED delta batch:
+        ``(pod_idx, pod_vals, node_idx, node_vals)`` — int32 index vectors
+        plus Pod/NodeArrays value batches, padded to the shared power-of-two
+        bucket (:func:`delta_bucket`) with the scratch-lane convention of
+        ``ops.device_state._gather_padded`` (pad idx = capacity, pad values =
+        the never-valid scratch constants). One C crossing replaces the
+        drain call plus ~14 numpy fancy-indexing gathers; the result feeds
+        ``DeviceClusterCache.apply_gathered`` / ``IncrementalDecider.
+        apply_gathered`` directly and is bit-identical to the
+        drain+gather path (test-locked)."""
+        from escalator_tpu.core.arrays import NodeArrays, PodArrays
+
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        as32 = lambda a: a.ctypes.data_as(i32p)      # noqa: E731
+        as64 = lambda a: a.ctypes.data_as(i64p)      # noqa: E731
+        asu8 = lambda a: a.ctypes.data_as(u8p)       # noqa: E731
+        with self.lock:
+            pb = delta_bucket(self.pod_dirty_count)
+            nb = delta_bucket(self.node_dirty_count)
+            pidx = np.empty(pb, np.int32)
+            pvals = PodArrays(
+                group=np.empty(pb, np.int32),
+                cpu_milli=np.empty(pb, np.int64),
+                mem_bytes=np.empty(pb, np.int64),
+                node=np.empty(pb, np.int32),
+                valid=np.empty(pb, np.bool_),
+            )
+            n = self._lib.ess_drain_pod_dirty_packed(
+                self._ptr, as32(pidx), as32(pvals.group), as64(pvals.cpu_milli),
+                as64(pvals.mem_bytes), as32(pvals.node), asu8(pvals.valid),
+                pb, self.pod_capacity,
+            )
+            if n < 0:  # pragma: no cover - bucket sized under the same lock
+                raise RuntimeError("packed pod drain bucket undersized")
+            nidx = np.empty(nb, np.int32)
+            nvals = NodeArrays(
+                group=np.empty(nb, np.int32),
+                cpu_milli=np.empty(nb, np.int64),
+                mem_bytes=np.empty(nb, np.int64),
+                creation_ns=np.empty(nb, np.int64),
+                tainted=np.empty(nb, np.bool_),
+                cordoned=np.empty(nb, np.bool_),
+                no_delete=np.empty(nb, np.bool_),
+                taint_time_sec=np.empty(nb, np.int64),
+                valid=np.empty(nb, np.bool_),
+            )
+            n = self._lib.ess_drain_node_dirty_packed(
+                self._ptr, as32(nidx), as32(nvals.group), as64(nvals.cpu_milli),
+                as64(nvals.mem_bytes), as64(nvals.creation_ns),
+                asu8(nvals.tainted), asu8(nvals.cordoned),
+                asu8(nvals.no_delete), as64(nvals.taint_time_sec),
+                asu8(nvals.valid), nb, self.node_capacity,
+            )
+            if n < 0:  # pragma: no cover
+                raise RuntimeError("packed node drain bucket undersized")
+        return pidx, pvals, nidx, nvals
+
     def pod_slot(self, uid: str) -> int:
         return self._lib.ess_pod_slot(self._ptr, uid.encode())
 
@@ -427,3 +535,35 @@ class NativeStateStore:
             valid=nv["valid"].view(bool),
         )
         return pods, nodes
+
+
+def make_state_store(pod_capacity: int = 1 << 17, node_capacity: int = 1 << 15,
+                     max_pods: int = 1 << 21, max_nodes: int = 1 << 18,
+                     kind: str = "auto"):
+    """The streaming-ingestion store, wherever the process runs: the C++
+    :class:`NativeStateStore` when the toolchain produced a library, else
+    the API-identical :class:`~escalator_tpu.native.pystore.PyStateStore`
+    (preallocated vectorized numpy — same slot/dirty/packed-drain
+    semantics, test-locked bit parity). ``kind`` forces one ("native" /
+    "numpy") for tests and benches that price both. The fallback decision
+    is logged once at WARN by the build probe with the compiler error."""
+    if kind not in ("auto", "native", "numpy"):
+        raise ValueError(f"unknown state-store kind {kind!r}")
+    if kind in ("auto", "native") and available():
+        return NativeStateStore(pod_capacity=pod_capacity,
+                                node_capacity=node_capacity,
+                                max_pods=max_pods, max_nodes=max_nodes)
+    if kind == "native":
+        raise RuntimeError(
+            f"native statestore unavailable ({unavailable_reason()})")
+    from escalator_tpu.native.pystore import PyStateStore
+
+    return PyStateStore(pod_capacity=pod_capacity,
+                        node_capacity=node_capacity,
+                        max_pods=max_pods, max_nodes=max_nodes)
+
+
+def store_kind(store) -> str:
+    """"native" | "numpy" — the flight-record annotation for which store
+    backs an event-driven backend."""
+    return "native" if isinstance(store, NativeStateStore) else "numpy"

@@ -42,7 +42,7 @@ DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_FLIGHT_RECORDER_SIZE",
 _META_KEYS = ("backend", "impl", "ordered", "digest", "dirty_groups",
               "refresh_audit", "caller", "trace_id", "fallback",
               "fallback_code", "chaos", "restored", "restored_tick",
-              "order_path", "order_dirty_lanes",
+              "order_path", "order_dirty_lanes", "store", "relist_audit",
               "overlap_host_ms", "overlap_sync_wait_ms", "overlap_saved_ms")
 
 #: stash key for the tick-open jaxmon snapshot (private to this module)

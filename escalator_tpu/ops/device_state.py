@@ -65,12 +65,11 @@ _register(PodArrays)
 _register(NodeArrays)
 _register(GroupArrays)
 
-_MIN_BUCKET = 64
-
-
-def _bucket(n: int) -> int:
-    """Smallest power-of-two >= n (min 64): bounds the set of compiled shapes."""
-    return max(_MIN_BUCKET, 1 << (max(n, 1) - 1).bit_length())
+# Delta-batch bucket policy: power-of-two, min 64 — bounds the set of
+# compiled scatter shapes. The ONE definition lives in the (jax-free) store
+# module, because the stores' packed dirty drain must pad to exactly the
+# same buckets or the two paths would compile disjoint shape sets.
+from escalator_tpu.native.statestore import delta_bucket as _bucket  # noqa: E402
 
 
 _POD_PAD = {"node": -1}
@@ -801,7 +800,8 @@ class IncrementalDecider:
         self._prev_cols = tuple(
             getattr(out, f) for f in _kernel.GROUP_DECISION_FIELDS)
 
-    def decide(self, now_sec, tainted_any: bool, _record: bool = True):
+    def decide(self, now_sec, tainted_any: bool, _record: bool = True,
+               overlap_work=None):
         """One lazy-orders tick (``kernel.lazy_orders_decide``) over the
         incremental dispatch pair. Returns ``(DecisionArrays, ordered)``
         with the protocol's exact semantics: when ``ordered`` is False the
@@ -810,7 +810,18 @@ class IncrementalDecider:
 
         ``_record=False`` suppresses input recording for this tick — the
         replay executor's own decides must not re-record themselves into
-        the ring they are replaying."""
+        the ring they are replaying.
+
+        ``overlap_work`` (round 12): an optional zero-arg host callback run
+        ONCE, in the window between the tick's decide dispatch returning
+        and its first blocking device read — i.e. while the device program
+        is in flight. This is how the streaming backend hides tick t+1's
+        event-drain under tick t's device time (the callback pre-drains the
+        store's accumulated watch deltas into a pending batch): the light
+        delta tick, whose lazy gate otherwise synchronizes immediately
+        after dispatch, gains the same host/device overlap the PR-5 ordered
+        path already had. The callback must not touch device state — it
+        runs with a donating dispatch in flight."""
         self._ticks += 1
         # repaired ordered-incremental ticks read a scalar AFTER the fused
         # program (see _order_finish) so the device is idle by the time the
@@ -841,10 +852,21 @@ class IncrementalDecider:
 
         from escalator_tpu import observability as obs
 
+        # at most ONE overlap-work run per tick, whichever dispatch path
+        # fires first (the lazy protocol may dispatch twice on a drain start)
+        overlap_ran = [False]
+
+        def run_overlap():
+            if overlap_work is None or overlap_ran[0]:
+                return
+            overlap_ran[0] = True
+            with obs.span("event_predrain"):
+                overlap_work()
+
         def dispatch(with_orders):
             if (with_orders and self._incremental_orders
                     and self._prev_cols is not None):
-                return self._ordered_incremental(now)
+                return self._ordered_incremental(now, run_overlap)
             if with_orders or self._prev_cols is None:
                 # full decide, fed the persistent aggregates: the O(P)/O(N)
                 # sweeps are skipped; every [G] row recomputes (cheap), so
@@ -857,6 +879,7 @@ class IncrementalDecider:
                         aggregates=_kernel.aggregates_tuple(self._aggs),
                         with_orders=with_orders,
                     )
+                    run_overlap()
                     if not (self._overlap and with_orders):
                         # fence blocks (and propagates device failures) —
                         # one synchronization, not a redundant pair; an
@@ -871,8 +894,12 @@ class IncrementalDecider:
                 idx = _kernel.dirty_indices(dirty)
                 out, self._aggs = _kernel.delta_decide_jit(
                     self._cache.cluster, self._aggs, self._prev_cols, idx, now)
-                # always fenced: the lazy gate reads nodes_delta right after
-                # this dispatch anyway, so an overlap here would buy nothing
+                # the overlap window the light tick otherwise lacks: the
+                # gate reads nodes_delta right after this dispatch, so any
+                # host work that can run now (the streaming backend's event
+                # pre-drain) hides under the in-flight delta program
+                run_overlap()
+                # fenced: the lazy gate synchronizes here regardless
                 out = obs.fence(out)
             self._set_prev(out)
             return out
@@ -928,14 +955,17 @@ class IncrementalDecider:
 
     # -- incremental ordered ticks (round 10) -------------------------------
 
-    def _ordered_incremental(self, now):
+    def _ordered_incremental(self, now, run_overlap=None):
         """An ordered dispatch WITHOUT the full [N] sort: group columns via
         the same ``delta_decide`` program the light tick runs, the ordering
         permutation via the persistent order state's rank-repair merge
         (ops.order_tail). Output contract identical to the full ordered
         decide: every non-order field bit-exact, the ordering WINDOWS
         bit-exact vs the full sort (the whole permutation is, in fact —
-        both formulations produce the unique strict 4-key order)."""
+        both formulations produce the unique strict 4-key order).
+        ``run_overlap`` (round 12) fires after the fused dispatch, before
+        the repair's one scalar readback — the ordered tick's overlap
+        window."""
         from escalator_tpu import observability as obs
 
         with obs.span("decide_ordered_incremental", kind="device"):
@@ -948,6 +978,8 @@ class IncrementalDecider:
                 out, self._aggs = _kernel.delta_decide_jit(
                     self._cache.cluster, self._aggs, self._prev_cols, idx,
                     now)
+                if run_overlap is not None:
+                    run_overlap()
                 perm, scale_down = self._order_bootstrap(out.tainted_offsets)
             else:
                 # steady state: delta decide + order repair as ONE fused
@@ -958,6 +990,8 @@ class IncrementalDecider:
                 out, self._aggs, ostate = _kernel.ordered_delta_decide_jit(
                     self._cache.cluster, self._aggs, self._prev_cols, idx,
                     now, om, ok1, ok2, operm, self._order_bucket)
+                if run_overlap is not None:
+                    run_overlap()
                 perm, scale_down = self._order_finish(
                     ostate, out.tainted_offsets)
             # tainted block first = untaint order; rolled to the tail =

@@ -1,0 +1,76 @@
+"""Deterministic two-group streaming-ingestion world — the ONE definition
+of the event-vs-relist parity fixture shared by `bench.py --smoke` and
+`tests/test_event_ingest_parity.py` (the smoke and the test suite must keep
+asserting the same contract, so they must drive the same world).
+
+Objects are explicitly named (the builders' global name counter would make
+two separately-built worlds drift otherwise).
+"""
+
+from __future__ import annotations
+
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.k8s.cache import EventfulClient, GroupFilters
+from escalator_tpu.testsupport.builders import (
+    NodeOpts,
+    PodOpts,
+    build_test_node,
+    build_test_pod,
+)
+
+GROUPS = ("alpha", "beta")
+LABEL_KEY = "customer"
+
+
+def stream_pod(name, group, cpu=500, mem=10**9, node=""):
+    return build_test_pod(PodOpts(
+        name=name, cpu=[cpu], mem=[mem],
+        node_selector_key=LABEL_KEY, node_selector_value=group,
+        node_name=node))
+
+
+def stream_node(name, group, cpu=4000, mem=16 * 10**9, creation=1):
+    return build_test_node(NodeOpts(
+        name=name, cpu=cpu, mem=mem, label_key=LABEL_KEY, label_value=group,
+        creation_time_ns=creation * 10**9))
+
+
+def stream_filters(values=GROUPS):
+    """One GroupFilters per group value — the same predicates the listers
+    resolve with (controller.node_group)."""
+    return [
+        GroupFilters(
+            name=v,
+            pod_filter=ngmod.new_pod_affinity_filter_func(LABEL_KEY, v),
+            node_filter=ngmod.new_node_label_filter_func(LABEL_KEY, v),
+        )
+        for v in values
+    ]
+
+
+def stream_configs(n):
+    return [
+        sem.GroupConfig(
+            min_nodes=0, max_nodes=100, taint_lower_percent=30,
+            taint_upper_percent=45, scale_up_percent=70,
+            slow_removal_rate=1, fast_removal_rate=2,
+            soft_delete_grace_sec=300, hard_delete_grace_sec=900,
+        )
+        for _ in range(n)
+    ]
+
+
+def stream_world(nodes_per_group=4, pods_per_group=14) -> EventfulClient:
+    """EventfulClient holding the deterministic two-group world: per group,
+    `nodes_per_group` nodes (distinct creation times) and `pods_per_group`
+    pods bound round-robin onto them."""
+    client = EventfulClient()
+    for g, val in enumerate(GROUPS):
+        for i in range(nodes_per_group):
+            client.add_node(stream_node(
+                f"{val}-n{i}", val, creation=10 * g + i + 1))
+        for i in range(pods_per_group):
+            client.add_pod(stream_pod(
+                f"{val}-p{i}", val, node=f"{val}-n{i % nodes_per_group}"))
+    return client

@@ -208,6 +208,8 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
                        str(tmp_path / "flight-smoke.json"))
     monkeypatch.setenv("ESCALATOR_TPU_REPLAY_SMOKE",
                        str(tmp_path / "replay-smoke.json"))
+    monkeypatch.setenv("ESCALATOR_TPU_HOST_PHASES_SMOKE",
+                       str(tmp_path / "host-phases.json"))
     out = bench.run_smoke()
     assert out["smoke_cfg8_parity"] == "ok"
     assert out["smoke_cfg10_parity"] == "ok"
@@ -235,6 +237,23 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
     assert dump["ticks"], "smoke dump carries no tick records"
     assert any(p["name"] == "delta_decide"
                for t in dump["ticks"] for p in t["phases"])
+    # round 12: streaming ingestion smoke — event-driven vs re-list digest
+    # parity on every exercised store kind, the production phase taxonomy
+    # (event_drain / triple_build, run_smoke asserts the names internally),
+    # and the host-phase breakdown artifact CI uploads
+    for kind in out["smoke_streaming_store_kinds"]:
+        assert out[f"smoke_streaming_parity_{kind}"] == "ok"
+    assert "numpy" in out["smoke_streaming_store_kinds"]
+    assert out["smoke_streaming_phases"] == "ok"
+    assert out["smoke_streaming_backend_store"] in ("native", "numpy")
+    host_phases = json.loads((tmp_path / "host-phases.json").read_text())
+    assert "event_drain" in host_phases["native_backend_tick_ms"]
+    assert "triple_build" in host_phases["native_backend_tick_ms"]
+    for kind in out["smoke_streaming_store_kinds"]:
+        assert host_phases["streaming_ticks_ms"][kind]["_ticks"] >= 1
+    dump_phase_names = {p["name"]
+                        for t in dump["ticks"] for p in t["phases"]}
+    assert {"event_drain", "triple_build"} <= dump_phase_names
 
 
 def test_archived_e2e_filter(bench):

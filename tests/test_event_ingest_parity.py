@@ -1,0 +1,433 @@
+"""Streaming ingestion (round 12): event-applier edge cases vs full re-list.
+
+The tentpole's safety contract: the event-maintained store must decide
+EXACTLY what a full re-list would, on every tick, through every ugly event
+interleaving — pod rebinding across node slot reuse, delete-then-re-add of
+the same UID inside one tick window, group add/remove while events are
+queued, and randomized soak churn. Every tick's parity is digest-exact
+(crc32 over the [G] status/delta columns — layout-independent, so the
+slot-keyed store and the packer's group-contiguous layout are comparable).
+
+Also locks the store twins: PyStateStore is bit-identical to the C++
+NativeStateStore for the same mutation sequence (columns, dirty order,
+packed drain), and the packed drain is bit-identical to the legacy
+drain+gather path.
+"""
+
+import numpy as np
+import pytest
+
+from escalator_tpu.controller import node_group as ngmod
+from escalator_tpu.core import semantics as sem
+from escalator_tpu.core.arrays import ClusterArrays, pack_cluster, pack_groups
+from escalator_tpu.k8s import types as k8s
+from escalator_tpu.k8s.cache import WatchBridge
+from escalator_tpu.k8s.listers import relist_group_inputs
+from escalator_tpu.native import statestore
+from escalator_tpu.native.pystore import PyStateStore
+
+# the parity fixture is shared with bench.py --smoke (ONE world definition,
+# so the smoke and this suite assert the same contract)
+from escalator_tpu.testsupport.streamworld import (
+    GROUPS,
+    stream_configs as make_configs,
+    stream_filters as make_filters,
+    stream_node as node,
+    stream_pod as pod,
+    stream_world as make_world,
+)
+
+
+class StreamHarness:
+    """Event pipeline + decider on one side, re-list reference on the other."""
+
+    def __init__(self, store_kind="numpy", n_groups=2):
+        from escalator_tpu.ops.device_state import (
+            DeviceClusterCache,
+            IncrementalDecider,
+        )
+
+        self.client = make_world()
+        self.filters = make_filters(GROUPS[:n_groups])
+        self.configs = make_configs(n_groups)
+        self.states = [sem.GroupState() for _ in range(n_groups)]
+        self.store = statestore.make_state_store(
+            pod_capacity=256, node_capacity=64, kind=store_kind)
+        self.bridge = WatchBridge(self.store, self.filters)
+        self.client.subscribe(self.bridge.apply, replay=True)
+        pods_v, nodes_v = self.store.as_pod_node_arrays()
+        self.groups = pack_groups(
+            list(zip(self.configs, self.states, strict=True)), pad_groups=8)
+        self.store.drain_dirty()
+        self.cache = DeviceClusterCache(ClusterArrays(
+            groups=self.groups, pods=pods_v, nodes=nodes_v))
+        self.inc = IncrementalDecider(self.cache, refresh_every=0)
+        self.inc.decide(1_700_000_000, False)   # bootstrap
+
+    def stream_tick(self, now=1_700_000_000):
+        from escalator_tpu.observability.replay import decision_digest
+
+        gathered = self.store.drain_dirty_packed()
+        self.inc.apply_gathered(gathered)
+        nodes_v = self.store.as_pod_node_arrays()[1]
+        tainted_any = bool(
+            (np.asarray(nodes_v.valid) & np.asarray(nodes_v.tainted)).any())
+        out, _ordered = self.inc.decide(now, tainted_any)
+        return decision_digest(out)
+
+    def relist_digest(self, now=1_700_000_000):
+        import jax
+
+        from escalator_tpu.observability.replay import decision_digest
+        from escalator_tpu.ops.kernel import decide_jit
+
+        gi = relist_group_inputs(
+            self.client, self.filters, self.configs, self.states)
+        cluster = pack_cluster(gi, pad_pods=512, pad_nodes=64, pad_groups=8)
+        out = jax.block_until_ready(decide_jit(
+            jax.device_put(cluster), np.int64(now), with_orders=False))
+        return decision_digest(out)
+
+    def assert_parity(self, now=1_700_000_000, msg=""):
+        got, want = self.stream_tick(now), self.relist_digest(now)
+        assert got == want, f"stream {got} != relist {want} {msg}"
+
+
+# --------------------------------------------------------------- store twins
+NATIVE = pytest.mark.skipif(
+    not statestore.available(),
+    reason=f"native build unavailable: {statestore.unavailable_reason()}",
+)
+
+
+def _drive_store(s, rng):
+    s.upsert_pods_batch([f"p{i}" for i in range(40)],
+                        rng.integers(0, 4, 40), np.full(40, 500),
+                        np.full(40, 10**9), rng.integers(-1, 8, 40))
+    s.upsert_nodes_batch([f"n{i}" for i in range(8)], np.arange(8) % 4,
+                         np.full(8, 4000), np.full(8, 16 * 10**9),
+                         creation_ns=rng.integers(1, 10**12, 8),
+                         tainted=rng.integers(0, 2, 8))
+    for i in rng.integers(0, 40, 10):
+        s.delete_pod(f"p{i}")
+    s.delete_node("n3")
+    s.upsert_pod("p99", 2, 123, 456, node_slot=1)
+    s.upsert_node("n9", 1, 2000, 8 * 10**9)   # reuses n3's slot
+
+
+@NATIVE
+class TestStoreTwins:
+    def test_columns_dirty_and_packed_drain_bit_identical(self):
+        rng1 = np.random.default_rng(5)
+        rng2 = np.random.default_rng(5)
+        ns = statestore.NativeStateStore(pod_capacity=64, node_capacity=16)
+        ps = PyStateStore(pod_capacity=64, node_capacity=16)
+        _drive_store(ns, rng1)
+        _drive_store(ps, rng2)
+        for a, b in ((ns.pod_views(), ps.pod_views()),
+                     (ns.node_views(), ps.node_views())):
+            for name in a:
+                np.testing.assert_array_equal(a[name], b[name],
+                                              err_msg=name)
+        pa = ns.drain_dirty_packed()
+        pb = ps.drain_dirty_packed()
+        np.testing.assert_array_equal(pa[0], pb[0])
+        np.testing.assert_array_equal(pa[2], pb[2])
+        for f in pa[1].__dataclass_fields__:
+            x, y = getattr(pa[1], f), getattr(pb[1], f)
+            assert x.dtype == y.dtype, f
+            np.testing.assert_array_equal(x, y, err_msg=f)
+        for f in pa[3].__dataclass_fields__:
+            x, y = getattr(pa[3], f), getattr(pb[3], f)
+            assert x.dtype == y.dtype, f
+            np.testing.assert_array_equal(x, y, err_msg=f)
+
+    def test_packed_drain_matches_drain_plus_gather(self):
+        """The one-crossing packed drain is bit-identical to the legacy
+        drain_dirty + _gather_padded path (same buckets, same scratch, same
+        pad constants) — the fast path can never decide differently."""
+        from escalator_tpu.ops import device_state as ds
+
+        rng1 = np.random.default_rng(6)
+        rng2 = np.random.default_rng(6)
+        a = statestore.NativeStateStore(pod_capacity=64, node_capacity=16)
+        b = statestore.NativeStateStore(pod_capacity=64, node_capacity=16)
+        _drive_store(a, rng1)
+        _drive_store(b, rng2)
+        packed = a.drain_dirty_packed()
+        pd, nd = b.drain_dirty()
+        pods_v, nodes_v = b.as_pod_node_arrays()
+        pidx, pvals = ds._gather_padded(
+            pods_v, pd, ds._bucket(len(pd)), b.pod_capacity, ds._POD_PAD)
+        nidx, nvals = ds._gather_padded(
+            nodes_v, nd, ds._bucket(len(nd)), b.node_capacity, ds._NODE_PAD)
+        np.testing.assert_array_equal(packed[0], pidx)
+        np.testing.assert_array_equal(packed[2], nidx)
+        for f in pvals.__dataclass_fields__:
+            np.testing.assert_array_equal(
+                getattr(packed[1], f), getattr(pvals, f), err_msg=f)
+        for f in nvals.__dataclass_fields__:
+            np.testing.assert_array_equal(
+                getattr(packed[3], f), getattr(nvals, f), err_msg=f)
+
+    def test_unavailable_reason_none_when_available(self):
+        assert statestore.unavailable_reason() is None
+
+    def test_make_state_store_kinds(self):
+        assert isinstance(
+            statestore.make_state_store(kind="numpy", pod_capacity=64,
+                                        node_capacity=16),
+            PyStateStore)
+        assert statestore.store_kind(
+            statestore.make_state_store(kind="native", pod_capacity=64,
+                                        node_capacity=16)) == "native"
+        with pytest.raises(ValueError):
+            statestore.make_state_store(kind="bogus")
+
+
+# -------------------------------------------------- applier edge-case parity
+@pytest.mark.parametrize("store_kind", ["numpy", pytest.param(
+    "native", marks=NATIVE)])
+class TestApplierEdgeCasesVsRelist:
+    def test_pod_rebind_on_node_slot_reuse(self, store_kind):
+        """Delete a node whose slot is then reused by a NEW node: pods bound
+        to the dead node must not inherit the recycled slot, and pods of
+        the new node must bind to it — digest-exact vs re-list."""
+        h = StreamHarness(store_kind)
+        old_slot = h.store.node_slot("alpha-n1")
+        h.client.delete_node("alpha-n1")
+        h.client.add_node(node("beta-n9", "beta", creation=99))
+        assert h.store.node_slot("beta-n9") == old_slot   # slot reused
+        # a pod still claiming the dead node, and one landing on the new one
+        h.client.update_pod(pod("alpha-p1", "alpha", node="alpha-n1"))
+        h.client.add_pod(pod("beta-p77", "beta", cpu=900, node="beta-n9"))
+        h.assert_parity(msg="(slot reuse)")
+        # late node re-add heals the dangling binding too
+        h.client.add_node(node("alpha-n1", "alpha", creation=123))
+        h.assert_parity(msg="(node re-added)")
+
+    def test_delete_then_add_same_uid_one_window(self, store_kind):
+        """DELETE + ADD of the same pod UID inside one tick window must land
+        as the new pod's values (and exactly once) in the decided state."""
+        h = StreamHarness(store_kind)
+        victim = [p for p in h.client.list_pods() if p.name == "alpha-p5"][0]
+        h.client.remove_pod(victim)
+        h.client.add_pod(pod("alpha-p5", "alpha", cpu=2000, mem=4 * 10**9,
+                             node="alpha-n0"))
+        h.assert_parity(msg="(delete-then-add)")
+        # and the reverse order next window: add (update), then delete
+        h.client.update_pod(pod("alpha-p5", "alpha", cpu=100))
+        h.client.remove_pod(
+            [p for p in h.client.list_pods() if p.name == "alpha-p5"][0])
+        h.assert_parity(msg="(update-then-delete)")
+
+    def test_group_add_remove_while_events_queued(self, store_kind):
+        """Grow the filter set from 1 group to 2 and back while mutations
+        keep landing: set_groups + resync re-resolves membership, and every
+        tick stays digest-exact vs a re-list under the CURRENT filters."""
+        h = StreamHarness(store_kind, n_groups=1)   # only alpha configured
+        # beta objects exist in the world but match no group: ignored
+        h.client.add_pod(pod("beta-late", "beta", cpu=700))
+        h.assert_parity(msg="(single group)")
+        # group ADD: beta joins; queued mutations land around the resync
+        h.client.update_pod(pod("alpha-p2", "alpha", cpu=800,
+                                node="alpha-n2"))
+        h.filters = make_filters(GROUPS)
+        h.configs = make_configs(2)
+        h.states = h.states + [sem.GroupState()]
+        h.bridge.set_groups(h.filters, client=h.client)
+        h.groups = pack_groups(
+            list(zip(h.configs, h.states, strict=True)), pad_groups=8)
+        # group rows changed shape-compatibly ([8] pad): ship them with the
+        # next batch, the config-dirty compare marks every changed row
+        gathered = h.store.drain_dirty_packed()
+        h.inc.apply_gathered(gathered, h.groups)
+        h.assert_parity(msg="(group added)")
+        # group REMOVE: back to alpha-only; beta pods/nodes leave the store
+        h.client.update_pod(pod("beta-p1", "beta", cpu=50))
+        h.filters = make_filters(GROUPS[:1])
+        h.configs = make_configs(1)
+        h.states = h.states[:1]
+        h.bridge.set_groups(h.filters, client=h.client)
+        h.groups = pack_groups(
+            list(zip(h.configs, h.states, strict=True)), pad_groups=8)
+        gathered = h.store.drain_dirty_packed()
+        h.inc.apply_gathered(gathered, h.groups)
+        h.assert_parity(msg="(group removed)")
+
+    def test_soak_random_interleavings(self, store_kind):
+        """Soak: 20 windows of randomized add/update/delete/taint/group-move
+        events, parity asserted after every window."""
+        h = StreamHarness(store_kind)
+        rng = np.random.default_rng(11)
+        now = 1_700_000_000
+        for t in range(20):
+            for _ in range(int(rng.integers(1, 6))):
+                act = rng.integers(0, 5)
+                g = GROUPS[int(rng.integers(0, 2))]
+                i = int(rng.integers(0, 14))
+                if act == 0:
+                    h.client.add_pod(pod(
+                        f"{g}-extra{int(rng.integers(0, 20))}", g,
+                        cpu=int(rng.choice([100, 500, 1100, 2000])),
+                        node=f"{g}-n{int(rng.integers(0, 4))}"))
+                elif act == 1:
+                    h.client.update_pod(pod(
+                        f"{g}-p{i}", g,
+                        cpu=int(rng.choice([100, 500, 1100, 2000])),
+                        node=f"{g}-n{int(rng.integers(0, 4))}"))
+                elif act == 2:
+                    live = [p for p in h.client.list_pods()
+                            if p.name.startswith(f"{g}-extra")]
+                    if live:
+                        h.client.remove_pod(live[0])
+                elif act == 3:
+                    # taint flip on a random node (keeps its identity)
+                    names = [n.name for n in h.client.list_nodes()
+                             if n.labels.get("customer") == g]
+                    if names:
+                        nd = h.client.get_node(
+                            names[int(rng.integers(0, len(names)))]).copy()
+                        if nd.taints:
+                            nd.taints = []
+                        else:
+                            nd.taints = [k8s.Taint(
+                                key=k8s.TO_BE_REMOVED_BY_AUTOSCALER_KEY,
+                                value=str(now - 40))]
+                        h.client.update_node(nd)
+                else:
+                    # group move: a pod's selector flips to the other group
+                    other = GROUPS[1 - GROUPS.index(g)]
+                    h.client.update_pod(pod(f"{g}-p{i}", other))
+            h.assert_parity(now + t, msg=f"(soak window {t})")
+
+
+# ------------------------------------------------------------ resync healing
+def test_resync_heals_missed_delete_and_drift():
+    """A DELETED event the bridge never saw (simulated by mutating the
+    client's internal dict) leaves the store stale; bridge.resync drops the
+    stale entry and re-resolves everything — parity restored."""
+    h = StreamHarness("numpy")
+    with h.client._lock:
+        h.client._pods.pop("default/alpha-p3")    # vanish without an event
+    # the stream is now stale (still counts alpha-p3); resync reconciles
+    stats = h.bridge.resync(h.client)
+    assert stats["pods_dropped"] == 1
+    assert h.store.pod_slot("default/alpha-p3") == -1
+    h.assert_parity(msg="(after resync)")
+
+
+def test_native_backend_relist_audit_cadence():
+    """NativeJaxBackend(relist_audit_every=2): a missed delete heals at the
+    audit tick without operator action."""
+    from escalator_tpu.controller.native_backend import NativeJaxBackend
+
+    client = make_world()
+    backend = NativeJaxBackend(
+        client, make_filters(), pod_capacity=256, node_capacity=64,
+        incremental=True, refresh_every=0, relist_audit_every=2,
+        store_kind="numpy")
+    gi = [([], [], cfg, sem.GroupState()) for cfg in make_configs(2)]
+    backend.decide(gi, 1_700_000_000)
+    with client._lock:
+        client._pods.pop("default/beta-p2")       # missed event
+    backend.decide(gi, 1_700_000_060)             # tick 2: audit fires
+    assert backend.store.pod_slot("default/beta-p2") == -1, (
+        "relist audit should have dropped the stale pod")
+
+
+# ---------------------------------------------- streaming attach + predrain
+def test_incremental_backend_attach_event_source_matches_repack():
+    """IncrementalJaxBackend.attach_event_source: same decisions as the
+    repack backend fed by the listers, across churn ticks."""
+    from escalator_tpu.controller.backend import IncrementalJaxBackend
+
+    client = make_world()
+    opts = [
+        ngmod.NodeGroupOptions(
+            name=v, label_key="customer", label_value=v,
+            cloud_provider_group_name=f"{v}-asg", min_nodes=0, max_nodes=100,
+            taint_upper_capacity_threshold_percent=45,
+            taint_lower_capacity_threshold_percent=30,
+            scale_up_threshold_percent=70,
+            slow_node_removal_rate=1, fast_node_removal_rate=2,
+            soft_delete_grace_period="5m", hard_delete_grace_period="15m",
+            scale_up_cool_down_period="10m",
+        )
+        for v in GROUPS
+    ]
+    streaming = IncrementalJaxBackend(refresh_every=0)
+    streaming.attach_event_source(client, opts, pod_capacity=256,
+                                  node_capacity=64, store_kind="numpy")
+    assert streaming.needs_objects is False
+    repack = IncrementalJaxBackend(refresh_every=0)
+    filters = make_filters()
+    configs = make_configs(2)
+    states_a = [sem.GroupState() for _ in range(2)]
+    states_b = [sem.GroupState() for _ in range(2)]
+    now = 1_700_000_000
+    for t in range(4):
+        if t == 1:
+            client.update_pod(pod("alpha-p0", "alpha", cpu=1500,
+                                  node="alpha-n0"))
+        if t == 2:
+            client.delete_node("beta-n3")
+        if t == 3:
+            client.add_pod(pod("beta-burst", "beta", cpu=2000))
+        # streaming backend needs no objects
+        gi_stream = [([], [], configs[g], states_a[g]) for g in range(2)]
+        got = streaming.decide(gi_stream, now + t)
+        # repack backend walks the (re-listed) object world
+        gi_obj = relist_group_inputs(client, filters, configs, states_b)
+        want = repack.decide(gi_obj, now + t)
+        for gd_got, gd_want in zip(got, want, strict=True):
+            assert gd_got.decision.status == gd_want.decision.status, t
+            assert (gd_got.decision.nodes_delta
+                    == gd_want.decision.nodes_delta), t
+            assert (gd_got.decision.num_pods
+                    == gd_want.decision.num_pods), t
+    # flight record keeps the logical backend name + names the store
+    from escalator_tpu import observability as obs
+
+    recs = [r for r in obs.RECORDER.snapshot()
+            if r["root"] == "incremental-jax" and r.get("store")]
+    assert recs, "no streaming tick records under the logical backend name"
+    assert recs[-1]["store"] == "numpy"
+
+
+def test_predrain_pending_batches_apply_next_tick():
+    """Events that arrive during a tick's device window (captured by
+    _predrain into pending batches) are applied before the next tick's
+    drain, and the next tick stays digest-exact vs re-list."""
+    from escalator_tpu.controller.native_backend import NativeJaxBackend
+
+    client = make_world()
+    backend = NativeJaxBackend(
+        client, make_filters(), pod_capacity=256, node_capacity=64,
+        incremental=True, refresh_every=0, store_kind="numpy")
+    gi = [([], [], cfg, sem.GroupState()) for cfg in make_configs(2)]
+    backend.decide(gi, 1_700_000_000)            # rebuild
+    backend.decide(gi, 1_700_000_060)            # steady (fast path)
+    # events land "mid-decide": drain them exactly as the overlap hook does
+    client.add_pod(pod("alpha-mid1", "alpha", cpu=1200, node="alpha-n1"))
+    client.update_pod(pod("beta-p4", "beta", cpu=50))
+    backend._predrain()
+    assert backend._pending_batches, "predrain captured nothing"
+    # more events after the window closes (normal next-tick drain)
+    client.add_pod(pod("alpha-mid2", "alpha", cpu=800))
+    results = backend.decide(gi, 1_700_000_120)
+    # reference: re-list world decided by the golden-equivalent array path
+    import jax
+
+    from escalator_tpu.ops.kernel import decide_jit
+
+    gi_rel = relist_group_inputs(
+        client, make_filters(), make_configs(2),
+        [sem.GroupState() for _ in range(2)])
+    cluster = pack_cluster(gi_rel, pad_pods=512, pad_nodes=64, pad_groups=8)
+    full = jax.block_until_ready(decide_jit(
+        jax.device_put(cluster), np.int64(1_700_000_120), with_orders=False))
+    want = np.asarray(full.nodes_delta)
+    for g, gd in enumerate(results):
+        assert gd.decision.nodes_delta == int(want[g]), g
+    assert not backend._pending_batches

@@ -204,7 +204,12 @@ def test_native_backend_tick_records_fenced_phases():
         if p["path"].startswith("tick/decide/native-jax/")
     ]
     fenced_names = {p["name"] for p in backend_phases if p["fenced"]}
-    assert {"host_snapshot", "scatter", "decide", "unpack"} <= fenced_names
+    # round 12: the old host_snapshot composite is split into the streaming
+    # taxonomy — event_drain (store dirty drain + triple gather) and
+    # triple_build (the remaining [G]/[N] host assembly)
+    assert {"event_drain", "triple_build", "scatter", "decide",
+            "unpack"} <= fenced_names
+    assert rec.get("store") in ("native", "numpy")
 
 
 def test_incremental_backend_records_delta_phase_and_dirty_count():
