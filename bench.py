@@ -65,6 +65,15 @@ Configs:
               row (the noise-immune before/after; also standalone via
               ``--recorded <dump> <snap>``)
 
+Tail truth (round 13): every recorder-sourced per-phase column is a
+p50/p99/p999/min dict (``_recorder_phase_stats``), e2e churn rows carry
+``total_p99``/``total_p999``, cfg14/cfg15 decide rows carry ``*_p99_ms``/
+``*_p999_ms``, and the cfg16 headline's ``within_bar`` asserts the bar
+against the p99 (median kept as ``within_bar_median``). ``--smoke`` adds
+the tail loop — histogram-vs-np.percentile accuracy, the tail-capture
+fire path, and a ``debug-trace`` Perfetto round-trip — writing
+TAIL_SMOKE_LATEST.json + TRACE_SMOKE_LATEST.trace.json for CI.
+
 The full record is also written to BENCH_FULL_LATEST.json (named in the
 stdout line) so a driver that tail-grabs stdout can never truncate the
 artifact (round-4's BENCH_r04.json lost everything before cfg8 that way).
@@ -185,26 +194,48 @@ def _timeit(fn, iters=ITERS):
     return float(np.median(times)), float(np.min(times))
 
 
-def _recorder_phase_medians(root_name: str) -> dict:
-    """Median per-phase ms across the flight-recorder entries whose root is
+def _series_stats(values) -> dict:
+    """The round-13 tail-truth column set for a millisecond series:
+    p50/p99/p999/min. np.percentile (linear interpolation) is the ground
+    truth the streaming log-bucket histograms are validated against in
+    --smoke; the bench columns use it directly since the full series is in
+    hand here."""
+    arr = np.asarray(values, dtype=float)
+    return {
+        "p50": round(float(np.percentile(arr, 50)), 3),
+        "p99": round(float(np.percentile(arr, 99)), 3),
+        "p999": round(float(np.percentile(arr, 99.9)), 3),
+        "min": round(float(arr.min()), 3),
+    }
+
+
+def _phase_stats_from_records(records) -> dict:
+    """Per-phase p50/p99/p999/min across tick records (flight-recorder
+    form): the ONE summarizer behind every recorder-sourced bench column —
+    round 13 consolidated the two former median helpers (the recorder
+    summarizer and the smoke section's inline dict) into this."""
+    by_phase: dict = {}
+    n = 0
+    for rec in records:
+        n += 1
+        for p in rec["phases"]:
+            if p["path"] == rec["root"]:  # the root total, reported separately
+                continue
+            by_phase.setdefault(p["name"], []).append(p["ms"])
+    out = {k: _series_stats(v) for k, v in by_phase.items()}
+    out["_ticks"] = n
+    return out
+
+
+def _recorder_phase_stats(root_name: str) -> dict:
+    """Per-phase tail stats across the flight-recorder entries whose root is
     ``root_name`` — the bench's per-phase columns come from the SAME
     recorder production ships (not a parallel timing path), so a recorder
     regression is visible as a missing/zero bench column."""
     from escalator_tpu.observability import RECORDER
 
-    by_phase: dict = {}
-    n = 0
-    for rec in RECORDER.snapshot():
-        if rec["root"] != root_name:
-            continue
-        n += 1
-        for p in rec["phases"]:
-            if p["path"] == root_name:   # the root total, reported separately
-                continue
-            by_phase.setdefault(p["name"], []).append(p["ms"])
-    out = {k: round(float(np.median(v)), 3) for k, v in by_phase.items()}
-    out["_ticks"] = n
-    return out
+    return _phase_stats_from_records(
+        [r for r in RECORDER.snapshot() if r["root"] == root_name])
 
 
 def _time_decide_med_min(cluster, now, iters=ITERS, impl="xla"):
@@ -331,7 +362,7 @@ def _cfg6_native(rng, now, device, detail: dict, degraded: bool):
     # host tail is attributable in the committed artifact, not only in
     # local runs with the manual perf_counter splits above
     detail["cfg6_recorder_phases_ms"] = {
-        lab: _recorder_phase_medians(f"cfg6_{lab}")
+        lab: _recorder_phase_stats(f"cfg6_{lab}")
         for lab in ("0.1pct", "1pct", "10pct")
     }
     # sweep rows must be comparable: the variants ran interleaved with
@@ -454,7 +485,7 @@ def _native_tick_sweep(store, cache, impl, rng, now, num_pods, num_groups,
     runs under a flight-recorder timeline ``{spans_root}_{label}`` with the
     production phase names (upsert / event_drain / scatter / decide), so
     the committed artifact's per-phase host columns come from the SAME
-    recorder production ships (``_recorder_phase_medians``), not only this
+    recorder production ships (``_recorder_phase_stats``), not only this
     loop's manual perf_counter splits.
 
     The decide phase runs the SAME lazy-orders protocol the native backend
@@ -542,8 +573,17 @@ def _native_tick_sweep(store, cache, impl, rng, now, num_pods, num_groups,
             phases["scatter"].append((t3 - t2) * 1e3)
             phases["decide"].append((t4 - t3) * 1e3)
             phases["total"].append((t4 - t0) * 1e3)
-    return {lab: {k: round(float(np.median(v)), 3) for k, v in ph.items()}
-            for lab, ph in results.items()}
+    out = {}
+    for lab, ph in results.items():
+        row = {k: round(float(np.median(v)), 3) for k, v in ph.items()}
+        # round 13: every e2e churn row carries its tail columns too — the
+        # honest acceptance statistic per ROADMAP item 4 (a median hides a
+        # scatter-bucket recompile or a GC pause; the p99/p999 don't)
+        tail_stats = _series_stats(ph["total"])
+        row["total_p99"] = tail_stats["p99"]
+        row["total_p999"] = tail_stats["p999"]
+        out[lab] = row
+    return out
 
 
 def _sweep_monotonicity(sweep_totals: dict) -> str:
@@ -745,13 +785,19 @@ def _cfg14_incremental_vs_full(rng, now, device, detail: dict,
                         parity = f"MISMATCH: {f} at tick {t}"
             inc_med = float(np.median(delta_ms))
             full_med = float(np.median(full_ms))
+            inc_tail = _series_stats(delta_ms)
+            full_tail = _series_stats(full_ms)
             rows[frac] = {
                 "incremental_decide_ms": round(inc_med, 3),
+                "incremental_decide_p99_ms": inc_tail["p99"],
+                "incremental_decide_p999_ms": inc_tail["p999"],
                 "full_decide_ms": round(full_med, 3),
+                "full_decide_p99_ms": full_tail["p99"],
+                "full_decide_p999_ms": full_tail["p999"],
                 "dirty_groups_median": int(np.median(dirty)),
                 "speedup": round(full_med / inc_med, 2) if inc_med else None,
                 "parity": parity,
-                "recorder_phases_ms": _recorder_phase_medians(root),
+                "recorder_phases_ms": _recorder_phase_stats(root),
             }
         # the refresh audit, priced: the O(cluster) self-check a production
         # cadence amortizes (and proof the maintained state held)
@@ -920,8 +966,11 @@ def _cfg15_ordered_incremental(rng, now, device, detail: dict,
     inc_med = float(np.median(inc_ms))
     full_med = float(np.median(full_ms))
     light_med = float(np.median(light_ms))
+    inc_tail15 = _series_stats(inc_ms)
     detail["cfg15_ordered_incremental"] = {
         "ordered_incremental_ms": round(inc_med, 3),
+        "ordered_incremental_p99_ms": inc_tail15["p99"],
+        "ordered_incremental_p999_ms": inc_tail15["p999"],
         "ordered_full_sort_ms": round(full_med, 3),
         "light_incremental_ms": round(light_med, 3),
         "full_light_decide_ms": round(full_light_med, 3),
@@ -1061,8 +1110,16 @@ def _cfg16_streaming(rng, now, device, detail: dict, degraded: bool) -> None:
     from escalator_tpu.ops.device_state import DeviceClusterCache, IncrementalDecider
     from escalator_tpu.ops.kernel import decide_jit
 
+    # 100 timed ticks at the headline shape (round 13): the row's bar is now
+    # asserted on the p99, and a p99 over ~12 samples IS the max — one
+    # stolen-core burst on this shared rig (observed: a single 94 ms tick in
+    # an 18 ms steady run) would bust the bar with no code regression.
+    # n=100 puts p99 at the 2nd-worst tick, tolerating exactly one outlier;
+    # p999 still reports the true max. The 1M stretch row keeps few iters
+    # (each parity arm re-uploads 1M pods); its p99~max caveat is noted in
+    # docs/performance.md.
     shapes = [
-        ("100k", 100_000, 50_000, 2048, 1140, 12, 25.0),
+        ("100k", 100_000, 50_000, 2048, 1140, 100, 25.0),
         ("1M", 1_000_000, 100_000, 2048, 230, 3 if degraded else 6, 100.0),
     ]
     cfg16 = {}
@@ -1146,15 +1203,23 @@ def _cfg16_streaming(rng, now, device, detail: dict, degraded: bool) -> None:
             if t >= 2:
                 totals.append(total_ms)
         med = float(np.median(totals))
+        tick_tail = _series_stats(totals)
         row = {
             "e2e_tick_ms": round(med, 3),
             "e2e_tick_min_ms": round(float(np.min(totals)), 3),
+            # round 13 (ROADMAP item 4): the HEADLINE bar is asserted
+            # against the p99, not the median — an SLO is a tail statement.
+            # within_bar_median is kept alongside so regressions in either
+            # statistic stay attributable.
+            "e2e_tick_p99_ms": tick_tail["p99"],
+            "e2e_tick_p999_ms": tick_tail["p999"],
             "churned_pods_per_tick": n_churn,
             "store": store_kind(store),
             "digest_parity_vs_relist": parity,
             "bar_ms": bar_ms,
-            "within_bar": bool(med <= bar_ms),
-            "recorder_phases_ms": _recorder_phase_medians(root),
+            "within_bar": bool(tick_tail["p99"] <= bar_ms),
+            "within_bar_median": bool(med <= bar_ms),
+            "recorder_phases_ms": _recorder_phase_stats(root),
         }
         if label == "100k":
             # recorded-workload replay bench (satellite: the PR-6 bonus):
@@ -1187,6 +1252,8 @@ def _cfg16_streaming(rng, now, device, detail: dict, degraded: bool) -> None:
         cfg16[label] = row
         detail["cfg16_streaming"] = cfg16
         detail[f"cfg16_streaming_tick_{label}_1pct_ms"] = row["e2e_tick_ms"]
+        detail[f"cfg16_streaming_tick_{label}_1pct_p99_ms"] = (
+            row["e2e_tick_p99_ms"])
         del inc, cache, store, pods_v, nodes_v, host_cluster
 
 
@@ -1596,7 +1663,7 @@ def _bench_ffd_pack(rng, device) -> dict:
             with spans.span(prefix):
                 with spans.span("ffd_pack", kind="device"):
                     spans.fence(packed().new_nodes_needed)
-        out[f"{prefix}_recorder_phases"] = _recorder_phase_medians(prefix)
+        out[f"{prefix}_recorder_phases"] = _recorder_phase_stats(prefix)
 
     row("cfg10_ffd_pack_2048g_64pods", pod_cpu, pod_mem)
     shapes = np.array([[500, 10**9], [250, 5 * 10**8], [1000, 4 * 10**9]],
@@ -1912,7 +1979,7 @@ def run_sharded() -> None:
                 with spans.span("decide", kind="device"):
                     spans.fence(run())
     out["cfg8_recorder_phases_ms"] = {
-        v: _recorder_phase_medians(f"cfg8_{v}")
+        v: _recorder_phase_stats(f"cfg8_{v}")
         for v in ("busy_sharded_tail", "steady_light", "legacy_replicated")
     }
 
@@ -2362,20 +2429,13 @@ def run_smoke() -> dict:
     # the record without a delta_decide phase).
     steady3 = [r for r in recs3
                if any(p["name"] == "delta_decide" for p in r["phases"])]
-    by_phase3: dict = {}
-    for r in steady3:
-        for p in r["phases"]:
-            if p["path"] != r["root"]:
-                by_phase3.setdefault(p["name"], []).append(p["ms"])
-    backend_tick_ms = {k: round(float(np.median(v)), 3)
-                       for k, v in by_phase3.items()}
-    backend_tick_ms["_ticks"] = len(steady3)
+    backend_tick_ms = _phase_stats_from_records(steady3)
     assert backend_tick_ms["_ticks"] >= 3, backend_tick_ms
     host_phases = {
         "smoke": True,
         "native_backend_tick_ms": backend_tick_ms,
         "streaming_ticks_ms": {
-            kind: _recorder_phase_medians(f"cfg16_smoke_{kind}")
+            kind: _recorder_phase_stats(f"cfg16_smoke_{kind}")
             for kind in kinds
         },
     }
@@ -2436,6 +2496,210 @@ def run_smoke() -> dict:
         f"{ovh['enabled_min_ms']:.3f} / disabled min "
         f"{ovh['disabled_min_ms']:.3f}) — instrumentation grew a real cost")
     out["smoke_observability_overhead_ms"] = ovh["overhead_ms"]
+
+    # ---- tail-latency smoke (round 13): histogram accuracy, tail-capture
+    # fire path, trace-export round-trip — the ISSUE-8 acceptance loop at
+    # smoke scale, written to TAIL_SMOKE_LATEST.json for CI upload.
+    from escalator_tpu.observability import histograms as hgmod
+    from escalator_tpu.observability import tail as tailmod
+
+    tail_report: dict = {"smoke": True}
+
+    # (a) quantile accuracy: the streaming log-bucket engine vs
+    # np.percentile ground truth on adversarial distributions. The
+    # contract: every quantile within ONE bucket width (<= 25% relative)
+    # of the exact order statistic — bimodal (quantiles straddle the modes),
+    # heavy tail (pareto: p999 far from p50), and the single-sample
+    # degenerate case where every quantile IS the sample.
+    rng_t = np.random.default_rng(13)
+    acc: dict = {}
+    for dist_name, samples in (
+        ("bimodal", np.concatenate([rng_t.normal(2e-3, 3e-4, 4000),
+                                    rng_t.normal(8e-2, 1e-2, 250)])),
+        ("heavy_tail", (rng_t.pareto(1.5, 4000) + 1) * 1e-4),
+        ("single_sample", np.array([1.23e-2])),
+    ):
+        samples = np.clip(samples, 1e-7, 9.0)
+        h = hgmod.LogHistogram()
+        for s in samples:
+            h.record(float(s))
+        dist_rows = {}
+        for q in (50.0, 90.0, 99.0, 99.9):
+            gt = float(np.percentile(samples, q))
+            got = h.quantile(q / 100.0)
+            lo_e, hi_e = hgmod.bucket_bounds(gt)
+            width = hi_e - lo_e
+            assert abs(got - gt) <= width + 1e-12, (
+                f"histogram p{q:g} off by more than a bucket on "
+                f"{dist_name}: got {got:.6g} vs ground truth {gt:.6g} "
+                f"(bucket width {width:.3g})")
+            dist_rows[f"p{q:g}"] = {
+                "ground_truth_ms": round(gt * 1e3, 6),
+                "histogram_ms": round(got * 1e3, 6),
+                "bucket_width_ms": round(width * 1e3, 6),
+            }
+        acc[dist_name] = dist_rows
+    tail_report["quantile_accuracy"] = acc
+    out["smoke_tail_quantile_accuracy"] = "ok"
+
+    # production feed check: the smoke's real backend ticks above landed in
+    # the histograms through the SAME root-complete hook the recorder uses
+    # (event_drain is always a LEAF phase; delta_decide is a composite when
+    # the overlap hook nests event_predrain under it, and composites stay
+    # out of the per-phase series — same selection as the Prometheus feed)
+    drain_hist = hgmod.PHASES.peek("native-jax", "event_drain")
+    assert drain_hist is not None and drain_hist.count > 0, (
+        "streaming backend ticks missing from the phase histograms")
+    assert hgmod.tick_quantiles_ms()["count"] > 0
+    tail_report["native_backend_tick_quantiles_ms"] = hgmod.tick_quantiles_ms(
+        "native-jax")
+
+    # (b) the tail-capture fire path through the REAL hook chain: seed a
+    # root series with fast ticks, breach with a forced slow tick, and
+    # assert the reason="tail" dump landed with the breach annotation —
+    # then that an immediate second breach is rate-limited away.
+    tail_dir = tempfile.mkdtemp(prefix="escalator-tail-smoke-")
+    prev_env = {k: os.environ.get(k) for k in (
+        "ESCALATOR_TPU_TAIL_CAPTURE", "ESCALATOR_TPU_TAIL_MIN_TICKS",
+        "ESCALATOR_TPU_TAIL_DUMP_INTERVAL_SEC", "ESCALATOR_TPU_DUMP_DIR")}
+    # min_ticks == the number of seed ticks: the watchdog arms exactly at
+    # the forced slow tick, so a jittery CI core can't breach on a noisy
+    # seed tick and steal the rate-limit slot from the one this asserts on
+    os.environ.update({
+        "ESCALATOR_TPU_TAIL_CAPTURE": "3.0",
+        "ESCALATOR_TPU_TAIL_MIN_TICKS": "10",
+        "ESCALATOR_TPU_TAIL_DUMP_INTERVAL_SEC": "600",
+        "ESCALATOR_TPU_DUMP_DIR": tail_dir,
+    })
+    try:
+        tailmod.WATCHDOG.reset()
+        for _ in range(10):
+            with _spans.span("tail_smoke_tick"):
+                _spans.annotate(backend="tail-smoke")
+                with _spans.span("steady_work"):
+                    time.sleep(0.002)
+        with _spans.span("tail_smoke_tick"):
+            _spans.annotate(backend="tail-smoke")
+            with _spans.span("slow_work"):
+                time.sleep(0.05)   # ~25x steady: an unambiguous breach
+        tailmod.WATCHDOG.drain()
+        tail_dumps = [f for f in os.listdir(tail_dir) if "-tail-" in f]
+        assert len(tail_dumps) == 1, (
+            f"expected exactly one tail dump, found {tail_dumps}")
+        with open(os.path.join(tail_dir, tail_dumps[0])) as f:
+            tail_doc = json.load(f)
+        assert tail_doc["reason"] == "tail" and tail_doc["flight_recorder"]
+        breach = tail_doc["tail"]
+        assert breach["root"] == "tail_smoke_tick", breach
+        assert breach["duration_ms"] > breach["threshold_ms"], breach
+        # the bundle carries the breaching tick's span tree
+        assert any(r.get("seq") == breach["seq"] and any(
+            p["name"] == "slow_work" for p in r["phases"])
+            for r in tail_doc["ticks"]), "breaching tick not in the bundle"
+        # rate limit: another breach inside the interval must NOT dump again
+        with _spans.span("tail_smoke_tick"):
+            with _spans.span("slow_work"):
+                time.sleep(0.05)
+        tailmod.WATCHDOG.drain()
+        assert len([f for f in os.listdir(tail_dir) if "-tail-" in f]) == 1
+        tail_report["tail_capture"] = breach
+        out["smoke_tail_capture"] = "ok"
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        tailmod.WATCHDOG.reset()
+
+    # (c) trace export round-trip through the REAL `debug-trace` verb: a
+    # plugin-routed decide (in-process gRPC server when the toolchain has
+    # grpc; the same graft mechanism synthetically otherwise) so the
+    # exported Perfetto JSON shows client AND server spans in one trace.
+    trace_mode = "grpc"
+    try:
+        from escalator_tpu.plugin.client import ComputeClient
+        from escalator_tpu.plugin.server import make_server
+    except ImportError as e:
+        trace_mode = f"synthetic-graft (grpc unavailable: {e.name})"
+    tiny = _rng_cluster_arrays(rng, 2, 64, 16)
+    if trace_mode == "grpc":
+        server = make_server("127.0.0.1:0", max_workers=2)
+        server.start()
+        tclient = ComputeClient(
+            f"127.0.0.1:{server._escalator_bound_port}", timeout_sec=120.0)
+        try:
+            with _spans.span("tail_trace_tick"):
+                _spans.annotate(backend="grpc")
+                with _spans.span("rpc", kind="rpc"):
+                    _t_out, server_phases = tclient.decide_arrays_traced(
+                        tiny, int(now),
+                        span_ctx={"path": _spans.current_path()})
+                _spans.graft(server_phases or [],
+                             under="tail_trace_tick/rpc")
+        finally:
+            tclient.close()
+            server.stop(grace=None)
+    else:
+        with _spans.span("tail_trace_tick"):
+            _spans.annotate(backend="grpc")
+            with _spans.span("rpc", kind="rpc"):
+                time.sleep(0.001)
+            _spans.graft(
+                [{"name": "decide", "path": "plugin_decide/decide",
+                  "ms": 0.8, "kind": "device", "fenced": True,
+                  "offset_ms": 0.1}],
+                under="tail_trace_tick/rpc")
+    trace_dump_path = os.path.join(tail_dir, "trace-dump.json")
+    RECORDER.dump(trace_dump_path, reason="trace-smoke")
+    trace_out_path = os.path.join(tail_dir, "smoke.trace.json")
+    rc = cli_main(["debug-trace", "--dump", trace_dump_path,
+                   "--output", trace_out_path])
+    assert rc == 0, f"debug-trace exited {rc}"
+    with open(trace_out_path) as f:
+        trace_doc = json.load(f)
+    slices = [e for e in trace_doc["traceEvents"] if e.get("ph") == "X"]
+    for e in trace_doc["traceEvents"]:
+        assert {"name", "ph", "pid", "tid"} <= set(e), e
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float)), e
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0, e
+    tick_evs = [e for e in slices
+                if str(e["args"].get("path", "")).startswith(
+                    "tail_trace_tick")]
+    assert any(e["name"] == "rpc" and not e["args"].get("remote")
+               for e in tick_evs), "client rpc span missing from trace"
+    assert any(e["args"].get("remote") and e["name"] == "decide"
+               for e in tick_evs), "plugin-server span missing from trace"
+    tail_report["trace_export"] = {
+        "mode": trace_mode,
+        "trace_events": len(slices),
+        "client_and_server_merged": True,
+    }
+    out["smoke_trace_export"] = "ok"
+
+    # artifacts: the tail report + the exported trace, both uploaded by CI
+    # with run-summary digests (next to the flight/jaxlint artifacts)
+    tail_artifact = os.environ.get(
+        "ESCALATOR_TPU_TAIL_SMOKE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "TAIL_SMOKE_LATEST.json"),
+    )
+    trace_artifact = os.environ.get(
+        "ESCALATOR_TPU_TRACE_SMOKE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "TRACE_SMOKE_LATEST.trace.json"),
+    )
+    try:
+        with open(tail_artifact, "w") as f:
+            json.dump(tail_report, f, indent=1)
+            f.write("\n")
+        out["tail_smoke_report"] = tail_artifact
+        shutil.copyfile(trace_out_path, trace_artifact)
+        out["trace_smoke_artifact"] = trace_artifact
+    except OSError:   # read-only checkout: the in-memory asserts still ran
+        out["tail_smoke_report"] = "(write failed)"
+    shutil.rmtree(tail_dir, ignore_errors=True)
 
     # dump the ring alongside the smoke JSON: CI uploads it as an artifact
     # next to the jaxlint report, so every PR run carries an inspectable

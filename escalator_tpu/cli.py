@@ -91,6 +91,64 @@ def debug_dump_main(argv: List[str]) -> int:
     return 0
 
 
+def debug_trace_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-trace``: render a flight-recorder dump (or a
+    live plugin's ring over the ``Dump`` RPC) to Chrome trace-event /
+    Perfetto JSON — open the output at https://ui.perfetto.dev or
+    chrome://tracing. Nested phases become duration events, unfenced
+    overlap dispatches sit on their own track, and a plugin-routed decide's
+    grafted server spans render under the caller's rpc span, so one trace
+    shows client + server (docs/observability.md, tail-latency section).
+    Exit status: 0 on success, 2 when the dump cannot be read/fetched."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-trace",
+        description="render a flight dump to Perfetto trace-event JSON",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--dump",
+                     help="flight-recorder dump JSON (debug-dump output or"
+                          " an incident/tail dump)")
+    src.add_argument("--plugin-address",
+                     help="fetch the live ring from a running compute"
+                          " plugin instead of a file")
+    p.add_argument("--output", default="-",
+                   help="file path for the trace JSON, or - for stdout")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    from escalator_tpu.observability import traceexport
+
+    if args.dump:
+        try:
+            with open(args.dump) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read dump: {e}", file=sys.stderr)
+            return 2
+    else:
+        from escalator_tpu.plugin.client import ComputeClient
+
+        client = ComputeClient(args.plugin_address, timeout_sec=args.timeout)
+        try:
+            doc = client.dump()
+        except Exception as e:  # noqa: BLE001 - any transport failure: exit 2
+            print(f"cannot fetch dump from {args.plugin_address}: {e}",
+                  file=sys.stderr)
+            return 2
+        finally:
+            client.close()
+    trace = traceexport.trace_from_dump(doc)
+    text = json.dumps(trace, indent=1)
+    if args.output == "-":
+        print(text)
+    else:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        slices = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        print(f"trace ({len(doc.get('ticks', []))} ticks, {slices} slices)"
+              f" -> {args.output}")
+    return 0
+
+
 def debug_replay_main(argv: List[str]) -> int:
     """``escalator-tpu debug-replay``: re-execute a dumped flight-recorder
     ring OFFLINE, bit-exactly, against a device-state snapshot — the
@@ -354,6 +412,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # a leading verb)
     if argv and argv[0] == "debug-dump":
         return debug_dump_main(argv[1:])
+    if argv and argv[0] == "debug-trace":
+        return debug_trace_main(argv[1:])
     if argv and argv[0] == "debug-replay":
         return debug_replay_main(argv[1:])
     args = build_parser().parse_args(argv)

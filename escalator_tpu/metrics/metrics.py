@@ -257,6 +257,62 @@ jax_transfer_events = Counter(
 )
 
 
+# --- tail-latency truth (round 13: streaming log-bucket histograms) ----------
+class _TailHistogramCollector:
+    """Pull-time export of the observability layer's streaming log-bucket
+    histograms (observability/histograms.py: base-1.25 buckets, 1 µs..10 s)
+    as NATIVE Prometheus histograms:
+
+    - ``escalator_tpu_tick_phase_hist_seconds{backend,phase}`` — fine-bucket
+      per-phase series. The coarse pre-round-13
+      ``escalator_tpu_tick_phase_seconds`` histogram above stays exported
+      unchanged for dashboard compatibility; this family adds the bucket
+      resolution (25% worst-case quantile error at any magnitude) that
+      p999 queries actually need.
+    - ``escalator_tpu_tick_e2e_seconds{root}`` — the root end-to-end tick
+      series, keyed by root timeline name (the tail watchdog's comparison
+      population and the source of the plugin health tail fields).
+
+    Collected lazily so a process that never completed a timeline exports
+    empty families at zero cost.
+    """
+
+    def collect(self):
+        from prometheus_client.core import HistogramMetricFamily
+
+        from escalator_tpu.observability import histograms
+
+        phase_fam = HistogramMetricFamily(
+            "escalator_tpu_tick_phase_hist_seconds",
+            "per-phase device-fenced tick latency, fine log-bucket "
+            "(base-1.25) streaming histogram — same completed-timeline feed "
+            "as escalator_tpu_tick_phase_seconds, finer tail resolution",
+            labels=["backend", "phase"],
+        )
+        for (backend, phase), h in histograms.PHASES.items():
+            phase_fam.add_metric([backend, phase],
+                                 buckets=[(ub, float(c))
+                                          for ub, c in h.cumulative_buckets()],
+                                 sum_value=h.sum_seconds)
+        yield phase_fam
+        tick_fam = HistogramMetricFamily(
+            "escalator_tpu_tick_e2e_seconds",
+            "end-to-end root tick latency by root timeline name, fine "
+            "log-bucket streaming histogram (the tail watchdog's rolling-p99 "
+            "population)",
+            labels=["root"],
+        )
+        for (root,), h in histograms.TICKS.items():
+            tick_fam.add_metric([root],
+                                buckets=[(ub, float(c))
+                                         for ub, c in h.cumulative_buckets()],
+                                sum_value=h.sum_seconds)
+        yield tick_fam
+
+
+registry.register(_TailHistogramCollector())
+
+
 def start(address: str = "0.0.0.0:8080", readiness=None) -> WSGIServer:
     """Serve /metrics on a background thread (reference: metrics.go:260-268),
     plus /healthz (process liveness: 200 whenever the server answers) and

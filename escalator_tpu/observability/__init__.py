@@ -7,7 +7,13 @@ backend imports it unconditionally. jax.monitoring subscription happens
 lazily at the first tick of a process that already loaded jax.
 """
 
-from escalator_tpu.observability import flightrecorder, jaxmon, spans
+from escalator_tpu.observability import (
+    flightrecorder,
+    histograms,
+    jaxmon,
+    spans,
+    tail,
+)
 from escalator_tpu.observability.flightrecorder import (
     RECORDER,
     dump_on_incident,
@@ -29,5 +35,5 @@ flightrecorder.install()
 __all__ = [
     "RECORDER", "add_phase", "annotate", "current_path", "current_timeline",
     "dump_on_incident", "enabled", "fence", "flightrecorder", "graft",
-    "jaxmon", "set_enabled", "span", "spans",
+    "histograms", "jaxmon", "set_enabled", "span", "spans", "tail",
 ]

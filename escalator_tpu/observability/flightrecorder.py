@@ -33,7 +33,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from escalator_tpu.observability import jaxmon, spans
+from escalator_tpu.observability import histograms, jaxmon, spans
 
 DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_FLIGHT_RECORDER_SIZE",
                                       "256"))
@@ -109,7 +109,10 @@ class FlightRecorder:
             self._ring.clear()
 
     # -- dumping -----------------------------------------------------------
-    def as_dump(self, reason: str = "on-demand") -> Dict[str, Any]:
+    def as_dump(self, reason: str = "on-demand",
+                extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """``extra`` merges additional top-level sections into the dump
+        document (the tail watchdog's ``tail`` breach annotation)."""
         doc = {
             "flight_recorder": True,
             "reason": reason,
@@ -119,8 +122,11 @@ class FlightRecorder:
             "depth": self.depth,
             "total_recorded": self.total_recorded,
             "jaxmon": jaxmon.snapshot(),
+            "tick_quantiles_ms": histograms.tick_quantiles_ms(),
             "ticks": self.snapshot(),
         }
+        if extra:
+            doc.update(extra)
         # deterministic replay (round 11): when tick-input recording is on,
         # every dump is a self-contained replay bundle — the recorded
         # (idx, old→new) batches ride along under "tick_inputs" and
@@ -131,14 +137,15 @@ class FlightRecorder:
             doc["tick_inputs"] = replay.INPUT_LOG.snapshot()
         return doc
 
-    def dump(self, path: str, reason: str = "on-demand") -> str:
+    def dump(self, path: str, reason: str = "on-demand",
+             extra: Optional[Dict[str, Any]] = None) -> str:
         """Write the dump JSON crash-consistently (the shared
         ``utils.atomicio.atomic_write`` recipe: an incident dump racing a
         SIGKILL — or a power cut, now that dumps are part of the failover
         story — must not strand a truncated or non-durable artifact)."""
         from escalator_tpu.utils.atomicio import atomic_write
 
-        doc = self.as_dump(reason)
+        doc = self.as_dump(reason, extra=extra)
 
         def emit(f):
             json.dump(doc, f, indent=1)
@@ -166,23 +173,45 @@ def _on_root_start(tl: spans.Timeline) -> None:
 
 def _on_root_complete(tl: spans.Timeline) -> None:
     rec = RECORDER.record_timeline(tl)
+    backend = str(rec.get("backend") or rec.get("root") or "unknown")
+    # LEAF phases only: composite spans (the root, a backend's wrapper,
+    # the controller's decide envelope) share leaf names with the spans
+    # they contain ("decide" nests "decide"), and labeling both would
+    # double-count the same wall time under one {backend, phase} series.
+    # Composites stay in the recorder, where paths disambiguate them.
+    # GRAFTED phases are skipped too: they are remote time already inside
+    # the local rpc phase (counting both over-reports the tick), and the
+    # remote process exports its own per-phase series for them.
+    # ONE selection, consumed by both the histogram and Prometheus feeds —
+    # the two series families must never diverge on what counts as a leaf.
+    parents = {p["path"].rsplit("/", 1)[0] for p in rec["phases"]
+               if "/" in p["path"]}
+    leaves = [p for p in rec["phases"]
+              if p["path"] not in parents and not p.get("remote")]
+    try:
+        # tail watchdog FIRST, against the series as of the PRIOR ticks: at
+        # realistic sample counts p99 ~= max, so a breach folded in before
+        # the comparison could never exceed its own p99. A breach schedules
+        # a worker-thread dump, never blocking the tick path.
+        from escalator_tpu.observability import tail
+
+        tail.WATCHDOG.on_record(rec)
+    except Exception:  # noqa: BLE001 - observability must never break ticks
+        pass
+    try:
+        # streaming tail histograms (round 13): exact-quantile log-bucket
+        # engine; the root duration lands in its own e2e series keyed by
+        # root name (the tail watchdog's comparison population)
+        for p in leaves:
+            histograms.PHASES.observe((backend, p["name"]), p["ms"] / 1e3)
+        histograms.TICKS.observe((str(rec.get("root") or "unknown"),),
+                                 rec["duration_ms"] / 1e3)
+    except Exception:  # noqa: BLE001 - observability must never break ticks
+        pass
     try:
         from escalator_tpu.metrics import metrics
 
-        backend = str(rec.get("backend") or rec.get("root") or "unknown")
-        # LEAF phases only: composite spans (the root, a backend's wrapper,
-        # the controller's decide envelope) share leaf names with the spans
-        # they contain ("decide" nests "decide"), and labeling both would
-        # double-count the same wall time under one {backend, phase} series.
-        # Composites stay in the recorder, where paths disambiguate them.
-        # GRAFTED phases are skipped too: they are remote time already inside
-        # the local rpc phase (counting both over-reports the tick), and the
-        # remote process exports its own per-phase series for them.
-        parents = {p["path"].rsplit("/", 1)[0] for p in rec["phases"]
-                   if "/" in p["path"]}
-        for p in rec["phases"]:
-            if p["path"] in parents or p.get("remote"):
-                continue
+        for p in leaves:
             metrics.tick_phase_latency.labels(backend, p["name"]).observe(
                 p["ms"] / 1e3)
     except Exception:  # noqa: BLE001 - metrics must never break the tick
@@ -203,7 +232,8 @@ def install() -> None:
 _incident_seq = 0
 
 
-def dump_on_incident(reason: str) -> Optional[str]:
+def dump_on_incident(reason: str,
+                     extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
     """Best-effort incident dump (wedge watchdog, audit mismatch): write
     the ring to ``ESCALATOR_TPU_DUMP_DIR`` (falling back to the legacy
     ``ESCALATOR_TPU_FLIGHT_DUMP_DIR`` spelling, default cwd for compat)
@@ -224,7 +254,7 @@ def dump_on_incident(reason: str) -> Optional[str]:
             f"escalator-tpu-flight-{reason}-{os.getpid()}-"
             f"{int(time.time())}-{_incident_seq}.json",
         )
-        RECORDER.dump(path, reason=reason)
+        RECORDER.dump(path, reason=reason, extra=extra)
     except Exception:  # noqa: BLE001
         return None
     try:

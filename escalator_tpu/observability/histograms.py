@@ -1,0 +1,305 @@
+"""Streaming fixed-log-bucket latency histograms: the tail-latency substrate.
+
+Every number this repo published before round 13 was a median — bench rows,
+the coarse `tick_phase_seconds` Prometheus buckets, the Grafana panels — but
+PAPER.md's target is a latency *SLO* (<50 ms scale decisions), and an SLO is
+a tail statement. This module is the HdrHistogram-style (Gray/Tene) engine
+that turns the span layer's per-phase durations into always-on quantiles:
+
+- **Fixed log buckets.** Base-1.25 geometric buckets spanning 1 µs .. 10 s
+  (73 buckets + underflow + overflow), so any quantile is exact to within
+  one bucket width — a guaranteed <= 25% relative error at any magnitude,
+  from a 10 µs pack phase to a 5 s compile-contaminated tick, with no
+  a-priori knowledge of the distribution. `bench.py --smoke` proves the
+  bound against ``np.percentile`` ground truth on adversarial distributions.
+- **O(1) record.** One log, one clamp, one int64 increment under a lock
+  (~1 µs; inside the instrumentation-overhead budget the PR-4 interleaved
+  arms gate at < 1%). No allocation after construction.
+- **Mergeable.** Bucket layout is a module constant, so histograms add
+  counter-wise — per-backend series merge into the process root view the
+  plugin ``health()`` tail fields report.
+
+Zero dependencies (stdlib only), same deployment contract as spans.py: a
+golden-only controller records its tail without importing jax or numpy.
+
+Feeding happens in the flight recorder's root-complete hook
+(flightrecorder.py): every completed timeline lands its leaf phases in
+:data:`PHASES` keyed ``(backend, phase)`` and its root duration in
+:data:`TICKS` keyed by root name — the same single channel that feeds the
+ring and the Prometheus series, so quantiles, records and metrics can never
+disagree about what a tick cost. Prometheus export (the fine-bucket
+``escalator_tpu_tick_phase_hist_seconds`` / ``escalator_tpu_tick_e2e_seconds``
+native histograms) is a pull-time collector in metrics/metrics.py.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from array import array
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "BASE", "LO", "HI", "NUM_BUCKETS", "EDGES",
+    "LogHistogram", "HistogramSet",
+    "PHASES", "TICKS", "tick_quantiles_ms", "reset",
+]
+
+#: bucket growth factor: consecutive bucket bounds differ by 25%, which is
+#: the worst-case relative quantile error (one bucket width)
+BASE = 1.25
+#: smallest resolvable duration (1 µs): everything below lands in the
+#: underflow bucket, reported as LO
+LO = 1e-6
+#: top of the resolvable range (10 s): a wedged tick beyond it lands in the
+#: overflow bucket, reported as HI (the wedge watchdog owns anything slower)
+HI = 10.0
+
+_LOG_BASE = math.log(BASE)
+#: bucket i (0-based, after the underflow slot) covers [EDGES[i], EDGES[i+1])
+NUM_BUCKETS = int(math.ceil(math.log(HI / LO) / _LOG_BASE))          # 73
+EDGES: Tuple[float, ...] = tuple(
+    LO * BASE ** i for i in range(NUM_BUCKETS)) + (HI,)
+
+#: upper-bound labels, precomputed once (cumulative_buckets emits the full
+#: fixed layout on every scrape — formatting 73 floats per series per scrape
+#: would dominate the collector otherwise)
+_EDGE_LABELS: Tuple[str, ...] = tuple(
+    f"{e:.9g}" for e in EDGES[1:])
+
+#: counts layout: [underflow] + NUM_BUCKETS regular + [overflow]
+_UNDER = 0
+_FIRST = 1
+_OVER = NUM_BUCKETS + 1
+_SLOTS = NUM_BUCKETS + 2
+
+
+def bucket_index(seconds: float) -> int:
+    """Slot index for a duration (O(1)): log-estimate plus a one-step
+    correction for float rounding at bucket boundaries (the estimate can be
+    off by one when ``seconds`` sits exactly on an edge; the correction makes
+    boundary placement exact — locked by tests/test_tail_latency.py)."""
+    if seconds < LO:
+        return _UNDER
+    if seconds >= HI:
+        return _OVER
+    i = int(math.log(seconds / LO) / _LOG_BASE)
+    if i >= NUM_BUCKETS:
+        i = NUM_BUCKETS - 1
+    # correct the float estimate (at most one step either way)
+    if seconds < EDGES[i]:
+        i -= 1
+    elif i + 1 < NUM_BUCKETS and seconds >= EDGES[i + 1]:
+        i += 1
+    return _FIRST + i
+
+
+def bucket_bounds(seconds: float) -> Tuple[float, float]:
+    """(lower, upper) edge of the bucket a duration lands in — the "one
+    bucket width" the accuracy contract is stated against. Underflow reports
+    (0, LO); overflow (HI, HI)."""
+    slot = bucket_index(seconds)
+    if slot == _UNDER:
+        return 0.0, LO
+    if slot == _OVER:
+        return HI, HI
+    i = slot - _FIRST
+    return EDGES[i], EDGES[i + 1]
+
+
+class LogHistogram:
+    """One streaming latency series: int64 bucket counts + running sum.
+
+    Thread-safe (`record` from tick threads, `snapshot`/`quantile` from
+    scrape/health threads); the lock guards a handful of int ops, so a
+    record is ~1 µs.
+    """
+
+    __slots__ = ("_counts", "_count", "_sum", "_max", "_min", "_lock")
+
+    def __init__(self) -> None:
+        self._counts = array("q", [0]) * _SLOTS
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._min = math.inf
+        self._lock = threading.Lock()
+
+    # -- writing -----------------------------------------------------------
+    def record(self, seconds: float) -> None:
+        slot = bucket_index(seconds)
+        with self._lock:
+            self._counts[slot] += 1
+            self._count += 1
+            self._sum += seconds
+            if seconds > self._max:
+                self._max = seconds
+            if seconds < self._min:
+                self._min = seconds
+
+    def merge(self, other: "LogHistogram") -> None:
+        """Counter-wise add (bucket layout is a module constant, so merges
+        are exact — the per-backend tick series sum into the process root
+        view without re-sampling)."""
+        with other._lock:
+            counts = array("q", other._counts)
+            count, total = other._count, other._sum
+            mx, mn = other._max, other._min
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._count += count
+            self._sum += total
+            if mx > self._max:
+                self._max = mx
+            if mn < self._min:
+                self._min = mn
+
+    # -- reading -----------------------------------------------------------
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum_seconds(self) -> float:
+        return self._sum
+
+    @property
+    def max_seconds(self) -> float:
+        return self._max
+
+    @property
+    def min_seconds(self) -> float:
+        return self._min if self._count else 0.0
+
+    def quantile(self, q: float) -> Optional[float]:
+        """The q-quantile (q in [0, 1]) with linear interpolation inside the
+        landing bucket — always within one bucket width of the exact order
+        statistic. None on an empty histogram. Underflow reports LO's lower
+        neighborhood as LO/2; overflow clamps to HI (anything out there is
+        the wedge watchdog's jurisdiction, not a quantile's)."""
+        with self._lock:
+            counts = array("q", self._counts)
+            total = self._count
+        if total == 0:
+            return None
+        q = min(max(q, 0.0), 1.0)
+        target = q * total
+        cum = 0
+        for slot, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                if slot == _UNDER:
+                    return LO / 2
+                if slot == _OVER:
+                    return HI
+                lo, hi = EDGES[slot - _FIRST], EDGES[slot - _FIRST + 1]
+                frac = (target - cum) / c if c else 0.0
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return HI  # unreachable with consistent counts; defensive
+
+    def quantiles(self) -> Dict[str, Optional[float]]:
+        """The published accessor set: exact-to-one-bucket p50/p90/p99/p999
+        plus count/min/max (None quantiles on an empty series)."""
+        return {
+            "count": self._count,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+            "p999": self.quantile(0.999),
+            "min": self.min_seconds if self._count else None,
+            "max": self._max if self._count else None,
+        }
+
+    def cumulative_buckets(self) -> List[Tuple[str, int]]:
+        """Prometheus-histogram form: (upper-bound-label, cumulative count)
+        for EVERY bucket edge plus +Inf. The full fixed layout is emitted
+        even where empty: `sum by (le)` quantile queries (the shipped
+        Grafana panels) require every series to expose the same `le` set —
+        a truncated-series sum is non-monotonic in `le` and
+        histogram_quantile returns garbage — and `rate()` needs each `le`
+        series to exist continuously over time."""
+        with self._lock:
+            counts = array("q", self._counts)
+            total = self._count
+        out: List[Tuple[str, int]] = []
+        cum = counts[_UNDER]
+        for i in range(NUM_BUCKETS):
+            cum += counts[_FIRST + i]
+            out.append((_EDGE_LABELS[i], cum))
+        out.append(("+Inf", total))
+        return out
+
+
+class HistogramSet:
+    """Label-keyed LogHistogram registry (process-global instances below).
+
+    ``get`` allocates on first touch; the dict is tiny (backends x phase
+    names), so a snapshot is a cheap copy under the lock.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hists: Dict[Tuple[str, ...], LogHistogram] = {}
+
+    def get(self, *key: str) -> LogHistogram:
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = LogHistogram()
+            return h
+
+    def observe(self, key: Tuple[str, ...], seconds: float) -> None:
+        self.get(*key).record(seconds)
+
+    def peek(self, *key: str) -> Optional[LogHistogram]:
+        with self._lock:
+            return self._hists.get(key)
+
+    def items(self) -> Iterator[Tuple[Tuple[str, ...], LogHistogram]]:
+        with self._lock:
+            snap = list(self._hists.items())
+        return iter(snap)
+
+    def merged(self) -> LogHistogram:
+        out = LogHistogram()
+        for _, h in self.items():
+            out.merge(h)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._hists.clear()
+
+
+#: leaf-phase series keyed (backend, phase) — same leaf-only/remote-skip
+#: selection as the Prometheus feed (see flightrecorder._on_root_complete)
+PHASES = HistogramSet()
+#: root end-to-end series keyed by root timeline name ("tick" for the
+#: controller loop; standalone backend/bench roots keep their own series so
+#: the tail watchdog always compares a tick against its own population)
+TICKS = HistogramSet()
+
+
+def tick_quantiles_ms(root: Optional[str] = None) -> Dict[str, Optional[float]]:
+    """Quantiles of the root tick series in milliseconds — ``root=None``
+    merges every root series (the process-wide view the plugin ``health()``
+    tail fields ship). Quantile values are None when nothing recorded."""
+    if root is None:
+        h = TICKS.merged()
+    else:
+        h = TICKS.peek(root) or LogHistogram()
+    out = h.quantiles()
+    return {
+        k: (round(v * 1e3, 4) if isinstance(v, float) else v)
+        for k, v in out.items()
+    }
+
+
+def reset() -> None:
+    """Drop every recorded series (test/bench isolation; production never
+    calls this — the histograms are the process's lifetime tail memory)."""
+    PHASES.clear()
+    TICKS.clear()

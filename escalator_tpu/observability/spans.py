@@ -66,7 +66,9 @@ class Phase:
     duration_sec: float
     kind: str = "host"   # "host" | "device" | "rpc"
     fenced: bool = True  # duration is device-accurate (see module docstring)
-    #: start offset from the timeline root (None for grafted remote phases)
+    #: start offset from the timeline root. For grafted remote phases the
+    #: offset is relative to the REMOTE timeline's root (the peer shipped
+    #: it); the trace exporter re-anchors it under the local rpc span.
     offset_sec: Optional[float] = None
     #: grafted from another process's timeline (that process exports its own
     #: Prometheus series for these — the local histograms skip them)
@@ -260,12 +262,16 @@ def graft(phase_dicts: List[Dict[str, Any]], under: Optional[str] = None) -> Non
     for p in phase_dicts:
         try:
             path = str(p.get("path") or p.get("name") or "remote")
+            off = p.get("offset_ms")
             tl.phases.append(Phase(
                 name=str(p.get("name") or path.rsplit("/", 1)[-1]),
                 path=(prefix + "/" + path) if prefix else path,
                 duration_sec=float(p.get("ms", 0.0)) / 1e3,
                 kind=str(p.get("kind", "host")),
                 fenced=bool(p.get("fenced", False)),
+                # remote-root-relative (see Phase.offset_sec): kept so the
+                # trace exporter can lay the server spans out in time
+                offset_sec=float(off) / 1e3 if off is not None else None,
                 remote=True,
             ))
         except Exception:  # noqa: BLE001 - a malformed remote phase is dropped

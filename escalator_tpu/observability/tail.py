@@ -1,0 +1,201 @@
+"""Slow-tick deep capture: the tail watchdog.
+
+The flight recorder answers "what were the last N ticks"; the histograms
+(histograms.py) answer "what does the tail look like". This module closes
+the loop: when a completed root tick lands in its own series' tail — its
+duration exceeds ``multiplier x`` the live rolling p99 — the flight
+recorder auto-dumps with ``reason="tail"``, so every tail event arrives as
+a self-contained "why was this tick slow" bundle: the full span tree of the
+breaching tick (and the ticks before it), its compile/transfer deltas and
+dirty-group count, and — when input recording is on
+(``ESCALATOR_TPU_RECORD_INPUTS=1``) — the replay-ring slice covering it.
+The dump document carries a ``tail`` section naming the breaching tick's
+seq/root/duration and the p99+threshold it breached.
+
+Knobs (all env; parsed per tick, memoized on the raw strings):
+
+- ``ESCALATOR_TPU_TAIL_CAPTURE``: the breach multiplier (default ``4``;
+  ``0``/``off`` disables capture entirely — the histograms keep streaming
+  either way).
+- ``ESCALATOR_TPU_TAIL_MIN_TICKS``: samples a root series needs before the
+  watchdog arms (default 64 — a p99 over fewer ticks is mostly the max).
+- ``ESCALATOR_TPU_TAIL_DUMP_INTERVAL_SEC``: rate limit between tail dumps
+  (default 60). A pathological workload where EVERY tick breaches must
+  produce a trickle of bundles, not a dump-per-tick write storm.
+
+The breach check itself is O(buckets) (~5 µs) and runs in the root-complete
+hook, after every timed phase closed. The dump is handed to a daemon worker
+thread: serializing a 256-deep ring is milliseconds of JSON, and the
+breaching tick's *successor* must not inherit that cost inside its own
+timed window (the bench's p99 columns would otherwise report the
+instrumentation, not the workload). Rate-limit state is claimed before the
+handoff, so concurrent breaches collapse to one worker.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from escalator_tpu.observability import histograms
+
+__all__ = ["TailWatchdog", "WATCHDOG", "parse_tail_capture"]
+
+_ENV_MULT = "ESCALATOR_TPU_TAIL_CAPTURE"
+_ENV_MIN = "ESCALATOR_TPU_TAIL_MIN_TICKS"
+_ENV_INTERVAL = "ESCALATOR_TPU_TAIL_DUMP_INTERVAL_SEC"
+
+DEFAULT_MULTIPLIER = 4.0
+DEFAULT_MIN_TICKS = 64
+DEFAULT_INTERVAL_SEC = 60.0
+#: ticks between rolling-p99 recomputes per root series (see _p99_cache)
+_P99_REFRESH = 16
+
+
+def parse_tail_capture(raw: Optional[str]) -> Optional[float]:
+    """Multiplier from the ESCALATOR_TPU_TAIL_CAPTURE spelling: unset/empty
+    -> the default, "off"/"0"/non-positive -> disabled (None), else the
+    float multiplier. A junk value disables with a one-time warning rather
+    than crashing the tick path."""
+    if raw is None or raw.strip() == "":
+        return DEFAULT_MULTIPLIER
+    text = raw.strip().lower()
+    if text in ("off", "false", "no", "none"):
+        return None
+    try:
+        mult = float(text)
+    except ValueError:
+        import logging
+
+        logging.getLogger("escalator_tpu.observability").warning(
+            "ignoring invalid %s=%r (want a multiplier or 'off'); tail "
+            "capture disabled", _ENV_MULT, raw)
+        return None
+    return mult if mult > 0 else None
+
+
+class TailWatchdog:
+    """Per-process tail-breach detector (singleton :data:`WATCHDOG`)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._last_dump_mono: float = -float("inf")
+        self._worker: Optional[threading.Thread] = None
+        #: (raw env tuple) -> parsed config, so steady-state ticks pay one
+        #: dict lookup instead of three env parses
+        self._cfg_cache: Tuple[Tuple[Optional[str], ...],
+                               Tuple[Optional[float], int, float]] = (
+            ("\0",), (None, 0, 0.0))
+        #: root -> (histogram instance, count at compute time, p99 sec): the
+        #: rolling p99 refreshes every _P99_REFRESH ticks per root instead
+        #: of per tick — a quantile walk is ~10 µs and a p99 over hundreds
+        #: of samples moves negligibly in 16 ticks, so the steady-state
+        #: check stays ~1 µs (priced in cfg14_observability_overhead). The
+        #: instance doubles as a generation token: histograms.reset()
+        #: replaces the object, invalidating the cache even if the new
+        #: series' count catches up to the cached one.
+        self._p99_cache: Dict[str, Tuple[object, int, float]] = {}
+        self.breaches = 0          # breaches observed (dumped or rate-limited)
+        self.dumps = 0             # dumps actually handed to the worker
+
+    # -- config ------------------------------------------------------------
+    def _config(self) -> Tuple[Optional[float], int, float]:
+        raw = (os.environ.get(_ENV_MULT), os.environ.get(_ENV_MIN),
+               os.environ.get(_ENV_INTERVAL))
+        cached_raw, cached = self._cfg_cache
+        if raw == cached_raw:
+            return cached
+        mult = parse_tail_capture(raw[0])
+        try:
+            min_ticks = int(raw[1]) if raw[1] else DEFAULT_MIN_TICKS
+        except ValueError:
+            min_ticks = DEFAULT_MIN_TICKS
+        try:
+            interval = float(raw[2]) if raw[2] else DEFAULT_INTERVAL_SEC
+        except ValueError:
+            interval = DEFAULT_INTERVAL_SEC
+        cfg = (mult, max(1, min_ticks), max(0.0, interval))
+        self._cfg_cache = (raw, cfg)
+        return cfg
+
+    # -- the hook ----------------------------------------------------------
+    def on_record(self, rec: Dict[str, Any]) -> bool:
+        """Called by the flight recorder for every completed root timeline,
+        BEFORE the tick lands in its root histogram: the comparison
+        population is the *prior* ticks — at realistic sample counts
+        p99 ~= max, so a breach folded in first could never exceed its own
+        p99. Returns True when a tail dump was scheduled (tests poll
+        :meth:`drain`)."""
+        mult, min_ticks, interval = self._config()
+        if mult is None:
+            return False
+        root = str(rec.get("root") or "unknown")
+        hist = histograms.TICKS.peek(root)
+        if hist is None or hist.count < min_ticks:
+            return False
+        count = hist.count
+        cached = self._p99_cache.get(root)
+        if (cached is not None and cached[0] is hist
+                and count - cached[1] < _P99_REFRESH):
+            p99 = cached[2]
+        else:
+            p99 = hist.quantile(0.99)
+            if p99 is None:
+                return False
+            self._p99_cache[root] = (hist, count, p99)
+        duration_sec = float(rec.get("duration_ms", 0.0)) / 1e3
+        threshold = mult * p99
+        if duration_sec <= threshold:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            self.breaches += 1
+            if now - self._last_dump_mono < interval:
+                return False
+            self._last_dump_mono = now   # claimed before the handoff
+            self.dumps += 1
+        tail_info = {
+            "seq": rec.get("seq"),
+            "root": root,
+            "backend": rec.get("backend"),
+            "duration_ms": rec.get("duration_ms"),
+            "p99_ms": round(p99 * 1e3, 4),
+            "threshold_ms": round(threshold * 1e3, 4),
+            "multiplier": mult,
+            "tick_count": hist.count,
+        }
+        worker = threading.Thread(
+            target=self._dump, args=(tail_info,),
+            name="escalator-tail-dump", daemon=True)
+        with self._lock:
+            self._worker = worker
+        worker.start()
+        return True
+
+    @staticmethod
+    def _dump(tail_info: Dict[str, Any]) -> None:
+        # the worker serializes/writes; dump_on_incident never raises
+        from escalator_tpu.observability import flightrecorder
+
+        flightrecorder.dump_on_incident("tail", extra={"tail": tail_info})
+
+    # -- test/bench support -------------------------------------------------
+    def drain(self, timeout: float = 10.0) -> None:
+        """Join the in-flight dump worker (tests assert on the artifact; the
+        production path never waits)."""
+        with self._lock:
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._last_dump_mono = -float("inf")
+            self._p99_cache.clear()
+            self.breaches = 0
+            self.dumps = 0
+
+
+WATCHDOG = TailWatchdog()

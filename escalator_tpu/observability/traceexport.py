@@ -1,0 +1,178 @@
+"""Flight-recorder ring -> Chrome trace-event / Perfetto JSON.
+
+A flight dump is exact but hard to *read*: a tail bundle's span tree is a
+flat phase list with slash paths. This module renders any dump document (or
+the live ring) to the trace-event format Perfetto (https://ui.perfetto.dev)
+and ``chrome://tracing`` open natively — the same format the archived TPU
+device traces in ``tpu_traces/`` use — so a human can scrub a slow tick.
+
+Layout decisions:
+
+- every phase is a complete ("X") duration event; nesting is by time
+  containment, which the span layer's offsets guarantee for fenced phases;
+- **unfenced device phases get their own track** ("overlap"): an overlapped
+  dispatch's span measured enqueue time while the device program ran past
+  the span's close — drawing it nested would misrepresent containment, so
+  it sits on a parallel track flagged ``fenced=false`` (read it with the
+  record's ``overlap_*`` keys, per docs/observability.md);
+- **grafted plugin-server spans get their own track** ("plugin server") and
+  are re-anchored in time under the local ``rpc`` span that carried them
+  (their offsets are remote-root-relative — see ``spans.graft``), so one
+  trace shows client and server of a plugin-routed decide together;
+- phases recorded without an offset (``spans.add_phase`` accumulations)
+  are laid out cursor-sequentially from their parent's start — positions
+  are then best-effort, durations exact.
+
+``escalator-tpu debug-trace`` (cli.py) is the operator entry: a dump file
+or a live plugin's ``Dump`` RPC in, a ``.trace.json`` out.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+__all__ = ["trace_from_dump", "trace_from_records", "TID_TICK",
+           "TID_OVERLAP", "TID_REMOTE"]
+
+TID_TICK = 1      # fenced / host / rpc phases: the tick's main track
+TID_OVERLAP = 2   # unfenced device dispatches (overlap windows)
+TID_REMOTE = 3    # grafted plugin-server phases
+
+_THREAD_NAMES = {
+    TID_TICK: "tick",
+    TID_OVERLAP: "overlap (unfenced dispatch)",
+    TID_REMOTE: "plugin server (grafted)",
+}
+
+#: record keys lifted into the root event's args (the "why" annotations a
+#: human wants on the tick slice itself)
+_ROOT_ARG_KEYS = (
+    "backend", "impl", "ordered", "digest", "dirty_groups", "refresh_audit",
+    "store", "order_path", "order_dirty_lanes", "compile_events",
+    "compile_seconds", "transfer_events", "overlap_host_ms",
+    "overlap_sync_wait_ms", "overlap_saved_ms", "fallback", "fallback_code",
+    "chaos", "restored", "seq",
+)
+
+
+def _tid_for(phase: Dict[str, Any]) -> int:
+    if phase.get("remote"):
+        return TID_REMOTE
+    if not phase.get("fenced", True) and phase.get("kind") == "device":
+        return TID_OVERLAP
+    return TID_TICK
+
+
+def _record_events(rec: Dict[str, Any], pid: int) -> List[Dict[str, Any]]:
+    base_us = float(rec.get("time_unix", 0.0)) * 1e6
+    phases: List[Dict[str, Any]] = list(rec.get("phases") or ())
+    root = str(rec.get("root", ""))
+
+    # pass 1: absolute start (µs) of every offset-carrying LOCAL phase,
+    # keyed by path (first occurrence wins — the anchor for children)
+    starts: Dict[str, float] = {}
+    for p in phases:
+        off = p.get("offset_ms")
+        if off is None or p.get("remote"):
+            continue
+        starts.setdefault(str(p["path"]), base_us + float(off) * 1e3)
+
+    def _anchor(path: str) -> float:
+        """Start of the longest local strict path prefix (the enclosing
+        span), falling back to the record base."""
+        probe = path
+        while "/" in probe:
+            probe = probe.rsplit("/", 1)[0]
+            if probe in starts:
+                return starts[probe]
+        return base_us
+
+    # pass 2: events; offsetless phases advance a per-parent cursor
+    cursors: Dict[str, float] = {}
+    events: List[Dict[str, Any]] = []
+    for p in phases:
+        path = str(p.get("path") or p.get("name") or "phase")
+        dur_us = float(p.get("ms", 0.0)) * 1e3
+        off = p.get("offset_ms")
+        exact = off is not None
+        if p.get("remote"):
+            anchor = _anchor(path)
+            if exact:
+                ts = anchor + float(off) * 1e3
+            else:
+                ts = cursors.get(path.rsplit("/", 1)[0], anchor)
+                cursors[path.rsplit("/", 1)[0]] = ts + dur_us
+        elif exact:
+            ts = base_us + float(off) * 1e3
+        else:
+            parent = path.rsplit("/", 1)[0] if "/" in path else ""
+            ts = cursors.get(parent, starts.get(parent, base_us))
+            cursors[parent] = ts + dur_us
+        args: Dict[str, Any] = {
+            "path": path,
+            "fenced": bool(p.get("fenced", True)),
+        }
+        if p.get("remote"):
+            args["remote"] = True
+        if not exact:
+            args["layout"] = "cursor (no recorded offset)"
+        if path == root:
+            for k in _ROOT_ARG_KEYS:
+                if rec.get(k) is not None:
+                    args[k] = rec[k]
+        events.append({
+            "name": str(p.get("name") or path.rsplit("/", 1)[-1]),
+            "cat": str(p.get("kind", "host")),
+            "ph": "X",
+            "ts": round(ts, 3),
+            "dur": round(dur_us, 3),
+            "pid": pid,
+            "tid": _tid_for(p),
+            "args": args,
+        })
+    return events
+
+
+def trace_from_records(records: List[Dict[str, Any]], pid: int = 1,
+                       process_name: str = "escalator-tpu") -> Dict[str, Any]:
+    """Trace document from raw tick records (the live ring's snapshot)."""
+    events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": process_name},
+    }]
+    for tid, tname in _THREAD_NAMES.items():
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": tname},
+        })
+    for rec in records:
+        events.extend(_record_events(rec, pid))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def trace_from_dump(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Trace document from a flight dump (``FlightRecorder.as_dump`` /
+    ``debug-dump`` output). The dump's reason/pid/tail annotations ride
+    along under ``otherData`` so the provenance stays inside the trace."""
+    pid = int(doc.get("pid") or 1)
+    out = trace_from_records(
+        list(doc.get("ticks") or ()), pid=pid,
+        process_name=f"escalator-tpu (dump: {doc.get('reason', '?')})")
+    other: Dict[str, Any] = {
+        "reason": doc.get("reason"),
+        "dumped_at_unix": doc.get("dumped_at_unix"),
+        "total_recorded": doc.get("total_recorded"),
+    }
+    if doc.get("tail") is not None:
+        other["tail"] = doc["tail"]
+    if doc.get("tick_quantiles_ms") is not None:
+        other["tick_quantiles_ms"] = doc["tick_quantiles_ms"]
+    out["otherData"] = other
+    return out
+
+
+def live_trace() -> Dict[str, Any]:
+    """Trace of THIS process's live ring (no dump file round-trip)."""
+    from escalator_tpu.observability.flightrecorder import RECORDER
+
+    return trace_from_dump(RECORDER.as_dump("live-trace"))

@@ -95,6 +95,11 @@ class _ComputeService:
             last = self._last_decide_unix
             ticks = self._ticks_served
         age = -1.0 if last is None else time.time() - last
+        # tail visibility without a Prometheus scrape (round 13): the root
+        # tick quantiles from the streaming histograms — a stale-but-alive
+        # server's TAIL is inspectable from the same health probe that
+        # exposes its age (None until the first recorded tick)
+        q = obs.histograms.tick_quantiles_ms()
         return msgpack.packb({
             "device": self._device,
             "version": __version__,
@@ -104,6 +109,8 @@ class _ComputeService:
             "last_decide_age_sec": round(age, 3),
             "ticks_served": ticks,
             "flight_recorder_depth": obs.RECORDER.depth,
+            "tick_p99_ms": q["p99"],
+            "tick_p999_ms": q["p999"],
         })
 
     def dump(self, request: bytes, context) -> bytes:

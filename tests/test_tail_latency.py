@@ -1,0 +1,440 @@
+"""Tail-latency layer (round 13): streaming histograms, slow-tick deep
+capture, Perfetto trace export.
+
+Locks the ISSUE-8 contracts:
+
+- **histograms**: record/merge/quantile correctness within one bucket width
+  of ``np.percentile`` ground truth on adversarial distributions (bimodal,
+  heavy tail, single sample), exact bucket-boundary placement, under/overflow
+  clamping, counter-exact merges;
+- **tail capture**: a root tick breaching ``multiplier x`` the live rolling
+  p99 triggers a ``reason="tail"`` flight dump (worker-thread, rate-limited)
+  whose document carries the breach annotation and the breaching tick's
+  span tree; env parsing is validated;
+- **trace export**: any flight dump renders to schema-valid Chrome
+  trace-event / Perfetto JSON — nested phases as X duration events, unfenced
+  overlap dispatches and grafted plugin-server spans on their own tracks —
+  and a REAL plugin-routed decide produces one merged client+server trace
+  through the actual ``escalator-tpu debug-trace`` verb;
+- **inertness**: with tail capture armed and histograms streaming, traced
+  entries' jaxprs stay byte-identical to the recording-off arm (the layer
+  hangs off the timeline-completion hook, strictly outside traced code);
+- **plugin health**: ``tick_p99_ms``/``tick_p999_ms`` ride the health
+  response, so a stale-but-alive server's tail is visible without a
+  Prometheus scrape.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from escalator_tpu import observability as obs
+from escalator_tpu.metrics import metrics
+from escalator_tpu.observability import histograms as hg
+from escalator_tpu.observability import spans, tail, traceexport
+
+
+def _counter(name, labels=None):
+    return metrics.registry.get_sample_value(name, labels or {}) or 0.0
+
+
+# ----------------------------------------------------------- histogram engine
+DISTRIBUTIONS = {
+    "bimodal": lambda rng: np.concatenate([
+        rng.normal(2e-3, 3e-4, 5000), rng.normal(8e-2, 1e-2, 300)]),
+    "heavy_tail": lambda rng: (rng.pareto(1.5, 5000) + 1) * 1e-4,
+    "lognormal": lambda rng: rng.lognormal(-6.0, 1.5, 4000),
+    "single_sample": lambda rng: np.array([1.23e-2]),
+}
+
+
+@pytest.mark.parametrize("dist", sorted(DISTRIBUTIONS))
+def test_quantiles_within_one_bucket_of_percentile(dist):
+    rng = np.random.default_rng(17)
+    samples = np.clip(DISTRIBUTIONS[dist](rng), 1e-7, 9.0)
+    h = hg.LogHistogram()
+    for s in samples:
+        h.record(float(s))
+    assert h.count == len(samples)
+    assert h.sum_seconds == pytest.approx(float(samples.sum()), rel=1e-9)
+    assert h.max_seconds == pytest.approx(float(samples.max()))
+    assert h.min_seconds == pytest.approx(float(samples.min()))
+    for q in (0.0, 0.5, 0.9, 0.99, 0.999, 1.0):
+        gt = float(np.percentile(samples, q * 100))
+        got = h.quantile(q)
+        lo, hi = hg.bucket_bounds(gt)
+        assert abs(got - gt) <= (hi - lo) + 1e-12, (
+            f"{dist} p{q * 100:g}: {got} vs ground truth {gt} "
+            f"(bucket width {hi - lo})")
+
+
+def test_bucket_boundary_exactness_and_clamping():
+    # an exact edge value belongs to the bucket it OPENS: [edge_i, edge_i+1)
+    for i in (0, 1, 7, 36, hg.NUM_BUCKETS - 1):
+        assert hg.bucket_index(hg.EDGES[i]) == i + 1, i
+        # one ulp below the edge stays in the previous bucket (i=0 underflows)
+        below = np.nextafter(hg.EDGES[i], 0.0)
+        assert hg.bucket_index(float(below)) == i, i
+    # range clamps: underflow and overflow have their own slots
+    assert hg.bucket_index(0.0) == 0
+    assert hg.bucket_index(5e-7) == 0
+    assert hg.bucket_index(hg.HI) == hg.NUM_BUCKETS + 1
+    assert hg.bucket_index(123.0) == hg.NUM_BUCKETS + 1
+    h = hg.LogHistogram()
+    h.record(0.0)
+    h.record(99.0)
+    assert h.count == 2
+    assert h.quantile(0.0) == hg.LO / 2      # underflow reported inside (0, LO)
+    assert h.quantile(1.0) == hg.HI          # overflow clamps to HI
+    # consecutive bucket bounds grow by exactly BASE (the 25% error bound)
+    lo1, hi1 = hg.bucket_bounds(1e-3)
+    assert hi1 / lo1 == pytest.approx(hg.BASE)
+
+
+def test_merge_is_counter_exact():
+    rng = np.random.default_rng(3)
+    s1 = rng.lognormal(-6, 1, 2000)
+    s2 = rng.lognormal(-3, 0.5, 500)
+    a, b, whole = hg.LogHistogram(), hg.LogHistogram(), hg.LogHistogram()
+    for s in s1:
+        a.record(float(s))
+        whole.record(float(s))
+    for s in s2:
+        b.record(float(s))
+        whole.record(float(s))
+    a.merge(b)
+    assert a.count == whole.count
+    assert a.sum_seconds == pytest.approx(whole.sum_seconds)
+    assert list(a._counts) == list(whole._counts)
+    for q in (0.5, 0.99, 0.999):
+        assert a.quantile(q) == whole.quantile(q)
+    # empty histogram: quantiles are None, not garbage
+    assert hg.LogHistogram().quantile(0.99) is None
+    assert hg.LogHistogram().quantiles()["p999"] is None
+
+
+def test_hook_feeds_phase_and_tick_histograms():
+    """Completed timelines land leaf phases in PHASES (composites and
+    grafted remote phases excluded — the Prometheus selection) and the root
+    duration in TICKS keyed by root name."""
+    root = "histfeed_root"
+    with spans.span(root):
+        spans.annotate(backend="histfeed")
+        with spans.span("outer"):
+            with spans.span("inner"):
+                pass
+        spans.graft([{"name": "srv", "path": "remote/srv", "ms": 1.0}],
+                    under=f"{root}/outer")
+    assert hg.PHASES.peek("histfeed", "inner").count >= 1
+    assert hg.PHASES.peek("histfeed", "outer") is None      # composite
+    assert hg.PHASES.peek("histfeed", "srv") is None        # remote
+    tick_h = hg.TICKS.peek(root)
+    assert tick_h is not None and tick_h.count == 1
+    q = hg.tick_quantiles_ms(root)
+    assert q["count"] == 1 and q["p99"] is not None
+    # the merged process view (plugin health's source) includes this root
+    assert hg.tick_quantiles_ms()["count"] >= 1
+
+
+def test_prometheus_export_carries_fine_histograms():
+    with spans.span("promfeed_tick"):
+        spans.annotate(backend="promfeed")
+        with spans.span("work"):
+            time.sleep(0.001)
+    from prometheus_client import generate_latest
+
+    text = generate_latest(metrics.registry).decode()
+    assert 'escalator_tpu_tick_phase_hist_seconds_bucket{' in text
+    assert 'escalator_tpu_tick_e2e_seconds_bucket{' in text
+    assert 'root="promfeed_tick"' in text
+    # cumulative counts end at +Inf == count
+    assert _counter("escalator_tpu_tick_e2e_seconds_count",
+                    {"root": "promfeed_tick"}) >= 1
+
+
+def test_cumulative_buckets_expose_identical_le_sets():
+    """`sum by (le)` quantile queries (the shipped Grafana panels) require
+    every series to emit the SAME full `le` set: a series truncated at its
+    own last non-empty bucket sums non-monotonically and histogram_quantile
+    returns garbage. Two histograms at very different magnitudes must expose
+    identical bucket labels, and each series must be monotone."""
+    fast, slow = hg.LogHistogram(), hg.LogHistogram()
+    for _ in range(100):
+        fast.record(2e-4)
+        slow.record(1.2e-2)
+    fb, sb = fast.cumulative_buckets(), slow.cumulative_buckets()
+    assert [le for le, _ in fb] == [le for le, _ in sb]
+    assert len(fb) == hg.NUM_BUCKETS + 1 and fb[-1][0] == "+Inf"
+    for series in (fb, sb):
+        counts = [c for _, c in series]
+        assert counts == sorted(counts) and counts[-1] == 100
+    # the cross-series sum stays monotone in le (what sum by (le) scrapes)
+    summed = [a + b for (_, a), (_, b) in zip(fb, sb)]
+    assert summed == sorted(summed)
+
+
+# ------------------------------------------------------------- tail capture
+def test_parse_tail_capture_spellings():
+    assert tail.parse_tail_capture(None) == tail.DEFAULT_MULTIPLIER
+    assert tail.parse_tail_capture("") == tail.DEFAULT_MULTIPLIER
+    assert tail.parse_tail_capture("2.5") == 2.5
+    for off in ("off", "0", "OFF", "false", "-1", "none"):
+        assert tail.parse_tail_capture(off) is None, off
+    assert tail.parse_tail_capture("bogus") is None   # warn, never crash
+
+
+def _run_ticks(root, n, sleep_sec, leaf="steady_work"):
+    for _ in range(n):
+        with spans.span(root):
+            spans.annotate(backend="tailtest")
+            with spans.span(leaf):
+                time.sleep(sleep_sec)
+
+
+def test_tail_breach_dumps_and_rate_limits(tmp_path, monkeypatch):
+    root = "tailtest_breach_tick"
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_CAPTURE", "3.0")
+    # min_ticks == seed count: the watchdog arms exactly at the slow tick.
+    # 100 seeds (not 10) so ONE outlier can't drag the rolling p99 into the
+    # slow bucket — the rate-limit leg below needs the SECOND slow tick to
+    # still register as a breach.
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_MIN_TICKS", "100")
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_DUMP_INTERVAL_SEC", "600")
+    tail.WATCHDOG.reset()
+    before = _counter("escalator_tpu_flight_recorder_dumps_total",
+                      {"reason": "tail"})
+    _run_ticks(root, 100, 0.0005)
+    _run_ticks(root, 1, 0.05, leaf="slow_work")
+    tail.WATCHDOG.drain()
+    dumps = sorted(tmp_path.glob("escalator-tpu-flight-tail-*.json"))
+    assert len(dumps) == 1, dumps
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"] == "tail" and doc["flight_recorder"]
+    breach = doc["tail"]
+    assert breach["root"] == root
+    assert breach["duration_ms"] > breach["threshold_ms"]
+    assert breach["threshold_ms"] == pytest.approx(
+        3.0 * breach["p99_ms"], abs=2e-3)   # both rounded to 4 decimals
+    assert breach["tick_count"] >= 100
+    # the bundle is self-contained forensics: the breaching tick's span
+    # tree is in the shipped ring, and the live tail quantiles ride along
+    assert any(r.get("seq") == breach["seq"]
+               and any(p["name"] == "slow_work" for p in r["phases"])
+               for r in doc["ticks"])
+    assert doc["tick_quantiles_ms"]["count"] > 0
+    assert _counter("escalator_tpu_flight_recorder_dumps_total",
+                    {"reason": "tail"}) == before + 1
+    # rate limit: an immediate second breach records but does not dump
+    _run_ticks(root, 1, 0.05, leaf="slow_work")
+    tail.WATCHDOG.drain()
+    assert len(list(tmp_path.glob("escalator-tpu-flight-tail-*.json"))) == 1
+    assert tail.WATCHDOG.breaches >= 2 and tail.WATCHDOG.dumps == 1
+    tail.WATCHDOG.reset()
+
+
+def test_tail_p99_cache_invalidated_by_series_replacement(tmp_path,
+                                                          monkeypatch):
+    """histograms.reset() restarts every series at count 0; a p99 cached
+    against the dead population must not be served to the fresh one (the
+    cache guards on count going backwards)."""
+    root = "tailtest_cachereset_tick"
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_CAPTURE", "3.0")
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_MIN_TICKS", "10")
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_DUMP_INTERVAL_SEC", "600")
+    tail.WATCHDOG.reset()
+    # population A: SLOW ticks — caches a large p99 (threshold ~120 ms)
+    _run_ticks(root, 11, 0.04)
+    assert tail.WATCHDOG.breaches == 0
+    # series replaced: population B is ~40x faster; a stale 40 ms p99 would
+    # hide the 50 ms breach below (3 x 40 ms >> 50 ms). The wide gaps —
+    # 1 ms seeds, 50 ms probe, 120 ms stale threshold — keep suite
+    # contention (a 1 ms sleep stretching several-fold on a stalled core)
+    # from flipping either leg.
+    hg.TICKS.clear()
+    _run_ticks(root, 10, 0.001)
+    _run_ticks(root, 1, 0.05, leaf="slow_work")
+    tail.WATCHDOG.drain()
+    assert tail.WATCHDOG.breaches >= 1, (
+        "stale p99 from the replaced series suppressed the breach")
+    tail.WATCHDOG.reset()
+
+
+def test_tail_capture_off_never_dumps(tmp_path, monkeypatch):
+    root = "tailtest_off_tick"
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_CAPTURE", "off")
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_MIN_TICKS", "5")
+    tail.WATCHDOG.reset()
+    _run_ticks(root, 5, 0.001)
+    _run_ticks(root, 1, 0.05, leaf="slow_work")
+    tail.WATCHDOG.drain()
+    assert not list(tmp_path.glob("escalator-tpu-flight-tail-*.json"))
+    # the histograms keep streaming even with capture off
+    assert hg.TICKS.peek(root).count == 6
+    tail.WATCHDOG.reset()
+
+
+# -------------------------------------------------------------- trace export
+def _validate_trace_events(doc):
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for e in doc["traceEvents"]:
+        assert {"name", "ph", "pid", "tid"} <= set(e), e
+        assert e["ph"] in ("X", "M"), e
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float)), e
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0, e
+            assert isinstance(e["args"]["path"], str)
+    return [e for e in doc["traceEvents"] if e["ph"] == "X"]
+
+
+def test_trace_export_nesting_and_tracks():
+    root = "tracetest_tick"
+    with spans.span(root):
+        spans.annotate(backend="tracetest", digest="abc123")
+        with spans.span("pack"):
+            time.sleep(0.001)
+        with spans.span("decide", kind="device"):
+            spans.fence(None)
+            time.sleep(0.002)
+        with spans.span("overlapped", kind="device"):
+            pass   # never fenced: overlap track
+    rec = obs.RECORDER.last()
+    assert rec["root"] == root
+    doc = traceexport.trace_from_records([rec])
+    xs = _validate_trace_events(doc)
+    by_name = {e["name"]: e for e in xs}
+    root_ev = by_name[root]
+    # containment: children inside the root slice, on the main track
+    for child in ("pack", "decide"):
+        e = by_name[child]
+        assert e["tid"] == traceexport.TID_TICK
+        assert root_ev["ts"] - 1 <= e["ts"]
+        assert (e["ts"] + e["dur"]) <= root_ev["ts"] + root_ev["dur"] + 1
+    # the unfenced device dispatch sits on the overlap track
+    assert by_name["overlapped"]["tid"] == traceexport.TID_OVERLAP
+    assert by_name["overlapped"]["args"]["fenced"] is False
+    # root slice carries the record annotations
+    assert root_ev["args"]["digest"] == "abc123"
+    assert root_ev["args"]["backend"] == "tracetest"
+    # metadata names the tracks
+    meta = {(e["name"], e["tid"]): e for e in doc["traceEvents"]
+            if e["ph"] == "M"}
+    assert ("process_name", 0) in meta
+    assert ("thread_name", traceexport.TID_OVERLAP) in meta
+
+
+def test_trace_export_merges_client_and_server(tmp_path):
+    """A REAL plugin-routed decide through an in-process gRPC server, dumped
+    and rendered via the actual `escalator-tpu debug-trace` verb: one trace
+    carries the client's rpc span and the grafted server-side decide on the
+    plugin track, laid out inside the rpc window."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841 - availability gate
+    from escalator_tpu.plugin.client import ComputeClient
+    from escalator_tpu.plugin.server import make_server
+    from tests.test_kernel_parity import random_group
+    import random
+
+    from escalator_tpu.core.arrays import pack_cluster
+
+    cluster = pack_cluster([random_group(random.Random(2), 0)],
+                           pad_pods=64, pad_nodes=16, pad_groups=2)
+    server = make_server("127.0.0.1:0", max_workers=2)
+    server.start()
+    client = ComputeClient(f"127.0.0.1:{server._escalator_bound_port}",
+                           timeout_sec=120.0)
+    root = "tracetest_plugin_tick"
+    try:
+        with spans.span(root):
+            spans.annotate(backend="grpc")
+            with spans.span("rpc", kind="rpc"):
+                _out, server_phases = client.decide_arrays_traced(
+                    cluster, 1_700_000_000,
+                    span_ctx={"path": spans.current_path()})
+            assert server_phases, "server shipped no span sidecar"
+            spans.graft(server_phases, under=f"{root}/rpc")
+    finally:
+        client.close()
+        server.stop(grace=None)
+    dump_path = tmp_path / "plugin-dump.json"
+    obs.RECORDER.dump(str(dump_path), reason="test")
+    out_path = tmp_path / "plugin.trace.json"
+    from escalator_tpu.cli import main as cli_main
+
+    rc = cli_main(["debug-trace", "--dump", str(dump_path),
+                   "--output", str(out_path)])
+    assert rc == 0
+    doc = json.loads(out_path.read_text())
+    xs = _validate_trace_events(doc)
+    tick = [e for e in xs if e["args"]["path"].startswith(root)]
+    rpc = next(e for e in tick if e["name"] == "rpc"
+               and not e["args"].get("remote"))
+    remote = [e for e in tick if e["args"].get("remote")]
+    assert any(e["name"] == "decide" for e in remote), remote
+    for e in remote:
+        assert e["tid"] == traceexport.TID_REMOTE
+        # re-anchored under the local rpc span (offsets are remote-root-
+        # relative; the exporter lays them out from the rpc start)
+        assert e["ts"] >= rpc["ts"] - 1, (e, rpc)
+
+
+def test_debug_trace_unreadable_dump_exits_2(tmp_path, capsys):
+    from escalator_tpu.cli import main as cli_main
+
+    assert cli_main(["debug-trace", "--dump",
+                     str(tmp_path / "missing.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{truncated")
+    assert cli_main(["debug-trace", "--dump", str(bad)]) == 2
+
+
+# ----------------------------------------------------------------- inertness
+def test_jaxprs_byte_identical_with_tail_layer_armed(monkeypatch):
+    """The tail layer hangs entirely off the timeline-completion hook:
+    tracing with histograms streaming + tail capture armed yields jaxprs
+    byte-identical to the recording-off arm."""
+    import jax
+
+    from escalator_tpu.analysis.registry import default_registry
+
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_CAPTURE", "2.0")
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_MIN_TICKS", "1")
+    entry = {e.name: e for e in default_registry()}["kernel.delta_decide"]
+    traced = entry.build()
+
+    def jaxpr_text():
+        return str(jax.make_jaxpr(traced.fn)(*traced.args))
+
+    spans.set_enabled(False)
+    try:
+        plain = jaxpr_text()
+    finally:
+        spans.set_enabled(True)
+    with spans.span("tail_inertness_trace"):
+        instrumented = jaxpr_text()
+    assert instrumented == plain
+
+
+# ---------------------------------------------------------------- plugin tail
+def test_plugin_health_carries_tail_fields():
+    pytest.importorskip("grpc")
+    import msgpack
+
+    from escalator_tpu.plugin.server import _ComputeService
+
+    svc = _ComputeService()
+    # ensure at least one root tick exists in this process
+    with spans.span("healthtest_tick"):
+        pass
+    h = msgpack.unpackb(svc.health(b"", None))
+    assert "tick_p99_ms" in h and "tick_p999_ms" in h
+    assert h["tick_p99_ms"] is None or h["tick_p99_ms"] > 0
+    # the merged root view has ticks in this process, so the quantiles are
+    # real numbers here (a fresh process would report None until a tick)
+    assert hg.tick_quantiles_ms()["count"] > 0
+    assert h["tick_p99_ms"] is not None

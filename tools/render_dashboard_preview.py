@@ -7,6 +7,15 @@ the preview is rendered deterministically from a simulated six-hour
 scale-up/scale-down cycle instead — same panels, same metric names, plausible
 shapes. Regenerate with: python tools/render_dashboard_preview.py
 
+The LATENCY panels are not hand-drawn shapes: per-tick latency samples for
+each scrape window flow through the REAL streaming log-bucket histogram
+engine (escalator_tpu.observability.histograms.LogHistogram — the same code
+behind `escalator_tpu_tick_phase_hist_seconds`), and the plotted series are
+its rolling-window p99s, i.e. exactly what the round-13 Grafana
+`histogram_quantile(0.99, ...)` queries would render. The tail-dumps panel
+counts the samples that breach the tail watchdog's `4 x rolling p99` rule
+on the same windows.
+
 Styling follows a fixed mark spec: 2px round-capped lines, hairline solid
 gridlines one step off the surface, text in ink tokens (never series colors),
 legend for every multi-series panel, sparing direct end-labels. Series hues
@@ -17,8 +26,14 @@ from __future__ import annotations
 
 import math
 import os
+import random
+import sys
 
-W, H = 1180, 1510
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from escalator_tpu.observability.histograms import LogHistogram  # noqa: E402
+
+W, H = 1180, 1800
 PANEL_W, PANEL_H = 560, 270
 PAD = 20
 PLOT_L, PLOT_T, PLOT_R, PLOT_B = 46, 34, 10, 52
@@ -39,7 +54,7 @@ def cycle():
     s = {k: [] for k in (
         "nodes", "untainted", "tainted", "cordoned", "cpu", "mem", "delta",
         "pods", "evicted", "target", "actual", "maxsize", "lock", "lockrate",
-        "lag", "decide", "pack", "run_a", "run_b", "pend_a",
+        "lag", "run_a", "run_b", "pend_a",
         "ph_run", "ph_pend", "ph_succ", "ph_fail")}
     nodes, tainted = 14.0, 2.0
     for i in range(T):
@@ -76,8 +91,6 @@ def cycle():
         s["lock"].append(locked)
         s["lockrate"].append(0.2 + 1.4 * locked)
         s["lag"].append(95 + 40 * burst + 8 * math.sin(i * 1.3))
-        s["decide"].append(0.0016 + 0.0006 * burst)
-        s["pack"].append(0.0031 + 0.0009 * burst)
         s["run_a"].append(30 + 180 * burst)
         s["run_b"].append(25 + 20 * math.sin(i * 0.6) ** 2)
         s["pend_a"].append(max(0.0, 90 * burst - 20))
@@ -86,6 +99,68 @@ def cycle():
         s["ph_succ"].append(8 + 0.9 * i)
         s["ph_fail"].append(2 + 0.03 * i)
     return s
+
+
+def _burst(i):
+    """The demand wave cycle() uses, shared so the latency windows see the
+    same load shape the rest of the dashboard plots."""
+    x = i / (T - 1)
+    return math.exp(-((x - 0.35) / 0.16) ** 2)
+
+
+#: (median_s, lognormal sigma, burst gain) per latency series — medians echo
+#: the committed cfg16/cfg6 recorder columns so the preview's magnitudes
+#: match what a real deployment scrapes
+_LATENCY_SPEC = {
+    "decide": (1.6e-3, 0.18, 2.2),
+    "pack": (3.1e-3, 0.12, 1.4),
+    "event_drain": (2.6e-4, 0.25, 1.2),
+    "scatter": (1.3e-3, 0.20, 1.3),
+    "delta_decide": (9.2e-3, 0.22, 1.9),
+    "e2e": (2.1e-2, 0.20, 1.8),
+}
+
+
+def latency_cycle(ticks_per_window=30, window=3):
+    """Per-window p99 series THROUGH THE REAL HISTOGRAM ENGINE: for every
+    scrape window, per-tick latency samples (lognormal around the spec
+    medians, burst-scaled, with occasional 8-20x outlier ticks standing in
+    for recompiles/GC) are recorded into a LogHistogram; the plotted value
+    is the p99 of the last ``window`` windows' merged histograms — i.e.
+    what `histogram_quantile(0.99, rate(..._bucket[15m]))` renders. Also
+    returns the tail-dump count series: samples breaching the tail
+    watchdog's `4 x rolling p99` rule, at most one dump per window (the
+    rate limiter)."""
+    rnd = random.Random(13)
+    p99 = {k: [] for k in _LATENCY_SPEC}
+    dumps = []
+    hists = {k: [] for k in _LATENCY_SPEC}
+    for i in range(T):
+        b = _burst(i)
+        for k, (med, sig, gain) in _LATENCY_SPEC.items():
+            mu = math.log(med * (1.0 + (gain - 1.0) * b))
+            h = LogHistogram()
+            window_samples = []
+            for _ in range(ticks_per_window):
+                v = rnd.lognormvariate(mu, sig)
+                if rnd.random() < 0.03:   # a recompile/GC outlier tick
+                    v *= rnd.uniform(8.0, 20.0)
+                h.record(v)
+                window_samples.append(v)
+            hists[k].append(h)
+            merged = LogHistogram()
+            for hh in hists[k][-window:]:
+                merged.merge(hh)
+            p99[k].append(merged.quantile(0.99))
+            if k == "e2e":
+                prior = LogHistogram()
+                for hh in hists[k][-window - 1:-1]:
+                    prior.merge(hh)
+                rolling = prior.quantile(0.99)
+                breach = rolling is not None and any(
+                    v > 4.0 * rolling for v in window_samples)
+                dumps.append(1.0 if breach else 0.0)
+    return p99, dumps
 
 
 def nice_ticks(lo, hi, n=4):
@@ -196,6 +271,7 @@ def timeseries_panel(x, y, title, series, unit="", labels=()):
 
 def main():
     s = cycle()
+    p99, tail_dumps = latency_cycle()
     panels, grid = [], [
         ("Node counts by state",
          [(s["nodes"], S1, "total"), (s["untainted"], S2, "untainted"),
@@ -215,7 +291,8 @@ def main():
          "", ()),
         ("Node registration lag (p90)", [(s["lag"], S1, "p90")], "s", ()),
         ("Solver latency (p99)",
-         [(s["decide"], S1, "decide"), (s["pack"], S2, "pack")], "s", ()),
+         [(p99["decide"], S1, "decide"), (p99["pack"], S2, "pack")],
+         "s", ()),
         ("Running Pods (by namespace)",
          [(s["run_a"], S1, "buildeng running"), (s["run_b"], S2,
            "shared running"), (s["pend_a"], S3, "buildeng pending")], "", ()),
@@ -223,6 +300,16 @@ def main():
          [(s["ph_run"], S1, "Running"), (s["ph_pend"], S2, "Pending"),
           (s["ph_succ"], S3, "Succeeded"), (s["ph_fail"], S4, "Failed")],
          "", (0,)),
+        # round 13: the two tail panels the Grafana board gained — phase
+        # p99s and the e2e-tick p99 + tail-dump rate, all through the real
+        # log-bucket engine (see latency_cycle)
+        ("Tick phase latency (p99)",
+         [(p99["event_drain"], S1, "event_drain"),
+          (p99["scatter"], S2, "scatter"),
+          (p99["delta_decide"], S3, "delta_decide")], "s", ()),
+        ("Tail: e2e p99 / tail dumps",
+         [(p99["e2e"], S1, "e2e tick p99 (s)"),
+          (tail_dumps, S2, "tail dumps (window)")], "", ()),
     ]
     for i, (title, series, unit, labels) in enumerate(grid):
         x = PAD + (i % 2) * (PANEL_W + PAD)
