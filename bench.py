@@ -64,6 +64,12 @@ Configs:
               from the flight recorder, and the recorded-workload replay
               row (the noise-immune before/after; also standalone via
               ``--recorded <dump> <snap>``)
+  cfg17       FLEET decision service (round-14 tentpole): C=1k tenants
+              (~100 pods each) through the continuous-batching scheduler —
+              decisions/sec, per-tenant p50/p99 request latency, mean
+              micro-batch size, per-tick 13-column bit-parity for EVERY
+              tenant vs its standalone decide, and the one-dispatch-per-
+              micro-batch proof from flight-recorder phase counts
 
 Tail truth (round 13): every recorder-sourced per-phase column is a
 p50/p99/p999/min dict (``_recorder_phase_stats``), e2e churn rows carry
@@ -1255,6 +1261,163 @@ def _cfg16_streaming(rng, now, device, detail: dict, degraded: bool) -> None:
         detail[f"cfg16_streaming_tick_{label}_1pct_p99_ms"] = (
             row["e2e_tick_p99_ms"])
         del inc, cache, store, pods_v, nodes_v, host_cluster
+
+
+def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
+    """cfg17 (round-14 tentpole): the FLEET decision service at C=1k
+    tenants (~100 pods each, 4 groups, 20 nodes) through the real
+    continuous-batching scheduler. Reports decisions/sec and per-tenant
+    p50/p99 request latency (enqueue -> result, the service's SLO number),
+    asserts per-tick 13-column BIT-PARITY for EVERY tenant against its
+    standalone ``decide_jit``, and proves the one-dispatch-per-micro-batch
+    claim from flight-recorder phase counts (each ``fleet_batch`` record
+    carries exactly one ``fleet_step`` device phase, and the batch sizes
+    sum to the decisions served)."""
+    import threading
+
+    from escalator_tpu.fleet import DecideRequest, FleetEngine, FleetScheduler
+    from escalator_tpu.observability import RECORDER
+    from escalator_tpu.ops import kernel as _k
+    import jax
+
+    C, Gt, Pt, Nt = 1000, 4, 100, 20
+    ticks = 3
+    engine = FleetEngine(num_groups=Gt, pod_capacity=128, node_capacity=32,
+                         max_tenants=C)
+    sched = FleetScheduler(engine, max_batch=128, flush_ms=5.0,
+                           queue_limit=4 * C, per_tenant_inflight=2)
+    try:
+        # a mostly-HEALTHY fleet: steady tenants have scale-down disabled
+        # (taint thresholds 0 — utilization sits between the thresholds, so
+        # decisions are 0/positive deltas and the light one-dispatch path
+        # serves them), while 2% are DRAINING (tainted nodes + live
+        # scale-down thresholds) and pay the per-tenant ordered follow-up —
+        # the production shape: drains are rare, batches stay one dispatch
+        bases = []
+        for t in range(C):
+            draining = t % 50 == 0
+            c = _rng_cluster_arrays(
+                np.random.default_rng(1000 + t), Gt, Pt, Nt,
+                tainted_frac=0.3 if draining else 0.0)
+            if not draining:
+                c.groups.taint_lower[:] = 0
+                c.groups.taint_upper[:] = 0
+            bases.append(c)
+
+        def fresh(t, tick):
+            b = bases[t]
+            copy = lambda soa: type(soa)(  # noqa: E731
+                **{f: np.array(getattr(soa, f))
+                   for f in soa.__dataclass_fields__})
+            c = type(b)(groups=copy(b.groups), pods=copy(b.pods),
+                        nodes=copy(b.nodes))
+            if tick:
+                # ~1% churn per tenant per tick
+                c.pods.cpu_milli[(tick * 7) % Pt] += 10 * tick
+            return c
+
+        def run_tick(tick, timed: bool):
+            nowi = int(now) + 60 * tick
+            clusters = [fresh(t, tick) for t in range(C)]
+            lat = [None] * C
+            done = threading.Event()
+            remaining = [C]
+            lock = threading.Lock()
+            t0 = time.perf_counter()
+
+            def make_cb(t, t_sub):
+                def cb(_fut):
+                    lat[t] = time.perf_counter() - t_sub
+                    with lock:
+                        remaining[0] -= 1
+                        if not remaining[0]:
+                            done.set()
+                return cb
+
+            # enqueue the whole tick against a paused worker, then resume:
+            # the saturated steady state — full micro-batches, determinis-
+            # tic batch count (ceil(C / max_batch)), latencies including
+            # real queue wait
+            sched.pause()
+            futs = []
+            for t in range(C):
+                t_sub = time.perf_counter()
+                f = sched.submit(f"tenant{t}", clusters[t], nowi)
+                f.add_done_callback(make_cb(t, t_sub))
+                futs.append(f)
+            sched.resume()
+            assert done.wait(timeout=600), "fleet tick did not complete"
+            wall = time.perf_counter() - t0
+            results = [f.result() for f in futs]
+            if timed:
+                # bit-parity for EVERY tenant, this tick
+                for t in range(C):
+                    ref = _k.decide_jit(jax.device_put(clusters[t]),
+                                        np.int64(nowi))
+                    for fld in _k.GROUP_DECISION_FIELDS:
+                        got = np.asarray(getattr(results[t].arrays, fld))
+                        want = np.asarray(getattr(ref, fld))
+                        assert np.array_equal(got, want), (
+                            f"cfg17 parity: tick {tick} tenant {t} {fld}")
+            return wall, lat, results
+
+        # two warm ticks: the bootstrap (full-lane delta buckets) and one
+        # churn tick (the steady 64-lane buckets) — the timed window must
+        # measure the steady state, not either shape's one-time compile
+        run_tick(0, timed=False)
+        run_tick(1, timed=False)
+        walls, lats, batch_sizes = [], [], []
+        served = 0
+        timed_recs = []
+        last_seq = RECORDER.total_recorded
+        for tick in range(2, ticks + 2):
+            wall, lat, results = run_tick(tick, timed=True)
+            walls.append(wall)
+            lats.extend(lat)
+            batch_sizes.extend(r.batch_size for r in results)
+            served += len(results)
+            # harvest this tick's batch records NOW: the 256-record ring
+            # can evict a whole tick's worth across the full timed window
+            timed_recs.extend(
+                r for r in RECORDER.snapshot()
+                if r["root"] == "fleet_batch"
+                and r.get("seq", 0) > last_seq)
+            last_seq = RECORDER.total_recorded
+        # one-dispatch proof: every fleet_batch record in the timed window
+        # carries exactly ONE fleet_step device phase
+        steps_per_batch = [
+            sum(1 for p in r["phases"] if p["name"] == "fleet_step")
+            for r in timed_recs]
+        assert steps_per_batch and all(s == 1 for s in steps_per_batch), (
+            f"cfg17: fleet_step phases per batch {set(steps_per_batch)}")
+        assert sum(r.get("batch_size", 0) for r in timed_recs) == served, (
+            "cfg17: batch sizes do not sum to the decisions served")
+        lat_ms = np.array(lats) * 1e3
+        fleet_row = {
+            "tenants": C,
+            "pods_per_tenant": Pt,
+            "ticks": ticks,
+            "decisions_per_sec": round(served / sum(walls), 1),
+            "tick_wall_ms": round(float(np.median(walls)) * 1e3, 3),
+            "per_tenant_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "per_tenant_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            "mean_batch_size": round(float(np.mean(batch_sizes)), 1),
+            "batches_observed": len(timed_recs),
+            "one_dispatch_per_batch": True,
+            "parity": "ok",
+            # timed records only: the ring also holds the warm ticks, whose
+            # fleet_step phases carry the one-time compiles
+            "fleet_step_ms": _phase_stats_from_records(timed_recs).get(
+                "fleet_step"),
+            "ordered_redispatches": engine.ordered_redispatches,
+        }
+        detail["cfg17_fleet"] = fleet_row
+        detail["cfg17_fleet_decisions_per_sec"] = (
+            fleet_row["decisions_per_sec"])
+        detail["cfg17_fleet_per_tenant_p99_ms"] = (
+            fleet_row["per_tenant_p99_ms"])
+    finally:
+        sched.shutdown()
 
 
 def _background_audit_row(store, cache, inc, now, P, G, cpu_m,
@@ -2701,6 +2864,151 @@ def run_smoke() -> dict:
         out["tail_smoke_report"] = "(write failed)"
     shutil.rmtree(tail_dir, ignore_errors=True)
 
+    # ---- fleet smoke (round 14): C=8 tenants through the REAL gRPC fleet
+    # server — coalescing observed, per-tenant 13-column digests equal the
+    # single-cluster decide, and the backpressure path fires under a
+    # flooded queue (RESOURCE_EXHAUSTED + retry-after trailer). Written to
+    # FLEET_SMOKE_LATEST.json for CI upload.
+    import threading as _threading
+
+    from escalator_tpu.analysis.registry import representative_cluster
+    from escalator_tpu.observability.replay import decision_digest
+    from escalator_tpu.ops import kernel as _fk
+
+    fleet_report: dict = {"smoke": True}
+    try:
+        import grpc as _grpc
+
+        from escalator_tpu.plugin.client import ComputeClient as _FC
+        from escalator_tpu.plugin.server import FleetConfig, make_server
+        fleet_mode = "grpc"
+    except ImportError as e:   # pragma: no cover - CI installs grpcio
+        fleet_mode = f"skipped (grpc unavailable: {e.name})"
+    if fleet_mode == "grpc":
+        Gf, Pf, Nf = 6, 24, 12
+        fsrv = make_server("127.0.0.1:0", max_workers=16, fleet=FleetConfig(
+            num_groups=Gf, pod_capacity=Pf, node_capacity=Nf, max_tenants=8,
+            max_batch=8, flush_ms=10.0, queue_limit=64,
+            per_tenant_inflight=1))
+        fsrv.start()
+        fclient = _FC(f"127.0.0.1:{fsrv._escalator_bound_port}",
+                      timeout_sec=300.0)
+        try:
+            # warm the fleet-step jit so the concurrent burst below measures
+            # batching, not the first compile
+            fclient.decide_arrays_fleet(
+                representative_cluster(Gf, Pf, Nf, seed=899), int(now),
+                "warmup")
+            tenants = {f"ft{i}": representative_cluster(Gf, Pf, Nf,
+                                                        seed=900 + i)
+                       for i in range(8)}
+            fres: dict = {}
+            flock = _threading.Lock()
+
+            def _one(tid, c):
+                o, _p, meta = fclient.decide_arrays_fleet(c, int(now), tid)
+                with flock:
+                    fres[tid] = (o, meta)
+
+            # deterministic coalescing: all eight tenants enqueue against a
+            # paused worker, then one resume serves them as ONE micro-batch
+            fsched0 = fsrv._escalator_service.fleet
+            fsched0.pause()
+            fthreads = [_threading.Thread(target=_one, args=kv)
+                        for kv in tenants.items()]
+            for t in fthreads:
+                t.start()
+            deadline = time.monotonic() + 30
+            while (fsched0.queue_depth < len(tenants)
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            fsched0.resume()
+            for t in fthreads:
+                t.join()
+            batch_sizes = sorted(meta["batch_size"]
+                                 for _o, meta in fres.values())
+            # per-tenant digest parity: each fleet response's decision
+            # digest equals the tenant's standalone single-cluster decide
+            for tid, c in tenants.items():
+                o, _meta = fres[tid]
+                ref = _fk.decide_jit(jax.device_put(c), np.int64(int(now)))
+                assert decision_digest(o) == decision_digest(ref), (
+                    f"fleet smoke digest diverged for {tid}")
+                for fld in _fk.GROUP_DECISION_FIELDS:
+                    np.testing.assert_array_equal(
+                        np.asarray(getattr(o, fld)),
+                        np.asarray(getattr(ref, fld)),
+                        err_msg=f"fleet smoke {tid}: {fld}")
+            # the scheduler actually coalesced concurrent tenants
+            assert batch_sizes[-1] >= 2, batch_sizes
+            fleet_report["tenants"] = len(tenants)
+            fleet_report["batch_sizes"] = batch_sizes
+            out["smoke_fleet_parity"] = "ok"
+            out["smoke_fleet_max_batch"] = batch_sizes[-1]
+
+            # backpressure: flood a PAUSED worker past a queue bound of 4 —
+            # the overflow rejects with RESOURCE_EXHAUSTED + retry-after
+            # trailer, the rest serve after resume
+            fsched = fsrv._escalator_service.fleet
+            fsched.queue_limit = 4
+            fsched.pause()
+            flood_out: list = []
+
+            def _flood(i):
+                try:
+                    fclient.decide_arrays_fleet(
+                        representative_cluster(Gf, Pf, Nf, seed=950 + i),
+                        int(now), f"flood{i}", max_attempts=1)
+                    with flock:
+                        flood_out.append("ok")
+                except _grpc.RpcError as e:
+                    md = dict(e.trailing_metadata() or ())
+                    with flock:
+                        flood_out.append((
+                            e.code().name,
+                            md.get("escalator-retry-after-ms")))
+
+            flood_threads = [_threading.Thread(target=_flood, args=(i,))
+                             for i in range(6)]
+            for t in flood_threads:
+                t.start()
+            time.sleep(1.0)
+            fsched.resume()
+            for t in flood_threads:
+                t.join()
+            rejected = [o for o in flood_out if o != "ok"]
+            assert flood_out.count("ok") == 4 and len(rejected) == 2, (
+                flood_out)
+            for code, retry_after in rejected:
+                assert code == "RESOURCE_EXHAUSTED" and retry_after, (
+                    flood_out)
+            fleet_report["backpressure"] = {
+                "served": flood_out.count("ok"),
+                "rejected": len(rejected),
+                "retry_after_ms": [float(r[1]) for r in rejected],
+            }
+            out["smoke_fleet_backpressure"] = "ok"
+            fh = fclient.health()
+            fleet_report["health_fleet"] = fh["fleet"]
+            assert fh["fleet"]["rejected_total"] >= 2
+        finally:
+            fclient.close()
+            fsrv.stop(grace=None)
+    fleet_report["mode"] = fleet_mode
+    out["smoke_fleet_mode"] = fleet_mode
+    fleet_artifact = os.environ.get(
+        "ESCALATOR_TPU_FLEET_SMOKE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "FLEET_SMOKE_LATEST.json"),
+    )
+    try:
+        with open(fleet_artifact, "w") as f:
+            json.dump(fleet_report, f, indent=1)
+            f.write("\n")
+        out["fleet_smoke_report"] = fleet_artifact
+    except OSError:   # read-only checkout: the in-memory asserts still ran
+        out["fleet_smoke_report"] = "(write failed)"
+
     # dump the ring alongside the smoke JSON: CI uploads it as an artifact
     # next to the jaxlint report, so every PR run carries an inspectable
     # flight record of the smoke ticks
@@ -2908,6 +3216,16 @@ def main() -> None:
         _cfg16_streaming(rng, now, device, detail, degraded)
     except Exception as e:  # pragma: no cover
         detail["cfg16_error"] = str(e)
+    _flush_partial(detail, device, degraded)
+
+    # 17. fleet decision service (round-14 tentpole): C=1k tenants through
+    # the continuous-batching scheduler — decisions/sec + per-tenant p99,
+    # 13-column bit-parity for every tenant every tick, and the
+    # one-dispatch-per-micro-batch proof from recorder phase counts
+    try:
+        _cfg17_fleet(rng, now, device, detail, degraded)
+    except Exception as e:  # pragma: no cover
+        detail["cfg17_error"] = str(e)
     _flush_partial(detail, device, degraded)
 
     # device memory: stats probe + computed envelope, after the biggest

@@ -243,6 +243,95 @@ def _probe_kernel_retraces() -> int:
     return kernel._decide_jit_raw._cache_size() - before
 
 
+def _fleet_stacked_cluster(C: int, seed: int = 0) -> ClusterArrays:
+    """[C, ...]-stacked tenants at the representative single-cluster shapes
+    (each leaf gains a leading cluster axis — the fleet kernel layout)."""
+    shards = [representative_cluster(seed=seed + c) for c in range(C)]
+    leaves = [c.tree_flatten()[0] for c in shards]
+    stacked = [np.stack(parts) for parts in zip(*leaves, strict=True)]
+    return ClusterArrays.tree_unflatten(None, stacked)
+
+
+_FLEET_C = 3
+
+
+def _build_fleet_decide() -> TracedEntry:
+    from escalator_tpu.ops import kernel
+
+    cluster = _fleet_stacked_cluster(_FLEET_C)
+    nows = np.full(_FLEET_C, NOW, np.int64)
+    return TracedEntry(fn=kernel.fleet_decide, args=(cluster, nows),
+                       jitted=kernel._fleet_decide_jit_raw)
+
+
+def _probe_fleet_decide_retraces() -> int:
+    """Two fleet batches, same stacked shapes, different tenant contents:
+    exactly one compile — batch content is never a cache key."""
+    from escalator_tpu.ops import kernel
+
+    before = kernel._fleet_decide_jit_raw._cache_size()
+    nows = np.full(_FLEET_C, NOW, np.int64)
+    for seed in (61, 62):
+        jax.block_until_ready(kernel._fleet_decide_jit_raw(
+            _fleet_stacked_cluster(_FLEET_C, seed=seed), nows))
+    return kernel._fleet_decide_jit_raw._cache_size() - before
+
+
+def _fleet_step_args(seed: int = 27, row: int = 0):
+    """Concrete fleet-step operands at tiny arena buckets, built with the
+    SAME helpers the engine's dispatch uses (zero_state, _gather_padded,
+    fleet_dirty_indices): one real tenant (a full-lane bootstrap batch)
+    plus one scratch-row pad entry."""
+    from escalator_tpu.fleet import service as fsvc
+    from escalator_tpu.ops import device_state as ds
+    from escalator_tpu.ops import kernel
+
+    C, G, P, N = 2, GROUPS, 24, 12
+    state = fsvc.zero_state(C, G, P, N)
+    cluster = representative_cluster(G, P, N, seed=seed)
+    B_pod = fsvc.delta_bucket(P)
+    B_node = fsvc.delta_bucket(N)
+    pi, pv = ds._gather_padded(cluster.pods, np.arange(P, dtype=np.int64),
+                               B_pod, P, ds._POD_PAD)
+    ni, nv = ds._gather_padded(cluster.nodes, np.arange(N, dtype=np.int64),
+                               B_node, N, ds._NODE_PAD)
+    pi0, pv0 = ds._gather_padded(fsvc._empty_pods(0), np.zeros(0, np.int64),
+                                 B_pod, P, ds._POD_PAD)
+    ni0, nv0 = ds._gather_padded(fsvc._empty_nodes(0), np.zeros(0, np.int64),
+                                 B_node, N, ds._NODE_PAD)
+    stack = lambda soas: type(soas[0])(  # noqa: E731
+        **{f.name: np.stack([getattr(s, f.name) for s in soas])
+           for f in dataclasses.fields(soas[0])})
+    rows = np.array([row, C], np.int32)
+    dirty = kernel.fleet_dirty_indices(
+        [np.ones(G, bool), np.zeros(G, bool)], G)
+    nows = np.array([NOW, 0], np.int64)
+    return (*state, rows, stack([cluster.groups, fsvc._empty_groups(G)]),
+            np.stack([pi, pi0]), stack([pv, pv0]),
+            np.stack([ni, ni0]), stack([nv, nv0]), dirty, nows)
+
+
+def _build_fleet_step() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    args = _fleet_step_args()
+    return TracedEntry(fn=ds._fleet_step_core, args=args,
+                       jitted=ds._fleet_step)
+
+
+def _probe_fleet_step_retraces() -> int:
+    """Two micro-batches at the SAME bucket shapes but different tenant
+    rows and contents (a tenant add/remove between batches changes row
+    indices, never a shape): exactly one compile."""
+    from escalator_tpu.ops import device_state as ds
+
+    before = ds._fleet_step._cache_size()
+    for seed, row in ((71, 0), (72, 1)):
+        state_out, out = ds._fleet_step(*_fleet_step_args(seed=seed, row=row))
+        jax.block_until_ready(out)
+    return ds._fleet_step._cache_size() - before
+
+
 def _build_mesh_decider() -> TracedEntry:
     from escalator_tpu.parallel import mesh as pmesh
 
@@ -985,6 +1074,30 @@ def default_registry() -> List[KernelEntry]:
             output_select=lambda out: out[1],
             collective_budget=0,
             donate_expected=True,
+        ),
+        e(
+            name="kernel.fleet_decide",
+            module="escalator_tpu.ops.kernel",
+            kind="jit",
+            build=_build_fleet_decide,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            collective_budget=0,   # tenants are independent by construction
+            retrace_budget=1,      # batch content is never a cache key
+            retrace_probe=_probe_fleet_decide_retraces,
+        ),
+        e(
+            name="device_state.fleet_step",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_fleet_step,
+            global_axes={"pods": 24, "nodes": 12},
+            output_dtypes=DECISION_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,
+            donate_expected=True,  # R5: the five fleet arenas replace in place
+            retrace_budget=1,      # tenant add/remove moves row indices only
+            retrace_probe=_probe_fleet_step_retraces,
         ),
         e(
             name="kernel.delta_decide",

@@ -1,0 +1,40 @@
+"""Fleet-scale decision service (round 14): multi-tenant continuous batching.
+
+One device program decides for an entire fleet of tenants per dispatch:
+
+- :mod:`escalator_tpu.fleet.service` — :class:`FleetEngine`, the device-side
+  arena owner: C-stacked resident cluster rows + per-tenant
+  ``GroupAggregates`` arenas, host twins for the per-tenant diff, tenant
+  lifecycle (register / evict / arena grow / compact), and the fused
+  per-micro-batch scatter + delta-decide dispatch
+  (``ops.device_state._fleet_step``).
+- :mod:`escalator_tpu.fleet.scheduler` — :class:`FleetScheduler`, the
+  continuous-batching front: request coalescing into tick-aligned
+  micro-batches (size- or deadline-triggered flush), a bounded admission
+  queue with backpressure, per-tenant in-flight caps, oldest-first
+  fairness, and per-tenant latency series feeding the tail layer.
+
+The gRPC integration lives in ``plugin/server.py`` (``make_server(fleet=…)``)
+and ``plugin/codec.py`` (the ``__tenant__`` frame sidecar). See
+docs/fleet.md for the operator view.
+"""
+
+from escalator_tpu.fleet.scheduler import (
+    AdmissionError,
+    FleetScheduler,
+)
+from escalator_tpu.fleet.service import (
+    DecideRequest,
+    EvictAck,
+    EvictRequest,
+    FleetDecision,
+    FleetEngine,
+    TenantError,
+    validate_tenant_id,
+)
+
+__all__ = [
+    "AdmissionError", "DecideRequest", "EvictAck", "EvictRequest",
+    "FleetDecision", "FleetEngine", "FleetScheduler", "TenantError",
+    "validate_tenant_id",
+]

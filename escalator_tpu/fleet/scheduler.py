@@ -1,0 +1,245 @@
+"""FleetScheduler: continuous batching in front of the FleetEngine.
+
+The plugin's fleet mode serves many tenants from one device; the scheduler
+is the admission-and-coalescing layer between their concurrent RPCs and the
+engine's one-dispatch-per-micro-batch step:
+
+- **Coalescing**: requests queue and flush as a micro-batch when either the
+  batch-size trigger (``max_batch`` waiting) or the deadline trigger (the
+  oldest request has waited ``flush_ms``) fires — tick-aligned batching
+  without penalizing a lone tenant more than one flush interval.
+- **Admission / backpressure**: the queue is bounded (``queue_limit``); an
+  overflowing submit raises :class:`AdmissionError` with a retry-after
+  estimate, which the gRPC edge maps to RESOURCE_EXHAUSTED + a
+  ``escalator-retry-after-ms`` trailer the client's RetryPolicy honors.
+- **Fairness under overload**: per-tenant in-flight caps
+  (``per_tenant_inflight``) stop one chatty tenant from occupying the whole
+  queue, and batch assembly walks the queue oldest-first, taking at most
+  one request per tenant per batch (a tenant's second request rides the
+  NEXT batch — the engine's arenas require it, and it keeps head-of-line
+  age bounded for everyone else).
+- **Per-tenant attribution**: every served request records its
+  enqueue-to-completion latency into the streaming histogram layer under a
+  tenant-labeled root (``fleet/<tenant>`` in
+  ``escalator_tpu_tick_e2e_seconds``), so per-tenant p99s ride the same
+  PR-8 tail machinery as tick latencies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, Union
+
+from escalator_tpu import observability as obs
+from escalator_tpu.fleet.service import (
+    DecideRequest,
+    EvictRequest,
+    FleetEngine,
+    TenantError,
+    validate_tenant_id,
+)
+from escalator_tpu.metrics import metrics
+
+
+class AdmissionError(Exception):
+    """A request the scheduler refused at the door. ``reason`` is the
+    metrics label (queue-full / tenant-inflight); ``retry_after_ms`` is the
+    backoff hint shipped to the client as a gRPC trailer."""
+
+    def __init__(self, reason: str, retry_after_ms: float):
+        super().__init__(
+            f"fleet admission rejected ({reason}); retry after "
+            f"{retry_after_ms:.0f} ms")
+        self.reason = reason
+        self.retry_after_ms = float(retry_after_ms)
+
+
+@dataclass
+class _Pending:
+    request: Union[DecideRequest, EvictRequest]
+    future: Future
+    enqueued: float = field(default_factory=time.monotonic)
+
+
+class FleetScheduler:
+    """Admission queue + micro-batch worker over one :class:`FleetEngine`.
+
+    ``submit``/``evict`` are thread-safe (the gRPC pool calls them
+    concurrently); one daemon worker owns the engine."""
+
+    def __init__(self, engine: FleetEngine, max_batch: int = 32,
+                 flush_ms: float = 2.0, queue_limit: int = 256,
+                 per_tenant_inflight: int = 2):
+        self.engine = engine
+        self.max_batch = int(max_batch)
+        self.flush_sec = float(flush_ms) / 1e3
+        self.queue_limit = int(queue_limit)
+        self.per_tenant_inflight = int(per_tenant_inflight)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._inflight: Dict[str, int] = {}
+        self._paused = False
+        self._closed = False
+        self.admitted_total = 0
+        self.rejected_total = 0
+        self._worker = threading.Thread(
+            target=self._run, name="escalator-tpu-fleet", daemon=True)
+        self._worker.start()
+
+    # -- admission ------------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._q)
+
+    def oldest_waiting_sec(self) -> float:
+        """Age of the oldest queued request (0.0 when the queue is empty) —
+        the health probe's stale-but-alive signal for the batcher: a live
+        scheduler keeps this under ~one flush interval; a wedged worker
+        shows it growing tick over tick."""
+        with self._cv:
+            if not self._q:
+                return 0.0
+            return time.monotonic() - self._q[0].enqueued
+
+    def _reject(self, reason: str, retry_after_ms: float):
+        self.rejected_total += 1
+        metrics.fleet_admission_rejects.labels(reason).inc()
+        raise AdmissionError(reason, retry_after_ms)
+
+    def submit(self, tenant_id: str, cluster, now_sec: int) -> Future:
+        """Admit one decide. Raises :class:`TenantError` on a malformed
+        tenant id (before anything queues — a bad request never poisons a
+        batch) and :class:`AdmissionError` on backpressure."""
+        validate_tenant_id(tenant_id)
+        return self._admit(DecideRequest(tenant_id, cluster, int(now_sec)))
+
+    def evict(self, tenant_id: str) -> Future:
+        """Admit an eviction (serialized with the decide stream, so a
+        decide admitted before the evict still serves). The unknown-tenant
+        TenantError is NOT counted here — the gRPC edge owns the
+        invalid-tenant metric (counting in both places double-counted one
+        rejected RPC)."""
+        validate_tenant_id(tenant_id)
+        if not self.engine.has_tenant(tenant_id):
+            raise TenantError(f"unknown tenant {tenant_id!r}")
+        return self._admit(EvictRequest(tenant_id))
+
+    def _admit(self, request) -> Future:
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("fleet scheduler is shut down")
+            tid = request.tenant_id
+            # tenant cap BEFORE the queue bound: when both apply, the
+            # precise reason is the tenant's own chattiness, not the queue
+            if self._inflight.get(tid, 0) >= self.per_tenant_inflight:
+                self._reject("tenant-inflight", self.flush_sec * 1e3)
+            if len(self._q) >= self.queue_limit:
+                # retry-after: how long the backlog takes to drain at one
+                # max_batch per flush interval (floor one interval)
+                est = (len(self._q) / max(self.max_batch, 1) + 1.0) * (
+                    self.flush_sec * 1e3)
+                self._reject("queue-full", est)
+            self._inflight[tid] = self._inflight.get(tid, 0) + 1
+            self.admitted_total += 1
+            self._q.append(_Pending(request, fut))
+            self._cv.notify()
+        return fut
+
+    # -- the worker -----------------------------------------------------------
+
+    def pause(self) -> None:
+        """Hold the worker (tests/smoke drive deterministic backpressure by
+        filling the queue against a paused worker)."""
+        with self._cv:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify()
+
+    def _take_batch(self):
+        """Oldest-first batch assembly, at most one request per tenant —
+        skipped requests keep their queue position for the next batch."""
+        batch = []
+        taken_tenants = set()
+        kept = deque()
+        while self._q and len(batch) < self.max_batch:
+            p = self._q.popleft()
+            if p.request.tenant_id in taken_tenants:
+                kept.append(p)
+                continue
+            taken_tenants.add(p.request.tenant_id)
+            batch.append(p)
+        kept.extend(self._q)
+        self._q = kept
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._closed:
+                        return
+                    if self._q and not self._paused:
+                        age = time.monotonic() - self._q[0].enqueued
+                        if (len(self._q) >= self.max_batch
+                                or age >= self.flush_sec):
+                            break
+                        self._cv.wait(timeout=self.flush_sec - age)
+                    else:
+                        self._cv.wait(timeout=0.1)
+                batch = self._take_batch()
+            if batch:
+                self._serve(batch)
+
+    def _serve(self, batch) -> None:
+        metrics.fleet_batch_size.observe(len(batch))
+        try:
+            results = self.engine.step([p.request for p in batch])
+        except BaseException as e:  # noqa: BLE001 - engine failure fails the batch
+            results = [e] * len(batch)
+        done = time.monotonic()
+        with self._cv:
+            for p in batch:
+                tid = p.request.tenant_id
+                left = self._inflight.get(tid, 1) - 1
+                if left > 0:
+                    self._inflight[tid] = left
+                else:
+                    self._inflight.pop(tid, None)
+            self._cv.notify()
+        from escalator_tpu.fleet.service import EvictAck
+
+        for p, res in zip(batch, results, strict=True):
+            if isinstance(res, EvictAck):
+                # retire the tenant's series with its arena slot: per-tenant
+                # cardinality tracks resident tenants, not every id ever seen
+                obs.histograms.TICKS.discard(f"fleet/{p.request.tenant_id}")
+            else:
+                # tenant-labeled root series feeding the PR-8 tail layer:
+                # the request's e2e latency (queue wait + batch service),
+                # one histogram per tenant — exported as
+                # escalator_tpu_tick_e2e_seconds{root="fleet/<tenant>"}
+                obs.histograms.TICKS.observe(
+                    (f"fleet/{p.request.tenant_id}",), done - p.enqueued)
+            if isinstance(res, BaseException):
+                p.future.set_exception(res)
+            else:
+                p.future.set_result(res)
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._closed = True
+            pending = list(self._q)
+            self._q.clear()
+            self._cv.notify_all()
+        for p in pending:
+            p.future.set_exception(RuntimeError("fleet scheduler shut down"))
+        self._worker.join(timeout=5.0)

@@ -1,0 +1,697 @@
+"""FleetEngine: the device-side owner of the multi-tenant decision arenas.
+
+The round-8 incremental decide keeps ONE cluster's state device-resident and
+pays O(dirty) per tick; the fleet engine stacks C independent tenants along a
+leading cluster axis and pays one dispatch per MICRO-BATCH of tenants:
+
+- resident arrays ``pods [C+1, P+1]`` / ``nodes [C+1, N+1]`` /
+  ``groups [C+1, G]`` (row C is a scratch tenant — the row-level analog of
+  the scratch lane; each row keeps its own scratch lane),
+- per-tenant :class:`~escalator_tpu.ops.kernel.GroupAggregates` arenas
+  ``[C+1, G]`` (+ ``node_pods_remaining [C+1, N+1]``) maintained by the same
+  exact integer deltas as the single-tenant path,
+- the 13 persistent decision columns ``[C+1, G]``.
+
+Ragged tenants pack into shared power-of-two ``(G, N, P)`` buckets (the
+``statestore.delta_bucket`` policy generalized to arena shapes) with their
+per-lane ``valid`` masks; a tenant outgrowing a bucket grows the arena
+(rare: buckets double), and :meth:`FleetEngine.compact` repacks live tenants
+into the smallest bucket after mass evictions.
+
+Per micro-batch, ``ops.device_state._fleet_step`` runs scatter + aggregate
+maintenance + per-tenant delta decide as ONE fused program. Host work per
+request is the positional column diff against the tenant's host twin
+(``_changed_slots`` — the IncrementalJaxBackend host-diff, per tenant) plus
+O(G) dirty bookkeeping; the dirty-group set is tracked host-side as a
+SUPERSET of the device semantics (recomputing a clean row reproduces its
+value bit-exactly, so a superset can never break parity — locked by the
+multi-tenant soak in tests/test_fleet.py).
+
+Orders run the lazy protocol PER TENANT: the batch dispatch is the light
+program; a tenant whose decision consumes an order (tainted nodes exist, or
+some group scales down) gets a single-tenant ordered re-dispatch fed its
+maintained aggregates (``device_state._fleet_tenant_state`` +
+``kernel.decide_jit(aggregates=…)``) — steady fleets sort never, drains sort
+per draining tenant.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, fields
+from typing import Dict, List, Sequence, Tuple, Union
+
+import numpy as np
+
+from escalator_tpu import observability as obs
+from escalator_tpu.core.arrays import (
+    NO_TAINT_TIME,
+    ClusterArrays,
+    GroupArrays,
+    NodeArrays,
+    PodArrays,
+)
+from escalator_tpu.metrics import metrics
+from escalator_tpu.native.statestore import delta_bucket
+
+log = logging.getLogger("escalator_tpu.fleet")
+
+#: Tenant-id wire contract: a non-empty printable string, bounded so a
+#: hostile frame cannot balloon the slot map key space per request.
+MAX_TENANT_ID_LEN = 128
+
+
+class TenantError(ValueError):
+    """A per-tenant request the fleet cannot serve (malformed/unknown tenant
+    id, bucket caps exceeded). Maps to INVALID_ARGUMENT at the gRPC edge —
+    and never poisons the batch it would have ridden in."""
+
+
+def validate_tenant_id(tenant_id) -> str:
+    """The ONE tenant-id validation both the gRPC edge and the engine run:
+    a non-empty printable str of at most MAX_TENANT_ID_LEN chars."""
+    if not isinstance(tenant_id, str):
+        raise TenantError(f"tenant id must be a string, got "
+                          f"{type(tenant_id).__name__}")
+    if not tenant_id or len(tenant_id) > MAX_TENANT_ID_LEN:
+        raise TenantError(
+            f"tenant id must be 1..{MAX_TENANT_ID_LEN} chars, got "
+            f"{len(tenant_id)}")
+    if not tenant_id.isprintable():
+        raise TenantError("tenant id must be printable")
+    return tenant_id
+
+
+@dataclass
+class DecideRequest:
+    """One tenant's decide: a packed cluster (any padding at or under the
+    arena caps) + the timestamp the decision evaluates at."""
+
+    tenant_id: str
+    cluster: ClusterArrays
+    now_sec: int
+
+
+@dataclass
+class EvictRequest:
+    """Deregister a tenant: its lanes clear, its slot frees for reuse."""
+
+    tenant_id: str
+
+
+@dataclass
+class EvictAck:
+    tenant_id: str
+
+
+@dataclass
+class FleetDecision:
+    """One tenant's result, sliced back to ITS request's padded shapes — the
+    13 decision columns are bit-identical to a standalone
+    ``decide_jit``/``delta_decide_jit`` on the same cluster. ``ordered``
+    carries the lazy-orders flag: False means the order fields are
+    input-order placeholders and no window may be read (exactly the
+    single-cluster protocol's contract)."""
+
+    tenant_id: str
+    arrays: object          # kernel.DecisionArrays with numpy leaves
+    ordered: bool
+    batch_size: int
+
+
+def _pow2(n: int, lo: int = 1) -> int:
+    return max(lo, 1 << max(int(n) - 1, 0).bit_length())
+
+
+def _empty_pods(P: int) -> PodArrays:
+    return PodArrays(
+        group=np.zeros(P, np.int32), cpu_milli=np.zeros(P, np.int64),
+        mem_bytes=np.zeros(P, np.int64), node=np.full(P, -1, np.int32),
+        valid=np.zeros(P, bool),
+    )
+
+
+def _empty_nodes(N: int) -> NodeArrays:
+    return NodeArrays(
+        group=np.zeros(N, np.int32), cpu_milli=np.zeros(N, np.int64),
+        mem_bytes=np.zeros(N, np.int64), creation_ns=np.zeros(N, np.int64),
+        tainted=np.zeros(N, bool), cordoned=np.zeros(N, bool),
+        no_delete=np.zeros(N, bool),
+        taint_time_sec=np.full(N, NO_TAINT_TIME, np.int64),
+        valid=np.zeros(N, bool),
+    )
+
+
+def _empty_groups(G: int) -> GroupArrays:
+    # pack_groups' padding conventions exactly (scale_up_thr=1 guards /0)
+    return GroupArrays(
+        min_nodes=np.zeros(G, np.int32), max_nodes=np.zeros(G, np.int32),
+        taint_lower=np.zeros(G, np.int32), taint_upper=np.zeros(G, np.int32),
+        scale_up_thr=np.ones(G, np.int32), slow_rate=np.zeros(G, np.int32),
+        fast_rate=np.zeros(G, np.int32), locked=np.zeros(G, bool),
+        requested_nodes=np.zeros(G, np.int32),
+        cached_cpu_milli=np.zeros(G, np.int64),
+        cached_mem_bytes=np.zeros(G, np.int64),
+        soft_grace_sec=np.zeros(G, np.int64),
+        hard_grace_sec=np.zeros(G, np.int64),
+        emptiest=np.zeros(G, bool), valid=np.zeros(G, bool),
+    )
+
+
+def _repad(src, bucket: int, empty_fn):
+    """A section re-padded into the arena bucket: the client's lanes lead,
+    the tail carries the SAME pad values a fresh twin starts with — so
+    padding lanes never read as changed in the positional diff."""
+    n = int(getattr(src, "valid").shape[0])
+    if n == bucket:
+        return src
+    out = empty_fn(bucket)
+    for f in fields(src):
+        getattr(out, f.name)[:n] = getattr(src, f.name)
+    return out
+
+
+def _changed_rows(old, new) -> np.ndarray:
+    """Row indices where ANY column differs (positional diff, all fields)."""
+    changed = None
+    for f in fields(old):
+        d = np.asarray(getattr(old, f.name)) != np.asarray(getattr(new, f.name))
+        changed = d if changed is None else (changed | d)
+    return np.nonzero(changed)[0].astype(np.int64)
+
+
+#: The persistent-decision-column dtypes, in kernel.GROUP_DECISION_FIELDS
+#: order — the [C+1, G] arena columns must match DecisionArrays bit-for-bit.
+_COL_DTYPES = {
+    "status": np.int32, "nodes_delta": np.int32,
+    "cpu_percent": np.float64, "mem_percent": np.float64,
+    "cpu_request_milli": np.int64, "mem_request_bytes": np.int64,
+    "cpu_capacity_milli": np.int64, "mem_capacity_bytes": np.int64,
+    "num_pods": np.int32, "num_nodes": np.int32,
+    "num_untainted": np.int32, "num_tainted": np.int32,
+    "num_cordoned": np.int32,
+}
+
+
+def zero_state(C: int, G: int, P: int, N: int):
+    """Freshly-zeroed host arenas at the given buckets: C+1 tenant rows
+    (row C is the scratch tenant), per-row scratch lane on the pod/node
+    axes. The (pods, nodes, groups, aggs, prev_cols) tuple feeds
+    ``ops.device_state._fleet_step`` directly — the jaxlint registry builds
+    its fleet fixture from this too, so the analyzed program is constructed
+    exactly like production's."""
+    from escalator_tpu.ops import kernel as _kernel
+
+    stack = lambda soa: type(soa)(  # noqa: E731
+        **{f.name: np.broadcast_to(
+            getattr(soa, f.name), (C + 1,) + getattr(soa, f.name).shape
+        ).copy() for f in fields(soa)})
+    pods = stack(_empty_pods(P + 1))
+    nodes = stack(_empty_nodes(N + 1))
+    groups = stack(_empty_groups(G))
+    aggs = _kernel.GroupAggregates(
+        cpu_req=np.zeros((C + 1, G), np.int64),
+        mem_req=np.zeros((C + 1, G), np.int64),
+        num_pods=np.zeros((C + 1, G), np.int64),
+        cpu_cap=np.zeros((C + 1, G), np.int64),
+        mem_cap=np.zeros((C + 1, G), np.int64),
+        num_nodes=np.zeros((C + 1, G), np.int64),
+        num_untainted=np.zeros((C + 1, G), np.int64),
+        num_tainted=np.zeros((C + 1, G), np.int64),
+        num_cordoned=np.zeros((C + 1, G), np.int64),
+        node_pods_remaining=np.zeros((C + 1, N + 1), np.int64),
+        dirty=np.zeros((C + 1, G), bool),
+    )
+    prev_cols = tuple(np.zeros((C + 1, G), _COL_DTYPES[n])
+                      for n in _kernel.GROUP_DECISION_FIELDS)
+    return pods, nodes, groups, aggs, prev_cols
+
+
+@dataclass
+class _Tenant:
+    slot: int
+    pods: PodArrays          # host twin at bucket shapes (no scratch lane)
+    nodes: NodeArrays
+    groups: GroupArrays
+    dirty: np.ndarray        # bool [G] — pending dirty groups (host mirror)
+    shapes: Tuple[int, int, int]   # the LAST request's (G, P, N) paddings
+    ticks: int = 0
+
+
+class FleetEngine:
+    """Owns the C-stacked device arenas + host twins for a fleet of tenants.
+
+    NOT internally synchronized for mutation: exactly one caller —
+    normally the :class:`~escalator_tpu.fleet.scheduler.FleetScheduler`
+    worker — may run :meth:`step` / :meth:`compact` at a time (reads like
+    :attr:`tenant_count` are safe from any thread)."""
+
+    def __init__(self, num_groups: int = 8, pod_capacity: int = 128,
+                 node_capacity: int = 64, max_tenants: int = 8,
+                 device=None,
+                 max_group_bucket: int = 1 << 12,
+                 max_pod_bucket: int = 1 << 20,
+                 max_node_bucket: int = 1 << 18,
+                 max_tenant_bucket: int = 1 << 16):
+        from escalator_tpu.jaxconfig import guarded_devices
+
+        self._device = device if device is not None else guarded_devices()[0]
+        self._G = _pow2(num_groups, 4)
+        self._P = _pow2(pod_capacity, 16)
+        self._N = _pow2(node_capacity, 8)
+        self._C = _pow2(max_tenants, 2)
+        self._caps = (max_group_bucket, max_pod_bucket, max_node_bucket,
+                      max_tenant_bucket)
+        self._tenants: Dict[str, _Tenant] = {}
+        self._free: List[int] = list(range(self._C))
+        self._lock = threading.Lock()   # slot map reads vs step mutation
+        self.batches = 0
+        self.decisions = 0
+        self.ordered_redispatches = 0
+        self._init_state()
+
+    # -- arena construction / reshaping --------------------------------------
+
+    def _host_zero_state(self, C: int, G: int, P: int, N: int):
+        return zero_state(C, G, P, N)
+
+    def _init_state(self) -> None:
+        import jax
+
+        from escalator_tpu.ops import device_state as _ds  # noqa: F401
+        # (importing device_state registers the SoA dataclasses as pytrees
+        # — device_put on PodArrays/NodeArrays/GroupArrays needs them)
+        self._state = jax.device_put(
+            self._host_zero_state(self._C, self._G, self._P, self._N),
+            self._device)
+
+    def _pull_state(self):
+        """D2H copy of the arenas (the reshape paths' staging buffers)."""
+        from jax import tree_util
+
+        return tree_util.tree_map(np.asarray, self._state)
+
+    def _grow(self, G2: int, P2: int, N2: int, C2: int) -> None:
+        """Grow the arenas to new buckets: copy the leading real lanes/rows
+        into freshly-zeroed arrays (pad values are position-invariant, so
+        the old scratch lane/rows are reproduced by construction) and
+        re-upload. O(arena) host work — rare by design: buckets double."""
+        import jax
+
+        cap_g, cap_p, cap_n, cap_c = self._caps
+        if G2 > cap_g or P2 > cap_p or N2 > cap_n or C2 > cap_c:
+            raise TenantError(
+                f"fleet arena bucket cap exceeded: need (G={G2}, P={P2}, "
+                f"N={N2}, C={C2}) caps (G={cap_g}, P={cap_p}, N={cap_n}, "
+                f"C={cap_c})")
+        old = self._pull_state()
+        new = self._host_zero_state(C2, G2, P2, N2)
+        C, G, P, N = self._C, self._G, self._P, self._N
+
+        def copy_soa(dst, src, lanes):
+            for f in fields(dst):
+                getattr(dst, f.name)[: C + 1, :lanes] = \
+                    getattr(src, f.name)[:, :lanes]
+
+        pods_o, nodes_o, groups_o, aggs_o, cols_o = old
+        pods_n, nodes_n, groups_n, aggs_n, cols_n = new
+        copy_soa(pods_n, pods_o, P)     # real lanes; scratch lane = pad
+        copy_soa(nodes_n, nodes_o, N)
+        copy_soa(groups_n, groups_o, G)
+        for f in fields(type(aggs_n)):
+            dst, src = getattr(aggs_n, f.name), getattr(aggs_o, f.name)
+            # node_pods_remaining copies its real lanes only (the old
+            # scratch lane holds 0, the new arrays' default); [G] columns
+            # copy whole (G2 >= G)
+            lanes = N if f.name == "node_pods_remaining" else src.shape[1]
+            dst[: C + 1, :lanes] = src[:, :lanes]
+        for dst, src in zip(cols_n, cols_o, strict=True):
+            dst[: C + 1, :G] = src
+        # the scratch tenant row (index C of the OLD stack) carried pad
+        # values only, so landing it at row C of the new stack is harmless;
+        # rows C..C2 start as fresh scratch/empty rows either way.
+        self._state = jax.device_put(new, self._device)
+        if G2 != G:
+            # new group rows exist for every tenant now; their persistent
+            # columns are zeros, not a computed decision — recompute
+            # everything at the next touch (superset-dirty is parity-safe)
+            for t in self._tenants.values():
+                t.dirty = np.ones(G2, bool)
+        for t in self._tenants.values():
+            t.pods = _repad(t.pods, P2, _empty_pods)
+            t.nodes = _repad(t.nodes, N2, _empty_nodes)
+            t.groups = _repad(t.groups, G2, _empty_groups)
+            if len(t.dirty) != G2:
+                d = np.zeros(G2, bool)
+                d[: len(t.dirty)] = t.dirty
+                t.dirty = d
+        if C2 != C:
+            self._free.extend(range(C, C2))
+        self._G, self._P, self._N, self._C = G2, P2, N2, C2
+        log.info("fleet arena grown to G=%d P=%d N=%d C=%d", G2, P2, N2, C2)
+
+    def compact(self) -> dict:
+        """Repack live tenants into the leading slots and shrink the tenant
+        axis to the smallest power-of-two bucket that holds them — the
+        post-mass-eviction memory reclaim. Lane buckets are left alone
+        (shrinking them would force every tenant's twin through a repad for
+        marginal HBM). Returns {tenants, old_c, new_c}."""
+        from jax import tree_util
+
+        import jax
+
+        with self._lock:
+            live = sorted(self._tenants.values(), key=lambda t: t.slot)
+            C2 = _pow2(len(live), 2)
+            old_c = self._C
+            rows = [t.slot for t in live]
+            old = self._pull_state()
+            new = self._host_zero_state(C2, self._G, self._P, self._N)
+
+            def place(dst_tree, src_tree):
+                for f_dst, f_src in zip(
+                        tree_util.tree_leaves(dst_tree),
+                        tree_util.tree_leaves(src_tree), strict=True):
+                    for i, r in enumerate(rows):
+                        f_dst[i] = f_src[r]
+
+            for dst, src in zip(new, old, strict=True):
+                place(dst, src)
+            self._state = jax.device_put(new, self._device)
+            for i, t in enumerate(live):
+                t.slot = i
+            self._free = list(range(len(live), C2))
+            self._C = C2
+        log.info("fleet arena compacted: %d tenants, C %d -> %d",
+                 len(live), old_c, C2)
+        return {"tenants": len(live), "old_c": old_c, "new_c": C2}
+
+    # -- tenant lifecycle ----------------------------------------------------
+
+    @property
+    def tenant_count(self) -> int:
+        return len(self._tenants)
+
+    @property
+    def buckets(self) -> dict:
+        return {"groups": self._G, "pods": self._P, "nodes": self._N,
+                "tenants": self._C}
+
+    def has_tenant(self, tenant_id: str) -> bool:
+        return tenant_id in self._tenants
+
+    def _register(self, tenant_id: str) -> _Tenant:
+        if not self._free:
+            self._grow(self._G, self._P, self._N, self._C * 2)
+        t = _Tenant(
+            slot=self._free.pop(0),
+            pods=_empty_pods(self._P), nodes=_empty_nodes(self._N),
+            groups=_empty_groups(self._G),
+            # bootstrap: EVERY group row computes on the first decide, so
+            # invalid/padding rows carry real NOOP_EMPTY decisions rather
+            # than the arena's zero-initialized columns
+            dirty=np.ones(self._G, bool),
+            shapes=(self._G, self._P, self._N),
+        )
+        self._tenants[tenant_id] = t
+        metrics.fleet_tenant_count.set(len(self._tenants))
+        return t
+
+    def _ensure_buckets(self, cluster: ClusterArrays) -> None:
+        G_c = int(cluster.groups.valid.shape[0])
+        P_c = int(cluster.pods.valid.shape[0])
+        N_c = int(cluster.nodes.valid.shape[0])
+        if G_c > self._G or P_c > self._P or N_c > self._N:
+            self._grow(max(self._G, _pow2(G_c, 4)),
+                       max(self._P, _pow2(P_c, 16)),
+                       max(self._N, _pow2(N_c, 8)), self._C)
+
+    # -- the micro-batch step ------------------------------------------------
+
+    def step(self, requests: Sequence[Union[DecideRequest, EvictRequest]]
+             ) -> List[Union[FleetDecision, EvictAck, Exception]]:
+        """Serve one micro-batch: at most one request per tenant (the
+        scheduler's coalescing guarantees it; direct callers must too).
+        Returns one result per request, position-aligned; a request that
+        fails validation comes back as its exception WITHOUT poisoning the
+        rest of the batch. One ``_fleet_step`` dispatch total, plus one
+        ordered re-dispatch per tenant whose decision consumes an order."""
+        from escalator_tpu.ops import device_state as ds
+        from escalator_tpu.ops import kernel as _kernel
+
+        seen = set()
+        for r in requests:
+            if r.tenant_id in seen:
+                raise ValueError(
+                    f"duplicate tenant {r.tenant_id!r} in one micro-batch")
+            seen.add(r.tenant_id)
+        results: List[Union[FleetDecision, EvictAck, Exception, None]] = (
+            [None] * len(requests))
+        with obs.span("fleet_batch"), self._lock:
+            obs.annotate(backend="fleet", batch_size=len(requests))
+            prepared = []   # (pos, tenant, new sections, now, request)
+            with obs.span("fleet_diff"):
+                # pass 1: grow the lane buckets for EVERY request up front —
+                # a grow mid-batch would invalidate sections staged at the
+                # old shapes (a cap breach rejects that request alone)
+                for pos, r in enumerate(requests):
+                    if isinstance(r, EvictRequest):
+                        continue
+                    try:
+                        self._ensure_buckets(r.cluster)
+                    except TenantError as e:
+                        results[pos] = e
+                for pos, r in enumerate(requests):
+                    if results[pos] is not None:
+                        continue
+                    try:
+                        prepared.append((pos, *self._prepare(r)))
+                    except TenantError as e:
+                        results[pos] = e
+            if prepared:
+                out_host = self._dispatch(prepared, ds, _kernel)
+                with obs.span("fleet_unpack"):
+                    for i, (pos, tenant, new_secs, now, r) in enumerate(
+                            prepared):
+                        results[pos] = self._finish(
+                            i, out_host, tenant, new_secs, now, r,
+                            len(prepared), ds, _kernel)
+            self.batches += 1
+            obs.annotate(
+                tenants=[r.tenant_id for r in requests],
+                fleet_tenants_resident=len(self._tenants))
+        return results   # type: ignore[return-value]
+
+    def _prepare(self, r):
+        """Validate + stage one request: resolve its tenant (registering a
+        new one), re-pad its sections into the arena buckets, and leave the
+        twin/dirty update to the post-dispatch finish."""
+        validate_tenant_id(r.tenant_id)
+        if isinstance(r, EvictRequest):
+            tenant = self._tenants.get(r.tenant_id)
+            if tenant is None:
+                raise TenantError(f"unknown tenant {r.tenant_id!r}")
+            # eviction is a decide against the EMPTY cluster: every valid
+            # lane clears, aggregates fall to zero, the slot frees after
+            new_secs = (_empty_pods(self._P), _empty_nodes(self._N),
+                        _empty_groups(self._G))
+            return tenant, new_secs, 0, r
+        tenant = self._tenants.get(r.tenant_id)
+        if tenant is None:
+            tenant = self._register(r.tenant_id)
+        tenant.shapes = (
+            int(r.cluster.groups.valid.shape[0]),
+            int(r.cluster.pods.valid.shape[0]),
+            int(r.cluster.nodes.valid.shape[0]),
+        )
+        new_secs = (
+            _repad(r.cluster.pods, self._P, _empty_pods),
+            _repad(r.cluster.nodes, self._N, _empty_nodes),
+            _repad(r.cluster.groups, self._G, _empty_groups),
+        )
+        return tenant, new_secs, int(r.now_sec), r
+
+    def _dispatch(self, prepared, ds, _kernel):
+        """Build the batched operands, run the ONE fused device program,
+        adopt the returned arenas, and return the batch outputs as host
+        arrays. Buckets: lane batches pad to the shared
+        ``statestore.delta_bucket`` widths, dirty rows to the shared
+        ``kernel.fleet_dirty_indices`` width, the tenant batch itself to a
+        power of two (pad entries ride the scratch tenant row) — so the jit
+        cache keys on a handful of bucket shapes, never on batch content."""
+        G, P, N, C = self._G, self._P, self._N, self._C
+        diffs = []
+        for _pos, tenant, (new_p, new_n, new_g), now, _r in prepared:
+            pod_slots = _changed_rows(tenant.pods, new_p)
+            node_slots = _changed_rows(tenant.nodes, new_n)
+            # dirty-group bookkeeping (host mirror, superset-safe): groups
+            # any changed lane pointed at — before OR after — plus every
+            # group row that changed
+            touched = tenant.dirty
+            for soa, slots in ((tenant.pods, pod_slots), (new_p, pod_slots),
+                               (tenant.nodes, node_slots),
+                               (new_n, node_slots)):
+                gids = np.asarray(soa.group)[slots]
+                touched[np.clip(gids, 0, G - 1)] = True
+            changed_g = np.zeros(G, bool)
+            changed_g[_changed_rows(tenant.groups, new_g)] = True
+            tenant.dirty = touched | changed_g
+            diffs.append((tenant, pod_slots, node_slots, new_p, new_n, new_g,
+                          now))
+        B_pod = delta_bucket(max(len(d[1]) for d in diffs))
+        B_node = delta_bucket(max(len(d[2]) for d in diffs))
+        T = _pow2(len(diffs))
+        rows = np.full(T, C, np.int32)
+        nows = np.zeros(T, np.int64)
+        pod_idx = np.full((T, B_pod), P, np.int32)
+        node_idx = np.full((T, B_node), N, np.int32)
+        pod_vals = [None] * T
+        node_vals = [None] * T
+        groups_new = [None] * T
+        dirty_masks = []
+        for t, (tenant, ps, ns, new_p, new_n, new_g, now) in enumerate(diffs):
+            rows[t] = tenant.slot
+            nows[t] = now
+            pi, pv = ds._gather_padded(new_p, ps, B_pod, P, ds._POD_PAD)
+            ni, nv = ds._gather_padded(new_n, ns, B_node, N, ds._NODE_PAD)
+            pod_idx[t], node_idx[t] = pi, ni
+            pod_vals[t], node_vals[t] = pv, nv
+            groups_new[t] = new_g
+            dirty_masks.append(tenant.dirty)
+        # pad batch entries: scratch tenant row + no-op batches
+        if len(diffs) < T:
+            _, pv0 = ds._gather_padded(
+                _empty_pods(0), np.zeros(0, np.int64), B_pod, P, ds._POD_PAD)
+            _, nv0 = ds._gather_padded(
+                _empty_nodes(0), np.zeros(0, np.int64), B_node, N,
+                ds._NODE_PAD)
+            for t in range(len(diffs), T):
+                pod_vals[t], node_vals[t] = pv0, nv0
+                groups_new[t] = _empty_groups(G)
+        dirty_masks.extend(
+            [np.zeros(G, bool)] * (T - len(diffs)))
+        dirty_idx = _kernel.fleet_dirty_indices(dirty_masks, G)
+        stack = lambda soas: type(soas[0])(  # noqa: E731
+            **{f.name: np.stack([getattr(s, f.name) for s in soas])
+               for f in fields(soas[0])})
+        with obs.span("fleet_step", kind="device"):
+            pods, nodes, groups, aggs, prev_cols = self._state
+            self._state = None   # donated — the refs die here
+            try:
+                state, out = ds._fleet_step(
+                    pods, nodes, groups, aggs, prev_cols, rows,
+                    stack(groups_new), pod_idx, stack(pod_vals),
+                    node_idx, stack(node_vals), dirty_idx, nows)
+                self._state = state
+                out_host = {
+                    f.name: np.asarray(getattr(out, f.name))
+                    for f in fields(out)
+                }
+            except BaseException:
+                # the donation may already have consumed the old buffers, so
+                # the pre-dispatch state is unrecoverable — rebuild the
+                # arenas from scratch and force every tenant through a full
+                # re-bootstrap (the host twins reset to empty, so each
+                # tenant's next diff re-uploads all its lanes). The batch
+                # still fails (the scheduler surfaces it per request), but
+                # the NEXT batch serves instead of unpacking None forever.
+                log.exception(
+                    "fleet_step dispatch failed; rebuilding the arenas — "
+                    "every tenant re-bootstraps on its next decide")
+                self._init_state()
+                for t in self._tenants.values():
+                    t.pods = _empty_pods(self._P)
+                    t.nodes = _empty_nodes(self._N)
+                    t.groups = _empty_groups(self._G)
+                    t.dirty = np.ones(self._G, bool)
+                raise
+        # adopt the twins + clear consumed dirty AFTER the dispatch went out
+        for tenant, _ps, _ns, new_p, new_n, new_g, _now in diffs:
+            tenant.pods, tenant.nodes, tenant.groups = new_p, new_n, new_g
+            tenant.dirty = np.zeros(G, bool)
+            tenant.ticks += 1
+        return out_host
+
+    def _finish(self, i, out_host, tenant, new_secs, now, r, batch_size,
+                ds, _kernel):
+        """Slice batch row ``i`` back to the request's shapes and run the
+        per-tenant lazy-orders tail (ordered re-dispatch when consumed)."""
+        if isinstance(r, EvictRequest):
+            self._tenants.pop(r.tenant_id, None)
+            self._free.append(tenant.slot)
+            self._free.sort()
+            metrics.fleet_tenant_count.set(len(self._tenants))
+            return EvictAck(tenant_id=r.tenant_id)
+        G_c, _P_c, N_c = tenant.shapes
+        new_p, new_n, _new_g = new_secs
+        sliced = {}
+        for f in fields(_kernel.DecisionArrays):
+            col = out_host[f.name][i]
+            if f.name in ("untainted_offsets", "tainted_offsets"):
+                sliced[f.name] = col[: G_c + 1]
+            elif f.name in _kernel.GROUP_DECISION_FIELDS:
+                sliced[f.name] = col[:G_c]
+            else:
+                sliced[f.name] = col[:N_c]
+        tainted_any = bool((np.asarray(new_n.valid)
+                            & np.asarray(new_n.tainted)).any())
+        needs_orders = tainted_any or bool(
+            (sliced["nodes_delta"] < 0).any())
+        ordered = False
+        if needs_orders:
+            sliced = self._ordered_redispatch(
+                tenant, now, G_c, N_c, ds, _kernel)
+            ordered = True
+        out = _kernel.DecisionArrays(**sliced)
+        self.decisions += 1
+        return FleetDecision(tenant_id=r.tenant_id, arrays=out,
+                             ordered=ordered, batch_size=batch_size)
+
+    def _ordered_redispatch(self, tenant, now, G_c, N_c, ds, _kernel):
+        """The lazy protocol's ordered tail for ONE tenant: gather its
+        resident row and run the full ordered decide fed its maintained
+        aggregates — windows bit-exact vs the tenant's standalone ordered
+        decide (invalid bucket lanes sort behind every selected lane, so
+        the leading windows are unchanged by the arena padding)."""
+        with obs.span("fleet_ordered_redispatch", kind="device"):
+            pods, nodes, groups, aggs, _cols = self._state
+            cluster, aggs_row = ds._fleet_tenant_state(
+                pods, nodes, groups, aggs, np.int32(tenant.slot))
+            out = obs.fence(_kernel.decide_jit(
+                cluster, np.int64(now),
+                aggregates=_kernel.aggregates_tuple(aggs_row),
+                with_orders=True))
+        self.ordered_redispatches += 1
+        sliced = {}
+        for f in fields(_kernel.DecisionArrays):
+            col = np.asarray(getattr(out, f.name))
+            if f.name in ("untainted_offsets", "tainted_offsets"):
+                sliced[f.name] = col[: G_c + 1]
+            elif f.name in _kernel.GROUP_DECISION_FIELDS:
+                sliced[f.name] = col[:G_c]
+            else:
+                sliced[f.name] = col[:N_c]
+        return sliced
+
+    # -- self-audit ----------------------------------------------------------
+
+    def audit(self) -> list:
+        """Recompute every tenant row's aggregates from the resident arrays
+        (``kernel.fleet_compute_aggregates_jit``) and bit-compare against
+        the maintained arenas — the fleet form of the round-8 refresh
+        audit. Returns the mismatched column names ([] = clean)."""
+        from dataclasses import fields as dfields
+
+        from escalator_tpu.ops import kernel as _kernel
+
+        with self._lock:
+            pods, nodes, groups, aggs, _cols = self._state
+            fresh = _kernel.fleet_compute_aggregates_jit(
+                ClusterArrays(groups=groups, pods=pods, nodes=nodes))
+            return [
+                f.name for f in dfields(_kernel.GroupAggregates)
+                if f.name != "dirty"
+                and not np.array_equal(np.asarray(getattr(aggs, f.name)),
+                                       np.asarray(getattr(fresh, f.name)))
+            ]

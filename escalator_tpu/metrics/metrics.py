@@ -237,6 +237,29 @@ chaos_injections = Counter(
     "site — nonzero only in fault-injection runs",
     ["site"], namespace="escalator_tpu", registry=registry,
 )
+# --- fleet decision service (round 14: multi-tenant continuous batching) -----
+fleet_batch_size = Histogram(
+    "fleet_batch_size",
+    "tenants coalesced into one fleet micro-batch (= one device dispatch); "
+    "a p50 stuck at 1 under load means coalescing is not happening — check "
+    "the scheduler flush knobs",
+    namespace="escalator_tpu", registry=registry,
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
+fleet_admission_rejects = Counter(
+    "fleet_admission_rejects_total",
+    "fleet decide requests rejected at admission, by reason (queue-full = "
+    "bounded queue overflowed -> RESOURCE_EXHAUSTED + retry-after, "
+    "tenant-inflight = per-tenant in-flight cap hit, invalid-tenant = "
+    "malformed/unknown tenant id -> INVALID_ARGUMENT)",
+    ["reason"], namespace="escalator_tpu", registry=registry,
+)
+fleet_tenant_count = Gauge(
+    "fleet_tenant_count",
+    "tenants currently resident in the fleet decision arenas",
+    namespace="escalator_tpu", registry=registry,
+)
+
 jax_compile_seconds = Histogram(
     "jax_compile_seconds",
     "XLA backend-compile durations observed via jax.monitoring (a warm "

@@ -43,7 +43,12 @@ _META_KEYS = ("backend", "impl", "ordered", "digest", "dirty_groups",
               "refresh_audit", "caller", "trace_id", "fallback",
               "fallback_code", "chaos", "restored", "restored_tick",
               "order_path", "order_dirty_lanes", "store", "relist_audit",
-              "overlap_host_ms", "overlap_sync_wait_ms", "overlap_saved_ms")
+              "overlap_host_ms", "overlap_sync_wait_ms", "overlap_saved_ms",
+              # fleet micro-batch attribution (round 14): which tenants one
+              # fleet_batch dispatch decided for, and the batch width the
+              # cfg17 one-dispatch proof sums against
+              "batch_size", "tenants", "fleet_tenants_resident",
+              "fleet_batch_size", "fleet_ordered")
 
 #: stash key for the tick-open jaxmon snapshot (private to this module)
 _MON0 = "_jaxmon_t0"

@@ -269,6 +269,14 @@ class HistogramSet:
             out.merge(h)
         return out
 
+    def discard(self, *key: str) -> None:
+        """Drop one series (no-op when absent) — the fleet scheduler
+        retires a tenant's ``fleet/<tenant>`` root on evict so per-tenant
+        series cardinality tracks RESIDENT tenants, not every id ever
+        seen."""
+        with self._lock:
+            self._hists.pop(key, None)
+
     def clear(self) -> None:
         with self._lock:
             self._hists.clear()

@@ -330,17 +330,17 @@ def fold_aggregate_deltas(aggs, deltas, touched, group_row_changed,
     )
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 8))
-def _scatter_update_aggs(pods, nodes, groups_old, groups_new, pod_idx,
-                         pod_vals, node_idx, node_vals, aggs):
+def _scatter_update_aggs_core(pods, nodes, groups_old, groups_new, pod_idx,
+                              pod_vals, node_idx, node_vals, aggs):
     """The incremental tick's scatter: apply the dirty-lane deltas to the
     resident arrays (exactly ``_scatter_body``) AND maintain the persistent
     per-group aggregates in the same device program — subtract each touched
     lane's old contribution, add its new one, and fold the touched groups
     (plus every group whose config/state row changed between ``groups_old``
     and ``groups_new``) into the dirty mask that ``kernel.delta_decide``
-    consumes. Donates pods/nodes (as ``_scatter_update``) and the aggregate
-    columns (each output sum aliases its input buffer: one add in place)."""
+    consumes. Plain traceable body: jitted (with donation) as
+    ``_scatter_update_aggs`` below, and vmapped over the cluster axis
+    inside the fleet step program (``_fleet_step``)."""
     G = groups_new.valid.shape[0]
     N = nodes.valid.shape[0]
     gather = lambda soa, idx: type(soa)(  # noqa: E731
@@ -368,6 +368,100 @@ def _scatter_update_aggs(pods, nodes, groups_old, groups_new, pod_idx,
     aggs_out = fold_aggregate_deltas(
         aggs, deltas, touched, group_rows_changed(groups_old, groups_new), npr)
     return cluster, aggs_out
+
+
+#: Jitted scatter+aggregate program with the documented donation contract:
+#: pods/nodes (in-place residency) and the aggregate columns (add in place).
+_scatter_update_aggs = partial(jax.jit, donate_argnums=(0, 1, 8))(
+    _scatter_update_aggs_core)
+
+
+# ---------------------------------------------------------------------------
+# Fleet arenas (round 14): per-tenant GroupAggregates + decision columns
+# stacked along a cluster axis, updated by ONE fused per-micro-batch program.
+# ---------------------------------------------------------------------------
+
+
+def _fleet_step_core(pods, nodes, groups, aggs, prev_cols, tenant_rows,
+                     groups_new, pod_idx, pod_vals, node_idx, node_vals,
+                     dirty_idx, now_sec):
+    """One fleet micro-batch as ONE device program: for the ``T`` tenants in
+    ``tenant_rows``, scatter their dirty-lane delta batches into the
+    C-stacked resident arrays, maintain their per-tenant aggregate arenas
+    (exact integer deltas — ``_scatter_update_aggs_core`` vmapped over the
+    batch), run the per-tenant delta decide on their compacted dirty-group
+    buckets (``kernel._delta_decide_core`` vmapped), and write the updated
+    rows back. Tenants NOT in the batch are untouched bitwise.
+
+    Shapes: the arenas carry ``C+1`` tenant rows (row ``C`` is a scratch
+    tenant, the row-level analog of the scratch lane) over per-tenant lane
+    buckets ``P+1``/``N+1`` (each row keeps its own scratch lane). Batch
+    operands are ``[T, ...]`` with ``T`` a power-of-two bucket: pad batch
+    entries point at the scratch tenant row with no-op delta batches
+    (pad-valued lanes, ``dirty_idx`` all ``G``), so duplicate row scatters
+    write identical values and the program stays deterministic. The jit
+    cache keys only on the bucket shapes — tenant add/evict changes row
+    CONTENT, never a shape, so steady fleet traffic never retraces.
+
+    Returns ``((pods, nodes, groups, aggs, prev_cols), out)`` where ``out``
+    is the batch's stacked DecisionArrays ``[T, ...]`` (order fields are
+    the light program's input-order placeholders) and the state replaces
+    the donated arenas."""
+    gather_rows = lambda tree: tree_util.tree_map(  # noqa: E731
+        lambda a: a[tenant_rows], tree)
+    pods_T = gather_rows(pods)
+    nodes_T = gather_rows(nodes)
+    groups_T = gather_rows(groups)
+    aggs_T = gather_rows(aggs)
+    prev_T = tuple(c[tenant_rows] for c in prev_cols)
+
+    def one(p, n, g_old, g_new, pi, pv, ni, nv, a, prev, didx, now):
+        cluster, a2 = _scatter_update_aggs_core(
+            p, n, g_old, g_new, pi, pv, ni, nv, a)
+        out, a3 = _kernel._delta_decide_core(
+            g_new, cluster.nodes, a2, prev, didx, now)
+        return cluster.pods, cluster.nodes, out, a3
+
+    pods_T2, nodes_T2, out_T, aggs_T2 = jax.vmap(one)(
+        pods_T, nodes_T, groups_T, groups_new, pod_idx, pod_vals,
+        node_idx, node_vals, aggs_T, prev_T, dirty_idx, now_sec)
+
+    put_rows = lambda full, upd: tree_util.tree_map(  # noqa: E731
+        lambda a, b: a.at[tenant_rows].set(b), full, upd)
+    state = (
+        put_rows(pods, pods_T2),
+        put_rows(nodes, nodes_T2),
+        put_rows(groups, groups_new),
+        put_rows(aggs, aggs_T2),
+        tuple(
+            full.at[tenant_rows].set(getattr(out_T, name))
+            for full, name in zip(prev_cols, _kernel.GROUP_DECISION_FIELDS,
+                                  strict=True)
+        ),
+    )
+    return state, out_T
+
+
+#: Jitted fleet step. DONATES the five arena operands — they are persistent
+#: device state replaced wholesale by the returned values (the fleet engine
+#: owns the drop-old-references protocol, mirroring IncrementalDecider).
+_fleet_step = partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))(
+    _fleet_step_core)
+
+
+@jax.jit
+def _fleet_tenant_state(pods, nodes, groups, aggs, row):
+    """Gather ONE tenant's resident row as an unstacked
+    ``(ClusterArrays, GroupAggregates)`` pair — the fleet service's ordered
+    re-dispatch path slices this and feeds ``kernel.decide_jit`` with the
+    maintained aggregates (so even the per-tenant ordered follow-up skips
+    the O(cluster) sweeps). ``row`` is traced: one compiled gather serves
+    every tenant."""
+    g = lambda tree: tree_util.tree_map(lambda a: a[row], tree)  # noqa: E731
+    return (
+        ClusterArrays(groups=g(groups), pods=g(pods), nodes=g(nodes)),
+        g(aggs),
+    )
 
 
 class DeviceClusterCache:

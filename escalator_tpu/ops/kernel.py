@@ -929,6 +929,72 @@ def ordered_delta_decide_jit(cluster: ClusterArrays, aggs: GroupAggregates,
                                      perm_old, bucket)
 
 
+# ---------------------------------------------------------------------------
+# Fleet-scale decide (round 14): a leading cluster axis over the
+# shape-polymorphic decision path — C independent tenants in ONE dispatch.
+# ---------------------------------------------------------------------------
+
+
+def fleet_decide(clusters: ClusterArrays, now_sec) -> DecisionArrays:
+    """C-stacked multi-tenant decide: every leaf of ``clusters`` carries a
+    leading cluster axis (``groups [C, G]``, ``pods [C, P]``, ``nodes
+    [C, N]``; ragged tenants are packed into the shared ``(G, N, P)``
+    buckets with their per-lane ``valid`` masks), and ``now_sec`` is int64
+    ``[C]`` — each tenant decides at the timestamp its request carried.
+
+    This is :func:`decide`'s light (``with_orders=False``) program vmapped
+    over the cluster axis: every op in that program is elementwise or a
+    segment-sum, so the batched lowering is one fused device program with
+    NO cross-tenant data flow — each tenant's 13 decision columns are
+    bit-identical to its standalone ``decide_jit(..., with_orders=False)``
+    at the same bucket shapes (and, because the [G] math reads only exact
+    integer aggregates, to its standalone decide at ANY padding). The
+    ordering sorts stay out by design: the fleet service runs the lazy-
+    orders protocol per tenant, re-dispatching a single-tenant ordered
+    decide only for tenants whose decision consumes an order (see
+    escalator_tpu/fleet/service.py)."""
+    return jax.vmap(
+        lambda c, t: decide(c, t, impl="xla", with_orders=False)
+    )(clusters, now_sec)
+
+
+_fleet_decide_jit_raw = jax.jit(fleet_decide)
+
+
+def fleet_decide_jit(clusters: ClusterArrays, now_sec) -> DecisionArrays:
+    """Jitted :func:`fleet_decide` with the same wedged-transport guard as
+    :func:`decide_jit` (the fleet service is a raw-library surface too)."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _fleet_decide_jit_raw(clusters, now_sec)
+
+
+#: Full per-tenant aggregate recompute over the cluster axis — the fleet
+#: arenas' bootstrap/audit reference, exactly ``compute_aggregates`` per
+#: tenant row (the maintained fleet aggregates must stay bit-equal to it).
+fleet_compute_aggregates_jit = jax.jit(
+    jax.vmap(lambda c: compute_aggregates(c, impl="xla")))
+
+
+def fleet_dirty_indices(dirty_masks, G: int, min_bucket: int = _MIN_DIRTY_BUCKET):
+    """Per-tenant dirty-row compaction into ONE shared ``[T, D]`` bucket:
+    the fleet analog of :func:`dirty_indices`, padded to the widest
+    tenant's power-of-two bucket so the batched delta program compiles a
+    handful of ``D`` widths as churn fluctuates — a per-tenant bucket
+    would retrace on every batch whose tenants disagree. Pad entries are
+    ``G`` (dropped on scatter), exactly the single-tenant convention."""
+    counts = [int(np.count_nonzero(np.asarray(m))) for m in dirty_masks]
+    widest = max(counts, default=0)
+    bucket = min(G, max(min_bucket, 1 << max(widest - 1, 0).bit_length()))
+    bucket = max(bucket, widest)
+    out = np.full((len(dirty_masks), bucket), G, np.int32)
+    for t, mask in enumerate(dirty_masks):
+        idx = np.nonzero(np.asarray(mask))[0]
+        out[t, : len(idx)] = idx
+    return out
+
+
 def lazy_orders_decide(dispatch, tainted_any: bool):
     """The lazy-orders tick protocol: pay the node-ordering sort only when a
     consumer exists, mirroring the reference, which sorts exclusively inside

@@ -106,6 +106,22 @@ def _rpc_code(err) -> "grpc.StatusCode | None":
     return None
 
 
+def _rpc_retry_after_sec(err) -> "float | None":
+    """The server's backoff hint from the ``escalator-retry-after-ms``
+    trailer (fleet backpressure ships it with RESOURCE_EXHAUSTED). None
+    when absent/unreadable — the client's own backoff stands."""
+    get_md = getattr(err, "trailing_metadata", None)
+    if not callable(get_md):
+        return None
+    try:
+        for key, value in (get_md() or ()):
+            if key == "escalator-retry-after-ms":
+                return max(0.0, float(value)) / 1e3
+    except Exception:  # noqa: BLE001 - a torn trailer must not mask the error
+        return None
+    return None
+
+
 def _chaos_rpc_attempt() -> None:
     """The plugin_rpc chaos site: raise a synthetic retryable error before
     the real RPC goes out (``code=`` rule param picks the status)."""
@@ -189,10 +205,13 @@ class ComputeClient:
                     # total policy): don't count a phantom retry
                     raise
                 metrics.plugin_rpc_retries.inc()
-                sleep = min(
-                    backoff * (1.0 + random.uniform(0, policy.jitter_frac)),
-                    budget_left,
-                )
+                sleep = backoff * (1.0 + random.uniform(0, policy.jitter_frac))
+                retry_after = _rpc_retry_after_sec(e)
+                if retry_after is not None:
+                    # the server told us when it expects capacity (fleet
+                    # backpressure): retrying sooner just re-rejects
+                    sleep = max(sleep, retry_after)
+                sleep = min(sleep, budget_left)
                 log.warning(
                     "plugin decide attempt %d/%d failed (%s); retrying in "
                     "%.0f ms", attempt + 1, attempts,
@@ -221,6 +240,33 @@ class ComputeClient:
         resp = self._decide_with_retry(frame, max_attempts=max_attempts)
         return codec.decode_decision_traced(resp)
 
+    def decide_arrays_fleet(self, cluster, now_sec: int, tenant_id: str,
+                            span_ctx: Optional[dict] = None,
+                            max_attempts: Optional[int] = None):
+        """Fleet-mode decide: tags the frame with the tenant sidecar and
+        returns ``(decision, server_phases, fleet_meta)``. ``fleet_meta``
+        is the server's ``__fleet__`` sidecar (``ordered`` — the lazy-
+        orders flag the caller MUST honor before reading order windows —
+        plus ``batch_size``), or None from a server without fleet mode
+        (which served the single-cluster decide: orders populated,
+        treat as ordered=True)."""
+        frame = codec.encode_cluster(cluster, now_sec, span_ctx=span_ctx,
+                                     tenant={"id": tenant_id})
+        resp = self._decide_with_retry(frame, max_attempts=max_attempts)
+        return codec.decode_decision_full(resp)
+
+    def evict_tenant(self, tenant_id: str) -> dict:
+        """Deregister ``tenant_id`` on a fleet-mode server. Returns the
+        ack sidecar; raises grpc.RpcError (INVALID_ARGUMENT) when the
+        tenant is unknown."""
+        from escalator_tpu.core.arrays import pack_cluster
+
+        frame = codec.encode_cluster(
+            pack_cluster([]), 0, tenant={"id": tenant_id, "evict": True})
+        _out, _phases, fleet = codec.decode_decision_full(
+            self._decide(frame, timeout=self.timeout_sec))
+        return fleet or {}
+
     def close(self) -> None:
         self._channel.close()
 
@@ -236,11 +282,16 @@ class GrpcBackend(ComputeBackend):
                  timeout_sec: float = 10.0,
                  retry: Optional[RetryPolicy] = None,
                  breaker_threshold: int = 3,
-                 breaker_probe_after: int = 5):
+                 breaker_probe_after: int = 5,
+                 tenant_id: Optional[str] = None):
         self.client = ComputeClient(address, timeout_sec, retry=retry)
         self.fallback = fallback or GoldenBackend()
         self._packer = PaddedPacker()
         self._packing = PackingPostPass()
+        #: fleet mode (round 14): tag every decide with this tenant id so a
+        #: fleet-enabled plugin coalesces it with other tenants' ticks; a
+        #: server without fleet mode ignores the tag (single-cluster path)
+        self.tenant_id = tenant_id
         #: consecutive decide failures (post-retry) that open the breaker
         self.breaker_threshold = int(breaker_threshold)
         #: fallback-served ticks between recovery probes while open
@@ -285,14 +336,23 @@ class GrpcBackend(ComputeBackend):
             with obs.span("pack"):
                 cluster = self._packer.pack(
                     group_inputs, dry_mode_flags, taint_trackers)
+            fleet_meta = None
             try:
                 with obs.span("rpc", kind="rpc"):
-                    out, server_phases = self.client.decide_arrays_traced(
-                        cluster, now_sec,
-                        span_ctx={"path": obs.current_path()},
-                        # a probe pays one deadline, never the full ladder:
-                        # a still-dead plugin must not stall the probe tick
-                        max_attempts=1 if probing else None)
+                    if self.tenant_id is not None:
+                        out, server_phases, fleet_meta = (
+                            self.client.decide_arrays_fleet(
+                                cluster, now_sec, self.tenant_id,
+                                span_ctx={"path": obs.current_path()},
+                                max_attempts=1 if probing else None))
+                    else:
+                        out, server_phases = self.client.decide_arrays_traced(
+                            cluster, now_sec,
+                            span_ctx={"path": obs.current_path()},
+                            # a probe pays one deadline, never the full
+                            # ladder: a still-dead plugin must not stall
+                            # the probe tick
+                            max_attempts=1 if probing else None)
                 if server_phases:
                     # nest the plugin-side phases under this tick's rpc span:
                     # the flight record then reads e.g.
@@ -332,8 +392,19 @@ class GrpcBackend(ComputeBackend):
             self._ticks_since_open = 0
             self._consecutive_failures = 0
             obs.annotate(digest=_decision_digest(out))
+            if fleet_meta is not None:
+                obs.annotate(fleet_batch_size=fleet_meta.get("batch_size"),
+                             fleet_ordered=fleet_meta.get("ordered"))
             with obs.span("unpack"):
-                results = _unpack(out, group_inputs)
+                # fleet responses carry the lazy-orders flag: ordered=False
+                # means the order fields are placeholders and candidate
+                # lists populate as unordered membership from the packed
+                # node masks (exactly the array backends' protocol); a
+                # single-cluster response (no sidecar) always has orders
+                ordered = (True if fleet_meta is None
+                           else bool(fleet_meta.get("ordered", True)))
+                results = _unpack(out, group_inputs, ordered=ordered,
+                                  node_masks=cluster.nodes)
             # packing-aware override runs client-side: it needs only the object
             # inputs already in hand, keeping the wire format untouched. On a
             # jax-less client it degrades to the pure-Python FFD (same math);

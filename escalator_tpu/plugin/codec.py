@@ -33,6 +33,18 @@ _VERSION = 1
 _SPAN_CTX_KEY = "__spanctx__"
 _SPANS_KEY = "__spans__"
 
+#: Fleet-mode sidecars (round 14), same mixed-version contract as the span
+#: sidecars: ``__tenant__`` rides the REQUEST frame (msgpack
+#: ``{"id": str}``, optionally ``{"evict": True}``) and names the tenant a
+#: fleet-mode server batches this cluster under; ``__fleet__`` rides the
+#: RESPONSE (msgpack ``{"ordered": bool, "tenant": str, "batch_size":
+#: int}``). A peer that predates them never looks the names up, and a new
+#: decoder treats absence as "single-cluster peer" — so a tenant-tagged
+#: frame decodes byte-identically to an untagged one on a pre-fleet (or
+#: fleet-disabled) server, and vice versa.
+_TENANT_KEY = "__tenant__"
+_FLEET_KEY = "__fleet__"
+
 #: Fields added to the wire format after v1 frames shipped, with the default a
 #: decoder must assume when a peer's frame predates them. Keyed by frame array
 #: name; the value is (dtype, fill) — the array is materialised against the
@@ -101,14 +113,20 @@ def _msgpack_array(obj: Any) -> np.ndarray:
 
 
 def encode_cluster(cluster: ClusterArrays, now_sec: int,
-                   span_ctx: Optional[Dict[str, Any]] = None) -> bytes:
+                   span_ctx: Optional[Dict[str, Any]] = None,
+                   tenant: Optional[Dict[str, Any]] = None) -> bytes:
     """``span_ctx`` (optional) propagates the caller's span context across
     the process boundary — a small msgpack dict (caller span path, trace
     id) the server annotates its own tick record with, so a plugin-side
-    flight record names which remote tick asked for it."""
+    flight record names which remote tick asked for it. ``tenant``
+    (optional) is the fleet-mode tenant sidecar (``{"id": str}``); a
+    server without fleet mode ignores it and serves the single-cluster
+    decide."""
     named = [("__now__", np.array([now_sec], np.int64))]
     if span_ctx:
         named.append((_SPAN_CTX_KEY, _msgpack_array(span_ctx)))
+    if tenant:
+        named.append((_TENANT_KEY, _msgpack_array(tenant)))
     for prefix, section in (
         ("g.", cluster.groups),
         ("p.", cluster.pods),
@@ -163,22 +181,49 @@ def decode_cluster_ctx(
 ) -> Tuple[ClusterArrays, int, Optional[Dict[str, Any]]]:
     """:func:`decode_cluster` plus the caller's span context (None when the
     peer sent none / predates tracing)."""
+    cluster, now_sec, span_ctx, _tenant = decode_cluster_full(data)
+    return cluster, now_sec, span_ctx
+
+
+def decode_cluster_full(
+    data: bytes,
+) -> Tuple[ClusterArrays, int, Optional[Dict[str, Any]],
+           Optional[Dict[str, Any]]]:
+    """:func:`decode_cluster_ctx` plus the fleet tenant sidecar (None when
+    the peer sent none / predates fleet mode). A present-but-torn tenant
+    sidecar decodes as the raw (unvalidated) msgpack value or None — the
+    SERVER owns validation, because a malformed tenant must become a named
+    INVALID_ARGUMENT, not a silent single-cluster fallback."""
     arrays = _decode_arrays(data)
     now_sec = int(arrays.pop("__now__")[0])
     span_ctx = _unpack_sidecar(arrays, _SPAN_CTX_KEY)
+    raw_tenant = arrays.get(_TENANT_KEY)
+    if raw_tenant is None:
+        tenant = None
+    else:
+        try:
+            tenant = msgpack.unpackb(raw_tenant.tobytes())
+        except Exception:  # noqa: BLE001 - torn sidecar: present but invalid
+            tenant = {"id": None}
     g = _section(arrays, "g.", GroupArrays)
     p = _section(arrays, "p.", PodArrays)
     n = _section(arrays, "n.", NodeArrays)
-    return ClusterArrays(groups=g, pods=p, nodes=n), now_sec, span_ctx
+    return ClusterArrays(groups=g, pods=p, nodes=n), now_sec, span_ctx, tenant
 
 
-def encode_decision(out, span_phases: Optional[List[Dict[str, Any]]] = None) -> bytes:
+def encode_decision(out, span_phases: Optional[List[Dict[str, Any]]] = None,
+                    fleet: Optional[Dict[str, Any]] = None) -> bytes:
     """Encode DecisionArrays (device or numpy) to a frame. ``span_phases``
     (optional, ``spans.Phase.as_dict`` form) ships the server-side timeline
-    back so the caller can graft it under its own tick span."""
+    back so the caller can graft it under its own tick span. ``fleet``
+    (optional) is the fleet-mode response sidecar (``{"ordered": bool,
+    ...}``) — its absence tells the client the decision came off the
+    single-cluster path (orders always populated there)."""
     named = [(f.name, np.asarray(getattr(out, f.name))) for f in fields(out)]
     if span_phases:
         named.append((_SPANS_KEY, _msgpack_array(span_phases)))
+    if fleet:
+        named.append((_FLEET_KEY, _msgpack_array(fleet)))
     return _encode_arrays(named)
 
 
@@ -191,10 +236,18 @@ def decode_decision(data: bytes):
 def decode_decision_traced(data: bytes):
     """:func:`decode_decision` plus the server's span phases (None when the
     peer sent none / predates tracing)."""
+    out, phases, _fleet = decode_decision_full(data)
+    return out, phases
+
+
+def decode_decision_full(data: bytes):
+    """:func:`decode_decision_traced` plus the fleet response sidecar (None
+    from a single-cluster peer / path)."""
     from escalator_tpu.ops.kernel import DecisionArrays
 
     arrays = _decode_arrays(data)
     phases = _unpack_sidecar(arrays, _SPANS_KEY)
+    fleet = _unpack_sidecar(arrays, _FLEET_KEY)
     return DecisionArrays(**{
         f.name: arrays[f.name] for f in fields(DecisionArrays)
-    }), phases
+    }), phases, fleet

@@ -10,11 +10,21 @@ Methods:
 - ``Decide``: cluster frame -> decision frame (batched kernel on the server's
   device). The frame may carry the caller's span context; the response then
   carries the server-side span timeline so the caller's flight record nests
-  the remote phases under its own tick.
+  the remote phases under its own tick. In FLEET mode
+  (``make_server(fleet=…)``), a frame carrying the ``__tenant__`` sidecar
+  routes through the continuous-batching scheduler instead: the request
+  coalesces with other tenants' into one device dispatch
+  (escalator_tpu/fleet/), backpressure surfaces as RESOURCE_EXHAUSTED with
+  an ``escalator-retry-after-ms`` trailer, and a malformed/unknown tenant id
+  is INVALID_ARGUMENT (validated BEFORE anything queues — it cannot poison a
+  batch). Frames without the sidecar — mixed-version peers — serve the
+  single-cluster path byte-identically to a fleet-disabled server.
 - ``Health``: empty -> msgpack {device, backend, version, last_decide_age_sec,
   flight_recorder_depth, ticks_served} — the age/depth pair lets a remote
   health check tell a stale-but-alive controller (socket answers, no decide
-  traffic) from a live one.
+  traffic) from a live one. Fleet mode adds a ``fleet`` section: tenant
+  count, queue depth, admitted/rejected totals, oldest-waiting-request age
+  (the batcher's own stale-but-alive signal).
 - ``Dump``: empty -> JSON bytes of the server's flight-recorder ring (the
   ``escalator-tpu debug-dump`` CLI's wire target).
 """
@@ -25,6 +35,8 @@ import logging
 import threading
 import time
 from concurrent import futures
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from dataclasses import dataclass
 
 import grpc
 import msgpack
@@ -39,12 +51,34 @@ log = logging.getLogger("escalator_tpu.plugin")
 
 SERVICE_NAME = "escalator.Compute"
 
+#: Trailer carrying the scheduler's backoff hint on RESOURCE_EXHAUSTED —
+#: the client RetryPolicy reads it as a backoff floor.
+RETRY_AFTER_METADATA_KEY = "escalator-retry-after-ms"
+
+
+@dataclass
+class FleetConfig:
+    """Knobs for the fleet decision service (engine arenas + scheduler).
+    Defaults size a small fleet; bench cfg17 documents the C=1k envelope."""
+
+    num_groups: int = 8
+    pod_capacity: int = 256
+    node_capacity: int = 64
+    max_tenants: int = 16
+    max_batch: int = 32
+    flush_ms: float = 2.0
+    queue_limit: int = 256
+    per_tenant_inflight: int = 2
+    #: per-request wait bound on the batch future (queue wait + service);
+    #: far above any sane flush interval — a breach means a wedged worker
+    decide_timeout_sec: float = 60.0
+
 
 class _ComputeService:
     """Runs the batched kernel on whatever device JAX resolved (TPU when present,
     XLA-CPU otherwise — same traced program, the parity-preserving fallback)."""
 
-    def __init__(self):
+    def __init__(self, fleet: "FleetConfig | None" = None):
         from escalator_tpu.ops import kernel  # defer jax init to server start
 
         self._kernel = kernel
@@ -58,11 +92,57 @@ class _ComputeService:
         self._stats_lock = threading.Lock()
         self._last_decide_unix: "float | None" = None
         self._ticks_served = 0
+        self._fleet_cfg = fleet
+        self._fleet = None
+        if fleet is not None:
+            from escalator_tpu.fleet import FleetEngine, FleetScheduler
+
+            engine = FleetEngine(
+                num_groups=fleet.num_groups,
+                pod_capacity=fleet.pod_capacity,
+                node_capacity=fleet.node_capacity,
+                max_tenants=fleet.max_tenants)
+            self._fleet = FleetScheduler(
+                engine, max_batch=fleet.max_batch, flush_ms=fleet.flush_ms,
+                queue_limit=fleet.queue_limit,
+                per_tenant_inflight=fleet.per_tenant_inflight)
+
+    @property
+    def fleet(self):
+        """The live FleetScheduler (None outside fleet mode) — tests and
+        embedders reach the engine through it."""
+        return self._fleet
+
+    def _empty_decision(self):
+        """A zero-group DecisionArrays — the evict ack's payload (the frame
+        format has no empty response; sidecars need a carrier)."""
+        from dataclasses import fields as dfields
+
+        k = self._kernel
+        z32 = np.zeros(0, np.int32)
+        cols = {}
+        for f in dfields(k.DecisionArrays):
+            if f.name in ("untainted_offsets", "tainted_offsets"):
+                cols[f.name] = np.zeros(1, np.int32)
+            elif f.name in ("cpu_percent", "mem_percent"):
+                cols[f.name] = np.zeros(0, np.float64)
+            elif f.name in ("cpu_request_milli", "mem_request_bytes",
+                            "cpu_capacity_milli", "mem_capacity_bytes"):
+                cols[f.name] = np.zeros(0, np.int64)
+            elif f.name == "reap_mask":
+                cols[f.name] = np.zeros(0, bool)
+            else:
+                cols[f.name] = z32
+        return k.DecisionArrays(**cols)
 
     def decide(self, request: bytes, context) -> bytes:
         t0 = time.perf_counter()
-        cluster, now_sec, span_ctx = codec.decode_cluster_ctx(request)
+        cluster, now_sec, span_ctx, tenant = codec.decode_cluster_full(request)
         t_decode = time.perf_counter() - t0
+        if tenant is not None and self._fleet is not None:
+            return self._fleet_decide(cluster, now_sec, tenant, context)
+        # no tenant sidecar (mixed-version peer), or fleet mode off: the
+        # single-cluster path, byte-identical to the pre-fleet server
         with obs.span("plugin_decide"):
             obs.annotate(backend="grpc-server", impl="xla")
             if span_ctx:
@@ -90,6 +170,59 @@ class _ComputeService:
                 self._ticks_served += 1
             return resp
 
+    def _fleet_decide(self, cluster, now_sec: int, tenant: dict,
+                      context) -> bytes:
+        """One tenant's decide through the continuous batcher. Validation
+        runs HERE, before anything queues: a malformed tenant id aborts
+        this RPC alone (INVALID_ARGUMENT) and the batch it would have
+        ridden in never sees it."""
+        from escalator_tpu.fleet import AdmissionError, TenantError
+
+        if not isinstance(tenant, dict):
+            metrics.fleet_admission_rejects.labels("invalid-tenant").inc()
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "tenant sidecar must be a msgpack map")
+        try:
+            if tenant.get("evict"):
+                fut = self._fleet.evict(tenant.get("id"))
+            else:
+                fut = self._fleet.submit(tenant.get("id"), cluster,
+                                         int(now_sec))
+        except TenantError as e:
+            metrics.fleet_admission_rejects.labels("invalid-tenant").inc()
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except AdmissionError as e:
+            # backpressure: the retry-after estimate rides a trailer the
+            # client RetryPolicy uses as its backoff floor
+            context.set_trailing_metadata((
+                (RETRY_AFTER_METADATA_KEY, f"{e.retry_after_ms:.0f}"),
+            ))
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        timeout = (self._fleet_cfg.decide_timeout_sec
+                   if self._fleet_cfg else 60.0)
+        try:
+            result = fut.result(timeout=timeout)
+        except TenantError as e:
+            # raced validation (e.g. concurrent evict): still this RPC's
+            # problem alone
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except FuturesTimeoutError:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                          f"fleet batch did not serve within {timeout}s")
+        with self._stats_lock:
+            self._last_decide_unix = time.time()
+            self._ticks_served += 1
+        from escalator_tpu.fleet import EvictAck
+
+        if isinstance(result, EvictAck):
+            return codec.encode_decision(
+                self._empty_decision(), fleet={"evicted": result.tenant_id})
+        return codec.encode_decision(result.arrays, fleet={
+            "ordered": bool(result.ordered),
+            "tenant": result.tenant_id,
+            "batch_size": int(result.batch_size),
+        })
+
     def health(self, request: bytes, context) -> bytes:
         with self._stats_lock:
             last = self._last_decide_unix
@@ -100,7 +233,7 @@ class _ComputeService:
         # server's TAIL is inspectable from the same health probe that
         # exposes its age (None until the first recorded tick)
         q = obs.histograms.tick_quantiles_ms()
-        return msgpack.packb({
+        doc = {
             "device": self._device,
             "version": __version__,
             "ok": True,
@@ -111,7 +244,22 @@ class _ComputeService:
             "flight_recorder_depth": obs.RECORDER.depth,
             "tick_p99_ms": q["p99"],
             "tick_p999_ms": q["p999"],
-        })
+        }
+        if self._fleet is not None:
+            # the batcher's stale-but-alive surface (mirrors tick_p99_ms):
+            # a wedged worker shows oldest_waiting growing while the queue
+            # answers admissions and this health probe stays green
+            doc["fleet"] = {
+                "tenants": self._fleet.engine.tenant_count,
+                "queue_depth": self._fleet.queue_depth,
+                "admitted_total": self._fleet.admitted_total,
+                "rejected_total": self._fleet.rejected_total,
+                "oldest_waiting_sec": round(
+                    self._fleet.oldest_waiting_sec(), 4),
+                "batches": self._fleet.engine.batches,
+                "buckets": self._fleet.engine.buckets,
+            }
+        return msgpack.packb(doc)
 
     def dump(self, request: bytes, context) -> bytes:
         import json
@@ -124,9 +272,16 @@ def _identity(x: bytes) -> bytes:
 
 
 def make_server(
-    address: str = "127.0.0.1:50551", max_workers: int = 4
+    address: str = "127.0.0.1:50551", max_workers: int = 4,
+    fleet: "FleetConfig | None" = None,
 ) -> grpc.Server:
     """Build (not start) the plugin server bound to ``address``.
+
+    ``fleet`` (a :class:`FleetConfig`) enables the multi-tenant
+    continuous-batching mode: tenant-tagged frames coalesce into fleet
+    micro-batches; untagged frames keep the single-cluster path. The built
+    server exposes the service as ``server._escalator_service`` so tests
+    and embedders can reach the scheduler/engine.
 
     Probes the accelerator first: _ComputeService.__init__ touches
     jax.devices(), which hangs indefinitely on a wedged transport. The probe
@@ -135,7 +290,7 @@ def make_server(
     from escalator_tpu.jaxconfig import ensure_responsive_accelerator
 
     ensure_responsive_accelerator()
-    service = _ComputeService()
+    service = _ComputeService(fleet=fleet)
     handlers = {
         "Decide": grpc.unary_unary_rpc_method_handler(
             service.decide,
@@ -169,7 +324,21 @@ def make_server(
     if bound == 0:
         raise RuntimeError(f"failed to bind compute plugin to {address}")
     server._escalator_bound_port = bound  # convenience for tests with port 0
-    log.info("compute plugin bound to %s (port %d)", address, bound)
+    server._escalator_service = service   # fleet scheduler/engine access
+    if service.fleet is not None:
+        # tear the batcher down WITH the server: stop() otherwise leaves the
+        # fleet worker thread (and the device arenas it owns) alive for the
+        # rest of the process, and queued requests could still dispatch
+        # after the listener is gone
+        grpc_stop = server.stop
+
+        def stop(grace=None):
+            service.fleet.shutdown()
+            return grpc_stop(grace)
+
+        server.stop = stop
+    log.info("compute plugin bound to %s (port %d)%s", address, bound,
+             " [fleet mode]" if fleet is not None else "")
     return server
 
 

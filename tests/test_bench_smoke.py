@@ -220,6 +220,8 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
                        str(tmp_path / "tail-smoke.json"))
     monkeypatch.setenv("ESCALATOR_TPU_TRACE_SMOKE",
                        str(tmp_path / "smoke.trace.json"))
+    monkeypatch.setenv("ESCALATOR_TPU_FLEET_SMOKE",
+                       str(tmp_path / "fleet-smoke.json"))
     out = bench.run_smoke()
     assert out["smoke_cfg8_parity"] == "ok"
     assert out["smoke_cfg10_parity"] == "ok"
@@ -280,6 +282,17 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
     trace = json.loads((tmp_path / "smoke.trace.json").read_text())
     slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
     assert slices and any(e["args"].get("remote") for e in slices)
+    # round 14: the fleet loop — C=8 tenants through the real gRPC fleet
+    # server (run_smoke asserts coalescing, per-tenant digest parity and
+    # the backpressure path internally; here we lock the artifact surface)
+    assert out["smoke_fleet_mode"] == "grpc"
+    assert out["smoke_fleet_parity"] == "ok"
+    assert out["smoke_fleet_backpressure"] == "ok"
+    assert out["smoke_fleet_max_batch"] >= 2
+    fleet_report = json.loads((tmp_path / "fleet-smoke.json").read_text())
+    assert fleet_report["tenants"] == 8
+    assert fleet_report["backpressure"]["rejected"] == 2
+    assert all(v > 0 for v in fleet_report["backpressure"]["retry_after_ms"])
 
 
 def test_archived_e2e_filter(bench):

@@ -1,0 +1,704 @@
+"""Fleet-scale decision service (round 14): kernel parity, the engine's
+multi-tenant soak (churn: add/evict/grow mid-run), scheduler admission/
+coalescing/fairness semantics, codec tenant framing (mixed-version
+byte-identity, malformed tenants), and the gRPC fleet mode end-to-end."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from escalator_tpu.analysis.registry import representative_cluster
+from escalator_tpu.fleet import (
+    AdmissionError,
+    DecideRequest,
+    EvictAck,
+    EvictRequest,
+    FleetEngine,
+    FleetScheduler,
+    TenantError,
+    validate_tenant_id,
+)
+from escalator_tpu.ops import kernel
+
+NOW = np.int64(1_700_000_000)
+
+# tiny arena buckets: every jit in this module compiles at toy shapes
+G, P, N = 6, 24, 12
+
+
+def tiny_cluster(seed: int) -> "object":
+    return representative_cluster(G, P, N, seed=seed)
+
+
+def mutate(cluster, rng: np.random.Generator):
+    """Random in-place churn across every lane class (the arrays are fresh
+    per call in these tests, so in-place is safe)."""
+    k = int(rng.integers(1, 4))
+    for _ in range(k):
+        what = rng.integers(0, 6)
+        if what == 0:
+            cluster.pods.cpu_milli[rng.integers(0, P)] += 50
+        elif what == 1:
+            i = rng.integers(0, P)
+            cluster.pods.valid[i] = not cluster.pods.valid[i]
+        elif what == 2:
+            i = rng.integers(0, N)
+            cluster.nodes.tainted[i] = not cluster.nodes.tainted[i]
+        elif what == 3:
+            cluster.nodes.group[rng.integers(0, N)] = rng.integers(0, G)
+        elif what == 4:
+            cluster.groups.locked[rng.integers(0, G)] ^= True
+        else:
+            cluster.pods.node[rng.integers(0, P)] = rng.integers(-1, N)
+    return cluster
+
+
+def assert_column_parity(fleet_arrays, cluster, now, msg=""):
+    """The acceptance contract: the 13 decision columns bit-identical to
+    the tenant's standalone decide on the same cluster."""
+    import jax
+
+    ref = kernel.decide_jit(jax.device_put(cluster), np.int64(now))
+    for f in kernel.GROUP_DECISION_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fleet_arrays, f)),
+            np.asarray(getattr(ref, f)), err_msg=f"{msg}:{f}")
+    return ref
+
+
+# ---------------------------------------------------------------------------
+# kernel layer
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_decide_jit_matches_per_tenant_decide():
+    from jax import tree_util
+
+    import jax
+
+    clusters = [tiny_cluster(s) for s in range(4)]
+    stacked = tree_util.tree_map(lambda *xs: np.stack(xs), *clusters)
+    nows = NOW + np.arange(4, dtype=np.int64) * 60
+    out = kernel.fleet_decide_jit(jax.device_put(stacked), nows)
+    for i, c in enumerate(clusters):
+        ref = kernel.decide_jit(jax.device_put(c), nows[i],
+                                with_orders=False)
+        for f in kernel.GROUP_DECISION_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out, f))[i], np.asarray(getattr(ref, f)),
+                err_msg=f"tenant {i}: {f}")
+        # the [N] tail is per-tenant too (reap eligibility at each now)
+        np.testing.assert_array_equal(
+            np.asarray(out.reap_mask)[i], np.asarray(ref.reap_mask))
+
+
+def test_fleet_dirty_indices_shared_bucket():
+    idx = kernel.fleet_dirty_indices(
+        [np.array([1, 0, 1, 0, 0, 0], bool), np.zeros(6, bool)], 6)
+    assert idx.shape == (2, 6)  # widest=2 -> min bucket 8, capped at G=6
+    assert list(idx[0][:2]) == [0, 2] and (idx[0][2:] == 6).all()
+    assert (idx[1] == 6).all()
+
+
+# ---------------------------------------------------------------------------
+# engine: parity + lifecycle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                       max_tenants=4)
+
+
+def test_engine_bootstrap_steady_and_batch_parity(engine):
+    clusters = {f"t{i}": tiny_cluster(10 + i) for i in range(3)}
+    res = engine.step([DecideRequest(t, c, int(NOW))
+                       for t, c in clusters.items()])
+    for (t, c), fd in zip(clusters.items(), res, strict=True):
+        assert fd.tenant_id == t and fd.batch_size == 3
+        assert_column_parity(fd.arrays, c, NOW, msg=f"bootstrap {t}")
+    # steady churn ticks, fresh arrays each tick (engine adopts references)
+    rng = np.random.default_rng(5)
+    for tick in range(1, 4):
+        now = int(NOW) + 60 * tick
+        reqs = []
+        for i, t in enumerate(clusters):
+            c = mutate(tiny_cluster(10 + i), rng)
+            clusters[t] = c
+            reqs.append(DecideRequest(t, c, now))
+        for r, fd in zip(reqs, engine.step(reqs), strict=True):
+            assert_column_parity(fd.arrays, r.cluster, now,
+                                 msg=f"tick {tick} {r.tenant_id}")
+    assert engine.audit() == []
+
+
+def test_engine_ordered_windows_match_standalone(engine):
+    """A draining tenant's ordered follow-up: the selection windows are
+    bit-exact vs its standalone ordered decide (arena padding sorts every
+    invalid lane behind the windows)."""
+    c = tiny_cluster(77)
+    c.nodes.tainted[:4] = True
+    c.nodes.cordoned[:4] = False
+    c.nodes.valid[:8] = True
+    fd = engine.step([DecideRequest("drainer", c, int(NOW))])[0]
+    assert fd.ordered
+    ref = assert_column_parity(fd.arrays, c, NOW, msg="drainer")
+    t_off = np.asarray(ref.tainted_offsets)
+    u_off = np.asarray(ref.untainted_offsets)
+    np.testing.assert_array_equal(
+        np.asarray(fd.arrays.tainted_offsets), t_off)
+    np.testing.assert_array_equal(
+        np.asarray(fd.arrays.untainted_offsets), u_off)
+    for g in range(G):
+        np.testing.assert_array_equal(
+            np.asarray(fd.arrays.untaint_order)[t_off[g]:t_off[g + 1]],
+            np.asarray(ref.untaint_order)[t_off[g]:t_off[g + 1]],
+            err_msg=f"untaint window g={g}")
+        np.testing.assert_array_equal(
+            np.asarray(fd.arrays.scale_down_order)[u_off[g]:u_off[g + 1]],
+            np.asarray(ref.scale_down_order)[u_off[g]:u_off[g + 1]],
+            err_msg=f"scale-down window g={g}")
+    np.testing.assert_array_equal(np.asarray(fd.arrays.reap_mask),
+                                  np.asarray(ref.reap_mask))
+
+
+def test_engine_evict_frees_slot_and_rejects_unknown(engine):
+    before = engine.tenant_count
+    res = engine.step([EvictRequest("t0")])
+    assert isinstance(res[0], EvictAck)
+    assert engine.tenant_count == before - 1
+    res = engine.step([EvictRequest("never-registered")])
+    assert isinstance(res[0], TenantError)
+    # the slot reuses cleanly: a NEW tenant lands on it with full parity
+    c = tiny_cluster(99)
+    fd = engine.step([DecideRequest("t0b", c, int(NOW))])[0]
+    assert_column_parity(fd.arrays, c, NOW, msg="slot reuse")
+    assert engine.audit() == []
+
+
+def test_engine_invalid_request_does_not_poison_batch(engine):
+    good = tiny_cluster(55)
+    res = engine.step([
+        EvictRequest("ghost-tenant"),
+        DecideRequest("survivor", good, int(NOW)),
+    ])
+    assert isinstance(res[0], TenantError)
+    assert_column_parity(res[1].arrays, good, NOW, msg="survivor")
+
+
+def test_engine_grow_and_compact():
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=2)
+    small = {f"s{i}": tiny_cluster(30 + i) for i in range(2)}
+    eng.step([DecideRequest(t, c, int(NOW)) for t, c in small.items()])
+    # tenant-axis growth: a third tenant doubles C
+    c3 = tiny_cluster(40)
+    fd = eng.step([DecideRequest("s2", c3, int(NOW))])[0]
+    assert_column_parity(fd.arrays, c3, NOW, msg="slot growth")
+    assert eng.buckets["tenants"] == 4
+    # lane/group growth: a tenant bigger than every bucket
+    big = representative_cluster(G * 2, P * 4, N * 4, seed=41)
+    fd = eng.step([DecideRequest("big", big, int(NOW))])[0]
+    assert_column_parity(fd.arrays, big, NOW, msg="lane growth")
+    assert eng.buckets["pods"] >= P * 4 and eng.buckets["groups"] >= G * 2
+    # pre-growth tenants keep bit-parity afterwards
+    c0 = mutate(tiny_cluster(30), np.random.default_rng(6))
+    fd = eng.step([DecideRequest("s0", c0, int(NOW) + 60)])[0]
+    assert_column_parity(fd.arrays, c0, int(NOW) + 60, msg="post-growth")
+    assert eng.audit() == []
+    # compact after evictions: slots repack, parity survives
+    eng.step([EvictRequest("s1"), EvictRequest("big")])
+    info = eng.compact()
+    assert info["tenants"] == 2 and info["new_c"] <= info["old_c"]
+    c0b = mutate(c0, np.random.default_rng(7))
+    fd = eng.step([DecideRequest("s0", c0b, int(NOW) + 120)])[0]
+    assert_column_parity(fd.arrays, c0b, int(NOW) + 120, msg="post-compact")
+    assert eng.audit() == []
+
+
+def test_engine_recovers_after_dispatch_failure(monkeypatch):
+    """A failed _fleet_step dispatch (device error after the arenas were
+    donated) must not wedge the engine: the failing batch errors, the
+    arenas rebuild, and every tenant re-bootstraps with full parity on its
+    next decide."""
+    from escalator_tpu.ops import device_state as ds
+
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=2)
+    c = tiny_cluster(21)
+    eng.step([DecideRequest("phoenix", c, int(NOW))])
+    real_step = ds._fleet_step
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device failure")
+
+    monkeypatch.setattr(ds, "_fleet_step", boom)
+    with pytest.raises(RuntimeError, match="injected device failure"):
+        eng.step([DecideRequest("phoenix", mutate(
+            _copy_cluster(c), np.random.default_rng(1)), int(NOW) + 60)])
+    monkeypatch.setattr(ds, "_fleet_step", real_step)
+    c2 = mutate(_copy_cluster(c), np.random.default_rng(2))
+    fd = eng.step([DecideRequest("phoenix", c2, int(NOW) + 120)])[0]
+    assert_column_parity(fd.arrays, c2, int(NOW) + 120, msg="post-failure")
+    assert eng.audit() == []
+
+
+def _copy_cluster(c):
+    return type(c)(groups=_copy_soa(c.groups), pods=_copy_soa(c.pods),
+                   nodes=_copy_soa(c.nodes))
+
+
+def test_evict_retires_per_tenant_histogram_series():
+    from escalator_tpu.observability import histograms
+
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=4, flush_ms=1.0)
+    try:
+        sched.submit("ephemeral", None, 0).result(timeout=10)
+        assert histograms.TICKS.peek("fleet/ephemeral") is not None
+        sched.evict("ephemeral").result(timeout=10)
+        assert histograms.TICKS.peek("fleet/ephemeral") is None
+    finally:
+        sched.shutdown()
+
+
+def test_engine_randomized_multi_tenant_soak():
+    """The acceptance soak: randomized per-tick churn over a live fleet
+    WITH tenant lifecycle churn (add/evict/grow mid-run); every tenant's
+    13 columns bit-identical to its standalone decide on every tick, and
+    the maintained aggregate arenas bit-equal to a recompute at the end."""
+    rng = np.random.default_rng(17)
+    pyrng = random.Random(17)
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=2)
+    world: dict = {}
+    next_id = 0
+    for tick in range(12):
+        now = int(NOW) + 60 * tick
+        reqs = []
+        # lifecycle churn
+        if world and pyrng.random() < 0.25:
+            victim = pyrng.choice(sorted(world))
+            del world[victim]
+            reqs.append(EvictRequest(victim))
+        if len(world) < 5 and pyrng.random() < 0.6:
+            tid = f"soak{next_id}"
+            next_id += 1
+            if pyrng.random() < 0.2:
+                # a tenant 4x the node bucket: forces an arena grow mid-run
+                world[tid] = representative_cluster(
+                    G, P, N * 4, seed=100 + next_id)
+            else:
+                world[tid] = tiny_cluster(100 + next_id)
+        # content churn on every live tenant, fresh arrays per tick
+        for tid in sorted(world):
+            c = world[tid]
+            fresh = type(c)(groups=_copy_soa(c.groups),
+                            pods=_copy_soa(c.pods),
+                            nodes=_copy_soa(c.nodes))
+            world[tid] = mutate(fresh, rng)
+            reqs.append(DecideRequest(tid, world[tid], now))
+        results = eng.step(reqs)
+        for r, res in zip(reqs, results, strict=True):
+            if isinstance(r, EvictRequest):
+                assert isinstance(res, EvictAck)
+            else:
+                assert_column_parity(res.arrays, r.cluster, now,
+                                     msg=f"soak tick {tick} {r.tenant_id}")
+    assert eng.audit() == [], "maintained fleet aggregates diverged"
+
+
+def _copy_soa(soa):
+    from dataclasses import fields
+
+    return type(soa)(**{f.name: np.array(getattr(soa, f.name))
+                        for f in fields(soa)})
+
+
+# ---------------------------------------------------------------------------
+# scheduler semantics (fake engine: admission logic needs no device)
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.batches = []
+        self.tenants = set()
+        self.block = threading.Event()
+        self.block.set()
+
+    @property
+    def tenant_count(self):
+        return len(self.tenants)
+
+    def has_tenant(self, tid):
+        return tid in self.tenants
+
+    def step(self, requests):
+        self.block.wait(timeout=10)
+        self.batches.append([r.tenant_id for r in requests])
+        out = []
+        for r in requests:
+            if isinstance(r, EvictRequest):
+                self.tenants.discard(r.tenant_id)
+                out.append(EvictAck(r.tenant_id))
+            else:
+                self.tenants.add(r.tenant_id)
+                out.append(("decided", r.tenant_id, r.now_sec))
+        return out
+
+
+def test_scheduler_validates_tenant_ids_before_queueing():
+    sched = FleetScheduler(_FakeEngine(), flush_ms=1.0)
+    try:
+        for bad in ("", "x" * 300, None, 7, "bad\x00id"):
+            with pytest.raises(TenantError):
+                sched.submit(bad, None, 0)
+        assert sched.admitted_total == 0 and sched.queue_depth == 0
+    finally:
+        sched.shutdown()
+    assert validate_tenant_id("ok-tenant") == "ok-tenant"
+
+
+def test_scheduler_coalescing_and_oldest_first_fairness():
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=8, flush_ms=20.0, queue_limit=64,
+                           per_tenant_inflight=4)
+    try:
+        sched.pause()
+        futs = [sched.submit(f"c{i}", None, i) for i in range(4)]
+        # two requests from one tenant: the second must ride the NEXT batch
+        futs.append(sched.submit("c0", None, 99))
+        assert sched.oldest_waiting_sec() > 0
+        sched.resume()
+        results = [f.result(timeout=10) for f in futs]
+        assert [r[1] for r in results[:4]] == [f"c{i}" for i in range(4)]
+        assert len(eng.batches) == 2, eng.batches
+        assert eng.batches[0] == ["c0", "c1", "c2", "c3"]  # oldest-first
+        assert eng.batches[1] == ["c0"]                    # the dup, next batch
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_backpressure_and_per_tenant_cap():
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=4, flush_ms=5.0, queue_limit=3,
+                           per_tenant_inflight=1)
+    try:
+        sched.pause()
+        sched.submit("a", None, 0)
+        with pytest.raises(AdmissionError) as ei:
+            sched.submit("a", None, 1)
+        assert ei.value.reason == "tenant-inflight"
+        sched.submit("b", None, 0)
+        sched.submit("c", None, 0)
+        with pytest.raises(AdmissionError) as ei:
+            sched.submit("d", None, 0)
+        assert ei.value.reason == "queue-full"
+        assert ei.value.retry_after_ms > 0
+        assert sched.rejected_total == 2 and sched.admitted_total == 3
+        sched.resume()
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_records_per_tenant_latency_series():
+    from escalator_tpu.observability import histograms
+
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=4, flush_ms=1.0)
+    try:
+        sched.submit("latency-tenant", None, 0).result(timeout=10)
+        h = histograms.TICKS.peek("fleet/latency-tenant")
+        assert h is not None and h.count >= 1
+        # the tenant-labeled root rides the same export as tick roots
+        assert any(key == ("fleet/latency-tenant",)
+                   for key, _ in histograms.TICKS.items())
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_engine_failure_fails_batch_not_process():
+    class _Boom(_FakeEngine):
+        def step(self, requests):
+            raise RuntimeError("device on fire")
+
+    sched = FleetScheduler(_Boom(), flush_ms=1.0)
+    try:
+        fut = sched.submit("t", None, 0)
+        with pytest.raises(RuntimeError, match="device on fire"):
+            fut.result(timeout=10)
+        # the worker survives and serves the next batch
+        ok = FleetScheduler(_FakeEngine(), flush_ms=1.0)
+        try:
+            assert ok.submit("t", None, 0).result(timeout=10)[0] == "decided"
+        finally:
+            ok.shutdown()
+    finally:
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# codec framing
+# ---------------------------------------------------------------------------
+
+
+def test_codec_tenant_sidecar_round_trip():
+    from escalator_tpu.plugin import codec
+
+    c = tiny_cluster(1)
+    frame = codec.encode_cluster(c, int(NOW), tenant={"id": "acme"})
+    cluster, now, _ctx, tenant = codec.decode_cluster_full(frame)
+    assert now == int(NOW) and tenant == {"id": "acme"}
+    np.testing.assert_array_equal(cluster.pods.cpu_milli, c.pods.cpu_milli)
+    # absence decodes as None (mixed-version peer)
+    _c2, _n2, _ctx2, t2 = codec.decode_cluster_full(
+        codec.encode_cluster(c, int(NOW)))
+    assert t2 is None
+    # old decoders (decode_cluster) ignore the sidecar entirely
+    decoded, now2 = codec.decode_cluster(frame)
+    assert now2 == int(NOW)
+    np.testing.assert_array_equal(decoded.nodes.valid, c.nodes.valid)
+
+
+def test_codec_torn_tenant_sidecar_is_present_but_invalid():
+    import numpy as _np
+
+    from escalator_tpu.plugin import codec
+
+    c = tiny_cluster(2)
+    named = [("__now__", _np.array([int(NOW)], _np.int64)),
+             (codec._TENANT_KEY, _np.frombuffer(b"\xc1\xc1\xc1", _np.uint8))]
+    for prefix, section in (("g.", c.groups), ("p.", c.pods),
+                            ("n.", c.nodes)):
+        for f in section.__dataclass_fields__:
+            named.append((prefix + f, getattr(section, f)))
+    _cl, _now, _ctx, tenant = codec.decode_cluster_full(
+        codec._encode_arrays(named))
+    # present-but-torn: the server must see "a tenant was intended" and
+    # reject with INVALID_ARGUMENT, never silently fall back
+    assert tenant == {"id": None}
+
+
+def test_codec_fleet_response_sidecar_round_trip():
+    import jax
+
+    from escalator_tpu.plugin import codec
+
+    c = tiny_cluster(3)
+    out = kernel.decide_jit(jax.device_put(c), NOW)
+    frame = codec.encode_decision(out, fleet={"ordered": False,
+                                              "batch_size": 7})
+    dec, _phases, fleet = codec.decode_decision_full(frame)
+    assert fleet == {"ordered": False, "batch_size": 7}
+    np.testing.assert_array_equal(np.asarray(dec.status),
+                                  np.asarray(out.status))
+    # absent from single-cluster peers
+    _d2, _p2, f2 = codec.decode_decision_full(codec.encode_decision(out))
+    assert f2 is None
+
+
+def test_client_parses_retry_after_trailer():
+    from escalator_tpu.plugin.client import _rpc_retry_after_sec
+
+    class _Err:
+        def trailing_metadata(self):
+            return (("escalator-retry-after-ms", "250"),)
+
+    class _NoMd:
+        pass
+
+    class _Torn:
+        def trailing_metadata(self):
+            return (("escalator-retry-after-ms", "not-a-number"),)
+
+    assert _rpc_retry_after_sec(_Err()) == pytest.approx(0.25)
+    assert _rpc_retry_after_sec(_NoMd()) is None
+    assert _rpc_retry_after_sec(_Torn()) is None
+
+
+# ---------------------------------------------------------------------------
+# gRPC fleet mode end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_plugin():
+    from escalator_tpu.plugin.client import ComputeClient
+    from escalator_tpu.plugin.server import FleetConfig, make_server
+
+    server = make_server("127.0.0.1:0", max_workers=8, fleet=FleetConfig(
+        num_groups=G, pod_capacity=P, node_capacity=N, max_tenants=8,
+        max_batch=8, flush_ms=10.0, queue_limit=4, per_tenant_inflight=1))
+    server.start()
+    client = ComputeClient(f"127.0.0.1:{server._escalator_bound_port}",
+                           timeout_sec=180.0)
+    # warm the fleet-step jit so per-test RPCs stay fast
+    client.decide_arrays_fleet(tiny_cluster(0), int(NOW), "warm")
+    yield server, client
+    client.close()
+    server.stop(grace=None)
+
+
+def test_grpc_fleet_concurrent_tenants_coalesce_with_parity(fleet_plugin):
+    _server, client = fleet_plugin
+    clusters = {f"g{i}": tiny_cluster(60 + i) for i in range(4)}
+    results = {}
+    lock = threading.Lock()
+
+    def one(tid, c):
+        out, _phases, meta = client.decide_arrays_fleet(c, int(NOW), tid)
+        with lock:
+            results[tid] = (out, meta)
+
+    threads = [threading.Thread(target=one, args=item)
+               for item in clusters.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    batch_sizes = set()
+    for tid, c in clusters.items():
+        out, meta = results[tid]
+        assert_column_parity(out, c, NOW, msg=tid)
+        assert meta["tenant"] == tid
+        batch_sizes.add(meta["batch_size"])
+    # coalescing observed: at least one multi-tenant micro-batch
+    assert max(batch_sizes) >= 2, batch_sizes
+
+
+def test_grpc_fleet_mixed_version_byte_identity(fleet_plugin):
+    """Both mixed-version directions: an untagged frame on a fleet server
+    and a tenant-tagged frame on a fleet-less server each produce the
+    byte-identical single-cluster response (span recording off — the span
+    sidecar carries per-call timings by design)."""
+    from escalator_tpu import observability as obs
+    from escalator_tpu.plugin import codec
+    from escalator_tpu.plugin.client import ComputeClient
+    from escalator_tpu.plugin.server import make_server
+
+    _server, client = fleet_plugin
+    plain = make_server("127.0.0.1:0")
+    plain.start()
+    plain_client = ComputeClient(
+        f"127.0.0.1:{plain._escalator_bound_port}", timeout_sec=180.0)
+    try:
+        c = tiny_cluster(42)
+        untagged = codec.encode_cluster(c, int(NOW))
+        tagged = codec.encode_cluster(c, int(NOW), tenant={"id": "mixed"})
+        obs.set_enabled(False)
+        try:
+            r_plain = plain_client._decide(untagged, timeout=120)
+            assert client._decide(untagged, timeout=120) == r_plain
+            assert plain_client._decide(tagged, timeout=120) == r_plain
+        finally:
+            obs.set_enabled(True)
+    finally:
+        plain_client.close()
+        plain.stop(grace=None)
+
+
+def test_grpc_fleet_malformed_tenant_is_invalid_argument(fleet_plugin):
+    import grpc
+
+    from escalator_tpu.plugin import codec
+
+    _server, client = fleet_plugin
+    for bad in ("", "x" * 300, 7):
+        frame = codec.encode_cluster(tiny_cluster(1), int(NOW),
+                                     tenant={"id": bad})
+        with pytest.raises(grpc.RpcError) as ei:
+            client._decide(frame, timeout=60)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    with pytest.raises(grpc.RpcError) as ei:
+        client.evict_tenant("never-was-here")
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    # the batch was not poisoned: the next decide serves with full parity
+    c = tiny_cluster(2)
+    out, _p, meta = client.decide_arrays_fleet(c, int(NOW) + 60, "after-bad")
+    assert_column_parity(out, c, int(NOW) + 60, msg="after-bad")
+    assert meta["tenant"] == "after-bad"
+
+
+def test_grpc_fleet_backpressure_resource_exhausted_with_retry_after(
+        fleet_plugin):
+    import grpc
+
+    server, client = fleet_plugin
+    sched = server._escalator_service.fleet
+    sched.pause()
+    outcomes = []
+    lock = threading.Lock()
+
+    def flood(i):
+        try:
+            client.decide_arrays_fleet(tiny_cluster(80 + i), int(NOW),
+                                       f"flood{i}", max_attempts=1)
+            with lock:
+                outcomes.append("ok")
+        except grpc.RpcError as e:
+            md = dict(e.trailing_metadata() or ())
+            with lock:
+                outcomes.append(
+                    (e.code().name, md.get("escalator-retry-after-ms")))
+
+    threads = [threading.Thread(target=flood, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)   # all six RPCs queued/rejected against the paused worker
+    sched.resume()
+    for t in threads:
+        t.join()
+    rejected = [o for o in outcomes if o != "ok"]
+    assert outcomes.count("ok") == 4 and len(rejected) == 2, outcomes
+    for code, retry_after in rejected:
+        assert code == "RESOURCE_EXHAUSTED"
+        assert retry_after is not None and float(retry_after) > 0
+
+
+def test_grpc_fleet_health_fields_and_evict(fleet_plugin):
+    _server, client = fleet_plugin
+    h = client.health()
+    fleet = h["fleet"]
+    assert fleet["tenants"] >= 1
+    assert {"queue_depth", "admitted_total", "rejected_total",
+            "oldest_waiting_sec", "batches", "buckets"} <= set(fleet)
+    assert fleet["admitted_total"] > fleet["queue_depth"]
+    ack = client.evict_tenant("warm")
+    assert ack == {"evicted": "warm"}
+    h2 = client.health()
+    assert h2["fleet"]["tenants"] == fleet["tenants"] - 1
+
+
+def test_grpc_backend_fleet_tenant_mode(fleet_plugin):
+    """GrpcBackend(tenant_id=…): a full controller-backend decide rides the
+    fleet path and honors the lazy-orders flag from the response sidecar."""
+    from escalator_tpu.core import semantics as sem
+    from escalator_tpu.plugin.client import GrpcBackend
+    from escalator_tpu.testsupport.builders import (
+        NodeOpts,
+        PodOpts,
+        build_test_nodes,
+        build_test_pods,
+    )
+
+    server, _client = fleet_plugin
+    backend = GrpcBackend(
+        f"127.0.0.1:{server._escalator_bound_port}", timeout_sec=180.0,
+        tenant_id="controller-a")
+    pods = build_test_pods(4, PodOpts(cpu=[500], mem=[10**8]))
+    nodes = build_test_nodes(2, NodeOpts(cpu=1000, mem=4 * 10**9))
+    cfg = sem.GroupConfig(
+        min_nodes=0, max_nodes=100, taint_lower_percent=30,
+        taint_upper_percent=45, scale_up_percent=70, slow_removal_rate=1,
+        fast_removal_rate=2)
+    out = backend.decide([(pods, nodes, cfg, sem.GroupState())], int(NOW))
+    assert out[0].decision.status == sem.DecisionStatus.OK
+    assert out[0].decision.nodes_delta == 1   # 2000/2000=100% -> ceil(2*30/70)
+    assert server._escalator_service.fleet.engine.has_tenant("controller-a")

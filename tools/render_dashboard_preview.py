@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 from escalator_tpu.observability.histograms import LogHistogram  # noqa: E402
 
-W, H = 1180, 1800
+W = 1180   # canvas height is derived from the panel grid in main()
 PANEL_W, PANEL_H = 560, 270
 PAD = 20
 PLOT_L, PLOT_T, PLOT_R, PLOT_B = 46, 34, 10, 52
@@ -163,6 +163,35 @@ def latency_cycle(ticks_per_window=30, window=3):
     return p99, dumps
 
 
+def fleet_cycle():
+    """Synthetic fleet-service series for the round-14 panel: micro-batch
+    size p50/p99 tracking the traffic bursts (coalescing deepens under
+    load), a slowly-ramping resident-tenant count with occasional
+    mass-eviction dips, and admission rejects that appear only when a
+    burst saturates the bounded queue. Batch sizes are plain order
+    statistics (they are counts, not latencies — the log-bucket engine's
+    1 µs..10 s domain is for the latency panels)."""
+    rnd = random.Random(21)
+    p50, p99, tenants, rejects = [], [], [], []
+    tcount = 120.0
+    for i in range(T):
+        b = _burst(i)
+        lam = 2.0 + 60.0 * b
+        samples = sorted(
+            max(1, min(128, int(rnd.gauss(lam, lam * 0.35 + 0.5))))
+            for _ in range(40))
+        p50.append(float(samples[len(samples) // 2]))
+        p99.append(float(samples[min(len(samples) - 1,
+                                     int(len(samples) * 0.99))]))
+        tcount = min(1000.0, tcount * 1.02)
+        if rnd.random() < 0.03:
+            tcount *= 0.85          # a mass eviction + compact
+        tenants.append(tcount)
+        rejects.append(
+            max(0.0, rnd.gauss((b - 0.65) * 60, 2.0)) if b > 0.65 else 0.0)
+    return p50, p99, tenants, rejects
+
+
 def nice_ticks(lo, hi, n=4):
     if hi <= lo:
         hi = lo + 1
@@ -272,6 +301,7 @@ def timeseries_panel(x, y, title, series, unit="", labels=()):
 def main():
     s = cycle()
     p99, tail_dumps = latency_cycle()
+    fleet_p50, fleet_p99, fleet_tenants, fleet_rejects = fleet_cycle()
     panels, grid = [], [
         ("Node counts by state",
          [(s["nodes"], S1, "total"), (s["untainted"], S2, "untainted"),
@@ -310,16 +340,25 @@ def main():
         ("Tail: e2e p99 / tail dumps",
          [(p99["e2e"], S1, "e2e tick p99 (s)"),
           (tail_dumps, S2, "tail dumps (window)")], "", ()),
+        # round 14: the fleet continuous-batching panel — batch-size
+        # quantiles, resident tenants, admission rejects (see fleet_cycle)
+        ("Fleet: batch size / tenants / rejects",
+         [(fleet_p50, S1, "batch p50"), (fleet_p99, S2, "batch p99"),
+          (fleet_tenants, S3, "tenants"),
+          (fleet_rejects, S4, "rejects (window)")], "", (2,)),
     ]
     for i, (title, series, unit, labels) in enumerate(grid):
         x = PAD + (i % 2) * (PANEL_W + PAD)
         y = 46 + (i // 2) * (PANEL_H + PAD)
         panels.append(timeseries_panel(x, y, title, series, unit, labels))
+    rows = (len(grid) + 1) // 2
+    height = 46 + rows * (PANEL_H + PAD) + PAD
 
     svg = "\n".join([
-        f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" height="{H}" '
-        f'viewBox="0 0 {W} {H}" font-family="system-ui, sans-serif">',
-        f'<rect width="{W}" height="{H}" fill="#f5f4f2"/>',
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+        f'height="{height}" '
+        f'viewBox="0 0 {W} {height}" font-family="system-ui, sans-serif">',
+        f'<rect width="{W}" height="{height}" fill="#f5f4f2"/>',
         f'<text x="{PAD}" y="30" fill="{INK}" font-size="17" '
         'font-weight="700">escalator-tpu dashboard preview '
         '(synthetic scale cycle)</text>',
