@@ -828,6 +828,14 @@ def _cfg14_incremental_vs_full(rng, now, device, detail: dict,
             rows["observability_overhead"] = _observability_overhead(
                 store, cache, inc, now, P, G, cpu_m,
                 iters=10 if degraded else 20)
+        # round 15: per-cfg HBM truth — what this shape's owners actually
+        # hold on device vs their executable budgets, per sweep row
+        from escalator_tpu.observability import resources as _res
+
+        rows["resource_owners"] = {
+            name: {"nbytes": r["nbytes"], "budget_bytes": r["budget_bytes"]}
+            for name, r in _res.RESOURCES.snapshot().items()
+            if r.get("kind") == "device" and r["nbytes"]}
         cfg14[label] = rows
         del inc, cache, store, pods_v, nodes_v
     detail["cfg14_incremental_vs_full"] = cfg14
@@ -1411,6 +1419,13 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
                 "fleet_step"),
             "ordered_redispatches": engine.ordered_redispatches,
         }
+        # round 15: the arenas' measured HBM vs the docs/fleet.md formula
+        from escalator_tpu.observability import resources as _res
+
+        arena_row = _res.RESOURCES.snapshot().get("fleet_arenas")
+        if arena_row:
+            fleet_row["arena_bytes"] = arena_row["nbytes"]
+            fleet_row["arena_budget_bytes"] = arena_row["budget_bytes"]
         detail["cfg17_fleet"] = fleet_row
         detail["cfg17_fleet_decisions_per_sec"] = (
             fleet_row["decisions_per_sec"])
@@ -1608,6 +1623,17 @@ def _memory_envelope(device, detail: dict) -> None:
         "max_pods_per_chip_4x_intermediates": int(
             hbm / (4 * pod_b + 0.1 * 4 * node_b)),
     }
+    # round 15: the envelope's per-owner half is now EXECUTABLE — the
+    # resource registry reports what each owner of persistent device state
+    # actually holds (and its declared formula budget) at capture time,
+    # next to the hand model above
+    try:
+        from escalator_tpu.observability import resources as _res
+
+        detail["device_resource_owners"] = _res.RESOURCES.snapshot()
+        detail["device_memory_capabilities"] = _res.capabilities()
+    except Exception as e:  # noqa: BLE001 - reporting must not kill a capture
+        detail["device_resource_owners_error"] = str(e)
 
 
 def _cfg9_pallas_matrix(detail, headline_cluster, host_headline,
@@ -1874,7 +1900,12 @@ def _summarize_tpu_captures() -> list:
 
     rows = []
     here = os.path.dirname(os.path.abspath(__file__))
-    paths = sorted(glob.glob(os.path.join(here, "TPU_BENCH_*.json")))
+    # round 15 hygiene: campaign captures live under tpu_traces/ now (the
+    # repo-root glob stays for any stray capture from an older campaign
+    # script still running against this checkout)
+    paths = sorted(glob.glob(os.path.join(here, "TPU_BENCH_*.json"))
+                   + glob.glob(os.path.join(here, "tpu_traces",
+                                            "TPU_BENCH_*.json")))
     paths += sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
     for path in paths:
         # CAPTURE.json is the campaign's copy of the last good capture, not an
@@ -1938,7 +1969,9 @@ def _summarize_tpu_partials() -> list:
 
     here = os.path.dirname(os.path.abspath(__file__))
     rows = []
-    for path in sorted(glob.glob(os.path.join(here, "TPU_PARTIAL_*.json"))):
+    for path in sorted(glob.glob(os.path.join(here, "TPU_PARTIAL_*.json"))
+                       + glob.glob(os.path.join(here, "tpu_traces",
+                                                "TPU_PARTIAL_*.json"))):
         try:
             with open(path) as f:
                 data = json.load(f)
@@ -2229,6 +2262,18 @@ def run_smoke() -> dict:
     now = np.int64(1_700_000_000)
     out = {"smoke": True}
 
+    # per-leg wall-clock accounting (round 15): the smoke has grown to ~10
+    # legs inside the tier-1 budget — the table below names which leg a
+    # runtime regression lives in, prints at the end, and persists into the
+    # smoke JSON artifacts so CI runs are comparable
+    leg_seconds: dict = {}
+    _leg_t0 = [time.perf_counter()]
+
+    def _leg(name: str) -> None:
+        t = time.perf_counter()
+        leg_seconds[name] = round(t - _leg_t0[0], 3)
+        _leg_t0[0] = t
+
     # ---- cfg8 path: podaxis ordered decider w/ sharded tail vs single ----
     G, P, N = 8, 512, 96
     cluster = _rng_cluster_arrays(rng, G, P, N, mixed=True, tainted_frac=0.25,
@@ -2260,6 +2305,7 @@ def run_smoke() -> dict:
             np.asarray(sharded.untaint_order)[t_off[g]:t_off[g + 1]],
             err_msg=f"cfg8 smoke: untaint window g={g}")
     out["smoke_cfg8_parity"] = "ok"
+    _leg("cfg8_order_tail")
 
     # ---- cfg10 path: blocked FFD (both scan programs) vs the golden model --
     for label, n_shapes in (("replicaset", 2), ("mixed", 0)):
@@ -2304,6 +2350,7 @@ def run_smoke() -> dict:
     # the prepass must have exercised BOTH scan programs
     assert out["smoke_cfg10_replicaset_path"] == "runs"
     assert out["smoke_cfg10_mixed_path"] == "pods"
+    _leg("cfg10_ffd")
 
     # ---- cfg14 path: incremental delta decide vs full recompute ----------
     # A compact multi-tick run of the round-8 incremental stack (native
@@ -2384,6 +2431,7 @@ def run_smoke() -> dict:
     out["smoke_cfg14_parity"] = "ok"
     out["smoke_cfg14_dirty_counts"] = dirty_counts
     out["smoke_order_paths"] = dict(inc.order_stats)
+    _leg("cfg14_incremental")
 
     # ---- replay smoke (round 11): snapshot -> record -> dump -> debug-replay
     # The failover/replay acceptance loop at smoke scale, driven through the
@@ -2452,6 +2500,7 @@ def run_smoke() -> dict:
         out["replay_smoke_report"] = replay_artifact
     except OSError:   # read-only checkout: the in-memory asserts still ran
         out["replay_smoke_report"] = "(write failed)"
+    _leg("replay")
 
     # ---- streaming ingestion smoke (round 12): event-driven vs re-list ---
     # The tentpole's parity contract at smoke scale, through the REAL event
@@ -2614,6 +2663,7 @@ def run_smoke() -> dict:
         out["host_phases_report"] = host_phase_path
     except OSError:   # read-only checkout: the in-memory asserts still ran
         out["host_phases_report"] = "(write failed)"
+    _leg("streaming")
 
     # ---- flight recorder: populated, named phases, bounded overhead ------
     # The 6 incremental ticks above ran through the instrumented
@@ -2659,6 +2709,7 @@ def run_smoke() -> dict:
         f"{ovh['enabled_min_ms']:.3f} / disabled min "
         f"{ovh['disabled_min_ms']:.3f}) — instrumentation grew a real cost")
     out["smoke_observability_overhead_ms"] = ovh["overhead_ms"]
+    _leg("recorder_overhead")
 
     # ---- tail-latency smoke (round 13): histogram accuracy, tail-capture
     # fire path, trace-export round-trip — the ISSUE-8 acceptance loop at
@@ -2863,6 +2914,7 @@ def run_smoke() -> dict:
     except OSError:   # read-only checkout: the in-memory asserts still ran
         out["tail_smoke_report"] = "(write failed)"
     shutil.rmtree(tail_dir, ignore_errors=True)
+    _leg("tail_trace")
 
     # ---- fleet smoke (round 14): C=8 tenants through the REAL gRPC fleet
     # server — coalescing observed, per-tenant 13-column digests equal the
@@ -3008,10 +3060,13 @@ def run_smoke() -> dict:
         out["fleet_smoke_report"] = fleet_artifact
     except OSError:   # read-only checkout: the in-memory asserts still ran
         out["fleet_smoke_report"] = "(write failed)"
+    _leg("fleet")
 
-    # dump the ring alongside the smoke JSON: CI uploads it as an artifact
-    # next to the jaxlint report, so every PR run carries an inspectable
-    # flight record of the smoke ticks
+    # dump the ring BEFORE the resources leg below: that leg's profiler
+    # pump serves a few hundred plugin decides (each a root record), which
+    # would flush the streaming/incremental smoke ticks out of the
+    # 256-deep ring — and the committed FLIGHT_SMOKE artifact must carry
+    # exactly those ticks' phase taxonomy
     dump_path = os.environ.get(
         "ESCALATOR_TPU_FLIGHT_DUMP",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -3021,6 +3076,211 @@ def run_smoke() -> dict:
         out["flight_recorder_dump"] = RECORDER.dump(dump_path, reason="smoke")
     except OSError:   # read-only checkout: the in-memory asserts still ran
         out["flight_recorder_dump"] = "(write failed)"
+
+    # ---- device resource observatory smoke (round 15): per-owner budgets,
+    # forced-leak watchdog fire, compile-ring attribution, and a
+    # debug-profile round-trip through the REAL plugin RPC — written to
+    # MEMORY_SMOKE_LATEST.json for CI upload.
+    import threading as _rthreading
+
+    from escalator_tpu.observability import jaxmon as jaxmonmod
+    from escalator_tpu.observability import resources as resmod
+    from escalator_tpu.observability import spans as _rspans
+
+    memory_report: dict = {"smoke": True}
+
+    # (a) per-owner device-buffer budgets: the cfg14 decider + cache above
+    # are live, so every persistent-state owner must be registered with
+    # measured bytes EXACTLY equal to its executable envelope formula (the
+    # docs' hand-computed HBM numbers, now asserted instead of maintained)
+    owners = resmod.RESOURCES.snapshot()
+    for need in ("cluster_arrays", "group_aggregates", "decision_columns",
+                 "order_state"):
+        assert need in owners, (need, sorted(owners))
+        row = owners[need]
+        assert row["nbytes"] > 0, (need, row)
+        assert row["budget_bytes"] is not None, (need, row)
+        assert row["nbytes"] == row["budget_bytes"], (
+            f"resource owner {need}: measured {row['nbytes']} B != declared "
+            f"budget {row['budget_bytes']} B — the executable envelope and "
+            f"the implementation diverged")
+    # fleet arenas register too (the fleet smoke's engine is still live);
+    # its budget is the docs/fleet.md capacity-envelope formula
+    if fleet_mode == "grpc":
+        row = owners.get("fleet_arenas")
+        assert row and row["nbytes"] == row["budget_bytes"] > 0, row
+    # the formulas are the docs' envelopes, independently of the budget
+    # closures: ONE cfg14-cache instance costs exactly this many bytes
+    # (other smoke legs' caches may still be alive, so the owner total is
+    # a multiple of per-instance expectations — recorded, not asserted)
+    memory_report["expected_cfg14_cluster_bytes"] = (
+        resmod.expected_cluster_bytes(cache.pod_capacity,
+                                      cache.node_capacity, Gi))
+    memory_report["owners"] = owners
+    memory_report["capabilities"] = resmod.capabilities()
+    memory_report["device_memory"] = resmod.device_memory()
+    memory_report["live_arrays"] = resmod.live_arrays_bytes()
+    # degrade contract: every capability surface either works or names why
+    for surface in ("device_memory", "live_arrays"):
+        v = memory_report[surface]
+        assert isinstance(v, dict) and v, (surface, v)
+    out["smoke_resource_budgets"] = "ok"
+
+    # (b) forced leak -> memory watchdog dump: a test-injected owner that
+    # grows every tick must fire the growth watchdog's reason="memory"
+    # flight dump (rate-limited like the tail watchdog)
+    import tempfile as _rtempfile
+
+    leak_dir = _rtempfile.mkdtemp(prefix="escalator-memory-smoke-")
+    saved_env = {k: os.environ.get(k) for k in (
+        "ESCALATOR_TPU_MEMORY_WATCH", "ESCALATOR_TPU_MEMORY_MIN_GROWTH",
+        "ESCALATOR_TPU_MEMORY_DUMP_INTERVAL_SEC",
+        "ESCALATOR_TPU_MEMORY_SAMPLE_EVERY", "ESCALATOR_TPU_DUMP_DIR")}
+    os.environ["ESCALATOR_TPU_MEMORY_WATCH"] = "8"
+    os.environ["ESCALATOR_TPU_MEMORY_MIN_GROWTH"] = "1000"
+    os.environ["ESCALATOR_TPU_MEMORY_DUMP_INTERVAL_SEC"] = "0"
+    os.environ["ESCALATOR_TPU_MEMORY_SAMPLE_EVERY"] = "1"
+    os.environ["ESCALATOR_TPU_DUMP_DIR"] = leak_dir
+
+    class _LeakyOwner:
+        def __init__(self):
+            self.arrays = []
+
+    leaky = _LeakyOwner()
+    leak_reg = resmod.RESOURCES.register(
+        "smoke_injected_leak", leaky, lambda o: o.arrays)
+    resmod.MEMORY_WATCHDOG.reset()
+    try:
+        for _ in range(10):
+            leaky.arrays.append(np.zeros(512, np.int64))
+            with _rspans.span("memory_smoke_tick"):
+                _rspans.annotate(backend="memory-smoke")
+        resmod.MEMORY_WATCHDOG.drain()
+        import glob as _rglob
+
+        leak_dumps = _rglob.glob(os.path.join(
+            leak_dir, "escalator-tpu-flight-memory-*.json"))
+        assert leak_dumps, "forced leak did not fire the memory watchdog"
+        with open(leak_dumps[0]) as f:
+            leak_doc = json.load(f)
+        assert leak_doc["reason"] == "memory"
+        wd = leak_doc["memory_watchdog"]
+        assert wd["growth_bytes"] >= 1000 and wd["rising_steps"] >= 4, wd
+        assert wd["owners"].get("smoke_injected_leak", 0) > 0, wd
+        # every dump (this one included) carries the memory section
+        assert leak_doc["memory"]["owners"], leak_doc["memory"]
+        memory_report["forced_leak"] = {
+            "growth_bytes": wd["growth_bytes"],
+            "window_ticks": wd["window_ticks"],
+            "dump": os.path.basename(leak_dumps[0]),
+        }
+    finally:
+        leak_reg.close()
+        resmod.MEMORY_WATCHDOG.reset()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        import shutil as _rshutil
+
+        _rshutil.rmtree(leak_dir, ignore_errors=True)
+    out["smoke_memory_watchdog"] = "ok"
+
+    # (c) compile observatory: the smoke's own compiles rode the ring with
+    # span-path attribution — the cfg14 delta program must be named
+    ring = jaxmonmod.compile_ring()
+    assert ring, "compile ring empty after a smoke run full of compiles"
+    attributed = jaxmonmod.attribute_compiles(ring)
+    # the ring is bounded, so assert on the program families the most
+    # recent legs certainly compiled rather than one specific early entry
+    known = {"kernel.decide", "kernel.delta_decide",
+             "kernel.ordered_delta_decide", "device_state.fleet_step",
+             "device_state.scatter_update_aggs"}
+    assert any(r.get("entry") in known for r in attributed), (
+        [r["key"] for r in attributed])
+    memory_report["compile_ring_depth"] = len(ring)
+    memory_report["compile_attribution"] = [
+        {k: r[k] for k in ("key", "count", "total_sec")} for r in attributed]
+    out["smoke_compile_attribution"] = "ok"
+
+    # (d) debug-profile round-trip through the REAL plugin RPC: a Profile
+    # capture of 2 served decides ships TensorBoard/XPlane files back over
+    # the wire and the CLI verb writes them locally
+    if fleet_mode == "grpc":
+        psrv = make_server("127.0.0.1:0", max_workers=8)
+        psrv.start()
+        paddr = f"127.0.0.1:{psrv._escalator_bound_port}"
+        pclient = _FC(paddr, timeout_sec=60.0)
+        prof_dir = _rtempfile.mkdtemp(prefix="escalator-profile-smoke-")
+        try:
+            # the fleet leg already compiled the single-cluster decide at
+            # (6, 24, 12) in this process — reuse the shape so this leg
+            # prices the profiler round-trip, not a fresh jit compile
+            pc = representative_cluster(6, 24, 12, seed=1234)
+            pclient.decide_arrays(pc, int(now))   # warm the server path
+            from escalator_tpu.cli import main as _cli_main
+
+            cli_rc: list = []
+
+            def _run_profile_cli():
+                cli_rc.append(_cli_main([
+                    "debug-profile", "--plugin-address", paddr,
+                    "--ticks", "2", "--output", prof_dir,
+                    "--timeout", "60"]))
+
+            pt = _rthreading.Thread(target=_run_profile_cli)
+            pt.start()
+            deadline = time.monotonic() + 90
+            while pt.is_alive() and time.monotonic() < deadline:
+                # keep decides flowing until the capture window closes (the
+                # profiler's first start_trace can take a moment, so a
+                # fixed count could all land before the trace arms)
+                pclient.decide_arrays(pc, int(now))
+                time.sleep(0.05)
+            pt.join(10)
+            assert cli_rc and cli_rc[0] == 0, f"debug-profile rc={cli_rc}"
+            prof_files = resmod.trace_files(prof_dir)
+            assert any(f.endswith(".xplane.pb") for f in prof_files), (
+                prof_files)
+            memory_report["profile_rpc"] = {
+                "files": prof_files,
+                "bytes": sum(os.path.getsize(os.path.join(prof_dir, f))
+                             for f in prof_files),
+            }
+            # the plugin health probe now carries the memory section too
+            ph = pclient.health()
+            assert "memory" in ph and "owners" in ph["memory"], ph.keys()
+            out["smoke_profile_rpc"] = "ok"
+        finally:
+            pclient.close()
+            psrv.stop(grace=None)
+            import shutil as _rshutil
+
+            _rshutil.rmtree(prof_dir, ignore_errors=True)
+    else:
+        out["smoke_profile_rpc"] = fleet_mode   # skipped (grpc unavailable)
+    _leg("resources")
+
+    # per-leg duration table (round 15 satellite): printed for humans,
+    # persisted in the smoke JSON artifacts for CI comparison
+    memory_report["leg_seconds"] = leg_seconds
+    out["smoke_leg_seconds"] = leg_seconds
+    print("smoke leg durations (s):", file=sys.stderr)
+    for name, sec in leg_seconds.items():
+        print(f"  {name:>20}: {sec:8.3f}", file=sys.stderr)
+    memory_artifact = os.environ.get(
+        "ESCALATOR_TPU_MEMORY_SMOKE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "MEMORY_SMOKE_LATEST.json"),
+    )
+    try:
+        with open(memory_artifact, "w") as f:
+            json.dump(_round_floats(memory_report), f, indent=1)
+            f.write("\n")
+        out["memory_smoke_report"] = memory_artifact
+    except OSError:   # read-only checkout: the in-memory asserts still ran
+        out["memory_smoke_report"] = "(write failed)"
     return out
 
 

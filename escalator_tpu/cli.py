@@ -214,6 +214,140 @@ def debug_replay_main(argv: List[str]) -> int:
     return 0
 
 
+def debug_compiles_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-compiles``: the compile observatory's operator
+    end — print the recent-compile ring from a flight dump (or a live
+    plugin via the ``Dump`` RPC), grouped by attributed jaxlint registry
+    entry, with each entry's retrace pin and a BUST flag where the observed
+    count exceeds it. A warm steady-state process shows an empty ring; a
+    populated one names which entry retraced, under which tick phase —
+    the runtime answer to "what is the device compiling and why".
+    Exit status: 0 on success, 2 when the dump cannot be read/fetched."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-compiles",
+        description="attribute recent XLA compiles against the jaxlint "
+                    "retrace pins",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--dump",
+                     help="flight-recorder dump JSON (debug-dump output or"
+                          " an incident/tail dump)")
+    src.add_argument("--plugin-address",
+                     help="fetch the live ring from a running compute"
+                          " plugin instead of a file")
+    p.add_argument("--json", action="store_true",
+                   help="emit the attribution rows as JSON instead of text")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    from escalator_tpu.observability import jaxmon
+
+    if args.dump:
+        try:
+            with open(args.dump) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read dump: {e}", file=sys.stderr)
+            return 2
+    else:
+        from escalator_tpu.plugin.client import ComputeClient
+
+        client = ComputeClient(args.plugin_address, timeout_sec=args.timeout)
+        try:
+            doc = client.dump()
+        except Exception as e:  # noqa: BLE001 - any transport failure: exit 2
+            print(f"cannot fetch dump from {args.plugin_address}: {e}",
+                  file=sys.stderr)
+            return 2
+        finally:
+            client.close()
+    ring = doc.get("compiles") or []
+    rows = jaxmon.attribute_compiles(ring)
+    mon = doc.get("jaxmon") or {}
+    if args.json:
+        print(json.dumps({"jaxmon": mon, "attribution": rows,
+                          "ring": ring}, indent=1))
+        return 0
+    print(f"compiles (lifetime): {int(mon.get('compile_events', 0))} "
+          f"({mon.get('compile_seconds', 0.0):.3f}s); "
+          f"ring holds {len(ring)} recent")
+    if not rows:
+        print("ring empty — no recent compiles (warm steady state)")
+        return 0
+    for row in rows:
+        pin = row.get("retrace_budget")
+        flag = " BUST" if row.get("bust") else ""
+        pin_txt = f" pin={pin}{flag}" if pin is not None else ""
+        print(f"- {row['key']}: {row['count']} compile(s), "
+              f"{row['total_sec']:.3f}s{pin_txt}")
+        for path in row["paths"]:
+            print(f"    under: {path}")
+    return 0
+
+
+def debug_profile_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-profile``: capture a jax profiler trace of a
+    running compute plugin's next K decides (the ``Profile`` RPC) and
+    write the TensorBoard/XPlane artifact locally — the profiler-native
+    sibling of ``debug-trace``'s Perfetto export, and the way ROADMAP item
+    3's TPU campaign gets an on-chip profile of the programs it times.
+    Load the output with ``tensorboard --logdir <output>`` (or drop the
+    ``.trace.json.gz`` into Perfetto). Exit status: 0 on success, 2 when
+    the capture cannot run (unreachable/pre-round-15 plugin, platform
+    without the profiler)."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-profile",
+        description="capture a jax profiler trace of a running plugin's "
+                    "next K ticks",
+    )
+    p.add_argument("--plugin-address", default="127.0.0.1:50551",
+                   help="compute plugin address (same as --plugin-address"
+                        " on the controller)")
+    p.add_argument("--ticks", type=int, default=4,
+                   help="root ticks to wrap the trace around")
+    p.add_argument("--output", default="escalator-tpu-profile",
+                   help="directory for the trace files (created)")
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="capture window bound in seconds — on expiry the "
+                        "partial trace still ships")
+    args = p.parse_args(argv)
+    from escalator_tpu.plugin.client import ComputeClient
+
+    client = ComputeClient(args.plugin_address, timeout_sec=10.0)
+    try:
+        res = client.profile(ticks=args.ticks, timeout_sec=args.timeout)
+    except Exception as e:  # noqa: BLE001 - transport/UNIMPLEMENTED: exit 2
+        print(f"cannot profile {args.plugin_address}: {e}", file=sys.stderr)
+        return 2
+    finally:
+        client.close()
+    if not res.get("ok"):
+        reason = (res.get("unsupported") or
+                  ("a capture is already in flight" if res.get("busy")
+                   else "unknown"))
+        print(f"profiler capture unavailable: {reason}", file=sys.stderr)
+        return 2
+    files = res.get("files") or {}
+    out_root = os.path.abspath(args.output)
+    for rel, blob in files.items():
+        # the server controls these names: confine every write to the
+        # output directory (a hostile peer sending "../../..." paths must
+        # not overwrite operator files)
+        path = os.path.abspath(os.path.join(out_root, rel))
+        if not path.startswith(out_root + os.sep):
+            print(f"skipping unsafe path from server: {rel!r}",
+                  file=sys.stderr)
+            continue
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(blob)
+    note = " (timed out: partial capture)" if res.get("timed_out") else ""
+    print(f"profiler trace: {res.get('ticks_captured', 0)} tick(s), "
+          f"{len(files)} file(s), {res.get('total_bytes', 0)} bytes -> "
+          f"{args.output}{note}")
+    print(f"view with: tensorboard --logdir {args.output}")
+    return 0 if files else 2
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="escalator-tpu",
@@ -416,6 +550,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return debug_trace_main(argv[1:])
     if argv and argv[0] == "debug-replay":
         return debug_replay_main(argv[1:])
+    if argv and argv[0] == "debug-compiles":
+        return debug_compiles_main(argv[1:])
+    if argv and argv[0] == "debug-profile":
+        return debug_profile_main(argv[1:])
     args = build_parser().parse_args(argv)
     setup_logging(args.loglevel, args.logfmt)
 

@@ -279,12 +279,21 @@ class FleetEngine:
     def _init_state(self) -> None:
         import jax
 
+        from escalator_tpu.observability import resources
         from escalator_tpu.ops import device_state as _ds  # noqa: F401
         # (importing device_state registers the SoA dataclasses as pytrees
         # — device_put on PodArrays/NodeArrays/GroupArrays needs them)
         self._state = jax.device_put(
             self._host_zero_state(self._C, self._G, self._P, self._N),
             self._device)
+        # HBM accounting: the C-stacked arenas are ONE owner whose budget
+        # is the docs/fleet.md capacity-envelope formula at the CURRENT
+        # buckets (the budget callable re-reads them, so a grow/compact
+        # moves the envelope with the arrays)
+        resources.RESOURCES.register(
+            "fleet_arenas", self, lambda e: e._state,
+            budget=lambda e: resources.expected_fleet_arena_bytes(
+                e._C, e._G, e._P, e._N))
 
     def _pull_state(self):
         """D2H copy of the arenas (the reshape paths' staging buffers)."""
@@ -349,6 +358,12 @@ class FleetEngine:
         if C2 != C:
             self._free.extend(range(C, C2))
         self._G, self._P, self._N, self._C = G2, P2, N2, C2
+        # arena lifecycle visibility (round 15): a grow silently doubled
+        # resident HBM before this — now it counts, annotates the
+        # fleet_batch flight record it happened under, and moves the
+        # registered fleet_arenas owner bytes + budget in the same tick
+        metrics.fleet_arena_grows.inc()
+        obs.annotate(fleet_arena_grow=f"G={G2} P={P2} N={N2} C={C2}")
         log.info("fleet arena grown to G=%d P=%d N=%d C=%d", G2, P2, N2, C2)
 
     def compact(self) -> dict:
@@ -361,7 +376,11 @@ class FleetEngine:
 
         import jax
 
-        with self._lock:
+        # own span root: compact runs OUTSIDE any batch (an operator or
+        # maintenance call), and annotate() is a no-op without a timeline
+        # — without this the advertised fleet_arena_compact annotation
+        # could never reach a flight record
+        with obs.span("fleet_compact"), self._lock:
             live = sorted(self._tenants.values(), key=lambda t: t.slot)
             C2 = _pow2(len(live), 2)
             old_c = self._C
@@ -383,6 +402,8 @@ class FleetEngine:
                 t.slot = i
             self._free = list(range(len(live), C2))
             self._C = C2
+            metrics.fleet_arena_compacts.inc()
+            obs.annotate(fleet_arena_compact=f"C={old_c}->{C2}")
         log.info("fleet arena compacted: %d tenants, C %d -> %d",
                  len(live), old_c, C2)
         return {"tenants": len(live), "old_c": old_c, "new_c": C2}

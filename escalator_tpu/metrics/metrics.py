@@ -259,6 +259,19 @@ fleet_tenant_count = Gauge(
     "tenants currently resident in the fleet decision arenas",
     namespace="escalator_tpu", registry=registry,
 )
+fleet_arena_grows = Counter(
+    "fleet_arena_grow_total",
+    "fleet arena bucket growths (any of the G/P/N/C buckets doubled) — "
+    "each one is an O(arena) host copy AND a step change in resident HBM; "
+    "a steady rate means the sizing knobs are wrong for the workload",
+    namespace="escalator_tpu", registry=registry,
+)
+fleet_arena_compacts = Counter(
+    "fleet_arena_compact_total",
+    "fleet arena compactions (live tenants repacked, tenant axis shrunk) — "
+    "the post-mass-eviction HBM reclaim",
+    namespace="escalator_tpu", registry=registry,
+)
 
 jax_compile_seconds = Histogram(
     "jax_compile_seconds",
@@ -334,6 +347,71 @@ class _TailHistogramCollector:
 
 
 registry.register(_TailHistogramCollector())
+
+
+# --- device resource observatory (round 15: HBM/arena accounting) ------------
+class _DeviceResourceCollector:
+    """Pull-time export of the buffer-accounting registry
+    (observability/resources.py):
+
+    - ``escalator_tpu_device_buffer_bytes{owner}`` — live bytes per
+      registered owner of persistent device state (resident cluster,
+      aggregates, decision/order columns, audit double buffer, fleet
+      arenas). Collected at scrape time from array METADATA — no device
+      sync, and retired owners (a dead decider) vanish instead of
+      flatlining at their last value.
+    - ``escalator_tpu_device_memory_bytes_in_use{device}`` /
+      ``..._peak_bytes{device}`` — the runtime allocator's own view where
+      ``memory_stats()`` reports (TPU runtimes that support it); series
+      simply absent on runtimes that return nothing (this rig's CPU), per
+      the explicit-"unsupported" degrade contract.
+    """
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        from escalator_tpu.observability import resources
+
+        owner_fam = GaugeMetricFamily(
+            "escalator_tpu_device_buffer_bytes",
+            "live bytes of registered persistent device-state owners "
+            "(buffer-accounting registry; metadata-derived, no device sync)",
+            labels=["owner"],
+        )
+        try:
+            for owner, row in sorted(resources.RESOURCES.snapshot().items()):
+                owner_fam.add_metric([owner], float(row["nbytes"]))
+        except Exception:  # noqa: BLE001 - a scrape must never crash
+            pass
+        yield owner_fam
+        in_use = GaugeMetricFamily(
+            "escalator_tpu_device_memory_bytes_in_use",
+            "runtime allocator bytes_in_use per device (absent where "
+            "memory_stats() is unsupported)",
+            labels=["device"],
+        )
+        peak = GaugeMetricFamily(
+            "escalator_tpu_device_memory_peak_bytes",
+            "runtime allocator peak_bytes_in_use per device (absent where "
+            "memory_stats() is unsupported)",
+            labels=["device"],
+        )
+        try:
+            mem = resources.device_memory()
+            if "unsupported" not in mem:
+                for dev, stats in sorted(mem.items()):
+                    if "bytes_in_use" in stats:
+                        in_use.add_metric([dev], float(stats["bytes_in_use"]))
+                    if "peak_bytes_in_use" in stats:
+                        peak.add_metric([dev],
+                                        float(stats["peak_bytes_in_use"]))
+        except Exception:  # noqa: BLE001
+            pass
+        yield in_use
+        yield peak
+
+
+registry.register(_DeviceResourceCollector())
 
 
 def start(address: str = "0.0.0.0:8080", readiness=None) -> WSGIServer:

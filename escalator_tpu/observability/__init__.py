@@ -11,6 +11,7 @@ from escalator_tpu.observability import (
     flightrecorder,
     histograms,
     jaxmon,
+    resources,
     spans,
     tail,
 )
@@ -35,5 +36,6 @@ flightrecorder.install()
 __all__ = [
     "RECORDER", "add_phase", "annotate", "current_path", "current_timeline",
     "dump_on_incident", "enabled", "fence", "flightrecorder", "graft",
-    "histograms", "jaxmon", "set_enabled", "span", "spans", "tail",
+    "histograms", "jaxmon", "resources", "set_enabled", "span", "spans",
+    "tail",
 ]

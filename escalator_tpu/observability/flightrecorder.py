@@ -48,7 +48,10 @@ _META_KEYS = ("backend", "impl", "ordered", "digest", "dirty_groups",
               # fleet_batch dispatch decided for, and the batch width the
               # cfg17 one-dispatch proof sums against
               "batch_size", "tenants", "fleet_tenants_resident",
-              "fleet_batch_size", "fleet_ordered")
+              "fleet_batch_size", "fleet_ordered",
+              # fleet arena lifecycle (round 15): a grow/compact inside a
+              # batch annotates the record that paid for it
+              "fleet_arena_grow", "fleet_arena_compact")
 
 #: stash key for the tick-open jaxmon snapshot (private to this module)
 _MON0 = "_jaxmon_t0"
@@ -130,6 +133,19 @@ class FlightRecorder:
             "tick_quantiles_ms": histograms.tick_quantiles_ms(),
             "ticks": self.snapshot(),
         }
+        try:
+            # device resource observatory (round 15): what the device was
+            # HOLDING and COMPILING around the dumped ticks — per-owner
+            # buffer accounting (+ allocator cross-check where supported)
+            # and the attributed recent-compile ring
+            from escalator_tpu.observability import resources
+
+            doc["memory"] = resources.memory_section()
+            ring = jaxmon.compile_ring()
+            if ring:
+                doc["compiles"] = ring
+        except Exception:  # noqa: BLE001 - a dump must never fail on extras
+            pass
         if extra:
             doc.update(extra)
         # deterministic replay (round 11): when tick-input recording is on,
@@ -221,6 +237,23 @@ def _on_root_complete(tl: spans.Timeline) -> None:
                 p["ms"] / 1e3)
     except Exception:  # noqa: BLE001 - metrics must never break the tick
         pass
+    # device resource observatory (round 15): sample the registered buffer
+    # totals for the leak watchdog (a metadata walk) and run the
+    # profiler-capture countdown — both once per completed root tick, each
+    # isolated so one failing can never starve the other
+    try:
+        from escalator_tpu.observability import resources
+    except Exception:  # noqa: BLE001 - observability must never break ticks
+        resources = None
+    if resources is not None:
+        try:
+            resources.MEMORY_WATCHDOG.on_tick(rec)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            resources.PROFILER.on_root_complete(rec)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def install() -> None:
@@ -235,6 +268,16 @@ def install() -> None:
 
 
 _incident_seq = 0
+
+
+def dump_dir() -> str:
+    """THE dump-directory resolution every incident artifact shares:
+    ``ESCALATOR_TPU_DUMP_DIR``, falling back to the legacy
+    ``ESCALATOR_TPU_FLIGHT_DUMP_DIR`` spelling, default cwd — one helper
+    so flight dumps and the tail watchdog's profiler captures can never
+    land in different directories."""
+    return (os.environ.get("ESCALATOR_TPU_DUMP_DIR")
+            or os.environ.get("ESCALATOR_TPU_FLIGHT_DUMP_DIR", "."))
 
 
 def dump_on_incident(reason: str,
@@ -252,8 +295,7 @@ def dump_on_incident(reason: str,
     global _incident_seq
     try:
         _incident_seq += 1
-        out_dir = (os.environ.get("ESCALATOR_TPU_DUMP_DIR")
-                   or os.environ.get("ESCALATOR_TPU_FLIGHT_DUMP_DIR", "."))
+        out_dir = dump_dir()
         path = os.path.join(
             out_dir,
             f"escalator-tpu-flight-{reason}-{os.getpid()}-"

@@ -26,16 +26,62 @@ picked up without a code change.
 Listeners cannot be unregistered on this jax; install is process-lifetime
 and idempotent. Callbacks are tolerant (``**kwargs``) so jax versions that
 add metadata keep working, and they never raise into jax internals.
+
+**Compile observatory (round 15):** beyond counting, every backend compile
+lands in a bounded ring (:func:`compile_ring`) with *attribution*: the span
+path live at compile time (compiles happen synchronously inside the
+dispatching span on the same thread), the timeline's backend annotation,
+the jaxlint registry entry the dispatch site maps to, and whatever metadata
+kwargs this jax version ships (0.4.x ships none; newer runtimes' fun_name
+etc. ride along untouched). The ring embeds in every flight dump
+(``compiles`` section) and feeds ``escalator-tpu debug-compiles``, which
+diffs observed per-entry compile counts against the jaxlint retrace pins —
+a surprise retrace on chip is then NAMED (which entry, under which tick
+phase), not just counted.
 """
 
 from __future__ import annotations
 
+import collections
+import os
 import threading
-from typing import Dict
+import time
+from typing import Any, Dict, List, Optional
 
 _lock = threading.Lock()
 _installed = False
 _install_failed: str = ""
+
+#: recent backend compiles, newest last (ESCALATOR_TPU_COMPILE_RING caps
+#: it; a junk value falls back to the default rather than crashing every
+#: importer at startup — same tolerance as the watchdog knobs)
+try:
+    _RING_CAPACITY = int(os.environ.get("ESCALATOR_TPU_COMPILE_RING", "64"))
+except ValueError:
+    _RING_CAPACITY = 64
+_ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+    maxlen=max(1, _RING_CAPACITY))
+_ring_seq = 0
+
+#: dispatch-site span leaf -> jaxlint registry entry (analysis/registry.py
+#: names). The attribution contract: a compile whose innermost span is one
+#: of these belongs to that entry's program family. Leaves absent here
+#: (bench warmups, test jits) attribute to None and still ride the ring.
+SPAN_ENTRY_MAP: Dict[str, str] = {
+    "delta_decide": "kernel.delta_decide",
+    "decide_ordered_incremental": "kernel.ordered_delta_decide",
+    "decide_ordered": "kernel.decide",
+    "decide_full": "kernel.decide",
+    "decide_light": "kernel.decide",
+    "decide": "kernel.decide",
+    "scatter": "device_state.scatter_update_aggs",
+    "fleet_step": "device_state.fleet_step",
+    "fleet_ordered_redispatch": "kernel.decide",
+    "audit_snapshot": "device_state.audit_snapshot",
+    "snapshot_freeze": "snapshot.freeze",
+    "restore_upload": "snapshot.restore_adopt",
+    "order_repair": "order_tail.order_update",
+}
 
 _counts: Dict[str, float] = {
     "compile_events": 0,
@@ -68,6 +114,38 @@ def _on_event(event: str, **kwargs) -> None:  # noqa: ANN003
         pass
 
 
+def _record_compile(event: str, duration: float,
+                    kwargs: Dict[str, Any]) -> None:
+    """One ring entry per backend compile, attributed by the live span path
+    (thread-local — the compile runs synchronously inside the dispatching
+    span). Runs under the module lock; every lookup is O(1)."""
+    global _ring_seq
+    from escalator_tpu.observability import spans
+
+    path = spans.current_path()
+    tl = spans.current_timeline()
+    leaf = path.rsplit("/", 1)[-1] if path else ""
+    entry: Dict[str, Any] = {
+        "seq": _ring_seq,
+        "time_unix": round(time.time(), 3),
+        "event": event.rsplit("/", 1)[-1],
+        "duration_sec": round(float(duration), 6),
+        "path": path,
+        "entry": SPAN_ENTRY_MAP.get(leaf),
+    }
+    _ring_seq += 1
+    if tl is not None:
+        entry["root"] = tl.name
+        backend = tl.meta.get("backend")
+        if backend is not None:
+            entry["backend"] = backend
+    for k, v in kwargs.items():
+        # version-tolerant metadata (fun_name, arg shapes on newer jaxes):
+        # stringify anything non-scalar so the ring stays JSON-serializable
+        entry[k] = v if isinstance(v, (str, int, float, bool)) else str(v)
+    _ring.append(entry)
+
+
 def _on_duration(event: str, duration: float, **kwargs) -> None:  # noqa: ANN003
     try:
         kind = _classify(event)
@@ -77,6 +155,7 @@ def _on_duration(event: str, duration: float, **kwargs) -> None:  # noqa: ANN003
                 _counts["compile_seconds"] += float(duration)
                 if _BACKEND_COMPILE in event:
                     _counts["compile_events"] += 1
+                    _record_compile(event, duration, kwargs)
                     m = _metrics()
                     m.jax_compile_events.inc()
                     m.jax_compile_seconds.observe(float(duration))
@@ -120,3 +199,71 @@ def snapshot() -> Dict[str, float]:
     """Copy of the monotonic counters (diff two snapshots for a window)."""
     with _lock:
         return dict(_counts)
+
+
+def compile_ring() -> List[Dict[str, Any]]:
+    """Snapshot of the recent-compile ring, oldest first (embedded in every
+    flight dump as ``compiles``; the debug-compiles CLI's source)."""
+    with _lock:
+        return list(_ring)
+
+
+def clear_ring() -> None:
+    """Drop recorded compiles (test/bench isolation)."""
+    with _lock:
+        _ring.clear()
+
+
+def retrace_pins() -> Dict[str, int]:
+    """The jaxlint registry's retrace budgets ``{entry: compiles}`` —
+    lazily imported (building the registry needs jax + the fixture
+    modules) and empty when unavailable, so debug tooling degrades on a
+    stripped install instead of crashing."""
+    try:
+        from escalator_tpu.analysis.registry import default_registry
+
+        return {e.name: e.retrace_budget for e in default_registry()
+                if e.retrace_budget is not None}
+    except Exception:  # noqa: BLE001 - debug surface: degrade, don't raise
+        return {}
+
+
+def attribute_compiles(
+        ring: Optional[List[Dict[str, Any]]] = None,
+        pins: Optional[Dict[str, int]] = None) -> List[Dict[str, Any]]:
+    """Group a compile ring by attributed registry entry: one row per
+    entry/path family with count, total seconds, last event time — and,
+    where the jaxlint registry pins a retrace budget, the budget plus a
+    ``bust`` flag when the observed count exceeds it (the offending span
+    paths NAME the shape family that retraced; a warm steady-state process
+    should show zero recent compiles at all)."""
+    if ring is None:
+        ring = compile_ring()
+    if pins is None:
+        pins = retrace_pins()
+    groups: Dict[str, Dict[str, Any]] = {}
+    for rec in ring:
+        key = rec.get("entry") or rec.get("path") or "(unattributed)"
+        row = groups.setdefault(key, {
+            "entry": rec.get("entry"),
+            "count": 0,
+            "total_sec": 0.0,
+            "paths": [],
+            "last_time_unix": None,
+        })
+        row["count"] += 1
+        row["total_sec"] = round(
+            row["total_sec"] + float(rec.get("duration_sec", 0.0)), 6)
+        path = rec.get("path")
+        if path and path not in row["paths"]:
+            row["paths"].append(path)
+        row["last_time_unix"] = rec.get("time_unix")
+    out = []
+    for key, row in sorted(groups.items()):
+        budget = pins.get(row["entry"]) if row["entry"] else None
+        if budget is not None:
+            row["retrace_budget"] = budget
+            row["bust"] = row["count"] > budget
+        row["key"] = key
+        out.append(row)
+    return out

@@ -1,0 +1,813 @@
+"""Device resource observatory: HBM/arena accounting, leak watchdog,
+on-demand profiler capture.
+
+The span/histogram layers (rounds 9/13) made every tick's *time* accountable;
+this module (round 15) does the same for the device's *memory* and the
+profiler's view of it, closing the "what was the device holding when that
+happened" gap:
+
+- **Buffer-accounting registry** (:data:`RESOURCES`): every owner of
+  persistent device state — the resident ClusterArrays, the maintained
+  GroupAggregates, the 13 decision columns, the order-state columns, the
+  audit double buffer, snapshot freeze copies, the fleet's C-stacked arenas —
+  registers a weakref'd provider at construction. Per-owner ``nbytes`` is
+  computed purely from array METADATA (``arr.nbytes`` reads the aval — no
+  device sync, works even on a donated-away buffer), so a snapshot costs
+  microseconds and is safe from any thread. Each owner also declares an
+  executable **budget**: the docs' hand-computed HBM envelope formulas
+  (docs/performance.md, docs/fleet.md) as code, asserted against the live
+  arrays in ``bench.py --smoke`` — the envelope can no longer silently
+  drift from the implementation.
+- **Growth watchdog** (:data:`MEMORY_WATCHDOG`): samples the total
+  registered bytes once per completed root tick (the same root-complete
+  hook as the ring/histograms); monotone growth across a full window is the
+  leak signature a fixed-buffer design must never show, and flags as a
+  rate-limited ``reason="memory"`` flight dump (same discipline as the tail
+  watchdog: dump on a worker, never on the tick path).
+- **Profiler capture** (:data:`PROFILER`): wrap ``jax.profiler`` around the
+  next K root ticks on demand — the ``escalator-tpu debug-profile`` CLI and
+  the plugin ``Profile`` RPC drive it, and ``ESCALATOR_TPU_TAIL_PROFILE=1``
+  arms the tail watchdog to capture a trace on its first breach, so a slow
+  tick on a TPU campaign yields an on-chip profile without a human in the
+  loop. The artifact is a TensorBoard/XPlane trace directory (CPU and TPU),
+  the profiler-native sibling of the ``debug-trace`` Perfetto export.
+
+Platform capability (``memory_stats()``, ``jax.live_arrays``,
+``jax.profiler``) is probed ONCE per process, WARN-logged when missing (the
+``unavailable_reason()`` pattern from native/statestore.py), and every
+surface degrades to explicit ``"unsupported"`` fields instead of raising —
+a CPU-only rig reports ``memory_stats: unsupported`` and keeps the registry
+accounting, which needs no runtime support at all.
+
+Zero hard dependencies: this module imports only the stdlib (+ the spans
+module) at import time; jax is reached through ``sys.modules`` exactly like
+``spans.fence`` — a golden-only controller pays nothing.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import math
+import os
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "RESOURCES", "MEMORY_WATCHDOG", "PROFILER",
+    "ResourceRegistry", "MemoryWatchdog", "ProfileCapture",
+    "capabilities", "unavailable_reason", "device_memory",
+    "live_arrays_bytes", "memory_section",
+    "expected_cluster_bytes", "expected_aggregates_bytes",
+    "expected_decision_columns_bytes", "expected_order_state_bytes",
+    "expected_fleet_arena_bytes",
+]
+
+log = logging.getLogger("escalator_tpu.observability")
+
+_ENV_WATCH = "ESCALATOR_TPU_MEMORY_WATCH"
+_ENV_MIN_GROWTH = "ESCALATOR_TPU_MEMORY_MIN_GROWTH"
+_ENV_INTERVAL = "ESCALATOR_TPU_MEMORY_DUMP_INTERVAL_SEC"
+_ENV_SAMPLE_EVERY = "ESCALATOR_TPU_MEMORY_SAMPLE_EVERY"
+
+DEFAULT_WINDOW = 64
+DEFAULT_MIN_GROWTH = 1 << 20          # 1 MiB across the window
+DEFAULT_INTERVAL_SEC = 300.0
+#: ticks between registry samples: the metadata walk is ~100 µs with many
+#: live owners, so sampling every tick would be the single largest line in
+#: the <1% instrumentation budget; a leak ramp is a minutes-scale signal,
+#: so a /8 decimation costs nothing but detection latency
+DEFAULT_SAMPLE_EVERY = 8
+
+
+# ---------------------------------------------------------------------------
+# Platform capability probe (the unavailable_reason() pattern)
+# ---------------------------------------------------------------------------
+
+_caps_lock = threading.Lock()
+_caps: Optional[Dict[str, Optional[str]]] = None
+
+
+def _probe_capabilities() -> Dict[str, Optional[str]]:
+    """One probe per process: for each capability, None = available, else
+    the human-readable reason it is not. Never imports jax — a process that
+    has not loaded it reports every runtime capability unsupported (the
+    registry accounting works regardless)."""
+    caps: Dict[str, Optional[str]] = {}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        reason = "jax not loaded in this process"
+        return {"memory_stats": reason, "live_arrays": reason,
+                "profiler": reason}
+    try:
+        devs = jax.local_devices()
+    except Exception as e:  # noqa: BLE001 - backend init failure
+        reason = f"jax device init failed: {e}"
+        return {"memory_stats": reason, "live_arrays": reason,
+                "profiler": reason}
+    try:
+        stats = devs[0].memory_stats() if devs else None
+        if stats:
+            caps["memory_stats"] = None
+        else:
+            caps["memory_stats"] = (
+                f"memory_stats() returns {stats!r} on "
+                f"{devs[0].platform if devs else 'no-device'} "
+                "(runtime does not report allocator stats)")
+    except Exception as e:  # noqa: BLE001
+        caps["memory_stats"] = f"memory_stats() raised: {e}"
+    caps["live_arrays"] = (None if callable(getattr(jax, "live_arrays", None))
+                           else "jax.live_arrays not provided by this jax")
+    prof = getattr(jax, "profiler", None)
+    if (prof is not None and callable(getattr(prof, "start_trace", None))
+            and callable(getattr(prof, "stop_trace", None))):
+        caps["profiler"] = None
+    else:
+        caps["profiler"] = "jax.profiler.start_trace/stop_trace unavailable"
+    return caps
+
+
+def capabilities(refresh: bool = False) -> Dict[str, Optional[str]]:
+    """The probed capability map (``{name: None-or-reason}``), cached after
+    the first call; missing capabilities WARN-log ONCE with the decision
+    taken (explicit ``"unsupported"`` fields, never an exception).
+    ``refresh=True`` re-probes — tests and late-jax-loading processes use
+    it (the cache deliberately re-probes on its own when jax appears after
+    a jax-less first probe)."""
+    global _caps
+    with _caps_lock:
+        stale = (_caps is not None
+                 and (_caps.get("memory_stats") or "").startswith(
+                     "jax not loaded")
+                 and "jax" in sys.modules)
+        if _caps is None or refresh or stale:
+            _caps = _probe_capabilities()
+            for name, reason in _caps.items():
+                if reason is not None:
+                    log.warning(
+                        "resource observatory: %s unavailable (%s); the "
+                        "corresponding surfaces report 'unsupported' and "
+                        "everything else keeps working", name, reason)
+        return dict(_caps)
+
+
+def unavailable_reason(capability: str) -> Optional[str]:
+    """Why ``capability`` (``memory_stats`` | ``live_arrays`` |
+    ``profiler``) is unavailable — None when it works (the
+    ``statestore.unavailable_reason`` contract)."""
+    return capabilities().get(capability)
+
+
+# ---------------------------------------------------------------------------
+# nbytes accounting: pure metadata walks, no jax import, no device sync
+# ---------------------------------------------------------------------------
+
+
+def _walk_nbytes(tree: Any) -> Tuple[int, int]:
+    """``(total_nbytes, leaf_count)`` over a pytree-ish value: arrays
+    (anything with ``shape`` + ``dtype.itemsize``), dataclasses,
+    tuples/lists, dicts, None. Bytes come from ``prod(shape) * itemsize``
+    rather than ``.nbytes`` — jax 0.4.x computes ``.nbytes`` through an
+    uncached dtype-canonicalization property (~15 µs/array, measured),
+    which would put the per-tick watchdog sample outside the <1%
+    instrumentation budget; shape and dtype are cached attributes on both
+    numpy and jax arrays, and the product is exact for dense arrays (the
+    only kind any owner holds). Unknown leaves count zero bytes rather
+    than raising — an accounting miss must never break a tick."""
+    if tree is None:
+        return 0, 0
+    shape = getattr(tree, "shape", None)
+    if shape is not None:
+        itemsize = getattr(getattr(tree, "dtype", None), "itemsize", None)
+        if isinstance(itemsize, int):
+            return math.prod(shape) * itemsize, 1
+    nb = getattr(tree, "nbytes", None)
+    if isinstance(nb, int):
+        return nb, 1
+    if dataclasses.is_dataclass(tree) and not isinstance(tree, type):
+        total = count = 0
+        for f in dataclasses.fields(tree):
+            b, c = _walk_nbytes(getattr(tree, f.name))
+            total += b
+            count += c
+        return total, count
+    if isinstance(tree, (tuple, list)):
+        total = count = 0
+        for item in tree:
+            b, c = _walk_nbytes(item)
+            total += b
+            count += c
+        return total, count
+    if isinstance(tree, dict):
+        total = count = 0
+        for item in tree.values():
+            b, c = _walk_nbytes(item)
+            total += b
+            count += c
+        return total, count
+    return 0, 0
+
+
+class Registration:
+    """Handle for one registered owner instance; ``close()`` deregisters
+    (dead weakrefs deregister themselves — close is for explicit teardown
+    like a store growth re-registering at new capacities)."""
+
+    def __init__(self, registry: "ResourceRegistry", key: Tuple[str, int]):
+        self._registry = registry
+        self._key = key
+
+    def close(self) -> None:
+        self._registry._remove(self._key)
+
+
+class ResourceRegistry:
+    """Process-global accounting of persistent device-state owners.
+
+    ``register(owner, obj, extract, budget=..., kind=...)`` stores a
+    WEAKREF to ``obj`` plus an ``extract(obj)`` callable returning the live
+    array tree (or None while absent) and an optional ``budget(obj)``
+    callable returning the declared byte envelope (None while
+    inapplicable). Owners are NAMES, not instances: several instances of
+    one owner (two deciders in a test process) sum under one label, so the
+    Prometheus series stays bounded. Dead referents prune lazily."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, int], Tuple[
+            "weakref.ref", Callable[[Any], Any],
+            Optional[Callable[[Any], Optional[int]]], str]] = {}
+
+    def register(self, owner: str, obj: Any,
+                 extract: Callable[[Any], Any],
+                 budget: Optional[Callable[[Any], Optional[int]]] = None,
+                 kind: str = "device") -> Registration:
+        key = (owner, id(obj))
+        with self._lock:
+            self._entries[key] = (weakref.ref(obj), extract, budget, kind)
+        return Registration(self, key)
+
+    def _remove(self, key: Tuple[str, int]) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def clear(self) -> None:
+        """Drop every registration (test isolation only — production owners
+        live for the process)."""
+        with self._lock:
+            self._entries.clear()
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-owner accounting: ``{owner: {nbytes, arrays, instances,
+        budget_bytes, kind}}`` — nbytes from array metadata only. A
+        provider that raises reports an ``error`` string for its owner
+        instead of propagating (observability must never break a tick)."""
+        with self._lock:
+            entries = list(self._entries.items())
+        out: Dict[str, Dict[str, Any]] = {}
+        dead: List[Tuple[str, int]] = []
+        for key, (ref, extract, budget, kind) in entries:
+            obj = ref()
+            if obj is None:
+                dead.append(key)
+                continue
+            owner = key[0]
+            row = out.setdefault(owner, {
+                "nbytes": 0, "arrays": 0, "instances": 0,
+                "budget_bytes": None, "kind": kind,
+            })
+            row["instances"] += 1
+            try:
+                nbytes, arrays = _walk_nbytes(extract(obj))
+                row["nbytes"] += nbytes
+                row["arrays"] += arrays
+                if budget is not None:
+                    b = budget(obj)
+                    if b is not None:
+                        row["budget_bytes"] = (b if row["budget_bytes"] is None
+                                               else row["budget_bytes"] + b)
+            except Exception as e:  # noqa: BLE001
+                row["error"] = str(e)
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._entries.pop(key, None)
+        return out
+
+    def sampled_bytes(self, kind: Optional[str] = "device") -> int:
+        """The watchdog's per-tick fast path: sum registered nbytes WITHOUT
+        evaluating budget callables (those may build fixture rows — scrape/
+        dump cost, not tick cost). Pure attribute walks, a few µs."""
+        with self._lock:
+            entries = list(self._entries.values())
+        total = 0
+        for ref, extract, _budget, entry_kind in entries:
+            if kind is not None and entry_kind != kind:
+                continue
+            obj = ref()
+            if obj is None:
+                continue
+            try:
+                total += _walk_nbytes(extract(obj))[0]
+            except Exception:  # noqa: BLE001 - accounting must never raise
+                continue
+        return total
+
+    def total_bytes(self, kind: Optional[str] = "device") -> int:
+        """Sum of registered nbytes (``kind=None`` for every kind)."""
+        return self.sampled_bytes(kind)
+
+
+RESOURCES = ResourceRegistry()
+
+
+def device_memory() -> Dict[str, Any]:
+    """Per-device allocator truth where the runtime supports it:
+    ``{device: {bytes_in_use, peak_bytes_in_use, ...}}`` — or
+    ``{device: {"unsupported": reason}}`` on runtimes (this rig's CPU, the
+    axon TPU runtime of every round-4 capture) that report nothing. The
+    registry accounting above is the portable signal; this is the
+    cross-check that catches what the registry cannot see (XLA temp
+    buffers, a leak OUTSIDE the registered owners)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {"unsupported": "jax not loaded in this process"}
+    out: Dict[str, Any] = {}
+    try:
+        devs = jax.local_devices()
+    except Exception as e:  # noqa: BLE001
+        return {"unsupported": f"jax device init failed: {e}"}
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception as e:  # noqa: BLE001
+            out[str(d)] = {"unsupported": f"memory_stats() raised: {e}"}
+            continue
+        if not stats:
+            out[str(d)] = {"unsupported": (
+                f"memory_stats() returns {stats!r} on {d.platform}")}
+            continue
+        out[str(d)] = {
+            k: stats[k]
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                      "largest_alloc_size", "num_allocs")
+            if k in stats
+        }
+    return out
+
+
+def live_arrays_bytes() -> Dict[str, Any]:
+    """Total bytes of every live jax array in the process
+    (``jax.live_arrays()`` — metadata sum, no sync), the registry's other
+    cross-check: ``live - registered`` bounds the unaccounted device state.
+    ``{"unsupported": reason}`` where the jax version lacks it."""
+    reason = unavailable_reason("live_arrays")
+    if reason is not None:
+        return {"unsupported": reason}
+    jax = sys.modules.get("jax")
+    try:
+        arrays = jax.live_arrays()
+        # shape x itemsize, not .nbytes: a long-lived process holds
+        # thousands of live arrays (cached constants of every compiled
+        # program) and jax 0.4.x's .nbytes property costs ~15 µs each —
+        # this sum runs on every dump and health probe
+        total = 0
+        for a in arrays:
+            total += _walk_nbytes(a)[0]
+        return {"count": len(arrays), "nbytes": total}
+    except Exception as e:  # noqa: BLE001
+        return {"unsupported": f"live_arrays() raised: {e}"}
+
+
+def memory_section() -> Dict[str, Any]:
+    """The ``memory`` section every flight dump and plugin ``health()``
+    carries: per-owner registry accounting + allocator/live-array
+    cross-checks (explicit ``unsupported`` where the platform reports
+    nothing) + watchdog state."""
+    owners = RESOURCES.snapshot()
+    return {
+        "owners": owners,
+        "total_registered_bytes": sum(
+            r["nbytes"] for r in owners.values() if r.get("kind") == "device"),
+        "device": device_memory(),
+        "live_arrays": live_arrays_bytes(),
+        "capabilities": capabilities(),
+        "watchdog": MEMORY_WATCHDOG.state(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Executable budget formulas: the docs' HBM envelopes as code
+# ---------------------------------------------------------------------------
+
+
+def _row_bytes(soa: Any) -> int:
+    """Bytes per lane of one SoA section, from its REAL dtypes (the single
+    source of truth stays the dataclass constructors, not a hand table)."""
+    return sum(getattr(soa, f.name).dtype.itemsize
+               for f in dataclasses.fields(soa))
+
+
+_section_rows_cache: Optional[Tuple[int, int, int]] = None
+
+
+def _section_rows() -> Tuple[int, int, int]:
+    """(pod_row_bytes, node_row_bytes, group_row_bytes) derived ONCE from
+    the canonical empty constructors (lazy import: jax-less processes never
+    call a budget; cached: budgets evaluate at scrape/dump cadence)."""
+    global _section_rows_cache
+    if _section_rows_cache is None:
+        from escalator_tpu.fleet.service import (
+            _empty_groups,
+            _empty_nodes,
+            _empty_pods,
+        )
+
+        _section_rows_cache = (
+            _row_bytes(_empty_pods(1)), _row_bytes(_empty_nodes(1)),
+            _row_bytes(_empty_groups(1)))
+    return _section_rows_cache
+
+
+def expected_cluster_bytes(pod_capacity: int, node_capacity: int,
+                           num_groups: int) -> int:
+    """Resident ClusterArrays envelope: ``(P+1)`` pod rows + ``(N+1)`` node
+    rows (each carries the scratch lane) + ``G`` group rows, at the real
+    column dtypes — the docs/performance.md "25 B/pod + 40 B/node" figures,
+    executable."""
+    pod_b, node_b, group_b = _section_rows()
+    return ((pod_capacity + 1) * pod_b + (node_capacity + 1) * node_b
+            + num_groups * group_b)
+
+
+def expected_aggregates_bytes(num_groups: int, node_lanes: int) -> int:
+    """GroupAggregates envelope: nine int64 ``[G]`` sums + bool ``[G]``
+    dirty + int64 ``[node_lanes]`` pods-remaining (node_lanes includes the
+    scratch lane on the resident path)."""
+    return num_groups * (9 * 8 + 1) + node_lanes * 8
+
+
+_col_bytes_cache: Optional[int] = None
+
+
+def expected_decision_columns_bytes(num_groups: int) -> int:
+    """The 13 persistent decision columns at their wire dtypes (the
+    ``fleet.service._COL_DTYPES`` contract — 76 B/group)."""
+    global _col_bytes_cache
+    if _col_bytes_cache is None:
+        import numpy as np
+
+        from escalator_tpu.fleet.service import _COL_DTYPES
+
+        _col_bytes_cache = sum(np.dtype(dt).itemsize
+                               for dt in _COL_DTYPES.values())
+    return num_groups * _col_bytes_cache
+
+
+def expected_order_state_bytes(node_lanes: int) -> int:
+    """Persistent order state (round 10): three int64 key columns + one
+    int32 permutation over the resident node lanes — 28 B/node."""
+    return node_lanes * (8 + 8 + 8 + 4)
+
+
+def expected_fleet_arena_bytes(num_tenants: int, num_groups: int,
+                               pod_bucket: int, node_bucket: int) -> int:
+    """The fleet's C-stacked arenas (docs/fleet.md capacity envelope):
+    ``C+1`` tenant rows (scratch tenant included) of cluster sections +
+    aggregates + decision columns at the arena buckets."""
+    per_tenant = (
+        expected_cluster_bytes(pod_bucket, node_bucket, num_groups)
+        + expected_aggregates_bytes(num_groups, node_bucket + 1)
+        + expected_decision_columns_bytes(num_groups)
+    )
+    return (num_tenants + 1) * per_tenant
+
+
+# ---------------------------------------------------------------------------
+# Growth watchdog: monotone registered-buffer growth == leak
+# ---------------------------------------------------------------------------
+
+
+class MemoryWatchdog:
+    """Flags monotone live-buffer growth over a window as a leak.
+
+    Every registered owner is a FIXED-size buffer between capacity growths
+    (buckets double, rarely), so the total registered bytes should be a
+    step function — a ramp is the signature of state retained per tick
+    (an audit buffer never released, snapshot freezes accumulating, a
+    fleet arena growing every batch). Sampled once per completed root tick
+    from the flight-recorder hook (a metadata walk, ~microseconds); a
+    breach claims the rate limit and dumps ``reason="memory"`` on a daemon
+    worker exactly like the tail watchdog.
+
+    Knobs (env, parsed per tick, memoized on the raw strings):
+
+    - ``ESCALATOR_TPU_MEMORY_WATCH``: window in ticks (default 64;
+      ``off``/``0`` disables).
+    - ``ESCALATOR_TPU_MEMORY_MIN_GROWTH``: bytes the window must gain
+      before a ramp counts (default 1 MiB) — jitter from transient owners
+      (the audit double buffer blinking in and out) must not page anyone.
+    - ``ESCALATOR_TPU_MEMORY_DUMP_INTERVAL_SEC``: rate limit between
+      memory dumps (default 300).
+    - ``ESCALATOR_TPU_MEMORY_SAMPLE_EVERY``: ticks between samples
+      (default 8 — the steady-tick cost is then a counter increment; the
+      window counts SAMPLES, so the default leak horizon is 8×64 ticks).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._samples: "collections.deque[int]" = collections.deque(
+            maxlen=DEFAULT_WINDOW)
+        self._last_dump_mono = -float("inf")
+        self._worker: Optional[threading.Thread] = None
+        self._ticks = 0
+        self._cfg_cache: Tuple[Tuple[Optional[str], ...],
+                               Tuple[int, int, float, int]] = (
+            ("\0",), (0, 0, 0.0, 1))
+        self.breaches = 0
+        self.dumps = 0
+
+    def _config(self) -> Tuple[int, int, float, int]:
+        raw = (os.environ.get(_ENV_WATCH), os.environ.get(_ENV_MIN_GROWTH),
+               os.environ.get(_ENV_INTERVAL),
+               os.environ.get(_ENV_SAMPLE_EVERY))
+        cached_raw, cached = self._cfg_cache
+        if raw == cached_raw:
+            return cached
+        window = DEFAULT_WINDOW
+        if raw[0] is not None:
+            text = raw[0].strip().lower()
+            if text in ("off", "false", "no", "none", "0"):
+                window = 0
+            else:
+                try:
+                    window = max(2, int(text))
+                except ValueError:
+                    window = DEFAULT_WINDOW
+        try:
+            min_growth = int(raw[1]) if raw[1] else DEFAULT_MIN_GROWTH
+        except ValueError:
+            min_growth = DEFAULT_MIN_GROWTH
+        try:
+            interval = float(raw[2]) if raw[2] else DEFAULT_INTERVAL_SEC
+        except ValueError:
+            interval = DEFAULT_INTERVAL_SEC
+        try:
+            every = max(1, int(raw[3])) if raw[3] else DEFAULT_SAMPLE_EVERY
+        except ValueError:
+            every = DEFAULT_SAMPLE_EVERY
+        cfg = (window, max(0, min_growth), max(0.0, interval), every)
+        self._cfg_cache = (raw, cfg)
+        return cfg
+
+    def on_tick(self, rec: Optional[Dict[str, Any]] = None) -> bool:
+        """Sample + evaluate (flight-recorder root-complete hook). Returns
+        True when a memory dump was scheduled."""
+        window, min_growth, interval, every = self._config()
+        if window <= 0:
+            if self._samples:
+                self._samples.clear()
+            return False
+        self._ticks += 1
+        if self._ticks % every:
+            return False
+        total = RESOURCES.sampled_bytes()
+        with self._lock:
+            if self._samples.maxlen != window:
+                self._samples = collections.deque(self._samples,
+                                                  maxlen=window)
+            self._samples.append(total)
+            if len(self._samples) < window:
+                return False
+            seq = list(self._samples)
+        steps = [b - a for a, b in zip(seq, seq[1:], strict=False)]
+        growth = seq[-1] - seq[0]
+        monotone = all(s >= 0 for s in steps)
+        rising = sum(1 for s in steps if s > 0)
+        if not (monotone and rising >= max(1, (window - 1) // 2)
+                and growth >= min_growth):
+            return False
+        now = time.monotonic()
+        with self._lock:
+            self.breaches += 1
+            if now - self._last_dump_mono < interval:
+                return False
+            self._last_dump_mono = now   # claimed before the handoff
+            self.dumps += 1
+            self._samples.clear()        # restart the window post-incident
+        info = {
+            "window_ticks": window,
+            "first_bytes": seq[0],
+            "last_bytes": seq[-1],
+            "growth_bytes": growth,
+            "rising_steps": rising,
+            "owners": {name: row["nbytes"]
+                       for name, row in RESOURCES.snapshot().items()},
+            "tick_seq": (rec or {}).get("seq"),
+        }
+        worker = threading.Thread(
+            target=self._dump, args=(info,),
+            name="escalator-memory-dump", daemon=True)
+        with self._lock:
+            self._worker = worker
+        worker.start()
+        return True
+
+    @staticmethod
+    def _dump(info: Dict[str, Any]) -> None:
+        from escalator_tpu.observability import flightrecorder
+
+        flightrecorder.dump_on_incident("memory",
+                                        extra={"memory_watchdog": info})
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "samples": len(self._samples),
+                "last_bytes": self._samples[-1] if self._samples else None,
+                "breaches": self.breaches,
+                "dumps": self.dumps,
+            }
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Join the in-flight dump worker (tests assert on the artifact)."""
+        with self._lock:
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._last_dump_mono = -float("inf")
+            self.breaches = 0
+            self.dumps = 0
+
+
+MEMORY_WATCHDOG = MemoryWatchdog()
+
+
+# ---------------------------------------------------------------------------
+# On-demand profiler capture: the next K root ticks as an XPlane trace
+# ---------------------------------------------------------------------------
+
+
+class ProfileCapture:
+    """Wraps ``jax.profiler.start_trace/stop_trace`` around the next K
+    completed root ticks. At most one capture at a time (the jax profiler
+    is process-global); arming from any thread is safe, the countdown runs
+    in the flight-recorder root-complete hook, and the stop (which
+    serializes the trace to ``out_dir``) lands in the inter-tick gap of
+    the Kth tick. Degrades to ``{"ok": False, "unsupported": reason}``
+    where the platform lacks the profiler — never raises into a tick."""
+
+    #: bound on waiting for a triggered stop's serialization to land —
+    #: stop_trace writes the whole XPlane artifact, measured at tens of
+    #: seconds late in a long-lived process
+    STOP_TIMEOUT_SEC = 180.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._active = False
+        self._stopping = False
+        self._remaining = 0
+        self._dir: Optional[str] = None
+        self._done: Optional[threading.Event] = None
+        self.captures = 0
+        self.last_error: Optional[str] = None
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def start(self, ticks: int, out_dir: str) -> Dict[str, Any]:
+        """Arm a capture of the next ``ticks`` root ticks into ``out_dir``
+        (created if needed). Non-blocking. Returns ``{"ok": True}``,
+        ``{"ok": False, "busy": True}`` when a capture is in flight (or
+        its stop is still serializing — starting a new trace under an
+        unfinished stop_trace errors inside jax), or
+        ``{"ok": False, "unsupported": reason}``."""
+        reason = unavailable_reason("profiler")
+        if reason is not None:
+            return {"ok": False, "unsupported": reason}
+        with self._lock:
+            if self._active or self._stopping:
+                return {"ok": False, "busy": True}
+            jax = sys.modules.get("jax")
+            try:
+                os.makedirs(out_dir, exist_ok=True)
+                jax.profiler.start_trace(out_dir)
+            except Exception as e:  # noqa: BLE001 - platform-dependent
+                self.last_error = str(e)
+                return {"ok": False, "unsupported": f"start_trace: {e}"}
+            self._active = True
+            self._remaining = max(1, int(ticks))
+            self._dir = out_dir
+            self._done = threading.Event()
+            return {"ok": True, "dir": out_dir, "ticks": self._remaining}
+
+    def on_root_complete(self, rec: Optional[Dict[str, Any]] = None) -> None:
+        """Countdown hook (flight recorder). The Kth tick TRIGGERS the
+        stop; the stop itself — stop_trace serializes the whole XPlane
+        artifact, tens of seconds in a long-lived process — runs on a
+        daemon worker, never on the tick/RPC thread (the same discipline
+        as the tail/memory dump workers)."""
+        if not self._active:        # cheap fast path: one attribute read
+            return
+        with self._lock:
+            if not self._active:
+                return
+            self._remaining -= 1
+            if self._remaining > 0:
+                return
+            self._trigger_stop_locked()
+
+    def _trigger_stop_locked(self) -> None:
+        """Hand the stop to a worker (caller holds the lock). ``_done``
+        sets only AFTER the serialization lands, so waiters see files."""
+        self._active = False
+        self._stopping = True
+        done = self._done
+        threading.Thread(target=self._do_stop, args=(done,),
+                         name="escalator-profile-stop", daemon=True).start()
+
+    def _do_stop(self, done: Optional[threading.Event]) -> None:
+        jax = sys.modules.get("jax")
+        try:
+            if jax is not None:
+                jax.profiler.stop_trace()
+            self.captures += 1
+        except Exception as e:  # noqa: BLE001
+            self.last_error = str(e)
+        with self._lock:
+            self._stopping = False
+        if done is not None:
+            done.set()
+
+    def capture(self, ticks: int, out_dir: str,
+                timeout: float = 60.0) -> Dict[str, Any]:
+        """Blocking convenience: arm, wait for the K ticks (driven by
+        whatever traffic the process serves), return
+        ``{"ok": True, "dir": ..., "ticks_captured": K}`` once the trace
+        files have landed. On timeout the trace is stopped with whatever
+        landed (``timed_out: True`` — a partial profile beats none); the
+        wait for that stop's serialization is bounded separately by
+        :data:`STOP_TIMEOUT_SEC`."""
+        res = self.start(ticks, out_dir)
+        if not res.get("ok"):
+            return res
+        done = self._done
+        assert done is not None
+        completed = done.wait(timeout)
+        with self._lock:
+            captured = max(1, int(ticks)) - max(0, self._remaining)
+            if not completed and self._active:
+                self._trigger_stop_locked()
+        if not completed and not done.wait(self.STOP_TIMEOUT_SEC):
+            # the serializer is STILL writing past the bound: the caller
+            # must not read (or delete) the directory under it — report a
+            # named failure instead of shipping torn files
+            return {"ok": False, "stop_timeout": True,
+                    "error": ("profiler stop did not finish within "
+                              f"{self.STOP_TIMEOUT_SEC:.0f}s; trace "
+                              "abandoned")}
+        out = {"ok": True, "dir": out_dir, "ticks_captured": captured}
+        if not completed:
+            out["timed_out"] = True
+        return out
+
+    def wait_idle(self, timeout: float = STOP_TIMEOUT_SEC) -> bool:
+        """Wait for the most recent capture's stop to finish serializing
+        (True when idle) — callers that read the trace directory after the
+        countdown stopped the capture (tests, the tail-profile operator)
+        must not race the worker's write."""
+        with self._lock:
+            done = self._done
+        return True if done is None else done.wait(timeout)
+
+    def abort(self, timeout: float = STOP_TIMEOUT_SEC) -> None:
+        """Stop an in-flight capture (test teardown); waits for the stop's
+        serialization so the next test's start is not spuriously busy."""
+        with self._lock:
+            done = self._done
+            if self._active:
+                self._trigger_stop_locked()
+        if done is not None:
+            done.wait(timeout)
+
+
+PROFILER = ProfileCapture()
+
+
+def trace_files(out_dir: str) -> List[str]:
+    """Relative paths of every file a profiler capture wrote under
+    ``out_dir`` (the xplane.pb / trace.json.gz set TensorBoard loads)."""
+    found: List[str] = []
+    for root, _dirs, files in os.walk(out_dir):
+        for name in files:
+            found.append(os.path.relpath(os.path.join(root, name), out_dir))
+    return sorted(found)

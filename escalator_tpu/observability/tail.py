@@ -22,6 +22,10 @@ Knobs (all env; parsed per tick, memoized on the raw strings):
 - ``ESCALATOR_TPU_TAIL_DUMP_INTERVAL_SEC``: rate limit between tail dumps
   (default 60). A pathological workload where EVERY tick breaches must
   produce a trickle of bundles, not a dump-per-tick write storm.
+- ``ESCALATOR_TPU_TAIL_PROFILE=1`` (round 15, opt-in): a breach that wins
+  the rate limit also arms a jax profiler capture of the next K ticks
+  (``ESCALATOR_TPU_TAIL_PROFILE_TICKS``, default 4) into the dump
+  directory — see observability/resources.py.
 
 The breach check itself is O(buckets) (~5 µs) and runs in the root-complete
 hook, after every timed phase closed. The dump is handed to a daemon worker
@@ -166,6 +170,29 @@ class TailWatchdog:
             "multiplier": mult,
             "tick_count": hist.count,
         }
+        if os.environ.get("ESCALATOR_TPU_TAIL_PROFILE", "").lower() in (
+                "1", "true", "yes"):
+            # opt-in escalation (round 15): the first tail breach after
+            # arming ALSO captures a jax profiler trace of the next K ticks
+            # (the ticks most likely to share the breach's cause), so a
+            # slow tick on a TPU campaign yields an on-chip profile with no
+            # human in the loop. Rides the SAME rate-limit claim as the
+            # dump — a breach storm produces a trickle of profiles, not a
+            # profiler pile-up. Degrades to an "unsupported" note where the
+            # platform lacks the profiler.
+            try:
+                from escalator_tpu.observability import flightrecorder, resources
+
+                ticks = int(os.environ.get(
+                    "ESCALATOR_TPU_TAIL_PROFILE_TICKS", "4"))
+                out_dir = os.path.join(
+                    flightrecorder.dump_dir(),
+                    f"escalator-tpu-profile-tail-{os.getpid()}-"
+                    f"{int(time.time())}")
+                tail_info["profile"] = dict(
+                    resources.PROFILER.start(ticks, out_dir))
+            except Exception as e:  # noqa: BLE001 - never break the tick
+                tail_info["profile"] = {"ok": False, "error": str(e)}
         worker = threading.Thread(
             target=self._dump, args=(tail_info,),
             name="escalator-tail-dump", daemon=True)

@@ -493,6 +493,21 @@ class DeviceClusterCache:
             ),
             self._device,
         )
+        self._register_resources()
+
+    def _register_resources(self) -> None:
+        """Account the resident cluster with the device resource registry
+        (observability/resources.py): per-owner live bytes from array
+        metadata, budgeted by the executable envelope formula. Re-called on
+        refresh_full (capacity growth re-keys the budget); the weakref'd
+        registration dies with the cache."""
+        from escalator_tpu.observability import resources
+
+        G = int(self._cluster.groups.valid.shape[0])
+        resources.RESOURCES.register(
+            "cluster_arrays", self, lambda c: c._cluster,
+            budget=lambda c, _G=G: resources.expected_cluster_bytes(
+                c.pod_capacity, c.node_capacity, _G))
 
     @property
     def cluster(self) -> ClusterArrays:
@@ -673,6 +688,7 @@ class DeviceClusterCache:
                 "adopt_resident: resident arrays must carry exactly one "
                 "scratch lane over the host capacity")
         self._cluster = resident
+        self._register_resources()
         return self
 
 
@@ -844,6 +860,14 @@ class IncrementalDecider:
         self._audit_pool = None
         self._audit_future = None
         self._snap_ready = None   # Event: in-flight audit's snapshot frozen
+        #: the background audit's frozen double buffer, held ONLY while a
+        #: worker audit is in flight (observability: the resource registry
+        #: accounts it, so the transient 2x cluster footprint is visible)
+        self._audit_bufs = None
+        #: a snapshot freeze's device copies, held only inside
+        #: snapshot_state (same accounting purpose)
+        self._snapshot_frozen = None
+        self._register_resources()
         self._ticks = 0
         self._dirty_counted_tick = -1
         #: apply_gathered batches pending attachment to this tick's input
@@ -869,6 +893,69 @@ class IncrementalDecider:
         self.last_audit_ok = True
         #: ordered-tick path counts: bootstrap / repair / clean / full_sort
         self.order_stats: dict = {}
+
+    def _register_resources(self) -> None:
+        """Register every persistent buffer this decider owns with the
+        device resource registry (observability/resources.py), each with
+        its executable byte budget — the docs' envelope formulas, asserted
+        live in bench --smoke. Budgets for state that does not exist yet
+        (decision columns before the first decide, order state before the
+        first ordered tick, the audit double buffer between audits) are
+        None until the buffers appear; measured bytes are 0 then too."""
+        from escalator_tpu.observability import resources as res
+
+        def _shapes(i):
+            G = int(i._aggs.dirty.shape[0])
+            N1 = int(i._aggs.node_pods_remaining.shape[0])
+            return G, N1
+
+        def _aggs_budget(i):
+            G, N1 = _shapes(i)
+            return res.expected_aggregates_bytes(G, N1)
+
+        def _cols_budget(i):
+            if i._prev_cols is None:
+                return None
+            G, _N1 = _shapes(i)
+            return res.expected_decision_columns_bytes(G)
+
+        def _order_budget(i):
+            if i._order_state is None:
+                return None
+            _G, N1 = _shapes(i)
+            return res.expected_order_state_bytes(N1)
+
+        def _audit_budget(i):
+            if i._audit_bufs is None:
+                return None
+            G, N1 = _shapes(i)
+            return (res.expected_cluster_bytes(
+                        i._cache.pod_capacity, i._cache.node_capacity, G)
+                    + res.expected_aggregates_bytes(G, N1))
+
+        def _freeze_budget(i):
+            if i._snapshot_frozen is None:
+                return None
+            G, N1 = _shapes(i)
+            total = (res.expected_cluster_bytes(
+                         i._cache.pod_capacity, i._cache.node_capacity, G)
+                     + res.expected_aggregates_bytes(G, N1)
+                     + res.expected_decision_columns_bytes(G))
+            if i._order_state is not None:
+                total += res.expected_order_state_bytes(N1)
+            return total
+
+        reg = res.RESOURCES.register
+        reg("group_aggregates", self, lambda i: i._aggs,
+            budget=_aggs_budget)
+        reg("decision_columns", self, lambda i: i._prev_cols,
+            budget=_cols_budget)
+        reg("order_state", self, lambda i: i._order_state,
+            budget=_order_budget)
+        reg("audit_double_buffer", self, lambda i: i._audit_bufs,
+            budget=_audit_budget)
+        reg("snapshot_freeze", self, lambda i: i._snapshot_frozen,
+            budget=_freeze_budget)
 
     @property
     def aggregates(self):
@@ -1307,13 +1394,22 @@ class IncrementalDecider:
                         _audit_snapshot(cluster, aggs))
             finally:
                 snap_ready.set()
-            # chaos: worker-thread death AFTER the snapshot gate released —
-            # the tick thread must never deadlock on a dead worker, and the
-            # reconcile path must degrade to the synchronous audit
-            CHAOS.inject("audit_worker")
-            fresh = obs.fence(_kernel.compute_aggregates_jit(
-                snap_cluster, impl=self._impl))
-            mismatched = self._mismatched_columns(snap_aggs, fresh)
+            # account the frozen double buffer while it lives (resource
+            # registry owner "audit_double_buffer"): the transient 2x
+            # cluster footprint is part of the HBM envelope and must be
+            # visible, not folklore
+            self._audit_bufs = (snap_cluster, snap_aggs)
+            try:
+                # chaos: worker-thread death AFTER the snapshot gate
+                # released — the tick thread must never deadlock on a dead
+                # worker, and the reconcile path must degrade to the
+                # synchronous audit
+                CHAOS.inject("audit_worker")
+                fresh = obs.fence(_kernel.compute_aggregates_jit(
+                    snap_cluster, impl=self._impl))
+                mismatched = self._mismatched_columns(snap_aggs, fresh)
+            finally:
+                self._audit_bufs = None
             obs.annotate(refresh_audit="ok" if not mismatched
                          else f"mismatch:{','.join(mismatched)}")
         return mismatched
@@ -1393,7 +1489,15 @@ class IncrementalDecider:
                 (self._cache.cluster, self._aggs, self._prev_cols,
                  self._order_state)))
         cluster_f, aggs_f, cols_f, order_f = frozen
-        leaves = snaplib.state_to_leaves(cluster_f, aggs_f, cols_f, order_f)
+        # account the device-side freeze copies while they live (resource
+        # registry owner "snapshot_freeze") — they die when the host copy
+        # below completes and `frozen` goes out of scope
+        self._snapshot_frozen = frozen
+        try:
+            leaves = snaplib.state_to_leaves(cluster_f, aggs_f, cols_f,
+                                             order_f)
+        finally:
+            self._snapshot_frozen = None
         meta = {
             "tick": self._ticks,
             "order_bucket": self._order_bucket,

@@ -347,6 +347,16 @@ class SnapshotWriter:
         self._pool = None
         self._pending = None
         self._ticks_seen = 0
+        #: host-side staged leaves held while a serialize+write is pending
+        #: — accounted with the resource registry (kind="host") so a slow
+        #: disk backing up checkpoint copies shows up as owner bytes, not
+        #: as an unattributable RSS ramp
+        self._staged_leaves = None
+        from escalator_tpu.observability import resources
+
+        resources.RESOURCES.register(
+            "snapshot_writer_staging", self, lambda w: w._staged_leaves,
+            kind="host")
         os.makedirs(directory, exist_ok=True)
 
     def maybe_checkpoint(self, inc, force: bool = False) -> bool:
@@ -375,6 +385,7 @@ class SnapshotWriter:
             # (slow disk): finish it first so writes stay ordered and at
             # most one serialized copy of the state exists at a time
             self._drain_pending()
+        self._staged_leaves = leaves
         self._pending = self._pool.submit(self._write, leaves, meta)
 
     def _write(self, leaves, meta) -> Optional[str]:
@@ -386,6 +397,8 @@ class SnapshotWriter:
             self.failures += 1
             log.error("snapshot checkpoint write failed: %s", e)
             return None
+        finally:
+            self._staged_leaves = None
         self.checkpoints += 1
         metrics.snapshot_checkpoints.inc()
         log.debug("snapshot checkpoint -> %s", path)

@@ -163,6 +163,11 @@ class ComputeClient:
             request_serializer=lambda x: x,
             response_deserializer=lambda x: x,
         )
+        self._profile = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Profile",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x,
+        )
 
     def health(self) -> dict:
         return msgpack.unpackb(self._health(b"", timeout=self.timeout_sec))
@@ -172,6 +177,29 @@ class ComputeClient:
         import json
 
         return json.loads(self._dump(b"", timeout=self.timeout_sec))
+
+    def profile(self, ticks: int = 4, timeout_sec: float = 60.0) -> dict:
+        """Capture a jax profiler trace of the server's next ``ticks``
+        decides (the debug-profile CLI's source). Returns the server's
+        msgpack response: ``{"ok": True, "files": {relpath: bytes}, ...}``
+        on success, ``{"ok": False, "unsupported"/"busy": ...}`` where the
+        capture cannot run. The RPC deadline covers the capture window
+        PLUS a generous serialization margin — ``stop_trace`` writes the
+        whole XPlane artifact before the server can answer, and that write
+        was measured taking tens of seconds in a long-lived process (a
+        deadline of window+rpc_timeout reliably DEADLINE_EXCEEDED exactly
+        when the capture had worked). Raises grpc.RpcError (e.g.
+        UNIMPLEMENTED from a pre-round-15 server) on transport failure."""
+        from escalator_tpu.observability.resources import ProfileCapture
+
+        req = msgpack.packb({"ticks": int(ticks),
+                             "timeout_sec": float(timeout_sec)})
+        # the server may legitimately take window + its full stop bound
+        # before answering — the deadline must cover BOTH or the RPC dies
+        # exactly when the capture worked
+        margin = ProfileCapture.STOP_TIMEOUT_SEC + self.timeout_sec
+        return msgpack.unpackb(
+            self._profile(req, timeout=timeout_sec + margin))
 
     def _decide_with_retry(self, frame: bytes,
                            max_attempts: Optional[int] = None) -> bytes:

@@ -27,11 +27,18 @@ Methods:
   (the batcher's own stale-but-alive signal).
 - ``Dump``: empty -> JSON bytes of the server's flight-recorder ring (the
   ``escalator-tpu debug-dump`` CLI's wire target).
+- ``Profile``: msgpack ``{ticks, timeout_sec}`` -> msgpack ``{ok, files:
+  {relpath: bytes}, ...}`` — wraps ``jax.profiler.trace()`` around the next
+  ``ticks`` decides this server serves and ships the TensorBoard/XPlane
+  artifact back (the ``escalator-tpu debug-profile`` CLI's wire target).
+  Degrades to ``{ok: False, unsupported: reason}`` where the platform lacks
+  the profiler.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent import futures
@@ -244,6 +251,11 @@ class _ComputeService:
             "flight_recorder_depth": obs.RECORDER.depth,
             "tick_p99_ms": q["p99"],
             "tick_p999_ms": q["p999"],
+            # device resource observatory (round 15): what this server's
+            # device is holding — per-owner registered bytes + allocator
+            # cross-check (explicit "unsupported" on runtimes that report
+            # nothing), same section every flight dump carries
+            "memory": obs.resources.memory_section(),
         }
         if self._fleet is not None:
             # the batcher's stale-but-alive surface (mirrors tick_p99_ms):
@@ -265,6 +277,58 @@ class _ComputeService:
         import json
 
         return json.dumps(obs.RECORDER.as_dump("plugin-dump")).encode()
+
+    #: total profile artifact bytes one Profile RPC will ship back — a
+    #: pathological capture must not balloon one response without bound
+    _PROFILE_MAX_BYTES = 64 << 20
+
+    def profile(self, request: bytes, context) -> bytes:
+        """On-demand profiler capture: arm ``jax.profiler`` around the next
+        ``ticks`` root ticks this process completes (decides served by this
+        plugin count; so do any local controller ticks in an embedded
+        server) and return the XPlane trace files. Blocking: the RPC
+        returns when the Kth tick lands or ``timeout_sec`` expires — a
+        timeout still ships whatever the trace captured (``timed_out``
+        flag), because a partial on-chip profile beats none."""
+        import shutil
+        import tempfile
+
+        from escalator_tpu.observability import resources
+
+        try:
+            req = msgpack.unpackb(request) if request else {}
+        except Exception:  # noqa: BLE001 - malformed request: named error
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "Profile request must be a msgpack map")
+        if not isinstance(req, dict):
+            # msgpack-valid but not a map: same named error, not a
+            # server-side AttributeError surfacing as UNKNOWN
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "Profile request must be a msgpack map")
+        ticks = int(req.get("ticks", 4) or 4)
+        timeout = float(req.get("timeout_sec", 60.0) or 60.0)
+        out_dir = tempfile.mkdtemp(prefix="escalator-tpu-profile-")
+        try:
+            res = resources.PROFILER.capture(ticks, out_dir, timeout=timeout)
+            if not res.get("ok"):
+                return msgpack.packb(res)
+            files: dict = {}
+            total = 0
+            for rel in resources.trace_files(out_dir):
+                path = os.path.join(out_dir, rel)
+                size = os.path.getsize(path)
+                if total + size > self._PROFILE_MAX_BYTES:
+                    res["truncated"] = True
+                    break
+                with open(path, "rb") as f:
+                    files[rel] = f.read()
+                total += size
+            res.pop("dir", None)   # server-local tempdir: meaningless remote
+            res["files"] = files
+            res["total_bytes"] = total
+            return msgpack.packb(res)
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
 
 
 def _identity(x: bytes) -> bytes:
@@ -304,6 +368,11 @@ def make_server(
         ),
         "Dump": grpc.unary_unary_rpc_method_handler(
             service.dump,
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "Profile": grpc.unary_unary_rpc_method_handler(
+            service.profile,
             request_deserializer=_identity,
             response_serializer=_identity,
         ),

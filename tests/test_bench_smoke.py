@@ -141,14 +141,19 @@ def test_capture_summary_reads_repo_artifacts(bench):
     rows = bench._summarize_tpu_captures()
     by_file = {r["file"]: r for r in rows}
     # every committed, fully-written campaign capture must summarize cleanly
-    # (an in-flight capture is empty and emits no row at all — skip those)
-    committed = sorted(p.name for p in REPO.glob("TPU_BENCH_2026*.json"))
-    for name in committed:
-        if not (REPO / name).stat().st_size:
+    # (an in-flight capture is empty and emits no row at all — skip those).
+    # Captures live under tpu_traces/ since round 15; the root glob stays
+    # for strays from an older campaign script.
+    committed = sorted(
+        list(REPO.glob("TPU_BENCH_2026*.json"))
+        + list((REPO / "tpu_traces").glob("TPU_BENCH_2026*.json")))
+    assert committed, "no campaign captures found under tpu_traces/"
+    for path in committed:
+        if not path.stat().st_size:
             continue
-        assert name in by_file, f"{name} missing from tpu_captures"
-        assert "error" not in by_file[name], by_file[name]
-        assert by_file[name]["value_ms"] > 0
+        assert path.name in by_file, f"{path.name} missing from tpu_captures"
+        assert "error" not in by_file[path.name], by_file[path.name]
+        assert by_file[path.name]["value_ms"] > 0
     # prior-round driver benches ride along flagged
     assert any(r.get("prior_round") for r in rows)
 
@@ -222,6 +227,8 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
                        str(tmp_path / "smoke.trace.json"))
     monkeypatch.setenv("ESCALATOR_TPU_FLEET_SMOKE",
                        str(tmp_path / "fleet-smoke.json"))
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_SMOKE",
+                       str(tmp_path / "memory-smoke.json"))
     out = bench.run_smoke()
     assert out["smoke_cfg8_parity"] == "ok"
     assert out["smoke_cfg10_parity"] == "ok"
@@ -293,6 +300,30 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
     assert fleet_report["tenants"] == 8
     assert fleet_report["backpressure"]["rejected"] == 2
     assert all(v > 0 for v in fleet_report["backpressure"]["retry_after_ms"])
+    # round 15: the device resource observatory — per-owner budgets
+    # asserted, the forced leak fired the memory watchdog, the compile
+    # ring attributed, and debug-profile round-tripped a real capture
+    # through the plugin RPC (run_smoke asserts the details internally;
+    # here we lock the artifact surface CI uploads)
+    assert out["smoke_resource_budgets"] == "ok"
+    assert out["smoke_memory_watchdog"] == "ok"
+    assert out["smoke_compile_attribution"] == "ok"
+    assert out["smoke_profile_rpc"] == "ok"
+    memory_report = json.loads((tmp_path / "memory-smoke.json").read_text())
+    for need in ("cluster_arrays", "group_aggregates", "decision_columns"):
+        row = memory_report["owners"][need]
+        assert row["nbytes"] == row["budget_bytes"] > 0, (need, row)
+    assert memory_report["forced_leak"]["growth_bytes"] > 0
+    assert any(f.endswith(".xplane.pb")
+               for f in memory_report["profile_rpc"]["files"])
+    # per-leg duration table (round 15 satellite): every major leg is
+    # named in both the stdout dict and the persisted artifact
+    legs = out["smoke_leg_seconds"]
+    assert {"cfg8_order_tail", "cfg10_ffd", "cfg14_incremental", "replay",
+            "streaming", "recorder_overhead", "tail_trace", "fleet",
+            "resources"} <= set(legs)
+    assert all(sec >= 0 for sec in legs.values())
+    assert memory_report["leg_seconds"] == legs
 
 
 def test_archived_e2e_filter(bench):

@@ -193,6 +193,14 @@ def test_engine_invalid_request_does_not_poison_batch(engine):
 
 
 def test_engine_grow_and_compact():
+    from escalator_tpu.metrics import metrics as _m
+    from escalator_tpu.observability import RECORDER, resources
+
+    def _ctr(name):
+        return _m.registry.get_sample_value(name) or 0.0
+
+    grows0 = _ctr("escalator_tpu_fleet_arena_grow_total")
+    compacts0 = _ctr("escalator_tpu_fleet_arena_compact_total")
     eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
                       max_tenants=2)
     small = {f"s{i}": tiny_cluster(30 + i) for i in range(2)}
@@ -202,6 +210,16 @@ def test_engine_grow_and_compact():
     fd = eng.step([DecideRequest("s2", c3, int(NOW))])[0]
     assert_column_parity(fd.arrays, c3, NOW, msg="slot growth")
     assert eng.buckets["tenants"] == 4
+    # round 15: the grow counted, annotated its fleet_batch flight record,
+    # and the registered arena owner's bytes == the envelope formula at
+    # the NEW buckets
+    assert _ctr("escalator_tpu_fleet_arena_grow_total") == grows0 + 1
+    grow_recs = [r for r in RECORDER.snapshot()
+                 if r.get("root") == "fleet_batch"
+                 and r.get("fleet_arena_grow")]
+    assert grow_recs and "C=4" in grow_recs[-1]["fleet_arena_grow"]
+    arena = resources.RESOURCES.snapshot()["fleet_arenas"]
+    assert arena["nbytes"] == arena["budget_bytes"] > 0
     # lane/group growth: a tenant bigger than every bucket
     big = representative_cluster(G * 2, P * 4, N * 4, seed=41)
     fd = eng.step([DecideRequest("big", big, int(NOW))])[0]
@@ -216,6 +234,12 @@ def test_engine_grow_and_compact():
     eng.step([EvictRequest("s1"), EvictRequest("big")])
     info = eng.compact()
     assert info["tenants"] == 2 and info["new_c"] <= info["old_c"]
+    assert _ctr("escalator_tpu_fleet_arena_compact_total") == compacts0 + 1
+    # compact runs under its own span root, so the annotation reaches a
+    # flight record even with no batch in flight
+    compact_recs = [r for r in RECORDER.snapshot()
+                    if r.get("root") == "fleet_compact"]
+    assert compact_recs and compact_recs[-1]["fleet_arena_compact"]
     c0b = mutate(c0, np.random.default_rng(7))
     fd = eng.step([DecideRequest("s0", c0b, int(NOW) + 120)])[0]
     assert_column_parity(fd.arrays, c0b, int(NOW) + 120, msg="post-compact")

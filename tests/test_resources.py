@@ -1,0 +1,393 @@
+"""Device resource observatory (round 15): buffer-accounting registry,
+executable budgets, the memory growth watchdog, compile attribution, and
+profiler capture — plus the inertness contract (the layer is hook-side
+only; traced programs are byte-identical with it armed or disabled)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from escalator_tpu import observability as obs
+from escalator_tpu.observability import jaxmon, resources as res, spans
+
+
+@pytest.fixture(autouse=True)
+def _watchdog_hygiene():
+    res.MEMORY_WATCHDOG.reset()
+    yield
+    res.MEMORY_WATCHDOG.reset()
+    res.PROFILER.abort()
+
+
+# ------------------------------------------------------------ accounting
+def test_registry_counts_metadata_bytes_and_prunes_dead_refs():
+    class Owner:
+        def __init__(self, arrays):
+            self.arrays = arrays
+
+    o = Owner([np.zeros(100, np.int64), np.zeros(7, np.int32)])
+    reg = res.RESOURCES.register("test_owner", o, lambda x: x.arrays)
+    try:
+        snap = res.RESOURCES.snapshot()["test_owner"]
+        assert snap["nbytes"] == 800 + 28
+        assert snap["arrays"] == 2 and snap["instances"] == 1
+        # dead referent: the entry prunes itself on the next snapshot
+        del o
+        import gc
+
+        gc.collect()
+        assert "test_owner" not in res.RESOURCES.snapshot()
+    finally:
+        reg.close()
+
+
+def test_registry_walks_dataclasses_tuples_and_none():
+    from escalator_tpu.fleet.service import _empty_pods
+
+    class Owner:
+        def __init__(self):
+            self.state = (_empty_pods(4), None, [np.zeros(3, np.int8)])
+
+    o = Owner()
+    reg = res.RESOURCES.register("test_tree", o, lambda x: x.state)
+    try:
+        snap = res.RESOURCES.snapshot()["test_tree"]
+        # PodArrays(4): group i32 + cpu i64 + mem i64 + node i32 + valid b
+        assert snap["nbytes"] == 4 * (4 + 8 + 8 + 4 + 1) + 3
+        assert snap["arrays"] == 6
+    finally:
+        reg.close()
+
+
+def test_provider_error_degrades_to_error_field():
+    class Owner:
+        pass
+
+    o = Owner()
+
+    def bad(_x):
+        raise RuntimeError("provider exploded")
+
+    reg = res.RESOURCES.register("test_bad", o, bad)
+    try:
+        snap = res.RESOURCES.snapshot()["test_bad"]
+        assert "provider exploded" in snap["error"]
+        assert snap["nbytes"] == 0
+    finally:
+        reg.close()
+
+
+# ------------------------------------------- decider owners + budgets
+@pytest.fixture(scope="module")
+def decider_world():
+    import jax  # noqa: F401
+
+    from escalator_tpu.analysis.registry import representative_cluster
+    from escalator_tpu.core.arrays import ClusterArrays
+    from escalator_tpu.native.statestore import make_state_store
+    from escalator_tpu.ops.device_state import (
+        DeviceClusterCache,
+        IncrementalDecider,
+    )
+
+    G = 4
+    store = make_state_store(pod_capacity=1 << 7, node_capacity=1 << 5)
+    store.upsert_pods_batch([f"rp{i}" for i in range(40)],
+                            np.arange(40) % G,
+                            np.full(40, 500), np.full(40, 10**9))
+    store.upsert_nodes_batch([f"rn{i}" for i in range(12)],
+                             np.arange(12) % G,
+                             np.full(12, 4000), np.full(12, 16 * 10**9))
+    pods_v, nodes_v = store.as_pod_node_arrays()
+    groups = representative_cluster(G, 1, 1, seed=42).groups
+    store.drain_dirty()
+    cache = DeviceClusterCache(
+        ClusterArrays(groups=groups, pods=pods_v, nodes=nodes_v))
+    inc = IncrementalDecider(cache, refresh_every=0)
+    inc.decide(np.int64(1_700_000_000), False)
+    return store, cache, inc, G
+
+
+def test_decider_owner_budgets_match_measured(decider_world):
+    _store, cache, inc, G = decider_world
+    snap = res.RESOURCES.snapshot()
+    for owner in ("cluster_arrays", "group_aggregates", "decision_columns"):
+        rows = snap[owner]
+        assert rows["nbytes"] > 0
+        assert rows["nbytes"] == rows["budget_bytes"], (owner, rows)
+    # formula vs capacities directly (one instance per owner here —
+    # module-scoped fixture, no other decider alive in this module)
+    assert snap["cluster_arrays"]["nbytes"] >= res.expected_cluster_bytes(
+        cache.pod_capacity, cache.node_capacity, G)
+    assert snap["group_aggregates"]["nbytes"] % (
+        res.expected_aggregates_bytes(G, cache.node_capacity + 1)) == 0
+    assert snap["decision_columns"]["nbytes"] % (
+        res.expected_decision_columns_bytes(G)) == 0
+
+
+def test_budget_formulas_match_real_dtypes():
+    """The envelope formulas derive from the REAL constructors, so the
+    docs' hand constants (25 B/pod, 40 B/node, 76 B of decision columns)
+    are locked against dataclass drift here."""
+    from escalator_tpu.fleet.service import _empty_nodes, _empty_pods
+
+    pod_b = sum(getattr(_empty_pods(1), f).dtype.itemsize
+                for f in _empty_pods(1).__dataclass_fields__)
+    node_b = sum(getattr(_empty_nodes(1), f).dtype.itemsize
+                 for f in _empty_nodes(1).__dataclass_fields__)
+    assert pod_b == 25 and node_b == 40
+    assert res.expected_decision_columns_bytes(1) == 76
+    assert res.expected_order_state_bytes(10) == 280
+    # fleet arena = (C+1) x (cluster + aggs + columns) at the buckets
+    one = (res.expected_cluster_bytes(8, 4, 2)
+           + res.expected_aggregates_bytes(2, 5)
+           + res.expected_decision_columns_bytes(2))
+    assert res.expected_fleet_arena_bytes(3, 2, 8, 4) == 4 * one
+
+
+# ------------------------------------------------------------ capability
+def test_capabilities_degrade_to_unsupported_not_raise():
+    caps = res.capabilities()
+    assert set(caps) == {"memory_stats", "live_arrays", "profiler"}
+    # CPU rig (tests/conftest pins cpu): memory_stats reports nothing —
+    # the surfaces must say so explicitly instead of raising
+    mem = res.device_memory()
+    assert isinstance(mem, dict) and mem
+    for stats in mem.values():
+        assert ("unsupported" in stats) or ("bytes_in_use" in stats)
+    la = res.live_arrays_bytes()
+    assert ("unsupported" in la) or (la["nbytes"] >= 0)
+    section = res.memory_section()
+    assert {"owners", "total_registered_bytes", "device", "live_arrays",
+            "capabilities", "watchdog"} <= set(section)
+
+
+# ------------------------------------------------------------- watchdog
+def test_forced_leak_fires_memory_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_WATCH", "6")
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_MIN_GROWTH", "100")
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_DUMP_INTERVAL_SEC", "3600")
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_SAMPLE_EVERY", "1")
+
+    class Leaky:
+        def __init__(self):
+            self.arrays = []
+
+    leaky = Leaky()
+    reg = res.RESOURCES.register("test_leak", leaky, lambda o: o.arrays)
+    res.MEMORY_WATCHDOG.reset()
+    try:
+        fired = []
+        for _ in range(8):
+            leaky.arrays.append(np.zeros(64, np.int64))
+            with spans.span("leak_tick"):
+                pass
+            fired.append(res.MEMORY_WATCHDOG.dumps)
+        res.MEMORY_WATCHDOG.drain()
+        dumps = sorted(tmp_path.glob("escalator-tpu-flight-memory-*.json"))
+        assert len(dumps) == 1, dumps   # rate limit holds after the first
+        doc = json.loads(dumps[0].read_text())
+        assert doc["reason"] == "memory"
+        wd = doc["memory_watchdog"]
+        assert wd["growth_bytes"] > 0 and wd["window_ticks"] == 6
+        assert wd["owners"]["test_leak"] > 0
+        # the dump's memory section names the leaking owner too
+        assert doc["memory"]["owners"]["test_leak"]["nbytes"] > 0
+        assert res.MEMORY_WATCHDOG.breaches >= 1
+    finally:
+        reg.close()
+
+
+def test_flat_buffers_never_breach(tmp_path, monkeypatch):
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_WATCH", "4")
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_MIN_GROWTH", "1")
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_SAMPLE_EVERY", "1")
+
+    class Flat:
+        def __init__(self):
+            self.arrays = [np.zeros(64)]
+
+    flat = Flat()
+    reg = res.RESOURCES.register("test_flat", flat, lambda o: o.arrays)
+    res.MEMORY_WATCHDOG.reset()
+    try:
+        for _ in range(12):
+            with spans.span("flat_tick"):
+                pass
+        assert res.MEMORY_WATCHDOG.breaches == 0
+        assert not list(tmp_path.glob("escalator-tpu-flight-memory-*"))
+    finally:
+        reg.close()
+
+
+def test_watchdog_off_switch(monkeypatch):
+    monkeypatch.setenv("ESCALATOR_TPU_MEMORY_WATCH", "off")
+    res.MEMORY_WATCHDOG.reset()
+    for _ in range(4):
+        with spans.span("off_tick"):
+            pass
+    assert res.MEMORY_WATCHDOG.state()["samples"] == 0
+
+
+# ------------------------------------------------------ compile ring
+def test_compile_ring_attributes_by_span_path():
+    import jax
+    import jax.numpy as jnp
+
+    assert jaxmon.install()
+    marker = float(np.random.default_rng(123).integers(1, 1 << 30))
+    fn = jax.jit(lambda x: x * marker - 0.5)   # never-seen closure
+    with spans.span("ring_tick"):
+        spans.annotate(backend="ring-test")
+        with spans.span("delta_decide", kind="device"):
+            spans.fence(fn(jnp.ones(11)))
+    ring = jaxmon.compile_ring()
+    mine = [r for r in ring if r.get("root") == "ring_tick"]
+    assert mine, ring[-3:]
+    rec = mine[-1]
+    assert rec["entry"] == "kernel.delta_decide"
+    assert rec["path"].endswith("delta_decide")
+    assert rec["backend"] == "ring-test"
+    assert rec["duration_sec"] > 0
+    # attribution summary groups + flags against the retrace pins
+    rows = jaxmon.attribute_compiles(mine, pins={"kernel.delta_decide": 0})
+    row = next(r for r in rows if r["entry"] == "kernel.delta_decide")
+    assert row["bust"] is True and row["retrace_budget"] == 0
+
+
+def test_debug_compiles_cli_reads_dump(tmp_path):
+    from escalator_tpu.cli import main as cli_main
+
+    dump_path = tmp_path / "ring.json"
+    dump_path.write_text(json.dumps(obs.RECORDER.as_dump("test")))
+    assert cli_main(["debug-compiles", "--dump", str(dump_path)]) == 0
+    assert cli_main(["debug-compiles", "--dump",
+                     str(tmp_path / "missing.json")]) == 2
+
+
+# ------------------------------------------------------ profiler capture
+def test_profiler_capture_counts_roots_and_writes_trace(tmp_path):
+    import jax  # noqa: F401 - capability needs jax loaded
+
+    out_dir = tmp_path / "trace"
+    r = res.PROFILER.start(2, str(out_dir))
+    assert r["ok"], r
+    # a second arm while active reports busy, never a nested trace
+    assert res.PROFILER.start(1, str(tmp_path / "other")) == {
+        "ok": False, "busy": True}
+    with spans.span("prof_tick"):
+        pass
+    assert res.PROFILER.active
+    with spans.span("prof_tick"):
+        pass
+    # the Kth tick TRIGGERS the stop; serialization runs on a worker (the
+    # tick thread must never pay the multi-second stop_trace write)
+    assert not res.PROFILER.active
+    assert res.PROFILER.wait_idle(120)
+    files = res.trace_files(str(out_dir))
+    assert any(f.endswith(".xplane.pb") for f in files), files
+
+
+@pytest.mark.slow   # stop_trace serialization grows with process history:
+                    # ~45 s late in a full-suite run — CI's unfiltered test
+                    # job covers this path; tier-1 keeps the fast captures
+def test_profiler_capture_timeout_ships_partial(tmp_path):
+    import jax  # noqa: F401
+
+    holder = {}
+
+    def run():
+        holder["r"] = res.PROFILER.capture(
+            50, str(tmp_path / "t2"), timeout=0.5)
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.1)
+    with spans.span("partial_tick"):
+        pass
+    # generous join: stop_trace serialization can take several seconds in
+    # a long-lived suite process (the profiler carries process metadata)
+    t.join(60)
+    assert "r" in holder, "capture thread did not finish"
+    r = holder["r"]
+    assert r["ok"] and r.get("timed_out") is True
+    assert res.trace_files(str(tmp_path / "t2"))
+
+
+def test_tail_profile_escalation_arms_capture(tmp_path, monkeypatch):
+    """ESCALATOR_TPU_TAIL_PROFILE=1: the first tail breach that wins the
+    dump rate limit also arms a profiler capture of the next K ticks."""
+    import jax  # noqa: F401
+
+    from escalator_tpu.observability import histograms, tail
+
+    monkeypatch.setenv("ESCALATOR_TPU_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_CAPTURE", "2")
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_MIN_TICKS", "8")
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_PROFILE", "1")
+    monkeypatch.setenv("ESCALATOR_TPU_TAIL_PROFILE_TICKS", "1")
+    histograms.reset()
+    tail.WATCHDOG.reset()
+    try:
+        for _ in range(10):
+            histograms.TICKS.observe(("tailprof_tick",), 0.001)
+        rec = {"root": "tailprof_tick", "seq": 1, "duration_ms": 500.0}
+        assert tail.WATCHDOG.on_record(rec) is True
+        assert res.PROFILER.active
+        with spans.span("tailprof_tick"):
+            pass
+        assert not res.PROFILER.active
+        assert res.PROFILER.wait_idle(120)
+        tail.WATCHDOG.drain()
+        dump = next(tmp_path.glob("escalator-tpu-flight-tail-*.json"))
+        doc = json.loads(dump.read_text())
+        assert doc["tail"]["profile"]["ok"] is True
+        prof_dirs = list(tmp_path.glob("escalator-tpu-profile-tail-*"))
+        assert prof_dirs and res.trace_files(str(prof_dirs[0]))
+    finally:
+        histograms.reset()
+        tail.WATCHDOG.reset()
+
+
+# --------------------------------------------------------------- inertness
+def test_jaxprs_byte_identical_with_resources_armed(decider_world,
+                                                    monkeypatch):
+    """The observatory is hook-side only: tracing a registered jaxlint
+    entry with the resources layer armed (owners registered, watchdog
+    sampling every tick, compile ring recording) yields a jaxpr
+    byte-identical to the layer disabled — no budget, donation or callback
+    invariant moves."""
+    import jax
+
+    from escalator_tpu.analysis.registry import default_registry
+
+    entries = {e.name: e for e in default_registry()}
+    for name in ("kernel.delta_decide", "device_state.scatter_update_aggs"):
+        traced = entries[name].build()
+
+        def jaxpr_text():
+            return str(jax.make_jaxpr(traced.fn)(*traced.args))
+
+        monkeypatch.setenv("ESCALATOR_TPU_MEMORY_WATCH", "off")
+        plain = jaxpr_text()
+        monkeypatch.setenv("ESCALATOR_TPU_MEMORY_WATCH", "4")
+        monkeypatch.setenv("ESCALATOR_TPU_MEMORY_SAMPLE_EVERY", "1")
+        with spans.span("armed_trace"):
+            armed = jaxpr_text()
+        assert armed == plain, f"{name}: jaxpr changed under resources"
+
+
+# ------------------------------------------------------- dump integration
+def test_flight_dump_carries_memory_and_compiles(decider_world):
+    doc = obs.RECORDER.as_dump("test")
+    assert "memory" in doc
+    assert doc["memory"]["total_registered_bytes"] > 0
+    assert "cluster_arrays" in doc["memory"]["owners"]
+    assert doc.get("compiles"), "compile ring missing from the dump"

@@ -438,3 +438,68 @@ def test_plugin_health_carries_tail_fields():
     # real numbers here (a fresh process would report None until a tick)
     assert hg.tick_quantiles_ms()["count"] > 0
     assert h["tick_p99_ms"] is not None
+
+
+# --------------------------------------------------- concurrency (round 15)
+def test_histogram_concurrent_record_merge_quantile_exact():
+    """LogHistogram.record is called from tick, tail-dump-worker and fleet
+    scheduler threads while scrape/health threads run merge/quantile — the
+    counters must stay EXACT under that interleaving (a lost increment
+    would silently skew every published quantile). Four writer threads
+    hammer distinct duration ranges while a reader merges and queries
+    concurrently; afterwards count, per-bucket totals and sum must equal
+    the single-threaded truth."""
+    import threading
+
+    h = hg.LogHistogram()
+    per_thread = 4000
+    ranges = [(1e-5, 1e-4), (1e-3, 5e-3), (0.05, 0.2), (1.0, 4.0)]
+    samples = []
+    rng = np.random.default_rng(77)
+    for lo, hi in ranges:
+        samples.append(rng.uniform(lo, hi, per_thread))
+
+    stop = threading.Event()
+    reader_errors = []
+
+    def reader():
+        # concurrent merge + quantile must never crash or observe torn
+        # state (count ahead of buckets, negative interpolation, ...)
+        while not stop.is_set():
+            try:
+                m = hg.LogHistogram()
+                m.merge(h)
+                # the +Inf cumulative count is the series total
+                assert m.cumulative_buckets()[-1][1] == m.count
+                q = m.quantile(0.99)
+                assert q is None or q > 0
+            except Exception as e:  # noqa: BLE001
+                reader_errors.append(e)
+                return
+
+    def writer(vals):
+        for v in vals:
+            h.record(float(v))
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    writers = [threading.Thread(target=writer, args=(vals,))
+               for vals in samples]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not reader_errors, reader_errors
+
+    # exactness: counts, bucket totals and sum match a serial reference
+    ref = hg.LogHistogram()
+    for vals in samples:
+        for v in vals:
+            ref.record(float(v))
+    assert h.count == len(ranges) * per_thread == ref.count
+    assert h.cumulative_buckets() == ref.cumulative_buckets()
+    assert h.sum_seconds == pytest.approx(ref.sum_seconds, rel=1e-9)
+    for q in (0.5, 0.99, 0.999):
+        assert h.quantile(q) == ref.quantile(q)

@@ -24,7 +24,11 @@ print(d[0])
 EOF
   then
     echo "$TS probe OK: $(tail -1 /tmp/tpu_probe_out)" >> "$LOG"
-    CAP="TPU_BENCH_$(date -u +%Y%m%dT%H%M%SZ).json"
+    # round 15 hygiene: captures, stderr logs and partials land under
+    # tpu_traces/ (bench.py's capture/partial summarizers glob both the
+    # repo root — legacy — and tpu_traces/)
+    mkdir -p tpu_traces
+    CAP="tpu_traces/TPU_BENCH_$(date -u +%Y%m%dT%H%M%SZ).json"
     # campaign captures race a short tunnel window: fewer iters, skip the
     # CPU-only sharded subprocess (the end-of-round driver run does it all)
     # 55 min: r4 added configs (fused-tick compile, plugin round-trips, cfg9
@@ -44,7 +48,7 @@ EOF
     # budget is generous (15 min) because the heaviest single gaps between
     # flushes — cfg13's 1M-pod build and one cfg9 row's four timing loops —
     # can take several minutes on a tunnel-weather-slowed session.
-    PARTIAL="TPU_PARTIAL_${CAP#TPU_BENCH_}"
+    PARTIAL="tpu_traces/TPU_PARTIAL_${CAP#tpu_traces/TPU_BENCH_}"
     rm -f "$PARTIAL"
     ESCALATOR_TPU_BENCH_ITERS=12 ESCALATOR_TPU_BENCH_SKIP_SHARDED=1 \
        ESCALATOR_TPU_BENCH_PARTIAL="$PARTIAL" \
@@ -81,7 +85,7 @@ EOF
         echo "$(date -u +%FT%TZ) bench ran but degraded mid-run (kept $CAP)" >> "$LOG"
       else
         echo "$(date -u +%FT%TZ) bench CAPTURED on live device -> $CAP" >> "$LOG"
-        cp "$CAP" TPU_BENCH_CAPTURE.json
+        cp "$CAP" tpu_traces/TPU_BENCH_CAPTURE.json
         # one device trace per impl per campaign while the window holds
         # (cheap next to the bench; evidence of what the TPU actually
         # executes — structure only, durations are profiler artifacts)
