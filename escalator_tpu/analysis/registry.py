@@ -332,6 +332,79 @@ def _probe_fleet_step_retraces() -> int:
     return ds._fleet_step._cache_size() - before
 
 
+_FLEET_SHARDS = 2
+
+
+def _fleet_shard_mesh():
+    from jax.sharding import Mesh
+
+    from escalator_tpu.ops import device_state as ds
+
+    return Mesh(np.array(jax.devices()[:_FLEET_SHARDS]),
+                (ds.FLEET_SHARD_AXIS,))
+
+
+def _fleet_step_sharded_args(seed: int = 27, rows=(0, 1)):
+    """The fleet-step operands with a leading shard axis: two shards, each
+    a real tenant + scratch pad entry — built by stacking the SAME
+    single-shard fixture the unsharded entry analyzes."""
+    from jax import tree_util
+
+    parts = [_fleet_step_args(seed=seed + 10 * s, row=rows[s])
+             for s in range(_FLEET_SHARDS)]
+    return tree_util.tree_map(lambda *xs: np.stack(xs), *parts)
+
+
+def _build_fleet_step_sharded() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    fn = ds.make_fleet_step_sharded(_fleet_shard_mesh())
+    return TracedEntry(fn=fn, args=_fleet_step_sharded_args(), jitted=fn)
+
+
+def _probe_fleet_step_sharded_retraces() -> int:
+    """Same contract as the unsharded probe, across the shard axis too:
+    different rows/contents per shard, identical bucket shapes — one
+    compile."""
+    from escalator_tpu.ops import device_state as ds
+
+    fn = ds.make_fleet_step_sharded(_fleet_shard_mesh())
+    before = fn._cache_size()
+    for seed, rows in ((81, (0, 1)), (82, (1, 0))):
+        state_out, out = fn(*_fleet_step_sharded_args(seed=seed, rows=rows))
+        jax.block_until_ready(out)
+    return fn._cache_size() - before
+
+
+def _build_fleet_decide_sharded() -> TracedEntry:
+    fn = _fleet_decide_sharded_fn()
+    cluster = _fleet_stacked_cluster(2 * _FLEET_SHARDS)
+    nows = np.full(2 * _FLEET_SHARDS, NOW, np.int64)
+    return TracedEntry(fn=fn, args=(cluster, nows), jitted=fn)
+
+
+_fleet_decide_sharded_cache: list = []
+
+
+def _fleet_decide_sharded_fn():
+    from escalator_tpu.ops import kernel
+
+    if not _fleet_decide_sharded_cache:
+        _fleet_decide_sharded_cache.append(
+            kernel.make_fleet_decide_sharded(_fleet_shard_mesh()))
+    return _fleet_decide_sharded_cache[0]
+
+
+def _probe_fleet_decide_sharded_retraces() -> int:
+    fn = _fleet_decide_sharded_fn()
+    before = fn._cache_size()
+    nows = np.full(2 * _FLEET_SHARDS, NOW, np.int64)
+    for seed in (91, 92):
+        jax.block_until_ready(fn(
+            _fleet_stacked_cluster(2 * _FLEET_SHARDS, seed=seed), nows))
+    return fn._cache_size() - before
+
+
 def _build_mesh_decider() -> TracedEntry:
     from escalator_tpu.parallel import mesh as pmesh
 
@@ -1098,6 +1171,34 @@ def default_registry() -> List[KernelEntry]:
             donate_expected=True,  # R5: the five fleet arenas replace in place
             retrace_budget=1,      # tenant add/remove moves row indices only
             retrace_probe=_probe_fleet_step_retraces,
+        ),
+        e(
+            name="kernel.fleet_decide_sharded",
+            module="escalator_tpu.ops.kernel",
+            kind="shard_map",
+            build=_build_fleet_decide_sharded,
+            mapped=True,
+            min_devices=_FLEET_SHARDS,
+            global_axes={"pods": PODS, "nodes": NODES},
+            output_dtypes=DECISION_DTYPES,
+            collective_budget=0,   # tenants are shard-local by construction
+            retrace_budget=1,
+            retrace_probe=_probe_fleet_decide_sharded_retraces,
+        ),
+        e(
+            name="device_state.fleet_step_sharded",
+            module="escalator_tpu.ops.device_state",
+            kind="shard_map",
+            build=_build_fleet_step_sharded,
+            mapped=True,
+            min_devices=_FLEET_SHARDS,
+            global_axes={"pods": 24, "nodes": 12},
+            output_dtypes=DECISION_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,   # per-shard bodies: zero cross-shard flow
+            donate_expected=True,  # R5: donation survives the shard_map wrap
+            retrace_budget=1,      # shard/row moves are content, not shape
+            retrace_probe=_probe_fleet_step_sharded_retraces,
         ),
         e(
             name="kernel.delta_decide",

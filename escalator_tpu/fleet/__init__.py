@@ -20,8 +20,10 @@ docs/fleet.md for the operator view.
 """
 
 from escalator_tpu.fleet.scheduler import (
+    DEFAULT_CLASSES,
     AdmissionError,
     FleetScheduler,
+    PriorityClass,
 )
 from escalator_tpu.fleet.service import (
     DecideRequest,
@@ -29,12 +31,13 @@ from escalator_tpu.fleet.service import (
     EvictRequest,
     FleetDecision,
     FleetEngine,
+    StaleBatchError,
     TenantError,
     validate_tenant_id,
 )
 
 __all__ = [
-    "AdmissionError", "DecideRequest", "EvictAck", "EvictRequest",
-    "FleetDecision", "FleetEngine", "FleetScheduler", "TenantError",
-    "validate_tenant_id",
+    "AdmissionError", "DEFAULT_CLASSES", "DecideRequest", "EvictAck",
+    "EvictRequest", "FleetDecision", "FleetEngine", "FleetScheduler",
+    "PriorityClass", "StaleBatchError", "TenantError", "validate_tenant_id",
 ]

@@ -1,38 +1,54 @@
 """FleetScheduler: continuous batching in front of the FleetEngine.
 
-The plugin's fleet mode serves many tenants from one device; the scheduler
-is the admission-and-coalescing layer between their concurrent RPCs and the
-engine's one-dispatch-per-micro-batch step:
+The plugin's fleet mode serves many tenants from one device mesh; the
+scheduler is the admission-and-coalescing layer between their concurrent
+RPCs and the engine's one-dispatch-per-micro-batch step:
 
 - **Coalescing**: requests queue and flush as a micro-batch when either the
   batch-size trigger (``max_batch`` waiting) or the deadline trigger (the
   oldest request has waited ``flush_ms``) fires — tick-aligned batching
   without penalizing a lone tenant more than one flush interval.
+- **Pipelining (round 16)**: with an engine that exposes the two-stage
+  ``prepare_batch``/``execute_batch`` API, a PREP worker assembles batch
+  k+1's host diff while the DISPATCH worker's batch k device program is in
+  flight (depth-1 staged slot — prep runs at most one batch ahead, and the
+  engine executes batches in prepare order). The ``fleet_batch`` flight
+  record carries ``overlap_host_ms`` (this batch's prep wall time) and
+  ``overlap_saved_ms`` (how much of that prep overlapped recent dispatch
+  windows) so the overlap is recorder-proven, not assumed.
+- **Priority classes (round 16)**: every request carries a class
+  (:class:`PriorityClass`: ``critical``/``standard``/``batch`` by default,
+  weights 4/2/1). Batch assembly is weighted-fair across the non-empty
+  class queues (oldest-first within a class, still at most one request per
+  tenant per batch); a class can be capped to a ``queue_share`` of the
+  admission queue (the default ``batch`` class may hold at most half) and
+  declares an optional ``p99_target_ms`` — measured per-class p99 (from the
+  ``fleet/class/<name>`` histogram series) is checked on a served-request
+  cadence and breaches count ``fleet_class_p99_breach_total{klass}``.
 - **Admission / backpressure**: the queue is bounded (``queue_limit``); an
   overflowing submit raises :class:`AdmissionError` with a retry-after
   estimate, which the gRPC edge maps to RESOURCE_EXHAUSTED + a
   ``escalator-retry-after-ms`` trailer the client's RetryPolicy honors.
-- **Fairness under overload**: per-tenant in-flight caps
-  (``per_tenant_inflight``) stop one chatty tenant from occupying the whole
-  queue, and batch assembly walks the queue oldest-first, taking at most
-  one request per tenant per batch (a tenant's second request rides the
-  NEXT batch — the engine's arenas require it, and it keeps head-of-line
-  age bounded for everyone else).
+  A ``tenant-inflight`` rejection's retry-after scales with the tenant's
+  own in-flight depth plus the queue backlog (a rejected client must not
+  thundering-herd back after one flush interval).
 - **Per-tenant attribution**: every served request records its
   enqueue-to-completion latency into the streaming histogram layer under a
-  tenant-labeled root (``fleet/<tenant>`` in
-  ``escalator_tpu_tick_e2e_seconds``), so per-tenant p99s ride the same
-  PR-8 tail machinery as tick latencies.
+  tenant-labeled root (``fleet/<tenant>``) AND its class root
+  (``fleet/class/<name>``), so per-tenant and per-class p99s ride the same
+  PR-8 tail machinery as tick latencies. Errored results are NOT recorded
+  (a failed batch's wait time is not service latency).
 """
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Dict, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 from escalator_tpu import observability as obs
 from escalator_tpu.fleet.service import (
@@ -45,10 +61,41 @@ from escalator_tpu.fleet.service import (
 from escalator_tpu.metrics import metrics
 
 
+@dataclass(frozen=True)
+class PriorityClass:
+    """One admission class. ``weight`` sets the class's share of each
+    micro-batch under saturation (weighted-fair assembly); ``queue_share``
+    caps how much of the bounded queue the class may occupy (1.0 = no cap;
+    overflow rejects with reason ``queue-full-<name>``); ``p99_target_ms``
+    declares the class SLO checked against the measured ``fleet/class/…``
+    p99 (None = best effort, never breaches)."""
+
+    name: str
+    weight: int = 1
+    queue_share: float = 1.0
+    p99_target_ms: Optional[float] = None
+
+
+#: The default class set: latency-sensitive control loops, the steady
+#: majority, and best-effort bulk (capped to half the queue so a bulk flood
+#: cannot starve admission for the other classes).
+DEFAULT_CLASSES = (
+    PriorityClass("critical", weight=4, queue_share=1.0, p99_target_ms=100.0),
+    PriorityClass("standard", weight=2, queue_share=1.0,
+                  p99_target_ms=1000.0),
+    PriorityClass("batch", weight=1, queue_share=0.5, p99_target_ms=None),
+)
+
+#: served-request cadence for the per-class p99-vs-target check — cheap
+#: (one histogram quantile) but not per-request
+_SLO_CHECK_EVERY = 16
+
+
 class AdmissionError(Exception):
     """A request the scheduler refused at the door. ``reason`` is the
-    metrics label (queue-full / tenant-inflight); ``retry_after_ms`` is the
-    backoff hint shipped to the client as a gRPC trailer."""
+    metrics label (queue-full / queue-full-<class> / tenant-inflight);
+    ``retry_after_ms`` is the backoff hint shipped to the client as a gRPC
+    trailer."""
 
     def __init__(self, reason: str, retry_after_ms: float):
         super().__init__(
@@ -62,39 +109,92 @@ class AdmissionError(Exception):
 class _Pending:
     request: Union[DecideRequest, EvictRequest]
     future: Future
+    klass: str = "standard"
     enqueued: float = field(default_factory=time.monotonic)
 
 
 class FleetScheduler:
-    """Admission queue + micro-batch worker over one :class:`FleetEngine`.
+    """Admission queue + micro-batch workers over one :class:`FleetEngine`.
 
     ``submit``/``evict`` are thread-safe (the gRPC pool calls them
-    concurrently); one daemon worker owns the engine."""
+    concurrently); the engine is owned by the worker pair — a PREP thread
+    and a DISPATCH thread in pipelined mode (the default when the engine
+    has the two-stage API), or one worker running ``engine.step`` when
+    ``pipeline=False``."""
 
     def __init__(self, engine: FleetEngine, max_batch: int = 32,
                  flush_ms: float = 2.0, queue_limit: int = 256,
-                 per_tenant_inflight: int = 2):
+                 per_tenant_inflight: int = 2,
+                 classes: Tuple[PriorityClass, ...] = DEFAULT_CLASSES,
+                 default_class: Optional[str] = None,
+                 pipeline: bool = True):
         self.engine = engine
         self.max_batch = int(max_batch)
         self.flush_sec = float(flush_ms) / 1e3
         self.queue_limit = int(queue_limit)
         self.per_tenant_inflight = int(per_tenant_inflight)
-        self._q: deque = deque()
+        self.classes: Dict[str, PriorityClass] = {}
+        for c in classes:
+            if c.name in self.classes:
+                raise ValueError(f"duplicate priority class {c.name!r}")
+            if c.weight < 1:
+                raise ValueError(f"class {c.name!r} weight must be >= 1")
+            self.classes[c.name] = c
+        if default_class is None:
+            default_class = ("standard" if "standard" in self.classes
+                             else next(iter(self.classes)))
+        if default_class not in self.classes:
+            raise ValueError(f"unknown default class {default_class!r}")
+        self.default_class = default_class
+        self._queues: Dict[str, deque] = {
+            name: deque() for name in self.classes}
         self._cv = threading.Condition()
         self._inflight: Dict[str, int] = {}
         self._paused = False
         self._closed = False
         self.admitted_total = 0
         self.rejected_total = 0
-        self._worker = threading.Thread(
-            target=self._run, name="escalator-tpu-fleet", daemon=True)
+        self.deferred_total = 0
+        self.class_breaches: Dict[str, int] = {n: 0 for n in self.classes}
+        self._class_served: Dict[str, int] = {n: 0 for n in self.classes}
+        # per-class ROLLING window for the SLO check: the lifetime
+        # fleet/class/<name> series keeps a breach pinned long after the
+        # class recovers (a startup spike dominates the cumulative p99
+        # until ~100x as many good samples dilute it) — the breach check
+        # reads the samples since the LAST check and resets
+        self._slo_windows: Dict[str, obs.histograms.LogHistogram] = {
+            n: obs.histograms.LogHistogram() for n in self.classes}
+        # tenant -> {class: queued count}: the evict-class inheritance
+        # index (scanning every queued request under the cv put an
+        # O(queue_limit) walk on the lock that serializes submit)
+        self._queued_classes: Dict[str, Dict[str, int]] = {}
+        self.pipelined = bool(pipeline) and hasattr(engine, "prepare_batch")
+        # pipelined-mode plumbing: the depth-1 staged slot between the two
+        # workers, and the recent dispatch windows the overlap accounting
+        # sums a prep window against (prep runs AHEAD of its own dispatch,
+        # so its overlap partner is whatever dispatches ran meanwhile)
+        self._staged_slot: Optional[tuple] = None
+        self._dispatch_windows: deque = deque(maxlen=8)
+        self._dispatch_busy_since: Optional[float] = None
+        if self.pipelined:
+            self._worker = threading.Thread(
+                target=self._run_prep, name="escalator-tpu-fleet-prep",
+                daemon=True)
+            self._dispatcher = threading.Thread(
+                target=self._run_dispatch,
+                name="escalator-tpu-fleet-dispatch", daemon=True)
+            self._dispatcher.start()
+        else:
+            self._worker = threading.Thread(
+                target=self._run, name="escalator-tpu-fleet", daemon=True)
+            self._dispatcher = None
         self._worker.start()
 
     # -- admission ------------------------------------------------------------
 
     @property
     def queue_depth(self) -> int:
-        return len(self._q)
+        return sum(len(q) for q in self._queues.values())
 
     def oldest_waiting_sec(self) -> float:
         """Age of the oldest queued request (0.0 when the queue is empty) —
@@ -102,84 +202,270 @@ class FleetScheduler:
         scheduler keeps this under ~one flush interval; a wedged worker
         shows it growing tick over tick."""
         with self._cv:
-            if not self._q:
-                return 0.0
-            return time.monotonic() - self._q[0].enqueued
+            oldest = self._oldest_enqueued()
+            return 0.0 if oldest is None else time.monotonic() - oldest
+
+    def _oldest_enqueued(self) -> Optional[float]:
+        heads = [q[0].enqueued for q in self._queues.values() if q]
+        return min(heads) if heads else None
 
     def _reject(self, reason: str, retry_after_ms: float):
         self.rejected_total += 1
         metrics.fleet_admission_rejects.labels(reason).inc()
         raise AdmissionError(reason, retry_after_ms)
 
-    def submit(self, tenant_id: str, cluster, now_sec: int) -> Future:
+    def _retry_after_ms(self, extra_batches: float) -> float:
+        """Backoff hint: the backlog drains at one ``max_batch`` per flush
+        interval; ``extra_batches`` rides on top (a tenant-inflight
+        rejection adds the tenant's own depth — each of its requests must
+        ride a SEPARATE batch, so its backlog clears serially even when
+        the queue is empty)."""
+        backlog = self.queue_depth / max(self.max_batch, 1)
+        return (extra_batches + backlog + 1.0) * self.flush_sec * 1e3
+
+    def resolve_class(self, klass: Optional[str]) -> str:
+        """Map a request's (optional) class name to a configured class —
+        the ONE validation both the gRPC edge and direct callers run."""
+        if klass is None:
+            return self.default_class
+        if klass not in self.classes:
+            raise TenantError(
+                f"unknown priority class {klass!r} (configured: "
+                f"{sorted(self.classes)})")
+        return klass
+
+    def submit(self, tenant_id: str, cluster, now_sec: int,
+               klass: Optional[str] = None) -> Future:
         """Admit one decide. Raises :class:`TenantError` on a malformed
-        tenant id (before anything queues — a bad request never poisons a
-        batch) and :class:`AdmissionError` on backpressure."""
+        tenant id or unknown priority class (before anything queues — a
+        bad request never poisons a batch) and :class:`AdmissionError` on
+        backpressure."""
         validate_tenant_id(tenant_id)
-        return self._admit(DecideRequest(tenant_id, cluster, int(now_sec)))
+        klass = self.resolve_class(klass)
+        return self._admit(
+            DecideRequest(tenant_id, cluster, int(now_sec)), klass)
 
     def evict(self, tenant_id: str) -> Future:
         """Admit an eviction (serialized with the decide stream, so a
-        decide admitted before the evict still serves). The unknown-tenant
-        TenantError is NOT counted here — the gRPC edge owns the
-        invalid-tenant metric (counting in both places double-counted one
-        rejected RPC)."""
+        decide admitted before the evict still serves). The evict inherits
+        the LIGHTEST class among the tenant's queued requests — riding a
+        heavier class could dispatch the evict in an EARLIER batch than a
+        decide admitted before it, resurrecting the tenant the caller just
+        tore down. The unknown-tenant TenantError is NOT counted here —
+        the gRPC edge owns the invalid-tenant metric (counting in both
+        places double-counted one rejected RPC)."""
         validate_tenant_id(tenant_id)
         if not self.engine.has_tenant(tenant_id):
             raise TenantError(f"unknown tenant {tenant_id!r}")
-        return self._admit(EvictRequest(tenant_id))
+        with self._cv:
+            queued = self._queued_classes.get(tenant_id)
+            if queued:
+                klass = min(queued, key=lambda n: self.classes[n].weight)
+            else:
+                klass = self.default_class
+        return self._admit(EvictRequest(tenant_id), klass)
 
-    def _admit(self, request) -> Future:
+    def _admit(self, request, klass: str) -> Future:
         fut: Future = Future()
+        cls = self.classes[klass]
         with self._cv:
             if self._closed:
                 raise RuntimeError("fleet scheduler is shut down")
             tid = request.tenant_id
             # tenant cap BEFORE the queue bound: when both apply, the
             # precise reason is the tenant's own chattiness, not the queue
-            if self._inflight.get(tid, 0) >= self.per_tenant_inflight:
-                self._reject("tenant-inflight", self.flush_sec * 1e3)
-            if len(self._q) >= self.queue_limit:
-                # retry-after: how long the backlog takes to drain at one
-                # max_batch per flush interval (floor one interval)
-                est = (len(self._q) / max(self.max_batch, 1) + 1.0) * (
-                    self.flush_sec * 1e3)
-                self._reject("queue-full", est)
-            self._inflight[tid] = self._inflight.get(tid, 0) + 1
+            depth = self._inflight.get(tid, 0)
+            if depth >= self.per_tenant_inflight:
+                self._reject("tenant-inflight", self._retry_after_ms(depth))
+            if cls.queue_share < 1.0 and len(self._queues[klass]) >= max(
+                    1, int(self.queue_limit * cls.queue_share)):
+                self._reject(f"queue-full-{klass}", self._retry_after_ms(0))
+            if self.queue_depth >= self.queue_limit:
+                self._reject("queue-full", self._retry_after_ms(0))
+            self._inflight[tid] = depth + 1
             self.admitted_total += 1
-            self._q.append(_Pending(request, fut))
-            self._cv.notify()
+            self._queues[klass].append(_Pending(request, fut, klass))
+            per_tenant = self._queued_classes.setdefault(tid, {})
+            per_tenant[klass] = per_tenant.get(klass, 0) + 1
+            self._cv.notify_all()
         return fut
 
-    # -- the worker -----------------------------------------------------------
+    def stats(self) -> dict:
+        """One CONSISTENT snapshot of the health counters, taken under the
+        scheduler lock — the plugin ``health()`` fleet section reads this
+        instead of racing the workers field by field. Includes per-class
+        queue depth, served count, measured p99 vs target, and breaches."""
+        with self._cv:
+            oldest = self._oldest_enqueued()
+            per_class = {
+                name: {
+                    "weight": cls.weight,
+                    "queue_depth": len(self._queues[name]),
+                    "served": self._class_served[name],
+                    "p99_target_ms": cls.p99_target_ms,
+                    "breaches": self.class_breaches[name],
+                }
+                for name, cls in self.classes.items()
+            }
+            snap = {
+                "queue_depth": self.queue_depth,
+                "admitted_total": self.admitted_total,
+                "rejected_total": self.rejected_total,
+                "deferred_total": self.deferred_total,
+                "oldest_waiting_sec": round(
+                    0.0 if oldest is None
+                    else time.monotonic() - oldest, 4),
+                "pipelined": self.pipelined,
+                "classes": per_class,
+            }
+        # quantiles OUTSIDE the lock: the histogram layer has its own
+        # synchronization, and a health probe must not serialize the hot
+        # submit path behind per-class p99 scans
+        for name, row in per_class.items():
+            h = obs.histograms.TICKS.peek(f"fleet/class/{name}")
+            p99 = h.quantile(0.99) if h is not None else None
+            row["p99_ms"] = None if p99 is None else round(p99 * 1e3, 3)
+        return snap
+
+    # -- batch assembly -------------------------------------------------------
 
     def pause(self) -> None:
-        """Hold the worker (tests/smoke drive deterministic backpressure by
-        filling the queue against a paused worker)."""
+        """Hold the workers (tests/smoke drive deterministic backpressure
+        by filling the queue against a paused scheduler)."""
         with self._cv:
             self._paused = True
 
     def resume(self) -> None:
         with self._cv:
             self._paused = False
-            self._cv.notify()
+            self._cv.notify_all()
 
-    def _take_batch(self):
-        """Oldest-first batch assembly, at most one request per tenant —
-        skipped requests keep their queue position for the next batch."""
-        batch = []
-        taken_tenants = set()
-        kept = deque()
-        while self._q and len(batch) < self.max_batch:
-            p = self._q.popleft()
-            if p.request.tenant_id in taken_tenants:
-                kept.append(p)
-                continue
-            taken_tenants.add(p.request.tenant_id)
+    def _take_batch(self) -> List[_Pending]:
+        """Weighted-fair batch assembly (caller holds the lock), ONE pass
+        per queue: each non-empty class gets a slot quota proportional to
+        its weight (at least one — head-of-line age stays bounded for
+        every class), then leftover capacity fills oldest-first across
+        classes via a heap merge over per-class scan cursors. Every queued
+        request is visited AT MOST ONCE per flush — the round-14 assembly
+        re-scanned every queue from the head for each leftover slot,
+        O(queue × batch) under a deep backlog. ``taken`` is the per-flush
+        tenant index enforcing at most one request per tenant per batch;
+        a skipped request keeps its queue position (a taken tenant stays
+        taken for the whole flush, so passing it once is final) and counts
+        ``fleet_batch_deferred_total``. Within a class requests leave
+        oldest-first."""
+        batch: List[_Pending] = []
+        taken: set = set()
+        deferred = 0
+        names = [n for n, q in self._queues.items() if q]
+        items = {n: list(self._queues[n]) for n in names}
+        consumed = {n: [False] * len(items[n]) for n in names}
+        cursor = {n: 0 for n in names}
+
+        def next_free(name: str) -> Optional[int]:
+            """Advance the class cursor to its next takeable request,
+            counting one-per-tenant skips as it passes them."""
+            nonlocal deferred
+            lst = items[name]
+            i = cursor[name]
+            while i < len(lst):
+                if lst[i].request.tenant_id in taken:
+                    deferred += 1
+                    i += 1
+                    continue
+                cursor[name] = i
+                return i
+            cursor[name] = i
+            return None
+
+        def take_at(name: str, i: int) -> None:
+            p = items[name][i]
+            consumed[name][i] = True
+            cursor[name] = i + 1
+            taken.add(p.request.tenant_id)
             batch.append(p)
-        kept.extend(self._q)
-        self._q = kept
+            self._drop_queued_class(p.request.tenant_id, name)
+
+        total_w = sum(self.classes[n].weight for n in names)
+        # phase 1: weighted quotas, heaviest classes first (every active
+        # class gets at least one slot — head-of-line age stays bounded
+        # for the lightest class too). That guarantee needs a slot per
+        # active class: with max_batch SMALLER than the active-class
+        # count, heaviest-first quotas would starve the lightest class
+        # for as long as heavier queues stay non-empty — skip straight
+        # to the oldest-first fill, which is starvation-free.
+        if self.max_batch >= len(names):
+            for name in sorted(names,
+                               key=lambda n: -self.classes[n].weight):
+                quota = max(1, (self.max_batch * self.classes[name].weight)
+                            // max(total_w, 1))
+                while quota > 0 and len(batch) < self.max_batch:
+                    i = next_free(name)
+                    if i is None:
+                        break
+                    take_at(name, i)
+                    quota -= 1
+        # phase 2: leftover capacity fills oldest-first across classes — a
+        # heap merge over the class cursors. A tenant can queue in more
+        # than one class, so a popped head re-ranks (re-push) when the
+        # cursor had to advance past newly-taken tenants.
+        heap: List[Tuple[float, str]] = []
+        for name in names:
+            i = next_free(name)
+            if i is not None:
+                heapq.heappush(heap, (items[name][i].enqueued, name))
+        while heap and len(batch) < self.max_batch:
+            key, name = heapq.heappop(heap)
+            i = next_free(name)
+            if i is None:
+                continue
+            if items[name][i].enqueued > key:
+                heapq.heappush(heap, (items[name][i].enqueued, name))
+                continue
+            take_at(name, i)
+            j = next_free(name)
+            if j is not None:
+                heapq.heappush(heap, (items[name][j].enqueued, name))
+        # rebuild the queues without the consumed entries, order preserved
+        for name in names:
+            q = self._queues[name]
+            q.clear()
+            q.extend(p for p, c in zip(items[name], consumed[name],
+                                       strict=True) if not c)
+        if deferred:
+            self.deferred_total += deferred
+            metrics.fleet_batch_deferred.inc(deferred)
         return batch
+
+    def _drop_queued_class(self, tid: str, klass: str) -> None:
+        """Decrement the tenant's queued-class index (caller holds the
+        lock) — requests leave the queues only here (batch take) and in
+        ``shutdown`` (which clears the index wholesale)."""
+        per_tenant = self._queued_classes.get(tid)
+        if not per_tenant:
+            return
+        left = per_tenant.get(klass, 1) - 1
+        if left > 0:
+            per_tenant[klass] = left
+        else:
+            per_tenant.pop(klass, None)
+            if not per_tenant:
+                self._queued_classes.pop(tid, None)
+
+    def _flush_wait(self) -> Optional[float]:
+        """None when a batch should flush NOW; else how long to wait
+        (caller holds the lock)."""
+        oldest = self._oldest_enqueued()
+        if oldest is None or self._paused:
+            return 0.1
+        if self.queue_depth >= self.max_batch:
+            return None
+        age = time.monotonic() - oldest
+        if age >= self.flush_sec:
+            return None
+        return self.flush_sec - age
+
+    # -- the non-pipelined worker --------------------------------------------
 
     def _run(self) -> None:
         while True:
@@ -187,25 +473,104 @@ class FleetScheduler:
                 while True:
                     if self._closed:
                         return
-                    if self._q and not self._paused:
-                        age = time.monotonic() - self._q[0].enqueued
-                        if (len(self._q) >= self.max_batch
-                                or age >= self.flush_sec):
-                            break
-                        self._cv.wait(timeout=self.flush_sec - age)
-                    else:
-                        self._cv.wait(timeout=0.1)
+                    wait = self._flush_wait()
+                    if wait is None:
+                        break
+                    self._cv.wait(timeout=wait)
                 batch = self._take_batch()
             if batch:
                 self._serve(batch)
 
-    def _serve(self, batch) -> None:
-        metrics.fleet_batch_size.observe(len(batch))
+    def _serve(self, batch: List[_Pending]) -> None:
         try:
             results = self.engine.step([p.request for p in batch])
         except BaseException as e:  # noqa: BLE001 - engine failure fails the batch
             results = [e] * len(batch)
+        self._complete(batch, results)
+
+    # -- the pipelined worker pair -------------------------------------------
+
+    def _run_prep(self) -> None:
+        """PREP worker: takes a flushed batch, runs the engine's host-side
+        prepare, and hands the prepared batch to the dispatch worker via
+        the depth-1 staged slot (waiting while the slot is occupied — prep
+        runs at most one batch ahead, which the engine's staged-batch
+        protocol requires)."""
+        while True:
+            with self._cv:
+                while True:
+                    if self._closed:
+                        return
+                    if self._staged_slot is None:
+                        wait = self._flush_wait()
+                        if wait is None:
+                            break
+                        self._cv.wait(timeout=wait)
+                    else:
+                        self._cv.wait(timeout=0.1)
+                batch = self._take_batch()
+            if not batch:
+                continue
+            p0 = time.monotonic()
+            try:
+                pb = self.engine.prepare_batch([p.request for p in batch])
+            except BaseException as e:  # noqa: BLE001 - prep failure fails the batch
+                self._complete(batch, [e] * len(batch))
+                continue
+            p1 = time.monotonic()
+            pb.overlap_saved_ms = self._overlap_saved_ms(p0, p1)
+            with self._cv:
+                self._staged_slot = (batch, pb)
+                self._cv.notify_all()
+
+    def _overlap_saved_ms(self, p0: float, p1: float) -> float:
+        """How much of the prep window [p0, p1] ran while a device dispatch
+        was in flight — summed against the recent dispatch windows (prep
+        runs ahead of its OWN dispatch, so its overlap partners are the
+        batches dispatched meanwhile). This is the recorder-proven 'host
+        work hidden under the device program' number."""
+        with self._cv:
+            windows = list(self._dispatch_windows)
+            if self._dispatch_busy_since is not None:
+                windows.append((self._dispatch_busy_since, time.monotonic()))
+        saved = 0.0
+        for d0, d1 in windows:
+            saved += max(0.0, min(p1, d1) - max(p0, d0))
+        return saved * 1e3
+
+    def _run_dispatch(self) -> None:
+        """DISPATCH worker: executes staged batches in order. On shutdown
+        it drains a staged batch first (the in-flight contract: a batch
+        that reached prepare either executes or is released — its futures
+        never dangle)."""
+        while True:
+            with self._cv:
+                while self._staged_slot is None:
+                    if self._closed:
+                        return
+                    self._cv.wait(timeout=0.1)
+                batch, pb = self._staged_slot
+                self._staged_slot = None
+                self._dispatch_busy_since = time.monotonic()
+                self._cv.notify_all()
+            try:
+                results = self.engine.execute_batch(pb)
+            except BaseException as e:  # noqa: BLE001 - engine failure fails the batch
+                results = [e] * len(batch)
+            with self._cv:
+                self._dispatch_windows.append(
+                    (self._dispatch_busy_since, time.monotonic()))
+                self._dispatch_busy_since = None
+            self._complete(batch, results)
+
+    # -- completion -----------------------------------------------------------
+
+    def _complete(self, batch: List[_Pending], results: list) -> None:
+        from escalator_tpu.fleet.service import EvictAck
+
+        metrics.fleet_batch_size.observe(len(batch))
         done = time.monotonic()
+        slo_checks = []
         with self._cv:
             for p in batch:
                 tid = p.request.tenant_id
@@ -214,32 +579,91 @@ class FleetScheduler:
                     self._inflight[tid] = left
                 else:
                     self._inflight.pop(tid, None)
-            self._cv.notify()
-        from escalator_tpu.fleet.service import EvictAck
-
+            for p, res in zip(batch, results, strict=True):
+                if isinstance(res, BaseException):
+                    # errored results are NOT service latency — recording
+                    # them would fold queue wait on a failed batch into the
+                    # tenant/class SLO series
+                    continue
+                self._class_served[p.klass] += 1
+                if self._class_served[p.klass] % _SLO_CHECK_EVERY == 0:
+                    slo_checks.append(p.klass)
+            self._cv.notify_all()
         for p, res in zip(batch, results, strict=True):
             if isinstance(res, EvictAck):
                 # retire the tenant's series with its arena slot: per-tenant
                 # cardinality tracks resident tenants, not every id ever seen
                 obs.histograms.TICKS.discard(f"fleet/{p.request.tenant_id}")
-            else:
-                # tenant-labeled root series feeding the PR-8 tail layer:
-                # the request's e2e latency (queue wait + batch service),
-                # one histogram per tenant — exported as
-                # escalator_tpu_tick_e2e_seconds{root="fleet/<tenant>"}
+            elif not isinstance(res, BaseException):
+                # tenant-labeled AND class-labeled root series feeding the
+                # PR-8 tail layer: the request's e2e latency (queue wait +
+                # batch service) — exported as
+                # escalator_tpu_tick_e2e_seconds{root="fleet/..."}
+                dur = done - p.enqueued
                 obs.histograms.TICKS.observe(
-                    (f"fleet/{p.request.tenant_id}",), done - p.enqueued)
+                    (f"fleet/{p.request.tenant_id}",), dur)
+                obs.histograms.TICKS.observe(
+                    (f"fleet/class/{p.klass}",), dur)
+                self._slo_windows[p.klass].record(dur)
             if isinstance(res, BaseException):
                 p.future.set_exception(res)
             else:
                 p.future.set_result(res)
+        for klass in slo_checks:
+            self._check_class_slo(klass)
+
+    def _check_class_slo(self, klass: str) -> None:
+        """Breach check over the ROLLING window (the samples recorded
+        since the last check for this class, >= the check cadence): a
+        lifetime series would pin one startup spike as a breach for hours
+        after the class recovered. The window resets after evaluation, so
+        `fleet_class_p99_breach_total` keeps counting exactly while the
+        RECENT p99 sits above target and stops one window after recovery
+        (the lifetime `fleet/class/<name>` series still feeds the
+        Prometheus export and `stats()`)."""
+        target = self.classes[klass].p99_target_ms
+        if target is None:
+            return
+        with self._cv:
+            window = self._slo_windows[klass]
+            self._slo_windows[klass] = obs.histograms.LogHistogram()
+        p99 = window.quantile(0.99)
+        if p99 is not None and p99 * 1e3 > target:
+            with self._cv:
+                self.class_breaches[klass] += 1
+            metrics.fleet_class_p99_breach.labels(klass).inc()
+
+    # -- shutdown -------------------------------------------------------------
 
     def shutdown(self) -> None:
+        """Stop the workers. The in-flight/staged batch DRAINS (its futures
+        resolve with real results); queued-but-never-prepped requests fail
+        with RuntimeError. A staged batch the dispatch worker could not
+        drain (wedged engine) is released back to the engine so its twin
+        adoption unwinds, and its futures fail."""
         with self._cv:
             self._closed = True
-            pending = list(self._q)
-            self._q.clear()
+            pending = [p for q in self._queues.values() for p in q]
+            for q in self._queues.values():
+                q.clear()
+            self._queued_classes.clear()
             self._cv.notify_all()
         for p in pending:
             p.future.set_exception(RuntimeError("fleet scheduler shut down"))
         self._worker.join(timeout=5.0)
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=10.0)
+            leftover = None
+            with self._cv:
+                leftover = self._staged_slot
+                self._staged_slot = None
+            if leftover is not None:
+                batch, pb = leftover
+                try:
+                    self.engine.release_prepared(pb)
+                except Exception:  # noqa: BLE001 - release is best-effort here
+                    pass
+                err = RuntimeError("fleet scheduler shut down")
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(err)

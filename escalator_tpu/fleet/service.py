@@ -2,15 +2,24 @@
 
 The round-8 incremental decide keeps ONE cluster's state device-resident and
 pays O(dirty) per tick; the fleet engine stacks C independent tenants along a
-leading cluster axis and pays one dispatch per MICRO-BATCH of tenants:
+leading cluster axis — since round 16 PARTITIONED across a device mesh — and
+pays one dispatch per MICRO-BATCH of tenants:
 
-- resident arrays ``pods [C+1, P+1]`` / ``nodes [C+1, N+1]`` /
-  ``groups [C+1, G]`` (row C is a scratch tenant — the row-level analog of
-  the scratch lane; each row keeps its own scratch lane),
+- resident arrays ``pods [S, Cs+1, P+1]`` / ``nodes [S, Cs+1, N+1]`` /
+  ``groups [S, Cs+1, G]`` — ``S`` mesh shards of ``Cs`` tenant rows each,
+  sharded one row per device (row ``Cs`` of every shard is that shard's
+  scratch tenant; each row keeps its own scratch lane),
 - per-tenant :class:`~escalator_tpu.ops.kernel.GroupAggregates` arenas
-  ``[C+1, G]`` (+ ``node_pods_remaining [C+1, N+1]``) maintained by the same
-  exact integer deltas as the single-tenant path,
-- the 13 persistent decision columns ``[C+1, G]``.
+  ``[S, Cs+1, G]`` (+ ``node_pods_remaining [S, Cs+1, N+1]``) maintained by
+  the same exact integer deltas as the single-tenant path,
+- the 13 persistent decision columns ``[S, Cs+1, G]``.
+
+Tenants are embarrassingly parallel — ``fleet_decide`` has zero collectives
+— so the sharded step (``ops.device_state.make_fleet_step_sharded``) runs
+each shard's micro-batch slice independently and per-shard device time
+shrinks with the mesh. Every tenant's 13 decision columns stay BIT-IDENTICAL
+to the unsharded single-device path (and to its standalone ``decide_jit``),
+locked by the randomized add/evict/grow soak in tests/test_fleet.py.
 
 Ragged tenants pack into shared power-of-two ``(G, N, P)`` buckets (the
 ``statestore.delta_bucket`` policy generalized to arena shapes) with their
@@ -18,19 +27,51 @@ per-lane ``valid`` masks; a tenant outgrowing a bucket grows the arena
 (rare: buckets double), and :meth:`FleetEngine.compact` repacks live tenants
 into the smallest bucket after mass evictions.
 
-Per micro-batch, ``ops.device_state._fleet_step`` runs scatter + aggregate
-maintenance + per-tenant delta decide as ONE fused program. Host work per
-request is the positional column diff against the tenant's host twin
-(``_changed_slots`` — the IncrementalJaxBackend host-diff, per tenant) plus
-O(G) dirty bookkeeping; the dirty-group set is tracked host-side as a
-SUPERSET of the device semantics (recomputing a clean row reproduces its
-value bit-exactly, so a superset can never break parity — locked by the
-multi-tenant soak in tests/test_fleet.py).
+**Two-stage pipeline API (round 16).** The old blocking ``step`` split into
+:meth:`FleetEngine.prepare_batch` (all host work: validation, per-tenant
+positional diff against the host twins, dirty bookkeeping, operand assembly
+— CPU-bound, no device access) and :meth:`FleetEngine.execute_batch` (the
+one fused device dispatch + per-tenant unpack/ordered tails), so a
+pipelining scheduler can assemble batch k+1's host diff while batch k's
+device program is in flight. ``step()`` is still both stages back-to-back.
+
+Concurrency contract (the scheduler runs ONE prep thread + ONE dispatch
+thread; lock order is ``_exec_lock`` → ``_host`` (condition) →
+``_device_lock``, and prepared batches execute IN ORDER):
+
+- ``prepare_batch`` owns the host twins/slot maps under ``_host`` and
+  registers itself as ``_staged`` before returning; ``execute_batch``
+  clears that registration at its very END (after ordered tails), under
+  ``_host``'s condition, which is also the channel arena reshapes wait on.
+- An arena reshape (grow/compact/rebuild) bumps ``_epoch`` and must first
+  ``_await_staged_drain`` — a staged batch's operands are shaped at the old
+  buckets. The wait releases ``_host`` (condition variable), so the
+  dispatch thread can finish the staged batch meanwhile.
+- ``execute_batch``'s epoch check is an UNLOCKED read on purpose: taking
+  ``_host`` there would deadlock against a grow waiting (under ``_host``)
+  for the staged batch this very call is trying to drain. A stale batch
+  (epoch behind — only the dispatch-failure rebuild produces one) FAILS
+  with :class:`StaleBatchError`; re-preparing from the dispatch thread
+  would race the prep thread and break in-order twin adoption.
+- The dispatch-failure path bumps the epoch UNLOCKED first (so drain
+  waiters can classify the staged batch stale) and again under ``_host``
+  atomically with the twin reset.
+- ``release_prepared`` (scheduler shutdown with a staged-but-never-
+  dispatched batch) takes ``_exec_lock`` bounded, then rolls the twins
+  back from the per-entry rollback records — twins advance at PREP time,
+  so an abandoned prep must unwind or the next diff would skip lanes the
+  device never saw.
+
+Because twins adopt at prepare time, callers must NOT mutate a request's
+arrays between ``submit`` and completion — the engine copies each section
+into the arena-bucket twin during prep (``_repad_copy``), so the window is
+the prep call itself.
 
 Orders run the lazy protocol PER TENANT: the batch dispatch is the light
 program; a tenant whose decision consumes an order (tainted nodes exist, or
 some group scales down) gets a single-tenant ordered re-dispatch fed its
-maintained aggregates (``device_state._fleet_tenant_state`` +
+maintained aggregates (``device_state._fleet_tenant_state_local`` over the
+tenant's own shard block +
 ``kernel.decide_jit(aggregates=…)``) — steady fleets sort never, drains sort
 per draining tenant.
 """
@@ -39,8 +80,9 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, fields
-from typing import Dict, List, Sequence, Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -66,6 +108,13 @@ class TenantError(ValueError):
     """A per-tenant request the fleet cannot serve (malformed/unknown tenant
     id, bucket caps exceeded). Maps to INVALID_ARGUMENT at the gRPC edge —
     and never poisons the batch it would have ridden in."""
+
+
+class StaleBatchError(RuntimeError):
+    """A prepared batch went stale before executing: the arenas were
+    rebuilt (dispatch-failure recovery) after it was prepared, so its
+    operands describe state that no longer exists. The batch fails and
+    its requests must be resubmitted."""
 
 
 def validate_tenant_id(tenant_id) -> str:
@@ -112,12 +161,14 @@ class FleetDecision:
     ``decide_jit``/``delta_decide_jit`` on the same cluster. ``ordered``
     carries the lazy-orders flag: False means the order fields are
     input-order placeholders and no window may be read (exactly the
-    single-cluster protocol's contract)."""
+    single-cluster protocol's contract). ``shard`` is the mesh row the
+    tenant's arena lives on."""
 
     tenant_id: str
     arrays: object          # kernel.DecisionArrays with numpy leaves
     ordered: bool
     batch_size: int
+    shard: int = 0
 
 
 def _pow2(n: int, lo: int = 1) -> int:
@@ -172,6 +223,17 @@ def _repad(src, bucket: int, empty_fn):
     return out
 
 
+def _repad_copy(src, bucket: int, empty_fn):
+    """:func:`_repad` that ALWAYS copies — prepared twins must not alias a
+    caller's request arrays (the pipeline holds them across the dispatch,
+    after the RPC that carried them has already returned)."""
+    out = _repad(src, bucket, empty_fn)
+    if out is src:
+        out = type(src)(**{f.name: np.array(getattr(src, f.name))
+                           for f in fields(src)})
+    return out
+
+
 def _changed_rows(old, new) -> np.ndarray:
     """Row indices where ANY column differs (positional diff, all fields)."""
     changed = None
@@ -182,7 +244,8 @@ def _changed_rows(old, new) -> np.ndarray:
 
 
 #: The persistent-decision-column dtypes, in kernel.GROUP_DECISION_FIELDS
-#: order — the [C+1, G] arena columns must match DecisionArrays bit-for-bit.
+#: order — the [S, Cs+1, G] arena columns must match DecisionArrays
+#: bit-for-bit.
 _COL_DTYPES = {
     "status": np.int32, "nodes_delta": np.int32,
     "cpu_percent": np.float64, "mem_percent": np.float64,
@@ -228,9 +291,27 @@ def zero_state(C: int, G: int, P: int, N: int):
     return pods, nodes, groups, aggs, prev_cols
 
 
+def zero_state_sharded(S: int, C: int, G: int, P: int, N: int):
+    """:func:`zero_state` with a leading shard axis: ``S`` independent
+    ``[C+1, …]`` arena stacks (each shard carries its OWN scratch tenant
+    row). Feeds ``device_state.make_fleet_step_sharded`` directly."""
+    base = zero_state(C, G, P, N)
+
+    def stack(x):
+        if isinstance(x, tuple):
+            return tuple(stack(v) for v in x)
+        if isinstance(x, np.ndarray):
+            return np.broadcast_to(x, (S,) + x.shape).copy()
+        return type(x)(**{f.name: stack(getattr(x, f.name))
+                          for f in fields(x)})
+
+    return tuple(stack(part) for part in base)
+
+
 @dataclass
 class _Tenant:
-    slot: int
+    shard: int               # mesh row the tenant's arena slot lives on
+    row: int                 # tenant row within the shard (< Cs)
     pods: PodArrays          # host twin at bucket shapes (no scratch lane)
     nodes: NodeArrays
     groups: GroupArrays
@@ -239,33 +320,102 @@ class _Tenant:
     ticks: int = 0
 
 
-class FleetEngine:
-    """Owns the C-stacked device arenas + host twins for a fleet of tenants.
+@dataclass
+class _Entry:
+    """One prepared request: everything execute/rollback needs, snapshotted
+    at prep time (execute must not read mutable tenant fields — a later
+    prep may be rewriting them concurrently)."""
 
-    NOT internally synchronized for mutation: exactly one caller —
-    normally the :class:`~escalator_tpu.fleet.scheduler.FleetScheduler`
-    worker — may run :meth:`step` / :meth:`compact` at a time (reads like
-    :attr:`tenant_count` are safe from any thread)."""
+    pos: int
+    request: Union[DecideRequest, EvictRequest]
+    tenant: _Tenant
+    shard: int
+    row: int
+    shapes: Tuple[int, int, int]
+    new_secs: tuple          # (pods, nodes, groups) at arena buckets
+    now: int
+    pod_slots: np.ndarray
+    node_slots: np.ndarray
+    dirty_mask: np.ndarray
+    tainted_any: bool
+    evict: bool
+    registered: bool         # this prep created the tenant (rollback: drop)
+    # rollback: the twin references this prep replaced (None for evicts —
+    # the tenant object itself, still holding its twins, is the rollback)
+    old_twins: Optional[tuple]
+    old_dirty: Optional[np.ndarray]
+    old_shapes: Optional[tuple]
+    t_index: int = -1        # position within the shard's batch slice
+
+
+@dataclass
+class _PreparedBatch:
+    """The output of :meth:`FleetEngine.prepare_batch`: host-assembled
+    operands for one micro-batch, valid at ``epoch``. ``results`` already
+    carries the per-request TenantErrors; execute fills the rest."""
+
+    epoch: int
+    requests: list
+    results: list
+    entries: List[_Entry]
+    operands: Optional[tuple]
+    prep_ms: float = 0.0
+    #: set by a pipelining scheduler: how much of this prep ran while a
+    #: device program was in flight (annotated onto the fleet_batch record)
+    overlap_saved_ms: Optional[float] = None
+    executed: bool = False
+    released: bool = False
+
+
+class FleetEngine:
+    """Owns the shard-stacked device arenas + host twins for a fleet of
+    tenants across a device mesh.
+
+    Mutation concurrency: at most ONE thread may run :meth:`prepare_batch`
+    at a time and ONE thread :meth:`execute_batch` (the scheduler's prep +
+    dispatch workers), with prepared batches executed in prepare order;
+    :meth:`step` is both stages back-to-back for sequential callers. Reads
+    like :attr:`tenant_count` are safe from any thread."""
 
     def __init__(self, num_groups: int = 8, pod_capacity: int = 128,
                  node_capacity: int = 64, max_tenants: int = 8,
-                 device=None,
+                 device=None, num_shards: int = 1,
                  max_group_bucket: int = 1 << 12,
                  max_pod_bucket: int = 1 << 20,
                  max_node_bucket: int = 1 << 18,
                  max_tenant_bucket: int = 1 << 16):
         from escalator_tpu.jaxconfig import guarded_devices
+        from escalator_tpu.ops import device_state as ds
 
-        self._device = device if device is not None else guarded_devices()[0]
+        if device is not None:
+            devices = [device]
+        else:
+            devices = list(guarded_devices())
+        S = len(devices) if num_shards in (0, None) else int(num_shards)
+        if S < 1 or S > len(devices):
+            raise ValueError(
+                f"num_shards={num_shards} needs 1..{len(devices)} of the "
+                f"available devices")
+        self._devices = devices[:S]
+        self._S = S
+        self._mesh = self._make_mesh(self._devices)
+        self._step_fn = ds.make_fleet_step_sharded(self._mesh)
         self._G = _pow2(num_groups, 4)
         self._P = _pow2(pod_capacity, 16)
         self._N = _pow2(node_capacity, 8)
-        self._C = _pow2(max_tenants, 2)
+        # per-SHARD tenant rows: the pow2 bucket over an even split
+        self._C = _pow2(-(-int(max_tenants) // S), 2)
         self._caps = (max_group_bucket, max_pod_bucket, max_node_bucket,
                       max_tenant_bucket)
         self._tenants: Dict[str, _Tenant] = {}
-        self._free: List[int] = list(range(self._C))
-        self._lock = threading.Lock()   # slot map reads vs step mutation
+        self._free: List[List[int]] = [list(range(self._C))
+                                       for _ in range(S)]
+        # lock order: _exec_lock -> _host (condition) -> _device_lock
+        self._exec_lock = threading.Lock()     # serializes execute/compact
+        self._host = threading.Condition()     # twins/slots/staged + drain cv
+        self._device_lock = threading.Lock()   # self._state swaps
+        self._epoch = 0
+        self._staged: Optional[_PreparedBatch] = None
         self.batches = 0
         self.decisions = 0
         self.ordered_redispatches = 0
@@ -273,8 +423,24 @@ class FleetEngine:
 
     # -- arena construction / reshaping --------------------------------------
 
+    @staticmethod
+    def _make_mesh(devices):
+        from jax.sharding import Mesh
+
+        from escalator_tpu.ops import device_state as ds
+
+        return Mesh(np.array(devices), (ds.FLEET_SHARD_AXIS,))
+
+    @property
+    def _sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from escalator_tpu.ops import device_state as ds
+
+        return NamedSharding(self._mesh, PartitionSpec(ds.FLEET_SHARD_AXIS))
+
     def _host_zero_state(self, C: int, G: int, P: int, N: int):
-        return zero_state(C, G, P, N)
+        return zero_state_sharded(self._S, C, G, P, N)
 
     def _init_state(self) -> None:
         import jax
@@ -285,14 +451,14 @@ class FleetEngine:
         # — device_put on PodArrays/NodeArrays/GroupArrays needs them)
         self._state = jax.device_put(
             self._host_zero_state(self._C, self._G, self._P, self._N),
-            self._device)
-        # HBM accounting: the C-stacked arenas are ONE owner whose budget
-        # is the docs/fleet.md capacity-envelope formula at the CURRENT
-        # buckets (the budget callable re-reads them, so a grow/compact
-        # moves the envelope with the arrays)
+            self._sharding)
+        # HBM accounting: the shard-stacked arenas are ONE owner whose
+        # budget is the docs/fleet.md capacity-envelope formula at the
+        # CURRENT buckets, times the shard count (each shard adds its own
+        # scratch row); a grow/compact moves the envelope with the arrays
         resources.RESOURCES.register(
             "fleet_arenas", self, lambda e: e._state,
-            budget=lambda e: resources.expected_fleet_arena_bytes(
+            budget=lambda e: e._S * resources.expected_fleet_arena_bytes(
                 e._C, e._G, e._P, e._N))
 
     def _pull_state(self):
@@ -301,46 +467,64 @@ class FleetEngine:
 
         return tree_util.tree_map(np.asarray, self._state)
 
+    def _await_staged_drain(self) -> None:
+        """Wait (releasing ``_host``) until no prepared batch is
+        outstanding at the CURRENT epoch — arena reshapes must not pull the
+        rug from under operands staged at the old buckets. A stale staged
+        batch (epoch behind, arenas already rebuilt) is skipped: execute
+        discards it with StaleBatchError rather than running it."""
+        while True:
+            st = self._staged
+            if st is None or st.released or st.executed:
+                return
+            if st.epoch != self._epoch and self._state is not None:
+                return
+            self._host.wait(timeout=0.1)
+
     def _grow(self, G2: int, P2: int, N2: int, C2: int) -> None:
         """Grow the arenas to new buckets: copy the leading real lanes/rows
-        into freshly-zeroed arrays (pad values are position-invariant, so
-        the old scratch lane/rows are reproduced by construction) and
-        re-upload. O(arena) host work — rare by design: buckets double."""
+        of every shard into freshly-zeroed arrays (pad values are
+        position-invariant, so the old scratch lane/rows are reproduced by
+        construction) and re-upload. O(arena) host work — rare by design:
+        buckets double. Caller holds ``_host``; waits out any staged batch
+        and bumps the epoch."""
         import jax
 
         cap_g, cap_p, cap_n, cap_c = self._caps
-        if G2 > cap_g or P2 > cap_p or N2 > cap_n or C2 > cap_c:
+        if G2 > cap_g or P2 > cap_p or N2 > cap_n or C2 * self._S > cap_c:
             raise TenantError(
                 f"fleet arena bucket cap exceeded: need (G={G2}, P={P2}, "
-                f"N={N2}, C={C2}) caps (G={cap_g}, P={cap_p}, N={cap_n}, "
-                f"C={cap_c})")
-        old = self._pull_state()
-        new = self._host_zero_state(C2, G2, P2, N2)
+                f"N={N2}, C={C2 * self._S}) caps (G={cap_g}, P={cap_p}, "
+                f"N={cap_n}, C={cap_c})")
+        self._await_staged_drain()
         C, G, P, N = self._C, self._G, self._P, self._N
+        with self._device_lock:
+            old = self._pull_state()
+            new = self._host_zero_state(C2, G2, P2, N2)
 
-        def copy_soa(dst, src, lanes):
-            for f in fields(dst):
-                getattr(dst, f.name)[: C + 1, :lanes] = \
-                    getattr(src, f.name)[:, :lanes]
+            def copy_soa(dst, src, lanes):
+                for f in fields(dst):
+                    getattr(dst, f.name)[:, : C + 1, :lanes] = \
+                        getattr(src, f.name)[:, :, :lanes]
 
-        pods_o, nodes_o, groups_o, aggs_o, cols_o = old
-        pods_n, nodes_n, groups_n, aggs_n, cols_n = new
-        copy_soa(pods_n, pods_o, P)     # real lanes; scratch lane = pad
-        copy_soa(nodes_n, nodes_o, N)
-        copy_soa(groups_n, groups_o, G)
-        for f in fields(type(aggs_n)):
-            dst, src = getattr(aggs_n, f.name), getattr(aggs_o, f.name)
-            # node_pods_remaining copies its real lanes only (the old
-            # scratch lane holds 0, the new arrays' default); [G] columns
-            # copy whole (G2 >= G)
-            lanes = N if f.name == "node_pods_remaining" else src.shape[1]
-            dst[: C + 1, :lanes] = src[:, :lanes]
-        for dst, src in zip(cols_n, cols_o, strict=True):
-            dst[: C + 1, :G] = src
-        # the scratch tenant row (index C of the OLD stack) carried pad
-        # values only, so landing it at row C of the new stack is harmless;
-        # rows C..C2 start as fresh scratch/empty rows either way.
-        self._state = jax.device_put(new, self._device)
+            pods_o, nodes_o, groups_o, aggs_o, cols_o = old
+            pods_n, nodes_n, groups_n, aggs_n, cols_n = new
+            copy_soa(pods_n, pods_o, P)     # real lanes; scratch lane = pad
+            copy_soa(nodes_n, nodes_o, N)
+            copy_soa(groups_n, groups_o, G)
+            for f in fields(type(aggs_n)):
+                dst, src = getattr(aggs_n, f.name), getattr(aggs_o, f.name)
+                # node_pods_remaining copies its real lanes only (the old
+                # scratch lane holds 0, the new arrays' default); [G]
+                # columns copy whole (G2 >= G)
+                lanes = N if f.name == "node_pods_remaining" else src.shape[2]
+                dst[:, : C + 1, :lanes] = src[:, :, :lanes]
+            for dst, src in zip(cols_n, cols_o, strict=True):
+                dst[:, : C + 1, :G] = src
+            # each shard's old scratch row (index C) carried pad values
+            # only, so landing it at row C of the new stack is harmless;
+            # rows C..C2 start as fresh scratch/empty rows either way.
+            self._state = jax.device_put(new, self._sharding)
         if G2 != G:
             # new group rows exist for every tenant now; their persistent
             # columns are zeros, not a computed decision — recompute
@@ -356,57 +540,96 @@ class FleetEngine:
                 d[: len(t.dirty)] = t.dirty
                 t.dirty = d
         if C2 != C:
-            self._free.extend(range(C, C2))
+            for s in range(self._S):
+                self._free[s].extend(range(C, C2))
         self._G, self._P, self._N, self._C = G2, P2, N2, C2
+        self._epoch += 1
         # arena lifecycle visibility (round 15): a grow silently doubled
         # resident HBM before this — now it counts, annotates the
-        # fleet_batch flight record it happened under, and moves the
-        # registered fleet_arenas owner bytes + budget in the same tick
+        # fleet_batch/fleet_prep flight record it happened under, and moves
+        # the registered fleet_arenas owner bytes + budget in the same tick
         metrics.fleet_arena_grows.inc()
-        obs.annotate(fleet_arena_grow=f"G={G2} P={P2} N={N2} C={C2}")
-        log.info("fleet arena grown to G=%d P=%d N=%d C=%d", G2, P2, N2, C2)
+        obs.annotate(fleet_arena_grow=(
+            f"G={G2} P={P2} N={N2} C={C2 * self._S}"))
+        log.info("fleet arena grown to G=%d P=%d N=%d C=%d (x%d shards)",
+                 G2, P2, N2, C2, self._S)
 
     def compact(self) -> dict:
-        """Repack live tenants into the leading slots and shrink the tenant
-        axis to the smallest power-of-two bucket that holds them — the
-        post-mass-eviction memory reclaim. Lane buckets are left alone
-        (shrinking them would force every tenant's twin through a repad for
-        marginal HBM). Returns {tenants, old_c, new_c}."""
-        from jax import tree_util
-
-        import jax
-
+        """Repack live tenants round-robin across the shards' leading rows
+        and shrink the tenant axis to the smallest power-of-two bucket that
+        holds them — the post-mass-eviction memory reclaim. Lane buckets
+        are left alone (shrinking them would force every tenant's twin
+        through a repad for marginal HBM). Returns {tenants, old_c,
+        new_c} (tenant-row counts summed over shards)."""
         # own span root: compact runs OUTSIDE any batch (an operator or
         # maintenance call), and annotate() is a no-op without a timeline
         # — without this the advertised fleet_arena_compact annotation
-        # could never reach a flight record
-        with obs.span("fleet_compact"), self._lock:
-            live = sorted(self._tenants.values(), key=lambda t: t.slot)
-            C2 = _pow2(len(live), 2)
-            old_c = self._C
-            rows = [t.slot for t in live]
+        # could never reach a flight record.
+        # Drain-then-lock loop: waiting for the staged batch WHILE holding
+        # _exec_lock would deadlock — the execute that drains it needs
+        # that very lock. So wait under _host alone, then take the locks
+        # and re-check nothing re-staged in the window.
+        with obs.span("fleet_compact"):
+            # bounded: under continuous pipelined traffic the prep thread
+            # can re-stage a batch in the drain->lock window every round,
+            # so an unbounded loop could spin forever — fail the admin
+            # call instead of wedging it (the caller retries off-peak or
+            # pauses the scheduler first)
+            deadline = time.monotonic() + 30.0
+            while True:
+                with self._host:
+                    self._await_staged_drain()
+                with self._exec_lock, self._host:
+                    st = self._staged
+                    if (st is None or st.executed or st.released
+                            or st.epoch != self._epoch):
+                        return self._compact_locked()
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "fleet compact timed out: a staged batch kept "
+                        "re-appearing for 30 s (continuous pipelined "
+                        "traffic) — pause the scheduler and retry")
+
+    def _compact_locked(self) -> dict:
+        """Caller holds ``_exec_lock`` + ``_host`` with no live staged
+        batch."""
+        import jax
+        from jax import tree_util
+
+        live = sorted(self._tenants.values(),
+                      key=lambda t: (t.shard, t.row))
+        C2 = _pow2(-(-len(live) // self._S), 2)
+        old_c = self._C * self._S
+        with self._device_lock:
             old = self._pull_state()
             new = self._host_zero_state(C2, self._G, self._P, self._N)
+            placement = [(t, i % self._S, i // self._S)
+                         for i, t in enumerate(live)]
 
             def place(dst_tree, src_tree):
                 for f_dst, f_src in zip(
                         tree_util.tree_leaves(dst_tree),
                         tree_util.tree_leaves(src_tree), strict=True):
-                    for i, r in enumerate(rows):
-                        f_dst[i] = f_src[r]
+                    for t, s2, r2 in placement:
+                        f_dst[s2, r2] = f_src[t.shard, t.row]
 
             for dst, src in zip(new, old, strict=True):
                 place(dst, src)
-            self._state = jax.device_put(new, self._device)
-            for i, t in enumerate(live):
-                t.slot = i
-            self._free = list(range(len(live), C2))
-            self._C = C2
-            metrics.fleet_arena_compacts.inc()
-            obs.annotate(fleet_arena_compact=f"C={old_c}->{C2}")
+            self._state = jax.device_put(new, self._sharding)
+        for t, s2, r2 in placement:
+            t.shard, t.row = s2, r2
+        used = [0] * self._S
+        for t in live:
+            used[t.shard] += 1
+        self._free = [list(range(used[s], C2)) for s in range(self._S)]
+        self._C = C2
+        self._epoch += 1
+        metrics.fleet_arena_compacts.inc()
+        obs.annotate(fleet_arena_compact=f"C={old_c}->{C2 * self._S}")
         log.info("fleet arena compacted: %d tenants, C %d -> %d",
-                 len(live), old_c, C2)
-        return {"tenants": len(live), "old_c": old_c, "new_c": C2}
+                 len(live), old_c, C2 * self._S)
+        return {"tenants": len(live), "old_c": old_c,
+                "new_c": C2 * self._S}
 
     # -- tenant lifecycle ----------------------------------------------------
 
@@ -415,18 +638,29 @@ class FleetEngine:
         return len(self._tenants)
 
     @property
+    def shards(self) -> int:
+        return self._S
+
+    @property
     def buckets(self) -> dict:
         return {"groups": self._G, "pods": self._P, "nodes": self._N,
-                "tenants": self._C}
+                "tenants": self._C * self._S,
+                "tenant_rows_per_shard": self._C, "shards": self._S}
 
     def has_tenant(self, tenant_id: str) -> bool:
         return tenant_id in self._tenants
 
+    def shard_of(self, tenant_id: str) -> Optional[int]:
+        t = self._tenants.get(tenant_id)
+        return None if t is None else t.shard
+
     def _register(self, tenant_id: str) -> _Tenant:
-        if not self._free:
+        if not any(self._free):
             self._grow(self._G, self._P, self._N, self._C * 2)
+        # balance: the shard with the most free rows (ties -> lowest id)
+        shard = max(range(self._S), key=lambda s: (len(self._free[s]), -s))
         t = _Tenant(
-            slot=self._free.pop(0),
+            shard=shard, row=self._free[shard].pop(0),
             pods=_empty_pods(self._P), nodes=_empty_nodes(self._N),
             groups=_empty_groups(self._G),
             # bootstrap: EVERY group row computes on the first decide, so
@@ -448,30 +682,29 @@ class FleetEngine:
                        max(self._P, _pow2(P_c, 16)),
                        max(self._N, _pow2(N_c, 8)), self._C)
 
-    # -- the micro-batch step ------------------------------------------------
+    # -- stage 1: host prep ---------------------------------------------------
 
-    def step(self, requests: Sequence[Union[DecideRequest, EvictRequest]]
-             ) -> List[Union[FleetDecision, EvictAck, Exception]]:
-        """Serve one micro-batch: at most one request per tenant (the
-        scheduler's coalescing guarantees it; direct callers must too).
-        Returns one result per request, position-aligned; a request that
-        fails validation comes back as its exception WITHOUT poisoning the
-        rest of the batch. One ``_fleet_step`` dispatch total, plus one
-        ordered re-dispatch per tenant whose decision consumes an order."""
-        from escalator_tpu.ops import device_state as ds
-        from escalator_tpu.ops import kernel as _kernel
-
+    def prepare_batch(self, requests: Sequence[Union[DecideRequest,
+                                                     EvictRequest]]
+                      ) -> _PreparedBatch:
+        """All host work for one micro-batch: validation, tenant lifecycle
+        (register/evict slot moves), per-tenant positional diff, dirty
+        bookkeeping, twin adoption, and operand assembly. No device access
+        (an arena grow is the one exception — it drains any staged batch
+        first). At most one request per tenant (the scheduler's coalescing
+        guarantees it; direct callers must too). The returned batch is
+        registered as the engine's staged batch until executed or
+        released."""
         seen = set()
         for r in requests:
             if r.tenant_id in seen:
                 raise ValueError(
                     f"duplicate tenant {r.tenant_id!r} in one micro-batch")
             seen.add(r.tenant_id)
-        results: List[Union[FleetDecision, EvictAck, Exception, None]] = (
-            [None] * len(requests))
-        with obs.span("fleet_batch"), self._lock:
-            obs.annotate(backend="fleet", batch_size=len(requests))
-            prepared = []   # (pos, tenant, new sections, now, request)
+        t0 = time.perf_counter()
+        results: List[object] = [None] * len(requests)
+        entries: List[_Entry] = []
+        with obs.span("fleet_prep"), self._host:
             with obs.span("fleet_diff"):
                 # pass 1: grow the lane buckets for EVERY request up front —
                 # a grow mid-batch would invalidate sections staged at the
@@ -483,204 +716,325 @@ class FleetEngine:
                         self._ensure_buckets(r.cluster)
                     except TenantError as e:
                         results[pos] = e
-                for pos, r in enumerate(requests):
-                    if results[pos] is not None:
-                        continue
-                    try:
-                        prepared.append((pos, *self._prepare(r)))
-                    except TenantError as e:
-                        results[pos] = e
-            if prepared:
-                out_host = self._dispatch(prepared, ds, _kernel)
-                with obs.span("fleet_unpack"):
-                    for i, (pos, tenant, new_secs, now, r) in enumerate(
-                            prepared):
-                        results[pos] = self._finish(
-                            i, out_host, tenant, new_secs, now, r,
-                            len(prepared), ds, _kernel)
-            self.batches += 1
-            obs.annotate(
-                tenants=[r.tenant_id for r in requests],
-                fleet_tenants_resident=len(self._tenants))
-        return results   # type: ignore[return-value]
+                pending_free: List[Tuple[int, int]] = []
+                try:
+                    for pos, r in enumerate(requests):
+                        if results[pos] is not None:
+                            continue
+                        try:
+                            entries.append(
+                                self._prepare_entry(pos, r, pending_free))
+                        except TenantError as e:
+                            results[pos] = e
+                    operands = (self._assemble(entries) if entries
+                                else None)
+                except BaseException:
+                    # a non-TenantError escape (a device error inside a
+                    # register-grow, an assembly failure) must not leave
+                    # the engine half-prepared: earlier entries' twins
+                    # were already adopted and evicted tenants already
+                    # popped — unwind them through the same per-entry
+                    # rollback records release_prepared uses (evict rows
+                    # were never flushed into _free, so the resurrect
+                    # path's membership guard holds), then re-raise so
+                    # the caller fails the whole batch
+                    for e in reversed(entries):
+                        self._rollback_entry(e)
+                    metrics.fleet_tenant_count.set(len(self._tenants))
+                    raise
+                # evicted rows become reusable for the NEXT prepare only —
+                # same-batch reuse would put two batch entries on one
+                # arena row (scatter order between them is undefined)
+                for shard, row in pending_free:
+                    self._free[shard].append(row)
+                    self._free[shard].sort()
+            pb = _PreparedBatch(
+                epoch=self._epoch, requests=list(requests), results=results,
+                entries=entries, operands=operands,
+                prep_ms=(time.perf_counter() - t0) * 1e3)
+            self._staged = pb
+        return pb
 
-    def _prepare(self, r):
+    def _prepare_entry(self, pos: int, r, pending_free) -> _Entry:
         """Validate + stage one request: resolve its tenant (registering a
-        new one), re-pad its sections into the arena buckets, and leave the
-        twin/dirty update to the post-dispatch finish."""
+        new one / unregistering an evict), diff against the host twin, fold
+        the dirty mask, ADOPT the new twins (rollback records kept), and
+        return the entry execute will slice."""
         validate_tenant_id(r.tenant_id)
-        if isinstance(r, EvictRequest):
-            tenant = self._tenants.get(r.tenant_id)
+        evict = isinstance(r, EvictRequest)
+        registered = False
+        if evict:
+            tenant = self._tenants.pop(r.tenant_id, None)
             if tenant is None:
                 raise TenantError(f"unknown tenant {r.tenant_id!r}")
+            metrics.fleet_tenant_count.set(len(self._tenants))
             # eviction is a decide against the EMPTY cluster: every valid
             # lane clears, aggregates fall to zero, the slot frees after
-            new_secs = (_empty_pods(self._P), _empty_nodes(self._N),
-                        _empty_groups(self._G))
-            return tenant, new_secs, 0, r
-        tenant = self._tenants.get(r.tenant_id)
-        if tenant is None:
-            tenant = self._register(r.tenant_id)
-        tenant.shapes = (
-            int(r.cluster.groups.valid.shape[0]),
-            int(r.cluster.pods.valid.shape[0]),
-            int(r.cluster.nodes.valid.shape[0]),
-        )
-        new_secs = (
-            _repad(r.cluster.pods, self._P, _empty_pods),
-            _repad(r.cluster.nodes, self._N, _empty_nodes),
-            _repad(r.cluster.groups, self._G, _empty_groups),
-        )
-        return tenant, new_secs, int(r.now_sec), r
+            new_p, new_n, new_g = (_empty_pods(self._P),
+                                   _empty_nodes(self._N),
+                                   _empty_groups(self._G))
+            now = 0
+            pending_free.append((tenant.shard, tenant.row))
+        else:
+            tenant = self._tenants.get(r.tenant_id)
+            if tenant is None:
+                tenant = self._register(r.tenant_id)
+                registered = True
+            new_p = _repad_copy(r.cluster.pods, self._P, _empty_pods)
+            new_n = _repad_copy(r.cluster.nodes, self._N, _empty_nodes)
+            new_g = _repad_copy(r.cluster.groups, self._G, _empty_groups)
+            now = int(r.now_sec)
+        old_twins = (tenant.pods, tenant.nodes, tenant.groups)
+        old_dirty = tenant.dirty
+        old_shapes = tenant.shapes
+        pod_slots = _changed_rows(tenant.pods, new_p)
+        node_slots = _changed_rows(tenant.nodes, new_n)
+        # dirty-group bookkeeping (host mirror, superset-safe): groups any
+        # changed lane pointed at — before OR after — plus every group row
+        # that changed
+        G = self._G
+        touched = old_dirty.copy()
+        for soa, slots in ((tenant.pods, pod_slots), (new_p, pod_slots),
+                           (tenant.nodes, node_slots), (new_n, node_slots)):
+            gids = np.asarray(soa.group)[slots]
+            touched[np.clip(gids, 0, G - 1)] = True
+        touched[_changed_rows(tenant.groups, new_g)] = True
+        # adopt the twins NOW (prep time): the diff for the NEXT batch must
+        # run against this request's content even while this batch is still
+        # in flight — in-order execution makes the device catch up first
+        tenant.pods, tenant.nodes, tenant.groups = new_p, new_n, new_g
+        tenant.dirty = np.zeros(G, bool)
+        tenant.ticks += 1
+        if not evict:
+            tenant.shapes = (
+                int(r.cluster.groups.valid.shape[0]),
+                int(r.cluster.pods.valid.shape[0]),
+                int(r.cluster.nodes.valid.shape[0]),
+            )
+        tainted_any = bool((np.asarray(new_n.valid)
+                            & np.asarray(new_n.tainted)).any())
+        return _Entry(
+            pos=pos, request=r, tenant=tenant, shard=tenant.shard,
+            row=tenant.row, shapes=tenant.shapes,
+            new_secs=(new_p, new_n, new_g), now=now,
+            pod_slots=pod_slots, node_slots=node_slots, dirty_mask=touched,
+            tainted_any=tainted_any, evict=evict, registered=registered,
+            old_twins=old_twins, old_dirty=old_dirty, old_shapes=old_shapes)
 
-    def _dispatch(self, prepared, ds, _kernel):
-        """Build the batched operands, run the ONE fused device program,
-        adopt the returned arenas, and return the batch outputs as host
-        arrays. Buckets: lane batches pad to the shared
+    def _assemble(self, entries: List[_Entry]) -> tuple:
+        """Build the ``[S, T, …]`` batched operands: each entry lands in
+        ITS shard's batch slice; shards with fewer (or no) entries pad with
+        scratch-row no-ops. Buckets: lane batches pad to the shared
         ``statestore.delta_bucket`` widths, dirty rows to the shared
-        ``kernel.fleet_dirty_indices`` width, the tenant batch itself to a
-        power of two (pad entries ride the scratch tenant row) — so the jit
-        cache keys on a handful of bucket shapes, never on batch content."""
-        G, P, N, C = self._G, self._P, self._N, self._C
-        diffs = []
-        for _pos, tenant, (new_p, new_n, new_g), now, _r in prepared:
-            pod_slots = _changed_rows(tenant.pods, new_p)
-            node_slots = _changed_rows(tenant.nodes, new_n)
-            # dirty-group bookkeeping (host mirror, superset-safe): groups
-            # any changed lane pointed at — before OR after — plus every
-            # group row that changed
-            touched = tenant.dirty
-            for soa, slots in ((tenant.pods, pod_slots), (new_p, pod_slots),
-                               (tenant.nodes, node_slots),
-                               (new_n, node_slots)):
-                gids = np.asarray(soa.group)[slots]
-                touched[np.clip(gids, 0, G - 1)] = True
-            changed_g = np.zeros(G, bool)
-            changed_g[_changed_rows(tenant.groups, new_g)] = True
-            tenant.dirty = touched | changed_g
-            diffs.append((tenant, pod_slots, node_slots, new_p, new_n, new_g,
-                          now))
-        B_pod = delta_bucket(max(len(d[1]) for d in diffs))
-        B_node = delta_bucket(max(len(d[2]) for d in diffs))
-        T = _pow2(len(diffs))
-        rows = np.full(T, C, np.int32)
-        nows = np.zeros(T, np.int64)
-        pod_idx = np.full((T, B_pod), P, np.int32)
-        node_idx = np.full((T, B_node), N, np.int32)
-        pod_vals = [None] * T
-        node_vals = [None] * T
-        groups_new = [None] * T
-        dirty_masks = []
-        for t, (tenant, ps, ns, new_p, new_n, new_g, now) in enumerate(diffs):
-            rows[t] = tenant.slot
-            nows[t] = now
-            pi, pv = ds._gather_padded(new_p, ps, B_pod, P, ds._POD_PAD)
-            ni, nv = ds._gather_padded(new_n, ns, B_node, N, ds._NODE_PAD)
-            pod_idx[t], node_idx[t] = pi, ni
-            pod_vals[t], node_vals[t] = pv, nv
-            groups_new[t] = new_g
-            dirty_masks.append(tenant.dirty)
-        # pad batch entries: scratch tenant row + no-op batches
-        if len(diffs) < T:
-            _, pv0 = ds._gather_padded(
-                _empty_pods(0), np.zeros(0, np.int64), B_pod, P, ds._POD_PAD)
-            _, nv0 = ds._gather_padded(
-                _empty_nodes(0), np.zeros(0, np.int64), B_node, N,
-                ds._NODE_PAD)
-            for t in range(len(diffs), T):
-                pod_vals[t], node_vals[t] = pv0, nv0
-                groups_new[t] = _empty_groups(G)
-        dirty_masks.extend(
-            [np.zeros(G, bool)] * (T - len(diffs)))
-        dirty_idx = _kernel.fleet_dirty_indices(dirty_masks, G)
-        stack = lambda soas: type(soas[0])(  # noqa: E731
-            **{f.name: np.stack([getattr(s, f.name) for s in soas])
-               for f in fields(soas[0])})
-        with obs.span("fleet_step", kind="device"):
-            pods, nodes, groups, aggs, prev_cols = self._state
-            self._state = None   # donated — the refs die here
-            try:
-                state, out = ds._fleet_step(
-                    pods, nodes, groups, aggs, prev_cols, rows,
-                    stack(groups_new), pod_idx, stack(pod_vals),
-                    node_idx, stack(node_vals), dirty_idx, nows)
-                self._state = state
-                out_host = {
+        ``kernel.fleet_dirty_bucket`` width, the per-shard batch width to a
+        power of two — so the jit cache keys on a handful of bucket shapes,
+        never on batch content."""
+        from escalator_tpu.ops import device_state as ds
+        from escalator_tpu.ops import kernel as _kernel
+
+        G, P, N, C, S = self._G, self._P, self._N, self._C, self._S
+        per_shard: List[List[_Entry]] = [[] for _ in range(S)]
+        for e in entries:
+            e.t_index = len(per_shard[e.shard])
+            per_shard[e.shard].append(e)
+        T = _pow2(max(len(lst) for lst in per_shard))
+        B_pod = delta_bucket(max(len(e.pod_slots) for e in entries))
+        B_node = delta_bucket(max(len(e.node_slots) for e in entries))
+        rows = np.full((S, T), C, np.int32)
+        nows = np.zeros((S, T), np.int64)
+        pod_idx = np.full((S, T, B_pod), P, np.int32)
+        node_idx = np.full((S, T, B_node), N, np.int32)
+        dirty_stack = np.zeros((S, T, G), bool)
+        # preallocate the value stacks from the pad gather (no-op entries
+        # carry exactly these values)
+        _, pv0 = ds._gather_padded(
+            _empty_pods(0), np.zeros(0, np.int64), B_pod, P, ds._POD_PAD)
+        _, nv0 = ds._gather_padded(
+            _empty_nodes(0), np.zeros(0, np.int64), B_node, N, ds._NODE_PAD)
+        bstack = lambda soa, lead: type(soa)(  # noqa: E731
+            **{f.name: np.broadcast_to(
+                getattr(soa, f.name), lead + getattr(soa, f.name).shape
+            ).copy() for f in fields(soa)})
+        pod_vals = bstack(pv0, (S, T))
+        node_vals = bstack(nv0, (S, T))
+        groups_new = bstack(_empty_groups(G), (S, T))
+        for s, lst in enumerate(per_shard):
+            for t, e in enumerate(lst):
+                rows[s, t] = e.row
+                nows[s, t] = e.now
+                new_p, new_n, new_g = e.new_secs
+                pi, pv = ds._gather_padded(new_p, e.pod_slots, B_pod, P,
+                                           ds._POD_PAD)
+                ni, nv = ds._gather_padded(new_n, e.node_slots, B_node, N,
+                                           ds._NODE_PAD)
+                pod_idx[s, t], node_idx[s, t] = pi, ni
+                for f in fields(pv):
+                    getattr(pod_vals, f.name)[s, t] = getattr(pv, f.name)
+                for f in fields(nv):
+                    getattr(node_vals, f.name)[s, t] = getattr(nv, f.name)
+                for f in fields(new_g):
+                    getattr(groups_new, f.name)[s, t] = getattr(new_g, f.name)
+                dirty_stack[s, t] = e.dirty_mask
+        dirty_idx = _kernel.fleet_dirty_indices_stacked(dirty_stack, G)
+        return (rows, groups_new, pod_idx, pod_vals, node_idx, node_vals,
+                dirty_idx, nows)
+
+    # -- stage 2: the device dispatch -----------------------------------------
+
+    def execute_batch(self, pb: _PreparedBatch
+                      ) -> List[Union[FleetDecision, EvictAck, Exception]]:
+        """Run one prepared batch: the ONE fused sharded device program,
+        per-tenant unpack, and ordered tails. A batch gone stale (epoch
+        behind — only the dispatch-failure rebuild can do this, since
+        grows/compacts DRAIN the staged batch before reshaping) fails with
+        :class:`StaleBatchError` instead of re-preparing: a re-prepare
+        from this (dispatch) thread would race the scheduler's prep
+        thread and break the in-order prepare→execute invariant the twins
+        depend on. The twins were already reset wholesale by the rebuild,
+        so there is nothing to roll back — the scheduler surfaces the
+        error per request and clients resubmit."""
+        # UNLOCKED epoch read by design: taking _host here deadlocks
+        # against a grow waiting (under _host) for THIS batch to drain
+        if pb.epoch != self._epoch:
+            self._discard_stale(pb)
+        with self._exec_lock:
+            if pb.epoch != self._epoch:
+                self._discard_stale(pb)
+            return self._execute_locked(pb)
+
+    def _discard_stale(self, pb: _PreparedBatch) -> None:
+        with self._host:
+            pb.released = True
+            if self._staged is pb:
+                self._staged = None
+            self._host.notify_all()
+        raise StaleBatchError(
+            "prepared fleet batch went stale (arenas rebuilt after a "
+            "dispatch failure); resubmit the requests")
+
+    def _execute_locked(self, pb: _PreparedBatch) -> list:
+        from escalator_tpu.ops import device_state as ds
+        from escalator_tpu.ops import kernel as _kernel
+
+        results = pb.results
+        try:
+            with obs.span("fleet_batch"):
+                obs.annotate(backend="fleet", batch_size=len(pb.entries),
+                             fleet_shards=self._S,
+                             overlap_host_ms=round(pb.prep_ms, 3))
+                if pb.overlap_saved_ms is not None:
+                    obs.annotate(
+                        overlap_saved_ms=round(pb.overlap_saved_ms, 3))
+                    metrics.fleet_overlap_saved_ms.inc(
+                        max(pb.overlap_saved_ms, 0.0))
+                if pb.entries:
+                    out_host = self._dispatch(pb, ds)
+                    with obs.span("fleet_unpack"):
+                        for e in pb.entries:
+                            results[e.pos] = self._finish(
+                                e, out_host, len(pb.entries), ds, _kernel)
+                self.batches += 1
+                obs.annotate(
+                    tenants=[r.tenant_id for r in pb.requests],
+                    fleet_tenants_resident=len(self._tenants))
+        finally:
+            pb.executed = True
+            with self._host:
+                if self._staged is pb:
+                    self._staged = None
+                self._host.notify_all()
+        return results
+
+    def _dispatch(self, pb: _PreparedBatch, ds) -> dict:
+        """The one fused sharded device program; adopts the returned arenas
+        and returns the batch outputs as host arrays ``[S, T, …]``."""
+        try:
+            with obs.span("fleet_step", kind="device"), self._device_lock:
+                state = self._state
+                self._state = None   # donated — the refs die here
+                state2, out = self._step_fn(*state, *pb.operands)
+                self._state = state2
+                return {
                     f.name: np.asarray(getattr(out, f.name))
                     for f in fields(out)
                 }
-            except BaseException:
-                # the donation may already have consumed the old buffers, so
-                # the pre-dispatch state is unrecoverable — rebuild the
-                # arenas from scratch and force every tenant through a full
-                # re-bootstrap (the host twins reset to empty, so each
-                # tenant's next diff re-uploads all its lanes). The batch
-                # still fails (the scheduler surfaces it per request), but
-                # the NEXT batch serves instead of unpacking None forever.
-                log.exception(
-                    "fleet_step dispatch failed; rebuilding the arenas — "
-                    "every tenant re-bootstraps on its next decide")
-                self._init_state()
+        except BaseException:
+            # the donation may already have consumed the old buffers, so
+            # the pre-dispatch state is unrecoverable — rebuild the arenas
+            # from scratch and force every tenant through a full
+            # re-bootstrap (the host twins reset to empty, so each tenant's
+            # next diff re-uploads all its lanes). The batch still fails
+            # (the scheduler surfaces it per request), but the NEXT batch
+            # serves instead of unpacking None forever.
+            log.exception(
+                "fleet_step dispatch failed; rebuilding the arenas — "
+                "every tenant re-bootstraps on its next decide")
+            # epoch bump UNLOCKED first: a drain-waiter inside a grow can
+            # classify any staged batch stale without waiting on the
+            # rebuild below
+            self._epoch += 1
+            with self._host:
+                with self._device_lock:
+                    self._init_state()
                 for t in self._tenants.values():
                     t.pods = _empty_pods(self._P)
                     t.nodes = _empty_nodes(self._N)
                     t.groups = _empty_groups(self._G)
                     t.dirty = np.ones(self._G, bool)
-                raise
-        # adopt the twins + clear consumed dirty AFTER the dispatch went out
-        for tenant, _ps, _ns, new_p, new_n, new_g, _now in diffs:
-            tenant.pods, tenant.nodes, tenant.groups = new_p, new_n, new_g
-            tenant.dirty = np.zeros(G, bool)
-            tenant.ticks += 1
-        return out_host
+                self._epoch += 1
+                if self._staged is pb:
+                    self._staged = None
+                self._host.notify_all()
+            raise
 
-    def _finish(self, i, out_host, tenant, new_secs, now, r, batch_size,
-                ds, _kernel):
-        """Slice batch row ``i`` back to the request's shapes and run the
-        per-tenant lazy-orders tail (ordered re-dispatch when consumed)."""
-        if isinstance(r, EvictRequest):
-            self._tenants.pop(r.tenant_id, None)
-            self._free.append(tenant.slot)
-            self._free.sort()
-            metrics.fleet_tenant_count.set(len(self._tenants))
-            return EvictAck(tenant_id=r.tenant_id)
-        G_c, _P_c, N_c = tenant.shapes
-        new_p, new_n, _new_g = new_secs
+    def _finish(self, e: _Entry, out_host, batch_size, ds, _kernel):
+        """Slice the entry's ``[shard, t]`` batch row back to its request's
+        shapes and run the per-tenant lazy-orders tail (ordered re-dispatch
+        when consumed)."""
+        if e.evict:
+            # slot freeing happened at prep (visible to the next prepare);
+            # the ack just confirms the zeroing dispatch went out
+            return EvictAck(tenant_id=e.request.tenant_id)
+        G_c, _P_c, N_c = e.shapes
         sliced = {}
         for f in fields(_kernel.DecisionArrays):
-            col = out_host[f.name][i]
+            col = out_host[f.name][e.shard, e.t_index]
             if f.name in ("untainted_offsets", "tainted_offsets"):
                 sliced[f.name] = col[: G_c + 1]
             elif f.name in _kernel.GROUP_DECISION_FIELDS:
                 sliced[f.name] = col[:G_c]
             else:
                 sliced[f.name] = col[:N_c]
-        tainted_any = bool((np.asarray(new_n.valid)
-                            & np.asarray(new_n.tainted)).any())
-        needs_orders = tainted_any or bool(
+        needs_orders = e.tainted_any or bool(
             (sliced["nodes_delta"] < 0).any())
         ordered = False
         if needs_orders:
-            sliced = self._ordered_redispatch(
-                tenant, now, G_c, N_c, ds, _kernel)
+            sliced = self._ordered_redispatch(e, G_c, N_c, ds, _kernel)
             ordered = True
         out = _kernel.DecisionArrays(**sliced)
         self.decisions += 1
-        return FleetDecision(tenant_id=r.tenant_id, arrays=out,
-                             ordered=ordered, batch_size=batch_size)
+        return FleetDecision(tenant_id=e.request.tenant_id, arrays=out,
+                             ordered=ordered, batch_size=batch_size,
+                             shard=e.shard)
 
-    def _ordered_redispatch(self, tenant, now, G_c, N_c, ds, _kernel):
+    def _ordered_redispatch(self, e: _Entry, G_c, N_c, ds, _kernel):
         """The lazy protocol's ordered tail for ONE tenant: gather its
-        resident row and run the full ordered decide fed its maintained
-        aggregates — windows bit-exact vs the tenant's standalone ordered
-        decide (invalid bucket lanes sort behind every selected lane, so
-        the leading windows are unchanged by the arena padding)."""
-        with obs.span("fleet_ordered_redispatch", kind="device"):
+        resident row off its shard and run the full ordered decide fed its
+        maintained aggregates — windows bit-exact vs the tenant's
+        standalone ordered decide (invalid bucket lanes sort behind every
+        selected lane, so the leading windows are unchanged by the arena
+        padding)."""
+        with obs.span("fleet_ordered_redispatch", kind="device"), \
+                self._device_lock:
             pods, nodes, groups, aggs, _cols = self._state
-            cluster, aggs_row = ds._fleet_tenant_state(
-                pods, nodes, groups, aggs, np.int32(tenant.slot))
+            # O(row) on the tenant's OWN shard device: a traced gather on
+            # the sharded axis would lower to an O(arena) SPMD program
+            local = ds.fleet_shard_local(
+                (pods, nodes, groups, aggs), e.shard)
+            cluster, aggs_row = ds._fleet_tenant_state_local(
+                *local, np.int32(e.row))
             out = obs.fence(_kernel.decide_jit(
-                cluster, np.int64(now),
+                cluster, np.int64(e.now),
                 aggregates=_kernel.aggregates_tuple(aggs_row),
                 with_orders=True))
         self.ordered_redispatches += 1
@@ -695,24 +1049,103 @@ class FleetEngine:
                 sliced[f.name] = col[:N_c]
         return sliced
 
+    # -- the sequential convenience + release --------------------------------
+
+    def step(self, requests: Sequence[Union[DecideRequest, EvictRequest]]
+             ) -> List[Union[FleetDecision, EvictAck, Exception]]:
+        """Serve one micro-batch end to end (prepare + execute) — the
+        sequential caller's API, and the non-pipelined scheduler path."""
+        return self.execute_batch(self.prepare_batch(requests))
+
+    def release_prepared(self, pb: _PreparedBatch,
+                         wait_sec: float = 5.0) -> bool:
+        """Abandon a prepared-but-never-executed batch (scheduler
+        shutdown): roll the host twins back from the per-entry rollback
+        records so the engine's next diff still matches the device state.
+        Waits (bounded) for any in-flight execute first; when the engine is
+        wedged past ``wait_sec`` the rollback is skipped (the staged
+        registration still clears so reshapes don't wait forever). Returns
+        True when the rollback ran."""
+        got = self._exec_lock.acquire(timeout=wait_sec)
+        try:
+            with self._host:
+                if pb.executed or pb.released:
+                    return False
+                pb.released = True
+                rolled = False
+                if got and pb.epoch == self._epoch:
+                    for e in reversed(pb.entries):
+                        self._rollback_entry(e)
+                    metrics.fleet_tenant_count.set(len(self._tenants))
+                    rolled = True
+                elif not got:
+                    log.warning(
+                        "release_prepared: execute still holds the engine "
+                        "after %.1fs — skipping twin rollback", wait_sec)
+                if self._staged is pb:
+                    self._staged = None
+                self._host.notify_all()
+                return rolled
+        finally:
+            if got:
+                self._exec_lock.release()
+
+    def _rollback_entry(self, e: _Entry) -> None:
+        tid = e.request.tenant_id
+        if e.evict:
+            # the evict never dispatched: resurrect the tenant (its twins
+            # were replaced with empties — restore) and re-claim its row
+            t = e.tenant
+            t.pods, t.nodes, t.groups = e.old_twins
+            t.dirty = e.old_dirty
+            t.shapes = e.old_shapes
+            t.ticks -= 1
+            self._tenants[tid] = t
+            if t.row in self._free[t.shard]:
+                self._free[t.shard].remove(t.row)
+            return
+        if e.registered:
+            # the registration never reached the device: drop the tenant
+            self._tenants.pop(tid, None)
+            self._free[e.shard].append(e.row)
+            self._free[e.shard].sort()
+            return
+        t = e.tenant
+        t.pods, t.nodes, t.groups = e.old_twins
+        t.dirty = e.old_dirty
+        t.shapes = e.old_shapes
+        t.ticks -= 1
+
     # -- self-audit ----------------------------------------------------------
 
     def audit(self) -> list:
         """Recompute every tenant row's aggregates from the resident arrays
         (``kernel.fleet_compute_aggregates_jit``) and bit-compare against
         the maintained arenas — the fleet form of the round-8 refresh
-        audit. Returns the mismatched column names ([] = clean)."""
+        audit, over every shard. Returns the mismatched column names
+        ([] = clean)."""
         from dataclasses import fields as dfields
 
         from escalator_tpu.ops import kernel as _kernel
 
-        with self._lock:
-            pods, nodes, groups, aggs, _cols = self._state
-            fresh = _kernel.fleet_compute_aggregates_jit(
-                ClusterArrays(groups=groups, pods=pods, nodes=nodes))
-            return [
-                f.name for f in dfields(_kernel.GroupAggregates)
-                if f.name != "dirty"
-                and not np.array_equal(np.asarray(getattr(aggs, f.name)),
-                                       np.asarray(getattr(fresh, f.name)))
-            ]
+        with self._exec_lock, self._host, self._device_lock:
+            host = self._pull_state()
+        pods, nodes, groups, aggs, _cols = host
+        merge = lambda soa: type(soa)(  # noqa: E731
+            **{f.name: np.asarray(getattr(soa, f.name)).reshape(
+                (-1,) + np.asarray(getattr(soa, f.name)).shape[2:])
+               for f in dfields(soa)})
+        fresh = _kernel.fleet_compute_aggregates_jit(
+            ClusterArrays(groups=merge(groups), pods=merge(pods),
+                          nodes=merge(nodes)))
+
+        def flat(col):
+            a = np.asarray(col)
+            return a.reshape((-1,) + a.shape[2:])
+
+        return [
+            f.name for f in dfields(_kernel.GroupAggregates)
+            if f.name != "dirty"
+            and not np.array_equal(flat(getattr(aggs, f.name)),
+                                   np.asarray(getattr(fresh, f.name)))
+        ]

@@ -272,6 +272,32 @@ fleet_arena_compacts = Counter(
     "the post-mass-eviction HBM reclaim",
     namespace="escalator_tpu", registry=registry,
 )
+fleet_batch_deferred = Counter(
+    "fleet_batch_deferred_total",
+    "queued fleet requests skipped by the one-request-per-tenant rule "
+    "during batch assembly (they keep their queue position for the next "
+    "batch) — a high rate relative to admissions means one tenant is "
+    "submitting faster than the flush cadence",
+    namespace="escalator_tpu", registry=registry,
+)
+fleet_overlap_saved_ms = Counter(
+    "fleet_batch_overlap_saved_ms_total",
+    "milliseconds of fleet host prep (diff/pack/twin adoption) that ran "
+    "while another batch's device program was in flight — the pipelined "
+    "scheduler's recorder-proven overlap win, summed across batches; flat "
+    "at 0 means the scheduler is running unpipelined or the device "
+    "programs finish before prep starts",
+    namespace="escalator_tpu", registry=registry,
+)
+fleet_class_p99_breach = Counter(
+    "fleet_class_p99_breach_total",
+    "per-priority-class SLO breach checks that found the class's RECENT "
+    "request p99 above its declared p99_target_ms — evaluated on a "
+    "served-request cadence over a rolling window (samples since the "
+    "last check), so a sustained breach counts repeatedly while it "
+    "lasts and the counter goes quiet one window after recovery",
+    ["klass"], namespace="escalator_tpu", registry=registry,
+)
 
 jax_compile_seconds = Histogram(
     "jax_compile_seconds",

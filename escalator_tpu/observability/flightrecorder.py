@@ -46,8 +46,10 @@ _META_KEYS = ("backend", "impl", "ordered", "digest", "dirty_groups",
               "overlap_host_ms", "overlap_sync_wait_ms", "overlap_saved_ms",
               # fleet micro-batch attribution (round 14): which tenants one
               # fleet_batch dispatch decided for, and the batch width the
-              # cfg17 one-dispatch proof sums against
+              # cfg17 one-dispatch proof sums against; round 16 adds the
+              # mesh width the batch partitioned over
               "batch_size", "tenants", "fleet_tenants_resident",
+              "fleet_shards",
               "fleet_batch_size", "fleet_ordered",
               # fleet arena lifecycle (round 15): a grow/compact inside a
               # batch annotates the record that paid for it

@@ -270,16 +270,22 @@ class ComputeClient:
 
     def decide_arrays_fleet(self, cluster, now_sec: int, tenant_id: str,
                             span_ctx: Optional[dict] = None,
-                            max_attempts: Optional[int] = None):
+                            max_attempts: Optional[int] = None,
+                            klass: Optional[str] = None):
         """Fleet-mode decide: tags the frame with the tenant sidecar and
         returns ``(decision, server_phases, fleet_meta)``. ``fleet_meta``
         is the server's ``__fleet__`` sidecar (``ordered`` — the lazy-
         orders flag the caller MUST honor before reading order windows —
-        plus ``batch_size``), or None from a server without fleet mode
-        (which served the single-cluster decide: orders populated,
-        treat as ordered=True)."""
+        plus ``batch_size`` and ``shard``), or None from a server without
+        fleet mode (which served the single-cluster decide: orders
+        populated, treat as ordered=True). ``klass`` picks the admission
+        priority class (server default when None; an unknown name is
+        INVALID_ARGUMENT)."""
+        tenant: dict = {"id": tenant_id}
+        if klass is not None:
+            tenant["class"] = klass
         frame = codec.encode_cluster(cluster, now_sec, span_ctx=span_ctx,
-                                     tenant={"id": tenant_id})
+                                     tenant=tenant)
         resp = self._decide_with_retry(frame, max_attempts=max_attempts)
         return codec.decode_decision_full(resp)
 
@@ -311,7 +317,8 @@ class GrpcBackend(ComputeBackend):
                  retry: Optional[RetryPolicy] = None,
                  breaker_threshold: int = 3,
                  breaker_probe_after: int = 5,
-                 tenant_id: Optional[str] = None):
+                 tenant_id: Optional[str] = None,
+                 tenant_class: Optional[str] = None):
         self.client = ComputeClient(address, timeout_sec, retry=retry)
         self.fallback = fallback or GoldenBackend()
         self._packer = PaddedPacker()
@@ -320,6 +327,9 @@ class GrpcBackend(ComputeBackend):
         #: fleet-enabled plugin coalesces it with other tenants' ticks; a
         #: server without fleet mode ignores the tag (single-cluster path)
         self.tenant_id = tenant_id
+        #: admission priority class for the fleet scheduler (round 16);
+        #: None rides the server's default class
+        self.tenant_class = tenant_class
         #: consecutive decide failures (post-retry) that open the breaker
         self.breaker_threshold = int(breaker_threshold)
         #: fallback-served ticks between recovery probes while open
@@ -372,7 +382,8 @@ class GrpcBackend(ComputeBackend):
                             self.client.decide_arrays_fleet(
                                 cluster, now_sec, self.tenant_id,
                                 span_ctx={"path": obs.current_path()},
-                                max_attempts=1 if probing else None))
+                                max_attempts=1 if probing else None,
+                                klass=self.tenant_class))
                     else:
                         out, server_phases = self.client.decide_arrays_traced(
                             cluster, now_sec,

@@ -66,7 +66,7 @@ RETRY_AFTER_METADATA_KEY = "escalator-retry-after-ms"
 @dataclass
 class FleetConfig:
     """Knobs for the fleet decision service (engine arenas + scheduler).
-    Defaults size a small fleet; bench cfg17 documents the C=1k envelope."""
+    Defaults size a small fleet; bench cfg17 documents the C=10k envelope."""
 
     num_groups: int = 8
     pod_capacity: int = 256
@@ -79,6 +79,18 @@ class FleetConfig:
     #: per-request wait bound on the batch future (queue wait + service);
     #: far above any sane flush interval — a breach means a wedged worker
     decide_timeout_sec: float = 60.0
+    #: mesh shards the tenant axis partitions over (0 = every device this
+    #: process sees); tenants are embarrassingly parallel, so per-shard
+    #: device time shrinks near-linearly with the mesh (round 16)
+    num_shards: int = 1
+    #: pipelined scheduler (round 16): batch k+1's host diff assembles
+    #: while batch k's device program is in flight
+    pipeline: bool = True
+    #: admission classes (None = scheduler defaults: critical/standard/
+    #: batch at weights 4/2/1, batch capped to half the queue); requests
+    #: pick one via the tenant sidecar's "class" key
+    classes: "tuple | None" = None
+    default_class: "str | None" = None
 
 
 class _ComputeService:
@@ -102,17 +114,26 @@ class _ComputeService:
         self._fleet_cfg = fleet
         self._fleet = None
         if fleet is not None:
-            from escalator_tpu.fleet import FleetEngine, FleetScheduler
+            from escalator_tpu.fleet import (
+                DEFAULT_CLASSES,
+                FleetEngine,
+                FleetScheduler,
+            )
 
             engine = FleetEngine(
                 num_groups=fleet.num_groups,
                 pod_capacity=fleet.pod_capacity,
                 node_capacity=fleet.node_capacity,
-                max_tenants=fleet.max_tenants)
+                max_tenants=fleet.max_tenants,
+                num_shards=fleet.num_shards)
             self._fleet = FleetScheduler(
                 engine, max_batch=fleet.max_batch, flush_ms=fleet.flush_ms,
                 queue_limit=fleet.queue_limit,
-                per_tenant_inflight=fleet.per_tenant_inflight)
+                per_tenant_inflight=fleet.per_tenant_inflight,
+                classes=(fleet.classes if fleet.classes is not None
+                         else DEFAULT_CLASSES),
+                default_class=fleet.default_class,
+                pipeline=fleet.pipeline)
 
     @property
     def fleet(self):
@@ -194,7 +215,8 @@ class _ComputeService:
                 fut = self._fleet.evict(tenant.get("id"))
             else:
                 fut = self._fleet.submit(tenant.get("id"), cluster,
-                                         int(now_sec))
+                                         int(now_sec),
+                                         klass=tenant.get("class"))
         except TenantError as e:
             metrics.fleet_admission_rejects.labels("invalid-tenant").inc()
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -228,6 +250,7 @@ class _ComputeService:
             "ordered": bool(result.ordered),
             "tenant": result.tenant_id,
             "batch_size": int(result.batch_size),
+            "shard": int(result.shard),
         })
 
     def health(self, request: bytes, context) -> bytes:
@@ -260,16 +283,16 @@ class _ComputeService:
         if self._fleet is not None:
             # the batcher's stale-but-alive surface (mirrors tick_p99_ms):
             # a wedged worker shows oldest_waiting growing while the queue
-            # answers admissions and this health probe stays green
+            # answers admissions and this health probe stays green.
+            # stats() snapshots the counters UNDER the scheduler lock
+            # (round-16 satellite: the old field-by-field reads could tear
+            # mid-batch) and carries the per-class SLO surface.
             doc["fleet"] = {
                 "tenants": self._fleet.engine.tenant_count,
-                "queue_depth": self._fleet.queue_depth,
-                "admitted_total": self._fleet.admitted_total,
-                "rejected_total": self._fleet.rejected_total,
-                "oldest_waiting_sec": round(
-                    self._fleet.oldest_waiting_sec(), 4),
                 "batches": self._fleet.engine.batches,
                 "buckets": self._fleet.engine.buckets,
+                "shards": self._fleet.engine.shards,
+                **self._fleet.stats(),
             }
         return msgpack.packb(doc)
 

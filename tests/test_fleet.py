@@ -8,11 +8,13 @@ from __future__ import annotations
 import random
 import threading
 import time
+import types
 
 import numpy as np
 import pytest
 
 from escalator_tpu.analysis.registry import representative_cluster
+from escalator_tpu.fleet import service as service_mod
 from escalator_tpu.fleet import (
     AdmissionError,
     DecideRequest,
@@ -192,6 +194,11 @@ def test_engine_invalid_request_does_not_poison_batch(engine):
     assert_column_parity(res[1].arrays, good, NOW, msg="survivor")
 
 
+@pytest.mark.slow   # ~26 s of grown-shape compiles; tier-1 keeps grow/compact
+                    # parity via the randomized soak (mid-run grows) and
+                    # test_engine_compact_during_staged_batch_completes —
+                    # the full metric/annotation assertions still run in CI's
+                    # unfiltered suite
 def test_engine_grow_and_compact():
     from escalator_tpu.metrics import metrics as _m
     from escalator_tpu.observability import RECORDER, resources
@@ -214,8 +221,10 @@ def test_engine_grow_and_compact():
     # and the registered arena owner's bytes == the envelope formula at
     # the NEW buckets
     assert _ctr("escalator_tpu_fleet_arena_grow_total") == grows0 + 1
+    # round 16: grows run at PREP time (the pipeline stage that owns the
+    # host twins), so the annotation lands on the fleet_prep record
     grow_recs = [r for r in RECORDER.snapshot()
-                 if r.get("root") == "fleet_batch"
+                 if r.get("root") in ("fleet_batch", "fleet_prep")
                  and r.get("fleet_arena_grow")]
     assert grow_recs and "C=4" in grow_recs[-1]["fleet_arena_grow"]
     arena = resources.RESOURCES.snapshot()["fleet_arenas"]
@@ -251,22 +260,20 @@ def test_engine_recovers_after_dispatch_failure(monkeypatch):
     donated) must not wedge the engine: the failing batch errors, the
     arenas rebuild, and every tenant re-bootstraps with full parity on its
     next decide."""
-    from escalator_tpu.ops import device_state as ds
-
     eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
                       max_tenants=2)
     c = tiny_cluster(21)
     eng.step([DecideRequest("phoenix", c, int(NOW))])
-    real_step = ds._fleet_step
+    real_step = eng._step_fn
 
     def boom(*a, **kw):
         raise RuntimeError("injected device failure")
 
-    monkeypatch.setattr(ds, "_fleet_step", boom)
+    monkeypatch.setattr(eng, "_step_fn", boom)
     with pytest.raises(RuntimeError, match="injected device failure"):
         eng.step([DecideRequest("phoenix", mutate(
             _copy_cluster(c), np.random.default_rng(1)), int(NOW) + 60)])
-    monkeypatch.setattr(ds, "_fleet_step", real_step)
+    monkeypatch.setattr(eng, "_step_fn", real_step)
     c2 = mutate(_copy_cluster(c), np.random.default_rng(2))
     fd = eng.step([DecideRequest("phoenix", c2, int(NOW) + 120)])[0]
     assert_column_parity(fd.arrays, c2, int(NOW) + 120, msg="post-failure")
@@ -292,18 +299,30 @@ def test_evict_retires_per_tenant_histogram_series():
         sched.shutdown()
 
 
-def test_engine_randomized_multi_tenant_soak():
+@pytest.mark.parametrize(
+    "num_shards",
+    [1, 2, pytest.param(4, marks=pytest.mark.slow)])
+def test_engine_randomized_multi_tenant_soak(num_shards):
     """The acceptance soak: randomized per-tick churn over a live fleet
     WITH tenant lifecycle churn (add/evict/grow mid-run); every tenant's
-    13 columns bit-identical to its standalone decide on every tick, and
-    the maintained aggregate arenas bit-equal to a recompute at the end."""
+    13 columns bit-identical to its standalone decide — the unsharded
+    single-device path — on every tick (for the 1-shard engine and the
+    2/4-shard mesh partitions; conftest forces 8 host devices so all
+    arms run real shard_map meshes), and the maintained aggregate arenas
+    bit-equal to a recompute at the end. The 4-shard arm is slow-marked:
+    it re-pays every grown-shape compile against the tier-1 870 s budget
+    while exercising the same code paths as the 2-shard arm — CI's
+    unfiltered suite runs it."""
     rng = np.random.default_rng(17)
     pyrng = random.Random(17)
     eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
-                      max_tenants=2)
+                      max_tenants=2, num_shards=num_shards)
     world: dict = {}
     next_id = 0
-    for tick in range(12):
+    # 9 ticks is the fewest that still covers ALL lifecycle paths with this
+    # seed: 5 registrations, 3 evicts, and one 4x-node-bucket tenant (the
+    # mid-run arena grow) — verified by simulating the pyrng consumption
+    for tick in range(9):
         now = int(NOW) + 60 * tick
         reqs = []
         # lifecycle churn
@@ -343,6 +362,237 @@ def _copy_soa(soa):
 
     return type(soa)(**{f.name: np.array(getattr(soa, f.name))
                         for f in fields(soa)})
+
+
+# ---------------------------------------------------------------------------
+# sharded engine (round 16): parity, balance, and the concurrency contract
+# ---------------------------------------------------------------------------
+
+
+def test_engine_sharded_parity_and_balance():
+    """A 2-shard engine: tenants spread across both mesh rows, every
+    decision bit-identical to the standalone (unsharded) decide, the
+    FleetDecision carries its shard, and the maintained arenas audit
+    clean across shards."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=4, num_shards=2)
+    assert eng.shards == 2 and eng.buckets["shards"] == 2
+    clusters = {f"sh{i}": tiny_cluster(200 + i) for i in range(4)}
+    res = eng.step([DecideRequest(t, c, int(NOW))
+                    for t, c in clusters.items()])
+    shards_used = set()
+    for (t, c), fd in zip(clusters.items(), res, strict=True):
+        assert_column_parity(fd.arrays, c, NOW, msg=f"sharded {t}")
+        assert fd.shard == eng.shard_of(t)
+        shards_used.add(fd.shard)
+    assert shards_used == {0, 1}, "tenants did not balance across shards"
+    # steady tick with churn, still bit-exact per tenant
+    rng = np.random.default_rng(8)
+    reqs = []
+    for i, t in enumerate(clusters):
+        clusters[t] = mutate(tiny_cluster(200 + i), rng)
+        reqs.append(DecideRequest(t, clusters[t], int(NOW) + 60))
+    for r, fd in zip(reqs, eng.step(reqs), strict=True):
+        assert_column_parity(fd.arrays, r.cluster, int(NOW) + 60,
+                             msg=f"sharded tick {r.tenant_id}")
+    assert eng.audit() == []
+
+
+def test_engine_grow_during_staged_batch_completes():
+    """Regression (round-16 pipeline): a prepare that needs an arena grow
+    while ANOTHER batch is staged must wait for that batch to drain —
+    and must NOT deadlock against the execute that drains it (the drain
+    wait releases the host condition)."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=4)
+    c_a = tiny_cluster(300)
+    eng.step([DecideRequest("a", c_a, int(NOW))])
+    c_a2 = mutate(_copy_cluster(c_a), np.random.default_rng(3))
+    pb_a = eng.prepare_batch([DecideRequest("a", c_a2, int(NOW) + 60)])
+    # outgrows only the NODE bucket: one grown-shape compile, not three
+    big = representative_cluster(G, P, N * 2, seed=301)
+    done = {}
+
+    def grow_then_decide():
+        # prepare of this batch needs a lane-bucket grow -> staged drain
+        done["b"] = eng.step([DecideRequest("b", big, int(NOW) + 60)])[0]
+
+    th = threading.Thread(target=grow_then_decide, daemon=True)
+    th.start()
+    time.sleep(0.3)   # let the grow reach the drain wait
+    res_a = eng.execute_batch(pb_a)
+    th.join(timeout=30)
+    assert not th.is_alive(), "grow-during-staged deadlocked"
+    assert_column_parity(res_a[0].arrays, c_a2, int(NOW) + 60, msg="staged a")
+    assert_column_parity(done["b"].arrays, big, int(NOW) + 60, msg="grown b")
+    assert eng.audit() == []
+
+
+def test_engine_compact_during_staged_batch_completes():
+    """Regression: compact() while a batch is staged must wait for it
+    WITHOUT holding the execute lock — holding it would deadlock against
+    the execute that drains the staged batch."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=4, num_shards=2)
+    cs = {t: tiny_cluster(400 + i) for i, t in enumerate(("ca", "cb", "cc"))}
+    eng.step([DecideRequest(t, c, int(NOW)) for t, c in cs.items()])
+    eng.step([EvictRequest("cc")])
+    c2 = mutate(_copy_cluster(cs["ca"]), np.random.default_rng(9))
+    pb = eng.prepare_batch([DecideRequest("ca", c2, int(NOW) + 60)])
+    done = {}
+
+    def compacting():
+        done["info"] = eng.compact()
+
+    th = threading.Thread(target=compacting, daemon=True)
+    th.start()
+    time.sleep(0.3)   # compact reaches the staged-drain wait
+    res = eng.execute_batch(pb)
+    th.join(timeout=30)
+    assert not th.is_alive(), "compact-during-staged deadlocked"
+    assert done["info"]["tenants"] == 2
+    assert_column_parity(res[0].arrays, c2, int(NOW) + 60, msg="staged ca")
+    # post-compact parity on a repacked tenant
+    c3 = mutate(_copy_cluster(cs["cb"]), np.random.default_rng(10))
+    fd = eng.step([DecideRequest("cb", c3, int(NOW) + 120)])[0]
+    assert_column_parity(fd.arrays, c3, int(NOW) + 120, msg="post-compact cb")
+    assert eng.audit() == []
+
+
+def test_engine_stale_prepared_batch_is_discarded_not_rerun():
+    """Regression (review finding): a prepared batch whose epoch fell
+    behind (dispatch-failure rebuild) must FAIL with StaleBatchError —
+    re-preparing from the execute path would race the prep thread and
+    desync twins from the arenas. The engine stays serviceable after."""
+    from escalator_tpu.fleet import StaleBatchError
+
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=2)
+    c = tiny_cluster(330)
+    eng.step([DecideRequest("st", c, int(NOW))])
+    c2 = mutate(_copy_cluster(c), np.random.default_rng(11))
+    pb = eng.prepare_batch([DecideRequest("st", c2, int(NOW) + 60)])
+    # simulate the dispatch-failure recovery the real path runs: epoch
+    # bump + wholesale twin reset (the only way a staged batch goes stale)
+    with eng._host:
+        eng._epoch += 1
+        for t in eng._tenants.values():
+            t.pods = service_mod._empty_pods(eng._P)
+            t.nodes = service_mod._empty_nodes(eng._N)
+            t.groups = service_mod._empty_groups(eng._G)
+            t.dirty = np.ones(eng._G, bool)
+    with pytest.raises(StaleBatchError):
+        eng.execute_batch(pb)
+    # the staged registration cleared (reshapes would not wait forever)
+    assert eng._staged is None
+    # and a resubmit serves with full parity against the rebuilt twins
+    fd = eng.step([DecideRequest("st", _copy_cluster(c2), int(NOW) + 60)])[0]
+    assert_column_parity(fd.arrays, c2, int(NOW) + 60, msg="post-stale")
+    assert eng.audit() == []
+
+
+def test_engine_release_prepared_rolls_back_twins():
+    """Regression: an abandoned prepared batch must unwind its twin
+    adoption — otherwise the tenant's next diff skips the lanes the
+    device never received and parity breaks silently."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=2)
+    c = tiny_cluster(310)
+    eng.step([DecideRequest("rb", c, int(NOW))])
+    c2 = mutate(_copy_cluster(c), np.random.default_rng(4))
+    pb = eng.prepare_batch([DecideRequest("rb", c2, int(NOW) + 60)])
+    assert eng.release_prepared(pb) is True
+    # re-submitting the same content must re-diff from the OLD twin
+    fd = eng.step([DecideRequest("rb", _copy_cluster(c2), int(NOW) + 60)])[0]
+    assert_column_parity(fd.arrays, c2, int(NOW) + 60, msg="post-release")
+    # an abandoned REGISTRATION unwinds too (tenant never reaches the device)
+    pb2 = eng.prepare_batch(
+        [DecideRequest("ghost", tiny_cluster(311), int(NOW))])
+    assert eng.release_prepared(pb2) is True
+    assert not eng.has_tenant("ghost")
+    # an abandoned EVICT resurrects the tenant
+    pb3 = eng.prepare_batch([EvictRequest("rb")])
+    assert not eng.has_tenant("rb")
+    assert eng.release_prepared(pb3) is True
+    assert eng.has_tenant("rb")
+    c3 = mutate(_copy_cluster(c2), np.random.default_rng(5))
+    fd = eng.step([DecideRequest("rb", c3, int(NOW) + 120)])[0]
+    assert_column_parity(fd.arrays, c3, int(NOW) + 120, msg="post-evict-rb")
+
+
+def test_engine_prepare_failure_rolls_back_twins(monkeypatch):
+    """Regression (review finding): a NON-TenantError escaping partway
+    through prepare_batch (a device error inside a register-grow, an
+    assembly failure) must unwind every already-adopted entry — evicted
+    tenants resurrect, registrations drop, twin adoptions roll back —
+    instead of leaving the engine permanently desynced from the arenas."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=4)
+    ca, cb = tiny_cluster(340), tiny_cluster(341)
+    eng.step([DecideRequest("pa", ca, int(NOW)),
+              DecideRequest("pb", cb, int(NOW))])
+    monkeypatch.setattr(eng, "_assemble",
+                        lambda entries: (_ for _ in ()).throw(
+                            RuntimeError("injected assembly failure")))
+    c2 = mutate(_copy_cluster(ca), np.random.default_rng(6))
+    with pytest.raises(RuntimeError, match="injected"):
+        eng.prepare_batch([DecideRequest("pa", c2, int(NOW) + 60),
+                           EvictRequest("pb"),
+                           DecideRequest("pnew", tiny_cluster(342),
+                                         int(NOW) + 60)])
+    # evict rolled back (tenant resurrected), registration dropped
+    assert eng.has_tenant("pb") and not eng.has_tenant("pnew")
+    assert eng._staged is None
+    monkeypatch.undo()
+    # twins re-diff from the PRE-failure content with full parity
+    fd = eng.step([DecideRequest("pa", _copy_cluster(c2),
+                                 int(NOW) + 60)])[0]
+    assert_column_parity(fd.arrays, c2, int(NOW) + 60, msg="post-prep-fail")
+    c3 = mutate(_copy_cluster(cb), np.random.default_rng(7))
+    fd = eng.step([DecideRequest("pb", c3, int(NOW) + 120)])[0]
+    assert_column_parity(fd.arrays, c3, int(NOW) + 120,
+                         msg="post-prep-fail pb")
+    assert eng.audit() == []
+    assert eng.audit() == []
+
+
+def test_engine_release_waits_for_inflight_execute(monkeypatch):
+    """Regression: release of a staged batch while an EARLIER batch's
+    execute is in flight must wait for the engine (bounded) before
+    rolling back, not race the dispatch."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=2)
+    c = tiny_cluster(320)
+    eng.step([DecideRequest("slow", c, int(NOW))])
+    real_step = eng._step_fn
+
+    def slow_step(*a, **kw):
+        time.sleep(0.5)
+        return real_step(*a, **kw)
+
+    monkeypatch.setattr(eng, "_step_fn", slow_step)
+    c2 = mutate(_copy_cluster(c), np.random.default_rng(6))
+    results = {}
+
+    def run_a():
+        results["a"] = eng.step(
+            [DecideRequest("slow", c2, int(NOW) + 60)])[0]
+
+    th = threading.Thread(target=run_a, daemon=True)
+    th.start()
+    time.sleep(0.15)   # batch A inside the slow dispatch
+    c_b = tiny_cluster(321)
+    pb_b = eng.prepare_batch([DecideRequest("other", c_b, int(NOW) + 60)])
+    t0 = time.monotonic()
+    assert eng.release_prepared(pb_b, wait_sec=10.0) is True
+    assert time.monotonic() - t0 > 0.1, "release did not wait for execute"
+    th.join(timeout=30)
+    assert_column_parity(results["a"].arrays, c2, int(NOW) + 60, msg="slow a")
+    assert not eng.has_tenant("other")
+    monkeypatch.setattr(eng, "_step_fn", real_step)
+    fd = eng.step([DecideRequest("other", c_b, int(NOW) + 120)])[0]
+    assert_column_parity(fd.arrays, c_b, int(NOW) + 120, msg="other after")
+    assert eng.audit() == []
 
 
 # ---------------------------------------------------------------------------
@@ -469,6 +719,337 @@ def test_scheduler_engine_failure_fails_batch_not_process():
 
 
 # ---------------------------------------------------------------------------
+# round 16: weighted-fair classes, SLO admission, pipelined scheduler
+# ---------------------------------------------------------------------------
+
+
+class _FakeTwoStage(_FakeEngine):
+    """Fake engine exposing the two-stage prepare/execute API (so the
+    scheduler runs its pipelined worker pair) with injectable delays."""
+
+    def __init__(self, prep_sec: float = 0.0, exec_sec: float = 0.0):
+        super().__init__()
+        self.prep_sec = prep_sec
+        self.exec_sec = exec_sec
+        self.executed_pbs = []
+        self.released_pbs = []
+
+    def prepare_batch(self, requests):
+        if self.prep_sec:
+            time.sleep(self.prep_sec)
+        return types.SimpleNamespace(
+            requests=list(requests), overlap_saved_ms=None,
+            prep_ms=self.prep_sec * 1e3)
+
+    def execute_batch(self, pb):
+        if self.exec_sec:
+            time.sleep(self.exec_sec)
+        self.executed_pbs.append(pb)
+        return super().step(pb.requests)
+
+    def release_prepared(self, pb, wait_sec: float = 5.0):
+        self.released_pbs.append(pb)
+        return True
+
+
+def test_scheduler_weighted_fair_class_shares():
+    """Saturated queues in all three default classes: one batch's slots
+    split 4/2/1 (critical/standard/batch at max_batch=7), oldest-first
+    within each class."""
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=7, flush_ms=30.0, queue_limit=64,
+                           per_tenant_inflight=4)
+    try:
+        sched.pause()
+        for i in range(8):
+            sched.submit(f"crit{i}", None, i, klass="critical")
+        for i in range(8):
+            sched.submit(f"std{i}", None, i, klass="standard")
+        for i in range(8):
+            sched.submit(f"bat{i}", None, i, klass="batch")
+        sched.resume()
+        deadline = time.monotonic() + 10
+        while not eng.batches and time.monotonic() < deadline:
+            time.sleep(0.01)
+        first = eng.batches[0]
+        assert len(first) == 7, first
+        counts = {p: sum(1 for t in first if t.startswith(p))
+                  for p in ("crit", "std", "bat")}
+        assert counts == {"crit": 4, "std": 2, "bat": 1}, first
+        # within a class: oldest-first
+        assert [t for t in first if t.startswith("crit")] == [
+            f"crit{i}" for i in range(4)]
+        st = sched.stats()
+        assert st["classes"]["critical"]["weight"] == 4
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_small_batch_does_not_starve_lightest_class():
+    """Regression (review finding): with max_batch smaller than the
+    active-class count, heaviest-first quotas would starve the lightest
+    class — assembly falls back to oldest-first, so a batch-class
+    request admitted first is served first."""
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=2, flush_ms=20.0, queue_limit=64,
+                           per_tenant_inflight=4)
+    try:
+        sched.pause()
+        f_b = sched.submit("bulk", None, 0, klass="batch")   # oldest
+        sched.submit("c1", None, 1, klass="critical")
+        sched.submit("c2", None, 2, klass="critical")
+        sched.submit("s1", None, 3, klass="standard")
+        sched.resume()
+        assert f_b.result(timeout=10)[0] == "decided"
+        # the oldest (batch-class) request rode the FIRST batch
+        assert "bulk" in eng.batches[0], eng.batches
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_chatty_tenant_bounded_head_of_line():
+    """Adversarial arrivals: one chatty tenant floods the queue ahead of
+    three trickle tenants — one-per-tenant batching keeps the trickle
+    tenants in the FIRST batch, and the skipped chatty requests count the
+    deferred counter while keeping their queue positions."""
+    from escalator_tpu.metrics import metrics as _m
+
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=4, flush_ms=20.0, queue_limit=64,
+                           per_tenant_inflight=16)
+    try:
+        sched.pause()
+        for i in range(10):
+            sched.submit("chatty", None, i)
+        for t in ("t1", "t2", "t3"):
+            sched.submit(t, None, 0)
+        d0 = _m.registry.get_sample_value(
+            "escalator_tpu_fleet_batch_deferred_total") or 0.0
+        sched.resume()
+        deadline = time.monotonic() + 10
+        while len(eng.batches) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.batches[0] == ["chatty", "t1", "t2", "t3"], eng.batches
+        for b in eng.batches:
+            assert b.count("chatty") <= 1
+        assert sched.deferred_total > 0
+        assert (_m.registry.get_sample_value(
+            "escalator_tpu_fleet_batch_deferred_total") or 0.0) > d0
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_class_queue_share_cap():
+    """The batch class may hold at most queue_share x queue_limit slots —
+    overflow rejects with the class-specific reason while the global
+    queue still has room."""
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=4, flush_ms=50.0, queue_limit=8,
+                           per_tenant_inflight=1)
+    try:
+        sched.pause()
+        for i in range(4):   # 8 * 0.5 = 4 slots for the batch class
+            sched.submit(f"b{i}", None, 0, klass="batch")
+        with pytest.raises(AdmissionError) as ei:
+            sched.submit("b-overflow", None, 0, klass="batch")
+        assert ei.value.reason == "queue-full-batch"
+        # other classes are unaffected by the batch-class cap
+        sched.submit("still-fine", None, 0, klass="critical")
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_retry_after_scales_with_inflight_depth():
+    """Satellite: a tenant-inflight rejection's retry-after reflects the
+    tenant's own depth (its requests ride SEPARATE batches) plus the
+    queue backlog — not the old flat one-flush-interval floor."""
+    eng = _FakeEngine()
+    flush_ms = 10.0
+    sched = FleetScheduler(eng, max_batch=4, flush_ms=flush_ms,
+                           queue_limit=64, per_tenant_inflight=3)
+    try:
+        sched.pause()
+        for i in range(3):
+            sched.submit("deep", None, i)
+        with pytest.raises(AdmissionError) as ei:
+            sched.submit("deep", None, 9)
+        assert ei.value.reason == "tenant-inflight"
+        first = ei.value.retry_after_ms
+        assert first >= 3 * flush_ms, first   # depth 3 -> >= 3 intervals
+        # a deeper queue pushes the estimate further out
+        for i in range(20):
+            sched.submit(f"fill{i}", None, i)
+        with pytest.raises(AdmissionError) as ei:
+            sched.submit("deep", None, 10)
+        assert ei.value.retry_after_ms > first
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_class_p99_breach_counter():
+    """A class whose measured p99 exceeds its declared target counts
+    breaches (checked on the served-request cadence) into both the
+    scheduler stats and the Prometheus counter."""
+    from escalator_tpu.fleet import PriorityClass
+    from escalator_tpu.metrics import metrics as _m
+    from escalator_tpu.observability import histograms
+
+    histograms.TICKS.discard("fleet/class/sla-tight")
+    eng = _FakeEngine()
+    sched = FleetScheduler(
+        eng, max_batch=8, flush_ms=1.0, queue_limit=64,
+        per_tenant_inflight=64,
+        classes=(PriorityClass("sla-tight", weight=1,
+                               p99_target_ms=0.0001),),
+        default_class="sla-tight")
+    try:
+        b0 = _m.registry.get_sample_value(
+            "escalator_tpu_fleet_class_p99_breach_total",
+            {"klass": "sla-tight"}) or 0.0
+        futs = [sched.submit(f"t{i}", None, 0) for i in range(20)]
+        for f in futs:
+            f.result(timeout=10)
+        assert sched.class_breaches["sla-tight"] >= 1
+        st = sched.stats()["classes"]["sla-tight"]
+        assert st["breaches"] >= 1
+        assert st["p99_ms"] is not None and st["p99_ms"] > st["p99_target_ms"]
+        assert (_m.registry.get_sample_value(
+            "escalator_tpu_fleet_class_p99_breach_total",
+            {"klass": "sla-tight"}) or 0.0) > b0
+    finally:
+        sched.shutdown()
+        histograms.TICKS.discard("fleet/class/sla-tight")
+
+
+def test_scheduler_class_breach_counter_recovers():
+    """Regression (review finding): the breach check reads a ROLLING
+    window, not the lifetime series — one slow episode must stop counting
+    breaches once the recent window is healthy again (a lifetime p99
+    would pin the counter climbing for ~100x as many good samples)."""
+    from escalator_tpu.fleet import PriorityClass
+    from escalator_tpu.observability import histograms
+
+    histograms.TICKS.discard("fleet/class/sla-win")
+    eng = _FakeEngine()
+    sched = FleetScheduler(
+        eng, max_batch=16, flush_ms=1.0, queue_limit=128,
+        per_tenant_inflight=64,
+        classes=(PriorityClass("sla-win", weight=1, p99_target_ms=60.0),),
+        default_class="sla-win")
+    try:
+        # slow episode: one full check window held >> target in the queue
+        sched.pause()
+        futs = [sched.submit(f"s{i}", None, 0) for i in range(16)]
+        time.sleep(0.2)
+        sched.resume()
+        for f in futs:
+            f.result(timeout=10)
+        deadline = time.monotonic() + 5
+        while not sched.class_breaches["sla-win"] and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        breached = sched.class_breaches["sla-win"]
+        assert breached >= 1
+        # recovery: fast windows only — the counter must go quiet even
+        # though the LIFETIME p99 still sits far above the 60 ms target
+        for r in range(3):
+            futs = [sched.submit(f"f{r}x{i}", None, 0) for i in range(16)]
+            for f in futs:
+                f.result(timeout=10)
+        assert sched.class_breaches["sla-win"] == breached
+    finally:
+        sched.shutdown()
+        histograms.TICKS.discard("fleet/class/sla-win")
+
+
+def test_scheduler_evict_inherits_lightest_queued_class():
+    """Regression (resurrection bug): an evict must not ride a heavier
+    class than the tenant's queued decides — it inherits the LIGHTEST
+    queued class so it can never dispatch before them."""
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=8, flush_ms=20.0, queue_limit=64,
+                           per_tenant_inflight=4)
+    try:
+        sched.submit("victim", None, 0).result(timeout=10)   # registers
+        sched.pause()
+        f_dec = sched.submit("victim", None, 1, klass="batch")
+        f_ev = sched.evict("victim")
+        with sched._cv:
+            klasses = [p.klass for p in sched._queues["batch"]]
+        assert len(klasses) == 2, "evict did not inherit the batch class"
+        sched.resume()
+        assert f_dec.result(timeout=10)[0] == "decided"
+        assert isinstance(f_ev.result(timeout=10), EvictAck)
+        assert not eng.has_tenant("victim")
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_pipelined_overlap_accounting():
+    """The pipelined worker pair: batch k+1's prep runs while batch k's
+    execute is in flight, and the prepared batch carries a positive
+    overlap_saved_ms measured against the dispatch windows."""
+    eng = _FakeTwoStage(prep_sec=0.03, exec_sec=0.08)
+    sched = FleetScheduler(eng, max_batch=2, flush_ms=1.0, queue_limit=64,
+                           per_tenant_inflight=4)
+    assert sched.pipelined
+    try:
+        futs = [sched.submit(f"p{i}", None, i) for i in range(6)]
+        for f in futs:
+            f.result(timeout=30)
+        assert len(eng.executed_pbs) >= 3
+        saved = [pb.overlap_saved_ms for pb in eng.executed_pbs
+                 if pb.overlap_saved_ms]
+        assert saved and max(saved) > 1.0, (
+            f"no prep/dispatch overlap measured: "
+            f"{[pb.overlap_saved_ms for pb in eng.executed_pbs]}")
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_pipelined_shutdown_drains_inflight():
+    """Satellite: shutdown with a batch mid-dispatch and another staged —
+    both DRAIN (their futures resolve with results); queued-but-never-
+    prepped futures fail cleanly with RuntimeError."""
+    eng = _FakeTwoStage(exec_sec=0.4)
+    sched = FleetScheduler(eng, max_batch=1, flush_ms=1.0, queue_limit=64,
+                           per_tenant_inflight=4)
+    try:
+        f1 = sched.submit("d1", None, 0)
+        f2 = sched.submit("d2", None, 0)
+        deadline = time.monotonic() + 5
+        while not eng.batches and time.monotonic() < deadline:
+            time.sleep(0.005)   # batch 1 inside the slow execute
+        futs_late = [sched.submit(f"late{i}", None, 0) for i in range(3)]
+    finally:
+        sched.shutdown()
+    assert f1.result(timeout=10)[0] == "decided"   # in-flight drained
+    assert f2.result(timeout=10)[0] == "decided"   # staged drained
+    failed = 0
+    for f in futs_late:
+        try:
+            f.result(timeout=10)
+        except RuntimeError:
+            failed += 1
+    assert failed == len(futs_late), "queued futures did not fail cleanly"
+
+
+def test_scheduler_stats_snapshot_fields():
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=4, flush_ms=1.0)
+    try:
+        sched.submit("statty", None, 0).result(timeout=10)
+        st = sched.stats()
+        assert {"queue_depth", "admitted_total", "rejected_total",
+                "deferred_total", "oldest_waiting_sec", "pipelined",
+                "classes"} <= set(st)
+        assert set(st["classes"]) == {"critical", "standard", "batch"}
+        assert st["admitted_total"] == 1 and st["pipelined"] is False
+    finally:
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # codec framing
 # ---------------------------------------------------------------------------
 
@@ -559,7 +1140,8 @@ def fleet_plugin():
 
     server = make_server("127.0.0.1:0", max_workers=8, fleet=FleetConfig(
         num_groups=G, pod_capacity=P, node_capacity=N, max_tenants=8,
-        max_batch=8, flush_ms=10.0, queue_limit=4, per_tenant_inflight=1))
+        max_batch=8, flush_ms=10.0, queue_limit=4, per_tenant_inflight=1,
+        num_shards=2))
     server.start()
     client = ComputeClient(f"127.0.0.1:{server._escalator_bound_port}",
                            timeout_sec=180.0)
@@ -592,6 +1174,7 @@ def test_grpc_fleet_concurrent_tenants_coalesce_with_parity(fleet_plugin):
         out, meta = results[tid]
         assert_column_parity(out, c, NOW, msg=tid)
         assert meta["tenant"] == tid
+        assert meta.get("shard") in (0, 1)   # round 16: 2-shard fixture
         batch_sizes.add(meta["batch_size"])
     # coalescing observed: at least one multi-tenant micro-batch
     assert max(batch_sizes) >= 2, batch_sizes
@@ -657,6 +1240,7 @@ def test_grpc_fleet_backpressure_resource_exhausted_with_retry_after(
     server, client = fleet_plugin
     sched = server._escalator_service.fleet
     sched.pause()
+    rejected0 = sched.rejected_total
     outcomes = []
     lock = threading.Lock()
 
@@ -675,7 +1259,10 @@ def test_grpc_fleet_backpressure_resource_exhausted_with_retry_after(
     threads = [threading.Thread(target=flood, args=(i,)) for i in range(6)]
     for t in threads:
         t.start()
-    time.sleep(1.0)   # all six RPCs queued/rejected against the paused worker
+    deadline = time.monotonic() + 10
+    while (sched.queue_depth + (sched.rejected_total - rejected0) < 6
+           and time.monotonic() < deadline):
+        time.sleep(0.02)   # all six queued/rejected against the paused worker
     sched.resume()
     for t in threads:
         t.join()
@@ -692,8 +1279,13 @@ def test_grpc_fleet_health_fields_and_evict(fleet_plugin):
     fleet = h["fleet"]
     assert fleet["tenants"] >= 1
     assert {"queue_depth", "admitted_total", "rejected_total",
-            "oldest_waiting_sec", "batches", "buckets"} <= set(fleet)
+            "oldest_waiting_sec", "batches", "buckets",
+            # round 16: locked-snapshot counters + shard/pipeline/class SLO
+            "deferred_total", "shards", "pipelined", "classes"} <= set(fleet)
     assert fleet["admitted_total"] > fleet["queue_depth"]
+    assert fleet["shards"] == 2 and fleet["pipelined"] is True
+    assert set(fleet["classes"]) == {"critical", "standard", "batch"}
+    assert fleet["classes"]["critical"]["weight"] == 4
     ack = client.evict_tenant("warm")
     assert ack == {"evicted": "warm"}
     h2 = client.health()
