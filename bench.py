@@ -3256,6 +3256,267 @@ def run_smoke() -> dict:
         out["fleet_smoke_report"] = "(write failed)"
     _leg("fleet")
 
+    # ---- request-journey smoke (round 17): a MULTI-CLASS run through the
+    # real gRPC fleet server proving (1) every request's five journey
+    # stages sum to its endpoint e2e within 5% (dispatch device-fenced),
+    # (2) the journal round-trips through the real Journal RPC, (3) the
+    # journey+journal hook cost stays <1% of a smoke-scale fleet batch,
+    # and (4) debug-trace renders per-request journey tracks with the
+    # client-side submit→response slice wrapping the grafted server
+    # journey. Written to JOURNEY_SMOKE_LATEST.json for CI upload.
+    journey_report: dict = {"smoke": True, "mode": fleet_mode}
+    if fleet_mode == "grpc":
+        from escalator_tpu import observability as _obs
+        from escalator_tpu.observability import histograms as _jh
+        from escalator_tpu.observability import journal as _jj
+        from escalator_tpu.observability import traceexport as _jt
+
+        # the canonical stage set (one definition — a stage added there
+        # must fail here, not silently under-assert)
+        _JSTAGES = _jh.JOURNEY_STAGES
+        _JSTAGES_ALL = _JSTAGES + ("service",)
+
+        jsrv = make_server("127.0.0.1:0", max_workers=16, fleet=FleetConfig(
+            num_groups=Gf, pod_capacity=Pf, node_capacity=Nf, max_tenants=8,
+            max_batch=8, flush_ms=10.0, queue_limit=64,
+            per_tenant_inflight=1, num_shards=fleet_shards))
+        jsrv.start()
+        jclient = _FC(f"127.0.0.1:{jsrv._escalator_bound_port}",
+                      timeout_sec=300.0)
+        try:
+            journal_seq0 = _jj.JOURNAL.total_recorded
+            # warm (same bucket shapes as the fleet leg: no new compiles)
+            jclient.decide_arrays_fleet(
+                representative_cluster(Gf, Pf, Nf, seed=980), int(now),
+                "jwarm")
+            jsched = jsrv._escalator_service.fleet
+            jtenants = {f"jt{i}": (representative_cluster(Gf, Pf, Nf,
+                                                          seed=981 + i),
+                                   ("critical", "standard", "batch")[i % 3])
+                        for i in range(6)}
+            jres: dict = {}
+            jlock = _threading.Lock()
+
+            def _jone(tid, c, klass):
+                # client-side root span wrapping submit→response, grafting
+                # the server journey under its rpc slice — the GrpcBackend
+                # convention, driven directly so the smoke controls the
+                # span names it asserts on below
+                with _obs.spans.span(f"journey_client_{tid}"):
+                    _obs.annotate(backend="journey-smoke")
+                    with _obs.spans.span("rpc", kind="rpc"):
+                        o, phases, meta = jclient.decide_arrays_fleet(
+                            c, int(now), tid,
+                            span_ctx={"path": _obs.current_path()},
+                            klass=klass)
+                    if phases:
+                        _obs.graft(phases,
+                                   under=_obs.current_path() + "/rpc")
+                with jlock:
+                    jres[tid] = (o, meta)
+
+            jsched.pause()
+            jthreads = [_threading.Thread(target=_jone, args=(t, c, k))
+                        for t, (c, k) in jtenants.items()]
+            for t in jthreads:
+                t.start()
+            deadline = time.monotonic() + 30
+            while (jsched.queue_depth < len(jtenants)
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            jsched.resume()
+            for t in jthreads:
+                t.join()
+            # (1) stage-sum ≈ e2e for EVERY request, from the sidecar the
+            # server shipped back AND from the fleet_batch records
+            sums = []
+            for tid, (o, meta) in jres.items():
+                j = (meta or {}).get("journey")
+                assert j, f"journey smoke: no journey sidecar for {tid}"
+                ssum = sum(j["stages_ms"][st] for st in _JSTAGES)
+                e2e = j["e2e_ms"]
+                assert abs(ssum - e2e) <= max(0.05 * e2e, 0.05), (
+                    f"journey smoke: stages sum {ssum} vs e2e {e2e} "
+                    f"for {tid}")
+                sums.append({"tenant": tid, "klass": j.get("klass"),
+                             "e2e_ms": e2e, "stages_ms": j["stages_ms"]})
+            jb_recs = [r for r in _FREC.snapshot()
+                       if r.get("root") == "fleet_batch"
+                       and r.get("journeys")]
+            ring_journeys = [j for r in jb_recs for j in r["journeys"]]
+            served = {j["tenant"] for j in ring_journeys}
+            assert set(jtenants) <= served, (set(jtenants), served)
+            for j in ring_journeys:
+                ssum = sum(j["stages_ms"].values())
+                assert abs(ssum - j["e2e_ms"]) <= max(
+                    0.05 * j["e2e_ms"], 0.05), j
+            # the dispatch stage is the FENCED fleet_step window
+            assert any(
+                p.get("name") == "fleet_step" and p.get("fenced")
+                for r in jb_recs for p in r.get("phases", ())), (
+                "fleet_step span not fenced")
+            # per-(class, stage) histograms populated for every class hit
+            for klass in ("critical", "standard", "batch"):
+                for stage in ("admission", "dispatch", "service"):
+                    h = _jh.STAGES.peek(klass, stage)
+                    assert h is not None and h.count >= 1, (klass, stage)
+            journey_report["requests"] = sums
+            journey_report["stage_sum_tolerance"] = "5%"
+            out["smoke_journey_decomposition"] = "ok"
+
+            # (2) journal round-trip through the REAL Journal RPC: the six
+            # registers + one forced admission reject must come back over
+            # the wire with monotonic seqs
+            jsched.queue_limit = 1
+            jsched.pause()
+            fill_out: list = []
+
+            def _jfill():
+                # the queue-filling request blocks until resume — it must
+                # ride a thread (a synchronous call against the paused
+                # scheduler would deadlock this leg)
+                try:
+                    jclient.decide_arrays_fleet(
+                        representative_cluster(Gf, Pf, Nf, seed=990),
+                        int(now), "jreject-a", max_attempts=1)
+                    fill_out.append("ok")
+                except _grpc.RpcError as e:   # pragma: no cover
+                    fill_out.append(e.code().name)
+
+            filler = _threading.Thread(target=_jfill)
+            filler.start()
+            deadline = time.monotonic() + 10
+            while jsched.queue_depth < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            try:
+                try:
+                    jclient.decide_arrays_fleet(
+                        representative_cluster(Gf, Pf, Nf, seed=991),
+                        int(now), "jreject-b", max_attempts=1)
+                    raise AssertionError(
+                        "journey smoke: queue-full reject did not fire")
+                except _grpc.RpcError as e:
+                    assert e.code().name == "RESOURCE_EXHAUSTED", e
+            finally:
+                jsched.queue_limit = 64
+                jsched.resume()
+                filler.join(timeout=30)
+            assert fill_out == ["ok"], fill_out
+            jdoc = jclient.journal(since_seq=journal_seq0)
+            kinds = [e["kind"] for e in jdoc["events"]]
+            assert "fleet-tenant-register" in kinds, kinds
+            assert "admission-reject" in kinds, kinds
+            seqs = [e["seq"] for e in jdoc["events"]]
+            assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+            registered = {e.get("tenant") for e in jdoc["events"]
+                          if e["kind"] == "fleet-tenant-register"}
+            assert set(jtenants) <= registered, (set(jtenants), registered)
+            journey_report["journal"] = {
+                "events": len(jdoc["events"]),
+                "kinds": sorted(set(kinds)),
+                "rpc": "ok",
+            }
+            out["smoke_journal_rpc"] = "ok"
+
+            # (3) overhead gate: one journey record (6 stage-histogram
+            # observes + the sink append + a journal event) micro-benched,
+            # multiplied by the batch width, must stay under 1% of the
+            # measured smoke-scale fleet batch — the PR-4 discipline
+            bench_journal = _jj.OpsJournal(capacity=256)
+            sink: list = []
+            iters = 2000
+            jt0 = time.perf_counter()
+            for _ in range(iters):
+                for stage in _JSTAGES_ALL:
+                    _jh.STAGES.observe(("overheadbench", stage), 1e-3)
+                sink.append({"tenant": "x"})
+                bench_journal.event("bench-journey", tenant="x",
+                                    klass="standard")
+                if len(sink) > 64:
+                    sink.clear()
+            hook_us = (time.perf_counter() - jt0) / iters * 1e6
+            for stage in _JSTAGES_ALL:
+                _jh.STAGES.discard("overheadbench", stage)
+            # denominator: the median WARM batch (the ring also holds the
+            # compile-scale warm-up batches, which would flatter the gate
+            # — the round-15 recorder lesson), with the PR-4/PR-13
+            # absolute floor: a smoke-scale batch is microscopic next to a
+            # production one, so percent-of-tiny is noise below 0.25 ms
+            warm_ms = sorted(r["duration_ms"] for r in jb_recs
+                             if not r.get("compile_events"))
+            batch_ms = (warm_ms[len(warm_ms) // 2] if warm_ms
+                        else min(r["duration_ms"] for r in jb_recs))
+            batch_n = max(len(r.get("journeys") or ()) for r in jb_recs)
+            hook_ms = hook_us * batch_n / 1e3
+            gate_ms = max(0.01 * batch_ms, 0.25)
+            assert hook_ms < gate_ms, (
+                f"journey+journal hook cost {hook_us:.1f} us x {batch_n} "
+                f"requests = {hook_ms:.3f} ms vs gate {gate_ms:.3f} ms "
+                f"(1% of a {batch_ms:.1f} ms warm fleet batch, floor "
+                "0.25 ms)")
+            journey_report["overhead"] = {
+                "hook_us_per_request": round(hook_us, 2),
+                "warm_batch_ms": batch_ms,
+                "hook_per_batch_ms": round(hook_ms, 4),
+                "gate_ms": round(gate_ms, 4),
+            }
+            out["smoke_journey_overhead_ms"] = round(hook_ms, 4)
+
+            # (4) debug-trace renders per-request journey tracks AND the
+            # client slice wrapping the grafted server journey — through
+            # the real CLI verb on a real ring dump
+            import tempfile as _jtempfile
+
+            from escalator_tpu.cli import main as _cli_main
+
+            jtmp = _jtempfile.mkdtemp(prefix="escalator-journey-smoke-")
+            jdump = os.path.join(jtmp, "journey-ring.json")
+            jtrace = os.path.join(jtmp, "journey.trace.json")
+            _FREC.dump(jdump, reason="journey-smoke")
+            rc = _cli_main(["debug-trace", "--dump", jdump,
+                            "--output", jtrace])
+            assert rc == 0, f"debug-trace exited {rc}"
+            with open(jtrace) as f:
+                trace_doc = json.load(f)
+            ev = trace_doc["traceEvents"]
+            jslices = [e for e in ev if e.get("ph") == "X"
+                       and e.get("tid", 0) >= _jt.TID_JOURNEY_BASE]
+            req_slices = [e for e in jslices
+                          if e["name"].startswith("req jt")]
+            assert req_slices, "no per-request journey slices in trace"
+            stage_names = {e["name"] for e in jslices}
+            assert {"admission", "dispatch", "unpack"} <= stage_names, (
+                stage_names)
+            # client+server merged: the journey_client record's grafted
+            # journey phases sit under its rpc slice path
+            grafted = [e for e in ev if e.get("ph") == "X"
+                       and "/rpc/journey/" in str(
+                           e.get("args", {}).get("path", ""))]
+            assert grafted, "client trace carries no grafted journey"
+            journey_report["trace"] = {
+                "request_slices": len(req_slices),
+                "stage_slices": len(jslices) - len(req_slices),
+                "grafted_client_slices": len(grafted),
+            }
+            out["smoke_journey_trace"] = "ok"
+        finally:
+            jclient.close()
+            jsrv.stop(grace=None)
+    journey_artifact = os.environ.get(
+        "ESCALATOR_TPU_JOURNEY_SMOKE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "JOURNEY_SMOKE_LATEST.json"),
+    )
+    try:
+        with open(journey_artifact, "w") as f:
+            json.dump(journey_report, f, indent=1)
+            f.write("\n")
+        out["journey_smoke_report"] = journey_artifact
+    except OSError:   # read-only checkout: the in-memory asserts still ran
+        out["journey_smoke_report"] = "(write failed)"
+    out["smoke_journey_mode"] = fleet_mode
+    _leg("journey")
+
     # dump the ring BEFORE the resources leg below: that leg's profiler
     # pump serves a few hundred plugin decides (each a root record), which
     # would flush the streaming/incremental smoke ticks out of the

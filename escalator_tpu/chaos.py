@@ -168,6 +168,15 @@ class ChaosMonkey:
             obs.annotate(chaos=site)
         except Exception:  # noqa: BLE001
             pass
+        try:
+            # third surface (round 17): the ops journal — a chaos run's
+            # firings are discrete events an operator replays against the
+            # tick ring ("what happened around tick N" includes "we shot it")
+            from escalator_tpu.observability import journal
+
+            journal.JOURNAL.event("chaos-fired", site=site)
+        except Exception:  # noqa: BLE001
+            pass
         log.warning("chaos: fired site %r", site)
 
 

@@ -214,6 +214,96 @@ def debug_replay_main(argv: List[str]) -> int:
     return 0
 
 
+def debug_journal_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-journal``: print the ops event journal — the
+    bounded ring of discrete operator events (tenant lifecycle, admission
+    rejects, SLO burns, chaos firings, watchdog breaches) every flight dump
+    embeds — from a dump file or a live plugin (the ``Journal`` RPC).
+    "What happened around tick N" becomes one query instead of log
+    archaeology: filter by kind (``--kind``), by sequence (``--since``),
+    or take the last N (``--tail``). Exit status: 0 on success (an empty
+    journal prints a note and still exits 0), 2 when the source cannot be
+    read/fetched."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-journal",
+        description="print the ops event journal of a dump or live plugin",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--dump",
+                     help="flight-recorder dump JSON (debug-dump output or"
+                          " an incident/tail dump) carrying a journal"
+                          " section")
+    src.add_argument("--plugin-address",
+                     help="fetch the live journal from a running compute"
+                          " plugin instead of a file")
+    p.add_argument("--kind", action="append", default=None,
+                   help="only events of this kind (repeatable, e.g."
+                        " --kind admission-reject --kind slo-breach)")
+    p.add_argument("--since", type=int, default=0,
+                   help="only events with seq > SINCE")
+    p.add_argument("--tail", type=int, default=0,
+                   help="only the last N (after the other filters)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the events as JSON instead of text lines")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    if args.dump:
+        try:
+            with open(args.dump) as f:
+                dump_doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read dump: {e}", file=sys.stderr)
+            return 2
+        doc = dump_doc.get("journal") or {"events": [],
+                                          "total_recorded": 0,
+                                          "capacity": 0}
+    else:
+        from escalator_tpu.plugin.client import ComputeClient
+
+        client = ComputeClient(args.plugin_address, timeout_sec=args.timeout)
+        try:
+            doc = client.journal(since_seq=args.since)
+        except Exception as e:  # noqa: BLE001 - any transport failure: exit 2
+            print(f"cannot fetch journal from {args.plugin_address}: {e}",
+                  file=sys.stderr)
+            return 2
+        finally:
+            client.close()
+    all_events = doc.get("events") or []
+    # the wrap note reads the UNfiltered ring: "events aged out" is a
+    # property of the ring, not of whatever filter the operator applied
+    wrapped_to = (all_events[0]["seq"] - 1
+                  if all_events and all_events[0].get("seq", 1) > 1 else 0)
+    events = all_events
+    if args.since:
+        events = [e for e in events if e.get("seq", 0) > args.since]
+    if args.kind:
+        wanted = set(args.kind)
+        events = [e for e in events if e.get("kind") in wanted]
+    if args.tail > 0:
+        events = events[-args.tail:]
+    if args.json:
+        print(json.dumps({"capacity": doc.get("capacity"),
+                          "total_recorded": doc.get("total_recorded"),
+                          "events": events}, indent=1))
+        return 0
+    total = doc.get("total_recorded", 0)
+    print(f"ops journal: {len(events)} event(s) shown, "
+          f"{total} recorded lifetime (ring capacity "
+          f"{doc.get('capacity', '?')})")
+    if wrapped_to and not args.since:
+        print(f"  (ring wrapped: events 1..{wrapped_to} aged out)")
+    for ev in events:
+        ts = time.strftime("%H:%M:%S",
+                           time.localtime(ev.get("time_unix", 0)))
+        rest = " ".join(
+            f"{k}={ev[k]}" for k in sorted(ev)
+            if k not in ("seq", "kind", "time_unix"))
+        print(f"[{ev.get('seq', '?'):>5}] {ts} {ev.get('kind', '?'):<22}"
+              f" {rest}".rstrip())
+    return 0
+
+
 def debug_compiles_main(argv: List[str]) -> int:
     """``escalator-tpu debug-compiles``: the compile observatory's operator
     end — print the recent-compile ring from a flight dump (or a live
@@ -550,6 +640,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return debug_trace_main(argv[1:])
     if argv and argv[0] == "debug-replay":
         return debug_replay_main(argv[1:])
+    if argv and argv[0] == "debug-journal":
+        return debug_journal_main(argv[1:])
     if argv and argv[0] == "debug-compiles":
         return debug_compiles_main(argv[1:])
     if argv and argv[0] == "debug-profile":

@@ -38,11 +38,29 @@ RPCs and the engine's one-dispatch-per-micro-batch step:
   (``fleet/class/<name>``), so per-tenant and per-class p99s ride the same
   PR-8 tail machinery as tick latencies. Errored results are NOT recorded
   (a failed batch's wait time is not service latency).
+- **Request journeys (round 17)**: every served decide carries a journey —
+  five contiguous, summing-to-e2e stage durations (``admission`` queue
+  wait incl. the class-deferral count, ``batch_assembly`` the prep window
+  it rode, ``dispatch`` the fused device program's fenced window,
+  ``ordered_tail`` its own lazy-orders re-dispatch, ``unpack`` the rest of
+  the respond path) — assembled on the RESPOND side (``_record_journey``,
+  off the device hot path; the only stamps on the take path are one
+  hoisted clock read per flush and per-skip counter increments inside
+  ``_take_batch``'s existing single pass). Journeys feed the
+  ``(class, stage)`` histograms behind
+  ``escalator_tpu_fleet_stage_seconds{klass,stage}``, ride the batch's
+  ``fleet_batch`` flight record (Perfetto per-request tracks via
+  ``debug-trace``), and ship back to the gRPC caller. Per-class
+  **error-budget burn** rides the same rolling window as the p99 check:
+  ``fleet_slo_budget_burn{klass}`` publishes the rate, fast burns journal
+  an escalation and (``ESCALATOR_TPU_TAIL_PROFILE=1``) arm a profiler
+  capture — the PR-10 tail-breach escalation path, now SLO-driven.
 """
 
 from __future__ import annotations
 
 import heapq
+import os
 import threading
 import time
 from collections import deque
@@ -90,6 +108,27 @@ DEFAULT_CLASSES = (
 #: (one histogram quantile) but not per-request
 _SLO_CHECK_EVERY = 16
 
+#: the five REAL journey stages, in pipeline order — their durations sum to
+#: the request's endpoint e2e by construction (contiguous wall-clock
+#: segments on one monotonic clock); "service" is the derived sixth series
+#: (everything after queue wait) the health probe's split reads. The tuple
+#: itself is canonical in observability.histograms (the exporter/bench
+#: import it there — one definition, no drift).
+JOURNEY_STAGES = obs.histograms.JOURNEY_STAGES
+
+#: error-budget burn thresholds for classes with a p99 target: the budget
+#: is the 1% of requests a p99 SLO permits over target, and burn is the
+#: observed violation fraction over the rolling check window divided by
+#: that allowance. 1.0 = burning exactly the allotment; the fast threshold
+#: is the classic multi-window page-now rate (Google SRE workbook: budget
+#: gone in ~2 days), the slow one the sustained-ticket rate.
+SLO_FAST_BURN = 14.4
+SLO_SLOW_BURN = 3.0
+
+#: seconds between fast-burn escalations per class (journal + optional
+#: profiler arm) — a sustained breach must trickle, not storm
+_SLO_ESCALATE_INTERVAL_SEC = 60.0
+
 
 class AdmissionError(Exception):
     """A request the scheduler refused at the door. ``reason`` is the
@@ -111,6 +150,13 @@ class _Pending:
     future: Future
     klass: str = "standard"
     enqueued: float = field(default_factory=time.monotonic)
+    #: journey bookkeeping (round 17): when the flush took this request
+    #: (admission stage closes here) and how many one-per-tenant skips it
+    #: ate while queued — both written inside _take_batch's existing single
+    #: pass (one hoisted clock read per flush, one attribute store per
+    #: request; no locks added)
+    taken: float = 0.0
+    deferrals: int = 0
 
 
 class FleetScheduler:
@@ -164,6 +210,22 @@ class FleetScheduler:
         # reads the samples since the LAST check and resets
         self._slo_windows: Dict[str, obs.histograms.LogHistogram] = {
             n: obs.histograms.LogHistogram() for n in self.classes}
+        # error-budget accounting (round 17): [requests, over-target] per
+        # class over the SAME rolling window as _slo_windows; the check
+        # turns them into a burn rate (violation fraction / the 1% a p99
+        # SLO allows), publishes fleet_slo_budget_burn{klass}, and
+        # fast-burn escalates (journal event + optional profiler arm)
+        self._slo_burn_counts: Dict[str, List[int]] = {
+            n: [0, 0] for n in self.classes}
+        self.last_burn: Dict[str, float] = {n: 0.0 for n in self.classes}
+        self._slo_escalated: Dict[str, float] = {}
+        # escalation needs TWO consecutive fast windows: within one ~16-
+        # request window every same-batch violation is perfectly
+        # correlated, so a single slow batch (GC pause, recompile) reads
+        # as burn >= 14.4 — sustained-across-windows is the page signal,
+        # one window is a hiccup (the gauge and slo-burn/slo-breach
+        # journal events still report immediately)
+        self._slo_fast_streak: Dict[str, int] = {n: 0 for n in self.classes}
         # tenant -> {class: queued count}: the evict-class inheritance
         # index (scanning every queued request under the cv put an
         # O(queue_limit) walk on the lock that serializes submit)
@@ -209,9 +271,14 @@ class FleetScheduler:
         heads = [q[0].enqueued for q in self._queues.values() if q]
         return min(heads) if heads else None
 
-    def _reject(self, reason: str, retry_after_ms: float):
+    def _reject(self, reason: str, retry_after_ms: float,
+                klass: Optional[str] = None,
+                tenant: Optional[str] = None):
         self.rejected_total += 1
         metrics.fleet_admission_rejects.labels(reason).inc()
+        obs.journal.JOURNAL.event("admission-reject", reason=reason,
+                                  klass=klass, tenant=tenant,
+                                  retry_after_ms=round(retry_after_ms, 1))
         raise AdmissionError(reason, retry_after_ms)
 
     def _retry_after_ms(self, extra_batches: float) -> float:
@@ -276,12 +343,15 @@ class FleetScheduler:
             # precise reason is the tenant's own chattiness, not the queue
             depth = self._inflight.get(tid, 0)
             if depth >= self.per_tenant_inflight:
-                self._reject("tenant-inflight", self._retry_after_ms(depth))
+                self._reject("tenant-inflight", self._retry_after_ms(depth),
+                             klass=klass, tenant=tid)
             if cls.queue_share < 1.0 and len(self._queues[klass]) >= max(
                     1, int(self.queue_limit * cls.queue_share)):
-                self._reject(f"queue-full-{klass}", self._retry_after_ms(0))
+                self._reject(f"queue-full-{klass}", self._retry_after_ms(0),
+                             klass=klass, tenant=tid)
             if self.queue_depth >= self.queue_limit:
-                self._reject("queue-full", self._retry_after_ms(0))
+                self._reject("queue-full", self._retry_after_ms(0),
+                             klass=klass, tenant=tid)
             self._inflight[tid] = depth + 1
             self.admitted_total += 1
             self._queues[klass].append(_Pending(request, fut, klass))
@@ -321,10 +391,24 @@ class FleetScheduler:
         # quantiles OUTSIDE the lock: the histogram layer has its own
         # synchronization, and a health probe must not serialize the hot
         # submit path behind per-class p99 scans
+        def _q(h, q):
+            v = h.quantile(q) if h is not None else None
+            return None if v is None else round(v * 1e3, 3)
+
         for name, row in per_class.items():
             h = obs.histograms.TICKS.peek(f"fleet/class/{name}")
-            p99 = h.quantile(0.99) if h is not None else None
-            row["p99_ms"] = None if p99 is None else round(p99 * 1e3, 3)
+            row["p99_ms"] = _q(h, 0.99)
+            # queue-wait vs service-time split from the journey stage
+            # histograms (round 17): stale-but-alive triage can now tell
+            # BACKPRESSURE (queue-wait p99 grows, service flat) from SLOW
+            # DISPATCH (service p99 grows) without a Prometheus scrape
+            qw = obs.histograms.STAGES.peek(name, "admission")
+            sv = obs.histograms.STAGES.peek(name, "service")
+            row["queue_wait_p50_ms"] = _q(qw, 0.50)
+            row["queue_wait_p99_ms"] = _q(qw, 0.99)
+            row["service_p50_ms"] = _q(sv, 0.50)
+            row["service_p99_ms"] = _q(sv, 0.99)
+            row["slo_burn"] = round(self.last_burn.get(name, 0.0), 2)
         return snap
 
     # -- batch assembly -------------------------------------------------------
@@ -357,6 +441,9 @@ class FleetScheduler:
         batch: List[_Pending] = []
         taken: set = set()
         deferred = 0
+        # one clock read per flush: every request this batch takes closes
+        # its admission (queue-wait) stage at the same flush instant
+        now_take = time.monotonic()
         names = [n for n, q in self._queues.items() if q]
         items = {n: list(self._queues[n]) for n in names}
         consumed = {n: [False] * len(items[n]) for n in names}
@@ -371,6 +458,7 @@ class FleetScheduler:
             while i < len(lst):
                 if lst[i].request.tenant_id in taken:
                     deferred += 1
+                    lst[i].deferrals += 1   # journey: class-deferral count
                     i += 1
                     continue
                 cursor[name] = i
@@ -383,6 +471,7 @@ class FleetScheduler:
             consumed[name][i] = True
             cursor[name] = i + 1
             taken.add(p.request.tenant_id)
+            p.taken = now_take          # journey: admission stage closes
             batch.append(p)
             self._drop_queued_class(p.request.tenant_id, name)
 
@@ -588,6 +677,15 @@ class FleetScheduler:
                 self._class_served[p.klass] += 1
                 if self._class_served[p.klass] % _SLO_CHECK_EVERY == 0:
                     slo_checks.append(p.klass)
+                # error-budget accounting over the same rolling window as
+                # the p99 check: a decide counted against its class's
+                # target (evicts have no latency contract)
+                target = self.classes[p.klass].p99_target_ms
+                if target is not None and not isinstance(res, EvictAck):
+                    cnt = self._slo_burn_counts[p.klass]
+                    cnt[0] += 1
+                    if (done - p.enqueued) * 1e3 > target:
+                        cnt[1] += 1
             self._cv.notify_all()
         for p, res in zip(batch, results, strict=True):
             if isinstance(res, EvictAck):
@@ -605,12 +703,69 @@ class FleetScheduler:
                 obs.histograms.TICKS.observe(
                     (f"fleet/class/{p.klass}",), dur)
                 self._slo_windows[p.klass].record(dur)
+                # journey bookkeeping lives HERE, on the respond side —
+                # off the device hot path, after every stage boundary is
+                # known, before the future resolves (the gRPC edge ships
+                # the journey back with the response)
+                self._record_journey(p, res, done)
             if isinstance(res, BaseException):
                 p.future.set_exception(res)
             else:
                 p.future.set_result(res)
         for klass in slo_checks:
             self._check_class_slo(klass)
+
+    def _record_journey(self, p: _Pending, res, done: float) -> None:
+        """Assemble one request's journey from the stage boundaries the
+        pipeline stamped (enqueue → taken → dispatch window → done, all
+        time.monotonic), feed the per-(class, stage) histograms, append to
+        the batch's fleet_batch record sink, and attach to the result.
+
+        The five stage durations are CONTIGUOUS wall-clock segments, so
+        they sum to the endpoint e2e (``done - enqueued`` — the same value
+        the fleet/<tenant> series just recorded) by construction; the
+        smoke's 5% tolerance covers only clamp/rounding slack. Engines
+        that predate the two-stage stamps (or stub engines in tests)
+        contribute a zero-width dispatch window and the time folds into
+        batch_assembly/unpack — the sum identity still holds."""
+        st = getattr(res, "stages", None) or {}
+        t0 = p.enqueued
+        t1 = p.taken or t0
+        t2 = st.get("dispatch_t0") or t1
+        t3 = st.get("dispatch_t1") or t2
+        # a stale dispatch window (engine stamped an earlier batch) must
+        # not produce negative stages: clamp into [t1, done]
+        t2 = min(max(t2, t1), done)
+        t3 = min(max(t3, t2), done)
+        tail_ms = float(st.get("ordered_tail_ms") or 0.0)
+        tail_ms = min(tail_ms, max(0.0, (done - t3) * 1e3))
+        stages_ms = {
+            "admission": (t1 - t0) * 1e3,
+            "batch_assembly": (t2 - t1) * 1e3,
+            "dispatch": (t3 - t2) * 1e3,
+            "ordered_tail": tail_ms,
+            "unpack": (done - t3) * 1e3 - tail_ms,
+        }
+        journey = {
+            "tenant": p.request.tenant_id,
+            "klass": p.klass,
+            "deferrals": p.deferrals,
+            "enqueued_mono": round(t0, 6),
+            "done_mono": round(done, 6),
+            "stages_ms": {k: round(v, 4) for k, v in stages_ms.items()},
+            "e2e_ms": round((done - t0) * 1e3, 4),
+        }
+        for stage, ms in stages_ms.items():
+            obs.histograms.STAGES.observe((p.klass, stage), ms / 1e3)
+        # the derived split the health probe reads: queue wait IS the
+        # admission stage; service = everything after the flush took it
+        obs.histograms.STAGES.observe((p.klass, "service"),
+                                      max(0.0, done - t1))
+        sink = st.get("sink")
+        if sink is not None:
+            sink.append(journey)
+        if hasattr(res, "journey"):
+            res.journey = journey
 
     def _check_class_slo(self, klass: str) -> None:
         """Breach check over the ROLLING window (the samples recorded
@@ -627,11 +782,84 @@ class FleetScheduler:
         with self._cv:
             window = self._slo_windows[klass]
             self._slo_windows[klass] = obs.histograms.LogHistogram()
+            requests, violations = self._slo_burn_counts[klass]
+            self._slo_burn_counts[klass] = [0, 0]
         p99 = window.quantile(0.99)
-        if p99 is not None and p99 * 1e3 > target:
+        breached = p99 is not None and p99 * 1e3 > target
+        if breached:
             with self._cv:
                 self.class_breaches[klass] += 1
             metrics.fleet_class_p99_breach.labels(klass).inc()
+        # error-budget burn over the same window: a p99 target allows 1%
+        # of requests over it; burn = observed violation fraction / 1%.
+        burn = ((violations / requests) / 0.01) if requests else 0.0
+        self.last_burn[klass] = burn
+        metrics.fleet_slo_budget_burn.labels(klass).set(burn)
+        level = ("fast" if burn >= SLO_FAST_BURN
+                 else "slow" if burn >= SLO_SLOW_BURN else None)
+        if breached or level is not None:
+            obs.journal.JOURNAL.event(
+                "slo-breach" if breached else "slo-burn", klass=klass,
+                p99_ms=None if p99 is None else round(p99 * 1e3, 3),
+                target_ms=target, burn=round(burn, 2),
+                level=level or "none", window_requests=requests)
+        with self._cv:
+            streak = (self._slo_fast_streak[klass] + 1 if level == "fast"
+                      else 0)
+            self._slo_fast_streak[klass] = streak
+        if streak >= 2:
+            self._escalate_slo(klass, burn, p99, target)
+
+    def _escalate_slo(self, klass: str, burn: float,
+                      p99: Optional[float], target: float) -> None:
+        """Fast-burn escalation — fired only on the SECOND consecutive
+        fast window (see ``_slo_fast_streak``) and rate-limited per class:
+        a journal event plus — when ``ESCALATOR_TPU_TAIL_PROFILE=1``, the
+        same opt-in that arms the tail watchdog's capture — a jax profiler
+        capture of the next K ticks into the dump directory, so a burning
+        SLO on a TPU campaign yields an on-chip profile with no human in
+        the loop.
+        The arm runs on a daemon worker (the watchdog-dump discipline):
+        ``jax.profiler.start_trace`` was measured taking ~16 s on its
+        FIRST call in a process, and the completion path must never pay
+        that. The worker journals the arm outcome as a follow-up
+        ``slo-profile-armed`` event."""
+        now = time.monotonic()
+        with self._cv:
+            if (now - self._slo_escalated.get(klass, -float("inf"))
+                    < _SLO_ESCALATE_INTERVAL_SEC):
+                return
+            self._slo_escalated[klass] = now
+        profile_on = os.environ.get(
+            "ESCALATOR_TPU_TAIL_PROFILE", "").lower() in ("1", "true", "yes")
+        obs.journal.JOURNAL.event(
+            "slo-escalation", klass=klass, burn=round(burn, 2),
+            p99_ms=None if p99 is None else round(p99 * 1e3, 3),
+            target_ms=target, profile_requested=profile_on)
+        if not profile_on:
+            return
+
+        def _arm():
+            try:
+                from escalator_tpu.observability import (
+                    flightrecorder,
+                    resources,
+                )
+
+                ticks = int(os.environ.get(
+                    "ESCALATOR_TPU_TAIL_PROFILE_TICKS", "4"))
+                out_dir = os.path.join(
+                    flightrecorder.dump_dir(),
+                    f"escalator-tpu-profile-slo-{klass}-{os.getpid()}-"
+                    f"{int(time.time())}")
+                profile = dict(resources.PROFILER.start(ticks, out_dir))
+            except Exception as e:  # noqa: BLE001 - never break anything
+                profile = {"ok": False, "error": str(e)}
+            obs.journal.JOURNAL.event("slo-profile-armed", klass=klass,
+                                      profile=profile)
+
+        threading.Thread(target=_arm, name="escalator-slo-profile",
+                         daemon=True).start()
 
     # -- shutdown -------------------------------------------------------------
 

@@ -81,7 +81,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from dataclasses import dataclass, fields
+from dataclasses import dataclass, field, fields
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -169,6 +169,16 @@ class FleetDecision:
     ordered: bool
     batch_size: int
     shard: int = 0
+    #: engine-side journey raw material (round 17): the batch's device
+    #: dispatch window (monotonic stamps), this tenant's ordered-tail cost,
+    #: and the shared per-batch journey sink the scheduler appends the
+    #: finished journey into (so the fleet_batch flight record carries it).
+    #: None from engines that predate journeys.
+    stages: Optional[dict] = None
+    #: the finished per-request journey, attached by the scheduler on the
+    #: respond side (stage durations summing to the endpoint e2e) — the
+    #: gRPC edge ships it back to the caller as span phases + fleet sidecar
+    journey: Optional[dict] = None
 
 
 def _pow2(n: int, lo: int = 1) -> int:
@@ -365,6 +375,15 @@ class _PreparedBatch:
     overlap_saved_ms: Optional[float] = None
     executed: bool = False
     released: bool = False
+    #: request-journey raw material (round 17): the fused dispatch's
+    #: monotonic window (device-fenced — dispatch_t1 is read after the
+    #: program's outputs landed on host) and the shared journey sink this
+    #: batch's fleet_batch record carries. The scheduler appends each
+    #: request's finished journey to the sink on the respond side, AFTER
+    #: the record is in the ring — list identity is the channel.
+    dispatch_t0: float = 0.0
+    dispatch_t1: float = 0.0
+    journeys: list = field(default_factory=list)
 
 
 class FleetEngine:
@@ -551,6 +570,9 @@ class FleetEngine:
         metrics.fleet_arena_grows.inc()
         obs.annotate(fleet_arena_grow=(
             f"G={G2} P={P2} N={N2} C={C2 * self._S}"))
+        obs.journal.JOURNAL.event(
+            "fleet-arena-grow", groups=G2, pods=P2, nodes=N2,
+            tenants=C2 * self._S, epoch=self._epoch)
         log.info("fleet arena grown to G=%d P=%d N=%d C=%d (x%d shards)",
                  G2, P2, N2, C2, self._S)
 
@@ -626,6 +648,9 @@ class FleetEngine:
         self._epoch += 1
         metrics.fleet_arena_compacts.inc()
         obs.annotate(fleet_arena_compact=f"C={old_c}->{C2 * self._S}")
+        obs.journal.JOURNAL.event(
+            "fleet-arena-compact", tenants=len(live), old_c=old_c,
+            new_c=C2 * self._S, epoch=self._epoch)
         log.info("fleet arena compacted: %d tenants, C %d -> %d",
                  len(live), old_c, C2 * self._S)
         return {"tenants": len(live), "old_c": old_c,
@@ -671,6 +696,8 @@ class FleetEngine:
         )
         self._tenants[tenant_id] = t
         metrics.fleet_tenant_count.set(len(self._tenants))
+        obs.journal.JOURNAL.event("fleet-tenant-register", tenant=tenant_id,
+                                  shard=t.shard, row=t.row)
         return t
 
     def _ensure_buckets(self, cluster: ClusterArrays) -> None:
@@ -768,6 +795,9 @@ class FleetEngine:
             if tenant is None:
                 raise TenantError(f"unknown tenant {r.tenant_id!r}")
             metrics.fleet_tenant_count.set(len(self._tenants))
+            obs.journal.JOURNAL.event(
+                "fleet-tenant-evict", tenant=r.tenant_id,
+                shard=tenant.shard, row=tenant.row)
             # eviction is a decide against the EMPTY cluster: every valid
             # lane clears, aggregates fall to zero, the slot frees after
             new_p, new_n, new_g = (_empty_pods(self._P),
@@ -908,6 +938,9 @@ class FleetEngine:
             if self._staged is pb:
                 self._staged = None
             self._host.notify_all()
+        obs.journal.JOURNAL.event(
+            "fleet-stale-batch", batch_epoch=pb.epoch, epoch=self._epoch,
+            requests=len(pb.requests))
         raise StaleBatchError(
             "prepared fleet batch went stale (arenas rebuilt after a "
             "dispatch failure); resubmit the requests")
@@ -927,12 +960,30 @@ class FleetEngine:
                         overlap_saved_ms=round(pb.overlap_saved_ms, 3))
                     metrics.fleet_overlap_saved_ms.inc(
                         max(pb.overlap_saved_ms, 0.0))
+                # journey anchoring (round 17): the record carries the
+                # shared journey sink (the scheduler appends finished
+                # journeys after completion) plus the monotonic time of
+                # this record's root open, so the trace exporter can lay
+                # journey slices out in record time. One clock-pair read
+                # per batch, not per request.
+                tl = obs.current_timeline()
+                if tl is not None:
+                    obs.annotate(
+                        journeys=pb.journeys,
+                        journey_mono_t0=round(
+                            time.monotonic()
+                            - (time.perf_counter() - tl.t0), 6))
                 if pb.entries:
+                    pb.dispatch_t0 = time.monotonic()
                     out_host = self._dispatch(pb, ds)
+                    # read AFTER _dispatch's host conversion blocked on the
+                    # program: the window is device time, not dispatch time
+                    pb.dispatch_t1 = time.monotonic()
                     with obs.span("fleet_unpack"):
                         for e in pb.entries:
                             results[e.pos] = self._finish(
-                                e, out_host, len(pb.entries), ds, _kernel)
+                                e, pb, out_host, len(pb.entries), ds,
+                                _kernel)
                 self.batches += 1
                 obs.annotate(
                     tenants=[r.tenant_id for r in pb.requests],
@@ -954,6 +1005,11 @@ class FleetEngine:
                 self._state = None   # donated — the refs die here
                 state2, out = self._step_fn(*state, *pb.operands)
                 self._state = state2
+                # fence before the host conversion: marks the fleet_step
+                # span device-fenced (the journey's dispatch stage quotes
+                # this window as device time) — the np.asarray reads below
+                # would block anyway, the fence makes the flag honest
+                obs.fence(out)
                 return {
                     f.name: np.asarray(getattr(out, f.name))
                     for f in fields(out)
@@ -969,6 +1025,9 @@ class FleetEngine:
             log.exception(
                 "fleet_step dispatch failed; rebuilding the arenas — "
                 "every tenant re-bootstraps on its next decide")
+            obs.journal.JOURNAL.event(
+                "fleet-rebuild", tenants=len(self._tenants),
+                epoch=self._epoch, requests=len(pb.requests))
             # epoch bump UNLOCKED first: a drain-waiter inside a grow can
             # classify any staged batch stale without waiting on the
             # rebuild below
@@ -987,7 +1046,8 @@ class FleetEngine:
                 self._host.notify_all()
             raise
 
-    def _finish(self, e: _Entry, out_host, batch_size, ds, _kernel):
+    def _finish(self, e: _Entry, pb: _PreparedBatch, out_host, batch_size,
+                ds, _kernel):
         """Slice the entry's ``[shard, t]`` batch row back to its request's
         shapes and run the per-tenant lazy-orders tail (ordered re-dispatch
         when consumed)."""
@@ -1008,14 +1068,25 @@ class FleetEngine:
         needs_orders = e.tainted_any or bool(
             (sliced["nodes_delta"] < 0).any())
         ordered = False
+        tail_ms = 0.0
         if needs_orders:
+            t_tail = time.monotonic()
             sliced = self._ordered_redispatch(e, G_c, N_c, ds, _kernel)
+            tail_ms = (time.monotonic() - t_tail) * 1e3
             ordered = True
         out = _kernel.DecisionArrays(**sliced)
         self.decisions += 1
-        return FleetDecision(tenant_id=e.request.tenant_id, arrays=out,
-                             ordered=ordered, batch_size=batch_size,
-                             shard=e.shard)
+        return FleetDecision(
+            tenant_id=e.request.tenant_id, arrays=out, ordered=ordered,
+            batch_size=batch_size, shard=e.shard,
+            # journey raw material: the batch's fenced dispatch window,
+            # THIS tenant's ordered-tail cost (other tenants' tails land
+            # in the request's unpack stage — they are real wait time on
+            # this thread), and the record's journey sink
+            stages={"dispatch_t0": pb.dispatch_t0,
+                    "dispatch_t1": pb.dispatch_t1,
+                    "ordered_tail_ms": tail_ms,
+                    "sink": pb.journeys})
 
     def _ordered_redispatch(self, e: _Entry, G_c, N_c, ds, _kernel):
         """The lazy protocol's ordered tail for ONE tenant: gather its

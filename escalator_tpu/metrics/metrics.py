@@ -289,6 +289,16 @@ fleet_overlap_saved_ms = Counter(
     "programs finish before prep starts",
     namespace="escalator_tpu", registry=registry,
 )
+fleet_slo_budget_burn = Gauge(
+    "fleet_slo_budget_burn",
+    "per-priority-class SLO error-budget burn rate over the rolling check "
+    "window: the fraction of requests over the class's p99_target_ms "
+    "divided by the 1% a p99 SLO allows (1.0 = burning exactly the "
+    "allotment; >= 14.4 is the fast-burn page threshold — the scheduler "
+    "journals an escalation and, with ESCALATOR_TPU_TAIL_PROFILE=1, arms "
+    "a profiler capture)",
+    ["klass"], namespace="escalator_tpu", registry=registry,
+)
 fleet_class_p99_breach = Counter(
     "fleet_class_p99_breach_total",
     "per-priority-class SLO breach checks that found the class's RECENT "
@@ -370,6 +380,23 @@ class _TailHistogramCollector:
                                          for ub, c in h.cumulative_buckets()],
                                 sum_value=h.sum_seconds)
         yield tick_fam
+        stage_fam = HistogramMetricFamily(
+            "escalator_tpu_fleet_stage_seconds",
+            "per-request fleet journey stage latency by priority class "
+            "(admission = queue wait, batch_assembly, dispatch = the fused "
+            "device program, ordered_tail, unpack — the five sum to the "
+            "request e2e; 'service' is the derived everything-after-queue "
+            "series the health split reads), fine log-bucket streaming "
+            "histogram fed from the scheduler's respond-side journeys",
+            labels=["klass", "stage"],
+        )
+        for (klass, stage), h in histograms.STAGES.items():
+            stage_fam.add_metric([klass, stage],
+                                 buckets=[(ub, float(c))
+                                          for ub, c in
+                                          h.cumulative_buckets()],
+                                 sum_value=h.sum_seconds)
+        yield stage_fam
 
 
 registry.register(_TailHistogramCollector())

@@ -11,6 +11,7 @@ from escalator_tpu.observability import (
     flightrecorder,
     histograms,
     jaxmon,
+    journal,
     resources,
     spans,
     tail,
@@ -36,6 +37,6 @@ flightrecorder.install()
 __all__ = [
     "RECORDER", "add_phase", "annotate", "current_path", "current_timeline",
     "dump_on_incident", "enabled", "fence", "flightrecorder", "graft",
-    "histograms", "jaxmon", "resources", "set_enabled", "span", "spans",
-    "tail",
+    "histograms", "jaxmon", "journal", "resources", "set_enabled", "span",
+    "spans", "tail",
 ]

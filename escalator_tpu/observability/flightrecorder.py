@@ -53,7 +53,14 @@ _META_KEYS = ("backend", "impl", "ordered", "digest", "dirty_groups",
               "fleet_batch_size", "fleet_ordered",
               # fleet arena lifecycle (round 15): a grow/compact inside a
               # batch annotates the record that paid for it
-              "fleet_arena_grow", "fleet_arena_compact")
+              "fleet_arena_grow", "fleet_arena_compact",
+              # request journeys (round 17): the fleet_batch record carries
+              # the batch's per-request journey LIST (the scheduler appends
+              # each journey on the respond side, after this record is in
+              # the ring — the list object is shared on purpose) plus the
+              # monotonic-clock anchor of the record's root open, so the
+              # trace exporter can lay journey slices out in record time
+              "journeys", "journey_mono_t0")
 
 #: stash key for the tick-open jaxmon snapshot (private to this module)
 _MON0 = "_jaxmon_t0"
@@ -146,6 +153,18 @@ class FlightRecorder:
             ring = jaxmon.compile_ring()
             if ring:
                 doc["compiles"] = ring
+        except Exception:  # noqa: BLE001 - a dump must never fail on extras
+            pass
+        try:
+            # ops event journal (round 17): the discrete-event ring rides
+            # along in EVERY dump, so "what happened around tick N" —
+            # tenant lifecycle, admission rejects, chaos firings, SLO
+            # burns, watchdog breaches — is in the same artifact as the
+            # tick timelines it happened around
+            from escalator_tpu.observability import journal
+
+            if journal.JOURNAL.depth:
+                doc["journal"] = journal.JOURNAL.as_doc()
         except Exception:  # noqa: BLE001 - a dump must never fail on extras
             pass
         if extra:

@@ -42,7 +42,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 __all__ = [
     "BASE", "LO", "HI", "NUM_BUCKETS", "EDGES",
     "LogHistogram", "HistogramSet",
-    "PHASES", "TICKS", "tick_quantiles_ms", "reset",
+    "PHASES", "TICKS", "STAGES", "JOURNEY_STAGES", "tick_quantiles_ms",
+    "reset",
 ]
 
 #: bucket growth factor: consecutive bucket bounds differ by 25%, which is
@@ -289,6 +290,22 @@ PHASES = HistogramSet()
 #: controller loop; standalone backend/bench roots keep their own series so
 #: the tail watchdog always compares a tick against its own population)
 TICKS = HistogramSet()
+#: THE canonical journey stage set, in pipeline order — the scheduler
+#: records them, the trace exporter lays them out, the plugin ships them,
+#: bench asserts on them; everyone imports THIS tuple (hand-copies drift:
+#: a sixth stage added in one place would silently never render elsewhere)
+JOURNEY_STAGES = ("admission", "batch_assembly", "dispatch", "ordered_tail",
+                  "unpack")
+
+#: fleet request-journey stage series keyed (class, stage) — fed from the
+#: scheduler's respond-side journey bookkeeping (round 17), NOT from the
+#: span layer: stages are per-REQUEST slices of the pipeline (admission /
+#: batch_assembly / dispatch / ordered_tail / unpack, plus the derived
+#: "service" = everything after queue wait that the health probe's
+#: queue-wait-vs-service split reads). Exported as
+#: ``escalator_tpu_fleet_stage_seconds{klass,stage}`` by the same pull-time
+#: collector as PHASES/TICKS.
+STAGES = HistogramSet()
 
 
 def tick_quantiles_ms(root: Optional[str] = None) -> Dict[str, Optional[float]]:
@@ -311,3 +328,4 @@ def reset() -> None:
     calls this — the histograms are the process's lifetime tail memory)."""
     PHASES.clear()
     TICKS.clear()
+    STAGES.clear()

@@ -1,0 +1,159 @@
+"""Ops event journal: a bounded ring of discrete, structured operator events.
+
+The flight recorder answers "what did the last N ticks COST"; the histograms
+answer "what does the tail look like". Neither answers "what HAPPENED around
+tick N" — a tenant eviction, an arena grow, a StaleBatchError, an admission
+reject storm, a chaos firing, an SLO burn — without grepping logs across
+threads and processes. This module is the discrete-event sibling of the tick
+ring: every noteworthy state change appends ONE structured event with a
+monotonic sequence number, and the ring rides along in every flight dump, so
+"what happened around that breach" is one artifact, not log archaeology.
+
+Event sources wired in round 17 (grep ``JOURNAL.event`` to enumerate):
+
+- fleet tenant lifecycle: register / evict / arena grow / arena compact /
+  dispatch-failure rebuild / stale prepared batches
+  (escalator_tpu/fleet/service.py),
+- admission rejects with reason + class + tenant and per-class SLO
+  breach / error-budget burn escalations (escalator_tpu/fleet/scheduler.py),
+- incremental refresh-audit outcomes — mismatches and audit-worker deaths
+  (ops/device_state.py),
+- chaos-site firings (escalator_tpu/chaos.py),
+- tail-latency and memory-growth watchdog breaches
+  (observability/tail.py, observability/resources.py).
+
+Design contract (same family as spans.py / histograms.py):
+
+- **Zero dependencies**, stdlib only; importable from a golden-only process.
+- **Never raises into the caller**: an observability failure must not become
+  a second incident. Field values are sanitized to JSON/msgpack-safe
+  scalars at append time (anything else is ``str()``-ed).
+- **Cheap**: one dict build + deque append under a lock (~1 µs); emitters
+  sit on state-CHANGE paths (registers, rejects, breaches), never on the
+  per-tick or per-request steady path.
+- **Bounded**: ``ESCALATOR_TPU_JOURNAL_SIZE`` (default 512) events; the
+  sequence number keeps counting, so a reader can tell "ring wrapped"
+  (first event's seq > 1) from "nothing happened".
+
+Readers: ``FlightRecorder.as_dump`` embeds the ring under ``"journal"``;
+``escalator-tpu debug-journal`` prints it from a dump file or a live plugin
+(the ``Journal`` RPC); the plugin serves it raw over msgpack.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["OpsJournal", "JOURNAL"]
+
+DEFAULT_CAPACITY = 512
+
+
+def _capacity_from_env() -> int:
+    from escalator_tpu.utils import envparse
+
+    raw = os.environ.get("ESCALATOR_TPU_JOURNAL_SIZE")
+    try:
+        parsed = envparse.parse_env_int(raw, "ESCALATOR_TPU_JOURNAL_SIZE",
+                                        minimum=16)
+    except ValueError as e:
+        import logging
+
+        logging.getLogger("escalator_tpu.observability").warning(
+            "%s; using default %d", e, DEFAULT_CAPACITY)
+        parsed = None
+    return DEFAULT_CAPACITY if parsed is None else parsed
+
+
+def _sanitize(value: Any) -> Any:
+    """JSON/msgpack-safe scalars only: events end up in flight dumps
+    (json.dump, no default=) and Journal RPC responses (msgpack.packb) —
+    one exotic field value must not fail a whole dump."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_sanitize(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _sanitize(v) for k, v in value.items()}
+    return str(value)
+
+
+class OpsJournal:
+    """Bounded, thread-safe ring of structured ops events (singleton
+    :data:`JOURNAL`)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = int(capacity) if capacity else _capacity_from_env()
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=self.capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    # -- writing -----------------------------------------------------------
+    def event(self, kind: str, **fields: Any) -> Optional[Dict[str, Any]]:
+        """Append one event. Returns the stored dict, or None when the
+        append failed (this method NEVER raises — emitters sit on incident
+        and lifecycle paths where a secondary failure is unaffordable)."""
+        try:
+            ev: Dict[str, Any] = {
+                "kind": str(kind),
+                "time_unix": round(time.time(), 3),
+            }
+            for k, v in fields.items():
+                if v is not None:
+                    ev[k] = _sanitize(v)
+            with self._lock:
+                self._seq += 1
+                ev["seq"] = self._seq
+                self._ring.append(ev)
+            return ev
+        except Exception:  # noqa: BLE001 - observability must never break callers
+            return None
+
+    # -- reading -----------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def total_recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def snapshot(self, since_seq: int = 0,
+                 kinds: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+        """Events with ``seq > since_seq`` (all by default), optionally
+        filtered to a kind set, oldest first."""
+        with self._lock:
+            events = list(self._ring)
+        if since_seq:
+            events = [e for e in events if e["seq"] > since_seq]
+        if kinds:
+            wanted = set(kinds)
+            events = [e for e in events if e["kind"] in wanted]
+        return events
+
+    def as_doc(self, since_seq: int = 0) -> Dict[str, Any]:
+        """The wire/dump form: events + ring metadata (a reader can tell a
+        wrapped ring — ``events[0].seq > 1`` — from a quiet one)."""
+        events = self.snapshot(since_seq=since_seq)
+        return {
+            "capacity": self.capacity,
+            "total_recorded": self.total_recorded,
+            "events": events,
+        }
+
+    def clear(self) -> None:
+        """Test isolation only (the seq counter keeps counting — sequence
+        numbers stay monotonic across clears, like the recorder's)."""
+        with self._lock:
+            self._ring.clear()
+
+
+#: the process-wide journal every emitter appends to
+JOURNAL = OpsJournal()

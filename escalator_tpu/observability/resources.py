@@ -538,29 +538,34 @@ class MemoryWatchdog:
         cached_raw, cached = self._cfg_cache
         if raw == cached_raw:
             return cached
-        window = DEFAULT_WINDOW
-        if raw[0] is not None:
-            text = raw[0].strip().lower()
-            if text in ("off", "false", "no", "none", "0"):
-                window = 0
-            else:
-                try:
-                    window = max(2, int(text))
-                except ValueError:
-                    window = DEFAULT_WINDOW
-        try:
-            min_growth = int(raw[1]) if raw[1] else DEFAULT_MIN_GROWTH
-        except ValueError:
-            min_growth = DEFAULT_MIN_GROWTH
-        try:
-            interval = float(raw[2]) if raw[2] else DEFAULT_INTERVAL_SEC
-        except ValueError:
-            interval = DEFAULT_INTERVAL_SEC
-        try:
-            every = max(1, int(raw[3])) if raw[3] else DEFAULT_SAMPLE_EVERY
-        except ValueError:
-            every = DEFAULT_SAMPLE_EVERY
-        cfg = (window, max(0, min_growth), max(0.0, interval), every)
+        # strict parses (round-17 satellite, shared with the tail
+        # watchdog): 0/negative/non-numeric values WARN once per distinct
+        # raw value (the memoization on the raw strings provides the
+        # once-ness) and run the default — the old bare int()/float()
+        # accepted MEMORY_SAMPLE_EVERY=0 and MIN_GROWTH=-5 without a word
+        from escalator_tpu.utils import envparse
+
+        def parse(fn, idx, name, default, **kw):
+            try:
+                got = fn(raw[idx], name, **kw)
+            except ValueError as e:
+                log.warning("%s; using default %s", e, default)
+                return default
+            return default if got is None else got
+
+        if raw[0] is not None and raw[0].strip() == "0":
+            window = 0   # documented disable spelling for the window knob
+        else:
+            window = parse(envparse.parse_env_int, 0, _ENV_WATCH,
+                           DEFAULT_WINDOW, allow_off=True, minimum=2)
+        min_growth = parse(envparse.parse_env_int, 1, _ENV_MIN_GROWTH,
+                           DEFAULT_MIN_GROWTH)
+        interval = parse(envparse.parse_env_float, 2, _ENV_INTERVAL,
+                         DEFAULT_INTERVAL_SEC, allow_off=True,
+                         allow_zero=True)
+        every = parse(envparse.parse_env_int, 3, _ENV_SAMPLE_EVERY,
+                      DEFAULT_SAMPLE_EVERY)
+        cfg = (window, min_growth, interval, every)
         self._cfg_cache = (raw, cfg)
         return cfg
 
@@ -609,6 +614,15 @@ class MemoryWatchdog:
                        for name, row in RESOURCES.snapshot().items()},
             "tick_seq": (rec or {}).get("seq"),
         }
+        try:
+            from escalator_tpu.observability import journal
+
+            journal.JOURNAL.event(
+                "memory-breach", growth_bytes=growth,
+                window_ticks=window, last_bytes=seq[-1],
+                tick_seq=(rec or {}).get("seq"))
+        except Exception:  # noqa: BLE001 - never break the tick
+            pass
         worker = threading.Thread(
             target=self._dump, args=(info,),
             name="escalator-memory-dump", daemon=True)

@@ -20,8 +20,17 @@ Knobs (all env; parsed per tick, memoized on the raw strings):
 - ``ESCALATOR_TPU_TAIL_MIN_TICKS``: samples a root series needs before the
   watchdog arms (default 64 — a p99 over fewer ticks is mostly the max).
 - ``ESCALATOR_TPU_TAIL_DUMP_INTERVAL_SEC``: rate limit between tail dumps
-  (default 60). A pathological workload where EVERY tick breaches must
-  produce a trickle of bundles, not a dump-per-tick write storm.
+  (default 60; ``off`` disables the limit). A pathological workload where
+  EVERY tick breaches must produce a trickle of bundles, not a
+  dump-per-tick write storm. The limit is claimed PER ROOT FAMILY
+  (round 17): ``fleet/<tenant>`` roots share one claim, ``fleet/class/…``
+  another, and every other root (the tick loop, fleet_batch, bench roots)
+  its own — a noisy per-tenant breach storm must not starve the tick
+  loop's forensic dumps for the whole interval.
+
+All three are strict-parsed (utils/envparse): 0/negative/non-numeric values
+WARN once (per distinct raw value) and run the default instead of being
+silently accepted — except the documented ``off``/``0`` disable spellings.
 - ``ESCALATOR_TPU_TAIL_PROFILE=1`` (round 15, opt-in): a breach that wins
   the rate limit also arms a jax profiler capture of the next K ticks
   (``ESCALATOR_TPU_TAIL_PROFILE_TICKS``, default 4) into the dump
@@ -60,23 +69,23 @@ _P99_REFRESH = 16
 
 def parse_tail_capture(raw: Optional[str]) -> Optional[float]:
     """Multiplier from the ESCALATOR_TPU_TAIL_CAPTURE spelling: unset/empty
-    -> the default, "off"/"0"/non-positive -> disabled (None), else the
-    float multiplier. A junk value disables with a one-time warning rather
-    than crashing the tick path."""
-    if raw is None or raw.strip() == "":
-        return DEFAULT_MULTIPLIER
-    text = raw.strip().lower()
-    if text in ("off", "false", "no", "none"):
-        return None
+    -> the default, "off"/"0" -> disabled (None), else a strict positive
+    float multiplier (utils/envparse). A rejected value — junk, negative —
+    disables with a one-time warning rather than crashing the tick path
+    (fail-soft: this parses on the tick path, not at startup)."""
+    from escalator_tpu.utils import envparse
+
     try:
-        mult = float(text)
-    except ValueError:
+        mult = envparse.parse_env_float(raw, _ENV_MULT, allow_off=True,
+                                        zero_is_off=True)
+    except ValueError as e:
         import logging
 
         logging.getLogger("escalator_tpu.observability").warning(
-            "ignoring invalid %s=%r (want a multiplier or 'off'); tail "
-            "capture disabled", _ENV_MULT, raw)
+            "%s; tail capture disabled", e)
         return None
+    if mult is None:
+        return DEFAULT_MULTIPLIER
     return mult if mult > 0 else None
 
 
@@ -85,7 +94,9 @@ class TailWatchdog:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._last_dump_mono: float = -float("inf")
+        #: rate-limit claims PER ROOT FAMILY (see _root_family): a breach
+        #: storm on fleet/<tenant> roots must not starve tick-root dumps
+        self._last_dump_mono: Dict[str, float] = {}
         self._worker: Optional[threading.Thread] = None
         #: (raw env tuple) -> parsed config, so steady-state ticks pay one
         #: dict lookup instead of three env parses
@@ -111,18 +122,45 @@ class TailWatchdog:
         cached_raw, cached = self._cfg_cache
         if raw == cached_raw:
             return cached
+        # strict parses (round-17 satellite): a rejected value WARNS and
+        # runs the default — the memoization on the raw strings makes the
+        # warning once-per-distinct-value, and the tick path never crashes
+        # on an operator typo
+        import logging
+
+        from escalator_tpu.utils import envparse
+
+        warn = logging.getLogger("escalator_tpu.observability").warning
         mult = parse_tail_capture(raw[0])
         try:
-            min_ticks = int(raw[1]) if raw[1] else DEFAULT_MIN_TICKS
-        except ValueError:
-            min_ticks = DEFAULT_MIN_TICKS
+            min_ticks = envparse.parse_env_int(raw[1], _ENV_MIN)
+        except ValueError as e:
+            warn("%s; using default %d", e, DEFAULT_MIN_TICKS)
+            min_ticks = None
         try:
-            interval = float(raw[2]) if raw[2] else DEFAULT_INTERVAL_SEC
-        except ValueError:
-            interval = DEFAULT_INTERVAL_SEC
-        cfg = (mult, max(1, min_ticks), max(0.0, interval))
+            interval = envparse.parse_env_float(raw[2], _ENV_INTERVAL,
+                                                allow_off=True,
+                                                allow_zero=True)
+        except ValueError as e:
+            warn("%s; using default %.0f", e, DEFAULT_INTERVAL_SEC)
+            interval = None
+        cfg = (mult,
+               DEFAULT_MIN_TICKS if min_ticks is None else min_ticks,
+               DEFAULT_INTERVAL_SEC if interval is None else interval)
         self._cfg_cache = (raw, cfg)
         return cfg
+
+    @staticmethod
+    def _root_family(root: str) -> str:
+        """The rate-limit key: per-tenant and per-class fleet roots collapse
+        to one family each (their cardinality scales with tenants — a
+        per-root claim would defeat the limit), every other root name is its
+        own family (the tick loop must never be starved by a fleet storm)."""
+        if root.startswith("fleet/class/"):
+            return "fleet/class"
+        if root.startswith("fleet/"):
+            return "fleet"
+        return root
 
     # -- the hook ----------------------------------------------------------
     def on_record(self, rec: Dict[str, Any]) -> bool:
@@ -154,12 +192,29 @@ class TailWatchdog:
         if duration_sec <= threshold:
             return False
         now = time.monotonic()
+        family = self._root_family(root)
         with self._lock:
             self.breaches += 1
-            if now - self._last_dump_mono < interval:
-                return False
-            self._last_dump_mono = now   # claimed before the handoff
-            self.dumps += 1
+            rate_limited = (now - self._last_dump_mono.get(
+                family, -float("inf")) < interval)
+            if not rate_limited:
+                self._last_dump_mono[family] = now  # claimed pre-handoff
+                self.dumps += 1
+        try:
+            # every breach is a journal event — dumped or rate-limited —
+            # so "when did the tail go bad" survives even when the dump
+            # rate limit swallowed the artifact
+            from escalator_tpu.observability import journal
+
+            journal.JOURNAL.event(
+                "tail-breach", root=root, seq=rec.get("seq"),
+                duration_ms=rec.get("duration_ms"),
+                p99_ms=round(p99 * 1e3, 4), multiplier=mult,
+                dumped=not rate_limited)
+        except Exception:  # noqa: BLE001 - never break the tick
+            pass
+        if rate_limited:
+            return False
         tail_info = {
             "seq": rec.get("seq"),
             "root": root,
@@ -219,7 +274,7 @@ class TailWatchdog:
 
     def reset(self) -> None:
         with self._lock:
-            self._last_dump_mono = -float("inf")
+            self._last_dump_mono.clear()
             self._p99_cache.clear()
             self.breaches = 0
             self.dumps = 0

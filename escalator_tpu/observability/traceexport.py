@@ -21,7 +21,16 @@ Layout decisions:
   trace shows client and server of a plugin-routed decide together;
 - phases recorded without an offset (``spans.add_phase`` accumulations)
   are laid out cursor-sequentially from their parent's start — positions
-  are then best-effort, durations exact.
+  are then best-effort, durations exact;
+- **request journeys get a per-request track family** (round 17): a
+  ``fleet_batch`` record carrying ``journeys`` (the scheduler's respond-side
+  per-request stage decomposition) renders one track per tenant — a parent
+  ``req <tenant>`` slice spanning enqueue→respond with the five stage
+  slices (admission / batch_assembly / dispatch / ordered_tail / unpack)
+  laid contiguously inside it, positioned in record time via the record's
+  ``journey_mono_t0`` clock anchor. The dispatch stage therefore lines up
+  under the fleet_batch slice's ``fleet_step`` span it rode, and a tenant's
+  queue wait is visibly the gap BEFORE the batch opened.
 
 ``escalator-tpu debug-trace`` (cli.py) is the operator entry: a dump file
 or a live plugin's ``Dump`` RPC in, a ``.trace.json`` out.
@@ -32,11 +41,22 @@ from __future__ import annotations
 from typing import Any, Dict, List
 
 __all__ = ["trace_from_dump", "trace_from_records", "TID_TICK",
-           "TID_OVERLAP", "TID_REMOTE"]
+           "TID_OVERLAP", "TID_REMOTE", "TID_JOURNEY_BASE",
+           "JOURNEY_STAGE_ORDER"]
 
 TID_TICK = 1      # fenced / host / rpc phases: the tick's main track
 TID_OVERLAP = 2   # unfenced device dispatches (overlap windows)
 TID_REMOTE = 3    # grafted plugin-server phases
+#: per-request journey tracks allocate upward from here, one per tenant
+#: (stable across the records of one trace)
+TID_JOURNEY_BASE = 32
+
+#: the canonical journey stage order (histograms.py is stdlib-only, so
+#: this module stays dependency-free); contiguous by construction, so
+#: cumulative layout from the enqueue anchor is exact
+from escalator_tpu.observability.histograms import (  # noqa: E402
+    JOURNEY_STAGES as JOURNEY_STAGE_ORDER,
+)
 
 _THREAD_NAMES = {
     TID_TICK: "tick",
@@ -61,6 +81,67 @@ def _tid_for(phase: Dict[str, Any]) -> int:
     if not phase.get("fenced", True) and phase.get("kind") == "device":
         return TID_OVERLAP
     return TID_TICK
+
+
+def _journey_events(rec: Dict[str, Any], pid: int,
+                    journey_tids: Dict[str, int]) -> List[Dict[str, Any]]:
+    """Per-request journey slices for one record (empty when the record
+    carries no journeys or no clock anchor). ``journey_tids`` is shared
+    across the trace so a tenant keeps ONE track; newly-allocated tracks
+    emit their thread_name metadata inline."""
+    journeys = rec.get("journeys") or ()
+    mono0 = rec.get("journey_mono_t0")
+    if not journeys or mono0 is None:
+        return []
+    base_us = float(rec.get("time_unix", 0.0)) * 1e6
+    events: List[Dict[str, Any]] = []
+    for j in journeys:
+        try:
+            tenant = str(j.get("tenant", "?"))
+            tid = journey_tids.get(tenant)
+            if tid is None:
+                tid = TID_JOURNEY_BASE + len(journey_tids)
+                journey_tids[tenant] = tid
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": f"journey {tenant}"},
+                })
+            t_enq = base_us + (float(j["enqueued_mono"])
+                               - float(mono0)) * 1e6
+            e2e_us = float(j.get("e2e_ms", 0.0)) * 1e3
+            events.append({
+                "name": f"req {tenant} [{j.get('klass', '?')}]",
+                "cat": "journey", "ph": "X",
+                "ts": round(t_enq, 3), "dur": round(e2e_us, 3),
+                "pid": pid, "tid": tid,
+                "args": {
+                    "path": f"journey/{tenant}",
+                    "fenced": True,
+                    "klass": j.get("klass"),
+                    "deferrals": j.get("deferrals"),
+                    "e2e_ms": j.get("e2e_ms"),
+                    "fleet_batch_seq": rec.get("seq"),
+                },
+            })
+            stages = j.get("stages_ms") or {}
+            cursor = t_enq
+            for stage in JOURNEY_STAGE_ORDER:
+                dur_us = float(stages.get(stage, 0.0)) * 1e3
+                if stage == "ordered_tail" and dur_us <= 0:
+                    continue   # most tenants never sort: keep tracks clean
+                events.append({
+                    "name": stage,
+                    "cat": "device" if stage == "dispatch" else "journey",
+                    "ph": "X",
+                    "ts": round(cursor, 3), "dur": round(max(dur_us, 0), 3),
+                    "pid": pid, "tid": tid,
+                    "args": {"path": f"journey/{tenant}/{stage}",
+                             "fenced": True},
+                })
+                cursor += max(dur_us, 0)
+        except Exception:  # noqa: BLE001 - a malformed journey is dropped
+            continue
+    return events
 
 
 def _record_events(rec: Dict[str, Any], pid: int) -> List[Dict[str, Any]]:
@@ -145,8 +226,10 @@ def trace_from_records(records: List[Dict[str, Any]], pid: int = 1,
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
             "args": {"name": tname},
         })
+    journey_tids: Dict[str, int] = {}
     for rec in records:
         events.extend(_record_events(rec, pid))
+        events.extend(_journey_events(rec, pid, journey_tids))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -1389,6 +1389,9 @@ class IncrementalDecider:
         from escalator_tpu.metrics import metrics
 
         metrics.incremental_audit_mismatch.inc()
+        obs.journal.JOURNAL.event(
+            "audit-mismatch", columns=mismatched, ticks=self._ticks,
+            mode=self._on_mismatch)
         dump_path = obs.dump_on_incident("audit-mismatch")
         msg = (
             "incremental aggregate refresh mismatch on columns "
@@ -1530,6 +1533,7 @@ class IncrementalDecider:
             metrics.audit_worker_failures.inc()
             from escalator_tpu import observability as obs
 
+            obs.journal.JOURNAL.event("audit-worker-death", ticks=self._ticks)
             dump_path = obs.dump_on_incident("audit-worker-death")
             logging.getLogger("escalator_tpu.device_state").error(
                 "background refresh-audit worker died; degrading to the "

@@ -168,6 +168,11 @@ class ComputeClient:
             request_serializer=lambda x: x,
             response_deserializer=lambda x: x,
         )
+        self._journal = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Journal",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x,
+        )
 
     def health(self) -> dict:
         return msgpack.unpackb(self._health(b"", timeout=self.timeout_sec))
@@ -177,6 +182,14 @@ class ComputeClient:
         import json
 
         return json.loads(self._dump(b"", timeout=self.timeout_sec))
+
+    def journal(self, since_seq: int = 0) -> dict:
+        """The server's ops event journal (the debug-journal CLI's live
+        source): ``{capacity, total_recorded, events: [...]}``, events
+        newer than ``since_seq`` (all by default). Raises grpc.RpcError
+        (UNIMPLEMENTED from a pre-round-17 server) on transport failure."""
+        req = msgpack.packb({"since": int(since_seq)}) if since_seq else b""
+        return msgpack.unpackb(self._journal(req, timeout=self.timeout_sec))
 
     def profile(self, ticks: int = 4, timeout_sec: float = 60.0) -> dict:
         """Capture a jax profiler trace of the server's next ``ticks``

@@ -27,6 +27,12 @@ Methods:
   (the batcher's own stale-but-alive signal).
 - ``Dump``: empty -> JSON bytes of the server's flight-recorder ring (the
   ``escalator-tpu debug-dump`` CLI's wire target).
+- ``Journal``: msgpack ``{since?: int}`` (or empty) -> msgpack
+  ``{capacity, total_recorded, events: [...]}`` — the ops event journal
+  (observability/journal.py: tenant lifecycle, admission rejects, SLO
+  burns, chaos firings, watchdog breaches) with monotonic sequence
+  numbers; ``since`` filters to events newer than a seq the caller already
+  has. The ``escalator-tpu debug-journal`` CLI's wire target.
 - ``Profile``: msgpack ``{ticks, timeout_sec}`` -> msgpack ``{ok, files:
   {relpath: bytes}, ...}`` — wraps ``jax.profiler.trace()`` around the next
   ``ticks`` decides this server serves and ships the TensorBoard/XPlane
@@ -91,6 +97,33 @@ class FleetConfig:
     #: pick one via the tenant sidecar's "class" key
     classes: "tuple | None" = None
     default_class: "str | None" = None
+
+
+def _journey_span_phases(journey: dict) -> list:
+    """A fleet journey as ``spans.Phase.as_dict``-style entries: a parent
+    ``journey`` phase spanning the e2e plus one child per stage, offsets
+    cumulative from the enqueue (the stages are contiguous by
+    construction). Offsets are journey-root-relative — the caller's trace
+    exporter re-anchors them under its local rpc slice, exactly the
+    grafted-remote-phase convention (spans.graft docstring)."""
+    stages = journey.get("stages_ms") or {}
+    phases = [{
+        "name": "journey", "path": "journey",
+        "ms": float(journey.get("e2e_ms", 0.0)),
+        "kind": "host", "fenced": True, "offset_ms": 0.0,
+    }]
+    offset = 0.0
+    from escalator_tpu.observability.histograms import JOURNEY_STAGES
+
+    for stage in JOURNEY_STAGES:
+        ms = float(stages.get(stage, 0.0))
+        phases.append({
+            "name": stage, "path": f"journey/{stage}", "ms": round(ms, 4),
+            "kind": "device" if stage == "dispatch" else "host",
+            "fenced": True, "offset_ms": round(offset, 4),
+        })
+        offset += ms
+    return phases
 
 
 class _ComputeService:
@@ -246,12 +279,27 @@ class _ComputeService:
         if isinstance(result, EvictAck):
             return codec.encode_decision(
                 self._empty_decision(), fleet={"evicted": result.tenant_id})
-        return codec.encode_decision(result.arrays, fleet={
+        fleet_meta = {
             "ordered": bool(result.ordered),
             "tenant": result.tenant_id,
             "batch_size": int(result.batch_size),
             "shard": int(result.shard),
-        })
+        }
+        # journey propagation (round 17): the server-side journey rides the
+        # response both as structured data (the fleet sidecar, for
+        # programmatic clients) and as span phases the caller's obs.graft
+        # nests under its rpc span — so the client-side submit→response
+        # slice visibly WRAPS the server's admission/assembly/dispatch/
+        # unpack decomposition in one debug-trace render.
+        journey = getattr(result, "journey", None)
+        shipped = None
+        if journey:
+            fleet_meta["journey"] = {
+                k: journey[k] for k in ("klass", "deferrals", "stages_ms",
+                                        "e2e_ms") if k in journey}
+            shipped = _journey_span_phases(journey)
+        return codec.encode_decision(result.arrays, fleet=fleet_meta,
+                                     span_phases=shipped)
 
     def health(self, request: bytes, context) -> bytes:
         with self._stats_lock:
@@ -300,6 +348,26 @@ class _ComputeService:
         import json
 
         return json.dumps(obs.RECORDER.as_dump("plugin-dump")).encode()
+
+    def journal(self, request: bytes, context) -> bytes:
+        """The ops event journal over the wire (``debug-journal``'s live
+        source). Request: empty, or msgpack ``{since: int}`` to fetch only
+        events newer than a sequence number the caller already holds."""
+        since = 0
+        if request:
+            try:
+                req = msgpack.unpackb(request)
+            except Exception:  # noqa: BLE001 - malformed request: named error
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "Journal request must be a msgpack map")
+            if not isinstance(req, dict):
+                # msgpack-valid but not a map (a bare since value, a
+                # list): same named error — silently serving the FULL
+                # journal would drop the caller's since filter
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "Journal request must be a msgpack map")
+            since = int(req.get("since", 0) or 0)
+        return msgpack.packb(obs.journal.JOURNAL.as_doc(since_seq=since))
 
     #: total profile artifact bytes one Profile RPC will ship back — a
     #: pathological capture must not balloon one response without bound
@@ -391,6 +459,11 @@ def make_server(
         ),
         "Dump": grpc.unary_unary_rpc_method_handler(
             service.dump,
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "Journal": grpc.unary_unary_rpc_method_handler(
+            service.journal,
             request_deserializer=_identity,
             response_serializer=_identity,
         ),

@@ -1,0 +1,82 @@
+"""Strict env-knob parsing: the ``parse_refresh_every`` discipline, shared.
+
+The observability watchdogs (tail, memory) read a family of env knobs —
+``ESCALATOR_TPU_TAIL_CAPTURE/TAIL_MIN_TICKS/TAIL_DUMP_INTERVAL_SEC`` and the
+``ESCALATOR_TPU_MEMORY_*`` set — on the tick path. Before round 17 they ran
+bare ``int(raw)``/``float(raw)`` with a silent fall-to-default on anything
+else, so ``TAIL_MIN_TICKS=-5`` or ``MEMORY_SAMPLE_EVERY=0`` were accepted
+without a word (the memory sampler silently clamped 0 to 1; a negative
+min-ticks armed the watchdog on the very first tick). These parsers are the
+shared strict core: they REJECT 0/negative/non-numeric values with a clear
+:class:`ValueError` naming the knob, and support ``"off"`` only where the
+knob documents it. Tick-path callers catch the error, WARN once per distinct
+raw value (their config caches memoize on the raw strings) and run the
+default — a typo must be loud, but it must never crash a tick.
+
+``ops.device_state.parse_refresh_every`` predates this module and keeps its
+own spelling (it is the fail-FAST form: backend construction raises); these
+are the fail-SOFT siblings for knobs parsed after startup.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["OFF_SPELLINGS", "parse_env_int", "parse_env_float"]
+
+#: the documented disable spellings (``allow_off`` knobs only)
+OFF_SPELLINGS = ("off", "false", "no", "none")
+
+
+def _reject(source: str, value, want: str) -> ValueError:
+    return ValueError(f"{source} must be {want}, got {value!r}")
+
+
+def parse_env_int(value: Optional[str], source: str, *,
+                  allow_off: bool = False,
+                  minimum: int = 1) -> Optional[int]:
+    """Strict integer knob: ``None``/blank returns None (caller applies its
+    default), ``"off"`` returns 0 where ``allow_off`` (the knob's documented
+    disable), anything else must parse as an int >= ``minimum`` or this
+    raises ValueError naming the knob."""
+    if value is None or not value.strip():
+        return None
+    text = value.strip().lower()
+    if allow_off and text in OFF_SPELLINGS:
+        return 0
+    want = (f"an integer >= {minimum}"
+            + (" or 'off'" if allow_off else ""))
+    try:
+        parsed = int(text)
+    except ValueError:
+        raise _reject(source, value, want) from None
+    if parsed < minimum:
+        raise _reject(source, value, want)
+    return parsed
+
+
+def parse_env_float(value: Optional[str], source: str, *,
+                    allow_off: bool = False,
+                    allow_zero: bool = False,
+                    zero_is_off: bool = False) -> Optional[float]:
+    """Strict float knob: ``None``/blank returns None (caller default),
+    ``"off"`` (plus ``"0"`` when ``zero_is_off`` — the TAIL_CAPTURE
+    contract) returns 0.0 where ``allow_off``. Anything else must parse as
+    a float > 0 (>= 0 when ``allow_zero``) or this raises ValueError."""
+    if value is None or not value.strip():
+        return None
+    text = value.strip().lower()
+    if allow_off and text in OFF_SPELLINGS:
+        return 0.0
+    want = ("a number > 0" if not allow_zero else "a number >= 0")
+    if allow_off:
+        want += " or 'off'"
+    try:
+        parsed = float(text)
+    except ValueError:
+        raise _reject(source, value, want) from None
+    if allow_off and zero_is_off and parsed == 0.0:
+        return 0.0
+    if parsed < 0 or (parsed == 0 and not allow_zero):
+        raise _reject(source, value, want)
+    return parsed

@@ -222,6 +222,46 @@ def fleet_slo_cycle(ticks_per_window=30, window=3):
     return p99, overlap
 
 
+def journey_cycle(ticks_per_window=30, window=3):
+    """Synthetic per-stage request-journey p99s THROUGH THE REAL HISTOGRAM
+    ENGINE (the round-17 panel): the critical class's five journey stages
+    — under load the admission (queue-wait) stage absorbs the burst while
+    dispatch stays flat (the fused program's width is the batch, not the
+    queue) — plus the SLO budget-burn series: the fraction of windowed
+    samples over the class target divided by the 1% a p99 SLO allows,
+    exactly the scheduler's `fleet_slo_budget_burn{klass}` computation."""
+    rnd = random.Random(55)
+    spec = {"admission": (2.5e-3, 0.3, 9.0), "batch_assembly": (1.2e-3,
+            0.2, 1.5), "dispatch": (6.0e-3, 0.15, 1.2),
+            "unpack": (8e-4, 0.2, 1.3)}
+    target_s = 0.060    # the preview class's p99 target (60 ms)
+    p99 = {k: [] for k in spec}
+    hists = {k: [] for k in spec}
+    burn = []
+    for i in range(T):
+        b = _burst(i)
+        e2e_samples = []
+        for k, (med, sig, gain) in spec.items():
+            mu = math.log(med * (1.0 + (gain - 1.0) * b))
+            h = LogHistogram()
+            vals = [rnd.lognormvariate(mu, sig)
+                    for _ in range(ticks_per_window)]
+            for v in vals:
+                h.record(v)
+            hists[k].append(h)
+            merged = LogHistogram()
+            for hh in hists[k][-window:]:
+                merged.merge(hh)
+            p99[k].append(merged.quantile(0.99))
+            if not e2e_samples:
+                e2e_samples = vals
+            else:
+                e2e_samples = [a + v for a, v in zip(e2e_samples, vals)]
+        over = sum(1 for v in e2e_samples if v > target_s)
+        burn.append((over / len(e2e_samples)) / 0.01)
+    return p99, burn
+
+
 def nice_ticks(lo, hi, n=4):
     if hi <= lo:
         hi = lo + 1
@@ -333,6 +373,7 @@ def main():
     p99, tail_dumps = latency_cycle()
     fleet_p50, fleet_p99, fleet_tenants, fleet_rejects = fleet_cycle()
     slo_p99, slo_overlap = fleet_slo_cycle()
+    stage_p99, budget_burn = journey_cycle()
     panels, grid = [], [
         ("Node counts by state",
          [(s["nodes"], S1, "total"), (s["untainted"], S2, "untainted"),
@@ -385,6 +426,14 @@ def main():
           (slo_p99["standard"], S2, "standard p99 (s)"),
           (slo_p99["batch"], S3, "batch p99 (s)"),
           (slo_overlap, S4, "overlap saved ms/s")], "", (3,)),
+        # round 17: the request-journey panel — per-stage p99s through the
+        # real log-bucket engine (queue wait absorbs the burst, dispatch
+        # stays flat) + the SLO error-budget burn rate (see journey_cycle)
+        ("Fleet: journey stages (critical p99) / budget burn",
+         [(stage_p99["admission"], S1, "admission (queue wait)"),
+          (stage_p99["dispatch"], S2, "dispatch"),
+          (stage_p99["batch_assembly"], S3, "batch_assembly"),
+          (budget_burn, S4, "budget burn (x allotment)")], "", (3,)),
     ]
     for i, (title, series, unit, labels) in enumerate(grid):
         x = PAD + (i % 2) * (PANEL_W + PAD)
