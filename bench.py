@@ -1292,7 +1292,12 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
     timed tick (the EVERY-tenant-every-tick contract lives in the
     tests/test_fleet.py soak — 10k standalone reference decides per tick
     would dwarf the bench), and the one-dispatch-per-micro-batch proof
-    from flight-recorder phase counts. NOTE on this rig: with few physical
+    from flight-recorder phase counts. Round 18: the timed drain ships
+    STREAMING DELTA frames (each resident tenant's positional churn, the
+    production shape after fleet streaming ingestion), the ordered tail
+    is proven at-most-one batched dispatch per micro-batch, and an
+    idle-fraction sweep measures the digest fast path's decisions/sec and
+    cache-hit rate. NOTE on this rig: with few physical
     cores the host prep dominates wall clock, so decisions/sec stays
     ~flat across shard counts — the honest per-device signal is the
     fleet_step device-phase shrink (each shard executes C/S tenants)."""
@@ -1300,10 +1305,12 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
 
     from escalator_tpu.fleet import (
         DecideRequest,
+        DeltaFrame,
         FleetEngine,
         FleetScheduler,
         PriorityClass,
     )
+    from escalator_tpu.fleet import service as _fsvc
     from escalator_tpu.observability import RECORDER
     from escalator_tpu.ops import kernel as _k
     import jax
@@ -1356,9 +1363,37 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
             c.pods.cpu_milli[(tick * 7) % Pt] += 10 * tick
         return c
 
+    # round 18: the timed drain ships STREAMING DELTA frames for resident
+    # tenants — the production shape after fleet streaming ingestion — so
+    # the per-request host cost is O(churn), not O(P+N) diff. Bootstrap
+    # (and every tenant's first request per engine arm) stays a full
+    # frame. Delta construction is the CLIENT's cost (the controller's
+    # store twin drains it incrementally in production) and happens before
+    # the timed window opens, like the cluster builds themselves.
+    prev_clusters: dict = {}
+
+    def _delta_of(prev, new):
+        def take(soa, idx):
+            return type(soa)(**{
+                f: np.asarray(getattr(soa, f))[idx]
+                for f in soa.__dataclass_fields__})
+        pidx = _fsvc._changed_rows(prev.pods, new.pods).astype(np.int32)
+        nidx = _fsvc._changed_rows(prev.nodes, new.nodes).astype(np.int32)
+        gchanged = len(_fsvc._changed_rows(prev.groups, new.groups)) > 0
+        return DeltaFrame(
+            shapes=(Gt, Pt, Nt),
+            pod_idx=pidx, pod_vals=take(new.pods, pidx),
+            node_idx=nidx, node_vals=take(new.nodes, nidx),
+            groups=new.groups if gchanged else None)
+
     def run_tick(sched, tick, timed: bool, prng):
         nowi = int(now) + 60 * tick
         clusters = [fresh(t, tick) for t in range(C)]
+        deltas = [None] * C
+        for t in range(C):
+            pv = prev_clusters.get(t)
+            if pv is not None:
+                deltas[t] = _delta_of(pv, clusters[t])
         lat = [None] * C
         done = threading.Event()
         remaining = [C]
@@ -1378,14 +1413,20 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
         futs = []
         for t in range(C):
             t_sub = time.perf_counter()
-            f = sched.submit(f"tenant{t}", clusters[t], nowi,
-                             klass=klass_of(t))
+            if deltas[t] is not None:
+                f = sched.submit(f"tenant{t}", None, nowi,
+                                 klass=klass_of(t), delta=deltas[t])
+            else:
+                f = sched.submit(f"tenant{t}", clusters[t], nowi,
+                                 klass=klass_of(t))
             f.add_done_callback(make_cb(t, t_sub))
             futs.append(f)
         sched.resume()
         assert done.wait(timeout=1200), "fleet tick did not complete"
         wall = time.perf_counter() - t0
         results = [f.result() for f in futs]
+        for t in range(C):
+            prev_clusters[t] = clusters[t]
         if timed:
             # 13-column bit-parity on a random tenant sample, this tick
             for t in prng.choice(C, size=parity_sample, replace=False):
@@ -1432,6 +1473,15 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
             for r in timed_recs]
         assert steps_per_batch and all(s == 1 for s in steps_per_batch), (
             f"cfg17: fleet_step phases per batch {set(steps_per_batch)}")
+        # round 18: the ordered tail is AT MOST ONE batched dispatch per
+        # micro-batch (every draining tenant rides it), never a per-tenant
+        # re-dispatch train
+        tails_per_batch = [
+            sum(1 for p in r["phases"] if p["name"] == "fleet_order_tail")
+            for r in timed_recs]
+        assert all(c <= 1 for c in tails_per_batch), (
+            f"cfg17: fleet_order_tail phases per batch "
+            f"{set(tails_per_batch)}")
         lat_ms = np.array(lats) * 1e3
         overlap_host = [r.get("overlap_host_ms") for r in timed_recs
                         if r.get("overlap_host_ms") is not None]
@@ -1462,6 +1512,11 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
                 "fleet_diff"),
             "unpack_ms": _phase_stats_from_records(timed_recs).get(
                 "fleet_unpack"),
+            "order_tail_ms": _phase_stats_from_records(timed_recs).get(
+                "fleet_order_tail"),
+            "order_tail_dispatches_per_batch_max": (
+                max(tails_per_batch) if tails_per_batch else 0),
+            "streamed_delta_requests": True,
             # recorder-proven pipeline overlap: prep wall per batch, and
             # how much of it ran under an in-flight device program
             "overlap_host_ms_total": round(float(np.sum(overlap_host)), 1),
@@ -1494,6 +1549,7 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
     headline = None
     for S in shard_counts:
         prng = np.random.default_rng(170 + S)
+        prev_clusters.clear()  # fresh engine arm: first frames are full
         engine = FleetEngine(num_groups=Gt, pod_capacity=128,
                              node_capacity=32, max_tenants=C, num_shards=S)
         sched = FleetScheduler(engine, max_batch=128, flush_ms=5.0,
@@ -1541,12 +1597,182 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
             sched.shutdown()
         del engine
 
+    # ---- round-18 idle-fraction sweep: the digest fast path under a
+    # fleet where only a fraction of tenants changed since their last
+    # request. Every request after bootstrap is a STREAMING DELTA frame
+    # (the production shape): changed tenants ship their one churned pod
+    # row at an advanced clock, idle tenants ship an EMPTY delta at their
+    # unchanged clock — the no-op probe answers those from the per-tenant
+    # decision cache without entering the micro-batch. Columns per
+    # fraction: drain decisions/sec, the measured cache-hit rate, and the
+    # recorder host-prep p50 (fleet_prep root — the O(churn) proof: a
+    # batch of one-row deltas costs milliseconds, not the O(P+N)-per-
+    # request diff). Two UNTIMED warm drains per fraction keep the
+    # one-time lane-bucket compiles (each fraction shrinks the real-
+    # request count per take to a new power-of-two width) out of the
+    # timed window. Smaller C than the headline sweep — the signal is
+    # the relative shape, not a second saturation number.
+    Si = shard_counts[-1]
+    Ci = 500 if degraded else 2_000
+    idle_ticks = 3
+    idle_warm = 2
+    idle_sweep = {}
+    engine = FleetEngine(num_groups=Gt, pod_capacity=128,
+                         node_capacity=32, max_tenants=Ci, num_shards=Si)
+    sched = FleetScheduler(engine, max_batch=128, flush_ms=5.0,
+                           queue_limit=4 * Ci, per_tenant_inflight=2,
+                           classes=classes, default_class="standard",
+                           pipeline=True)
+    try:
+        idle_prng = np.random.default_rng(181)
+        cur = [fresh(t, 0) for t in range(Ci)]
+        nows = [int(now) for _ in range(Ci)]
+
+        def _take(soa, idx):
+            return type(soa)(**{
+                f: np.asarray(getattr(soa, f))[idx]
+                for f in soa.__dataclass_fields__})
+
+        no_rows = np.zeros(0, np.int32)
+        empty_pods = _take(cur[0].pods, no_rows)
+        empty_nodes = _take(cur[0].nodes, no_rows)
+
+        def idle_drain(changed):
+            """One paused-submit/resume drain, all delta frames: tenants
+            in ``changed`` churn one pod row + advance their clock; the
+            rest ship an empty delta at their unchanged clock (the
+            digest no-op shape). Returns (wall_s, cache_hits_delta)."""
+            changed = set(int(t) for t in changed)
+            frames = []
+            for t in range(Ci):
+                if t in changed:
+                    row = t % Pt
+                    cur[t].pods.cpu_milli[row] += 10
+                    nows[t] += 60
+                    frames.append(DeltaFrame(
+                        shapes=(Gt, Pt, Nt),
+                        pod_idx=np.array([row], np.int32),
+                        pod_vals=_take(cur[t].pods, [row]),
+                        node_idx=no_rows, node_vals=empty_nodes,
+                        groups=None))
+                else:
+                    frames.append(DeltaFrame(
+                        shapes=(Gt, Pt, Nt),
+                        pod_idx=no_rows, pod_vals=empty_pods,
+                        node_idx=no_rows, node_vals=empty_nodes,
+                        groups=None))
+            hits0 = engine.cache_hits
+            sched.pause()
+            futs = [sched.submit(f"it{t}", None, nows[t],
+                                 klass=klass_of(t), delta=frames[t])
+                    for t in range(Ci)]
+            t0 = time.perf_counter()
+            sched.resume()
+            for f in futs:
+                f.result(timeout=1200)
+            return (time.perf_counter() - t0,
+                    int(engine.cache_hits - hits0))
+
+        # bootstrap: every tenant resident + cached (full frames — the
+        # only ones in the whole sweep) before the first arm
+        sched.pause()
+        boot = [sched.submit(f"it{t}", cur[t], nows[t],
+                             klass=klass_of(t)) for t in range(Ci)]
+        sched.resume()
+        for f in boot:
+            f.result(timeout=1200)
+        # the fused step's jit key includes the BUSIEST shard's entry
+        # count (rounded to a power of two): a uniform random changed set
+        # leaves that count straddling two bucket widths draw to draw, so
+        # a timed drain can hit a multi-second first compile no warm
+        # covered. Changed sets are therefore drawn STRATIFIED across
+        # shards (the balanced-placement expectation — registration
+        # round-robins tenants over shards): the busiest-shard count is
+        # deterministic per fraction and the warms compile exactly the
+        # widths the timed drains use. Tenant membership per shard comes
+        # from the public shard_of API.
+        shard_members: dict = {}
+        for t in range(Ci):
+            shard_members.setdefault(
+                engine.shard_of(f"it{t}"), []).append(t)
+
+        def stratified_changed(n):
+            shards = sorted(shard_members)
+            base, extra = divmod(n, len(shards))
+            out = []
+            for j, s in enumerate(shards):
+                members = shard_members[s]
+                k = min(base + (1 if j < extra else 0), len(members))
+                idx = idle_prng.choice(len(members), size=k,
+                                       replace=False)
+                out.extend(members[i] for i in idx)
+            return np.asarray(out)
+
+        for frac in (0.0, 0.5, 0.9, 0.99):
+            n_changed = Ci - int(round(frac * Ci))
+            # untimed warms: same fraction => same stratified busiest-
+            # shard count => the step program the timed drains run
+            # compiles HERE. Tenant 0 is DRAINING (t % 50 == 0) and is
+            # swapped into every warm set (for its own shard-0 pick, so
+            # the stratification holds): at high idle fractions a random
+            # changed set often carries no order-consuming tenant, which
+            # would leave the batched order-tail program's first compile
+            # to fire inside a timed drain.
+            s0 = set(shard_members[engine.shard_of("it0")])
+            for _ in range(idle_warm):
+                ch = stratified_changed(n_changed)
+                if 0 not in ch:
+                    # swap tenant 0 in for one of its own shard's picks
+                    # so the stratified per-shard counts are unchanged
+                    mine = [x for x in ch if x in s0]
+                    if mine:
+                        ch[ch == mine[0]] = 0
+                    else:
+                        ch[0] = 0
+                idle_drain(ch)
+            walls, hits = [], 0
+            prep_seq = RECORDER.total_recorded
+            for _ in range(idle_ticks):
+                wall, h = idle_drain(stratified_changed(n_changed))
+                walls.append(wall)
+                hits += h
+            prep_recs = [r for r in RECORDER.snapshot()
+                         if r.get("seq", 0) > prep_seq
+                         and r["root"] == "fleet_prep"]
+            n_idle_total = (Ci - n_changed) * idle_ticks
+            assert hits >= n_idle_total, (
+                f"cfg17 idle sweep: {hits} cache hits for "
+                f"{n_idle_total} idle re-sends at frac={frac}")
+            # MEDIAN drain wall, not the sum: a residual order-tail
+            # width's one-time compile can still pollute a single drain
+            # (the tail program keys on the busiest shard's DRAINING
+            # count, which stays a random draw); the median of 3 is
+            # robust to one polluted sample
+            med_wall = float(np.median(walls))
+            row = {
+                "tenants": Ci,
+                "idle_fraction": frac,
+                "decisions_per_sec": round(Ci / med_wall, 1),
+                "cache_hit_rate": round(
+                    hits / float(Ci * idle_ticks), 4),
+            }
+            if prep_recs:
+                # host prep per micro-batch of one-row deltas — the
+                # recorder-sourced O(churn) column
+                row["host_prep_ms_p50"] = round(float(np.median(
+                    [r["duration_ms"] for r in prep_recs])), 3)
+            idle_sweep[f"idle_{int(frac * 100)}pct"] = row
+    finally:
+        sched.shutdown()
+    del engine
+
     fleet_row = {
         "tenants": C, "pods_per_tenant": Pt, "timed_ticks": timed_ticks,
         "drain_model": ("all C requests enqueue against a paused "
                         "scheduler; one resume drains them — latency "
                         "includes real queue wait at saturation"),
         "sweep": sweep,
+        "idle_sweep": idle_sweep,
         "class_mix": {"critical": "10%", "standard": "60%", "batch": "30%"},
     }
     if len(shard_counts) >= 2:
@@ -1569,6 +1795,11 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
         "decisions_per_sec")
     detail["cfg17_fleet_per_tenant_p99_ms"] = fleet_row.get(
         "per_tenant_p99_ms")
+    if "idle_90pct" in idle_sweep:
+        detail["cfg17_fleet_idle90_decisions_per_sec"] = (
+            idle_sweep["idle_90pct"]["decisions_per_sec"])
+        detail["cfg17_fleet_idle90_cache_hit_rate"] = (
+            idle_sweep["idle_90pct"]["cache_hit_rate"])
 
 
 def _background_audit_row(store, cache, inc, now, P, G, cpu_m,
@@ -3234,6 +3465,76 @@ def run_smoke() -> dict:
                 "retry_after_ms": [float(r[1]) for r in rejected],
             }
             out["smoke_fleet_backpressure"] = "ok"
+
+            # round 18: streaming ingestion + the digest fast path through
+            # the SAME real server. A FleetStreamSession ships a full
+            # frame, churns its store twin and ships a DELTA frame — the
+            # answer must digest-equal both a standalone decide on the
+            # store content and the diff path (the same content as a full
+            # frame under a second tenant). An unchanged repeat must then
+            # answer from the cache: hit counted, batch_size 0, `cached`
+            # journey stage present.
+            from dataclasses import fields as _dcfields
+
+            from escalator_tpu.core.arrays import ClusterArrays as _SCA
+            from escalator_tpu.plugin.client import (
+                FleetStreamSession as _FSS,
+            )
+
+            fengine = fsrv._escalator_service.fleet.engine
+            ssess = _FSS(fclient, "smoke-stream", pod_capacity=Pf,
+                         node_capacity=Nf, store_kind="numpy")
+            sgroups = representative_cluster(Gf, Pf, Nf, seed=970).groups
+            ssess.set_groups(sgroups)
+            for i in range(8):
+                ssess.store.upsert_pod(f"sp{i}", i % Gf, 400 + 20 * i,
+                                       10 ** 9, i % 5)
+            for i in range(5):
+                ssess.store.upsert_node(f"sn{i}", i % Gf, 4000,
+                                        16 * 10 ** 9, tainted=(i == 4))
+
+            def _stream_content():
+                def copy(soa):
+                    return type(soa)(**{
+                        f.name: np.array(getattr(soa, f.name))
+                        for f in _dcfields(soa)})
+                pods, nodes = ssess.store.as_pod_node_arrays()
+                return _SCA(groups=copy(sgroups), pods=copy(pods),
+                            nodes=copy(nodes))
+
+            o_full, _p, m_full = ssess.decide(int(now))
+            ssess.store.upsert_pod("sp2", 2, 3000, 4 * 10 ** 9, 1)
+            ssess.store.delete_pod("sp6")
+            ssess.store.upsert_node("sn5", 5, 8000, 32 * 10 ** 9)
+            o_delta, _p, m_delta = ssess.decide(int(now) + 60)
+            assert ssess.full_frames == 1 and ssess.delta_frames == 1
+            content = _stream_content()
+            ref = _fk.decide_jit(jax.device_put(content),
+                                 np.int64(int(now) + 60))
+            o_diff, _p, m_diff = fclient.decide_arrays_fleet(
+                content, int(now) + 60, "smoke-diff")
+            assert (decision_digest(o_delta) == decision_digest(ref)
+                    == decision_digest(o_diff)), (
+                "fleet smoke: streamed-delta vs diff-path digests diverged")
+            # unchanged repeat: the digest fast path answers, no dispatch
+            hits0 = int(fengine.cache_hits)
+            o_hit, _p, m_hit = ssess.decide(int(now) + 60)
+            assert m_hit["cached"] and m_hit["batch_size"] == 0, m_hit
+            assert int(fengine.cache_hits) == hits0 + 1
+            assert "cached" in (m_hit.get("journey") or {}).get(
+                "stages_ms", {}), m_hit.get("journey")
+            assert decision_digest(o_hit) == decision_digest(o_delta), (
+                "fleet smoke: cached answer diverged from its dispatch")
+            fleet_report["streaming"] = {
+                "full_frames": ssess.full_frames,
+                "delta_frames": ssess.delta_frames,
+                "delta_vs_diff_parity": "ok",
+            }
+            fleet_report["cache_hits"] = int(fengine.cache_hits)
+            fleet_report["tail_batched"] = int(fengine.tail_dispatches)
+            out["smoke_fleet_streaming_parity"] = "ok"
+            out["smoke_fleet_cache_hits"] = int(fengine.cache_hits)
+
             fh = fclient.health()
             fleet_report["health_fleet"] = fh["fleet"]
             assert fh["fleet"]["rejected_total"] >= 2

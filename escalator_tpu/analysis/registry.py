@@ -376,6 +376,125 @@ def _probe_fleet_step_sharded_retraces() -> int:
     return fn._cache_size() - before
 
 
+def _fleet_step_drain_args(seed: int = 27, row: int = 0):
+    """The fleet step fed DRAIN-shaped operands (round 18 streaming
+    ingestion): a populated arena — one bootstrap step's output — plus a
+    SPARSE packed delta batch at the same ``delta_bucket`` shapes, exactly
+    what the tenant-drain apply path scatters when a client ships its
+    store twin's dirty drain instead of a full frame. Same program and
+    buckets as the bootstrap entry; this fixture pins the lint checks
+    (donation, 0-psum, retrace) on the operand shape cfg17's steady state
+    actually runs."""
+    from jax import tree_util
+
+    from escalator_tpu.fleet import service as fsvc
+    from escalator_tpu.ops import device_state as ds
+    from escalator_tpu.ops import kernel
+
+    C, G, P, N = 2, GROUPS, 24, 12
+    state_out, _out = ds._fleet_step(*_fleet_step_args(seed=seed, row=row))
+    state = tree_util.tree_map(np.asarray, state_out)
+    cluster = representative_cluster(G, P, N, seed=seed + 5)
+    B_pod = fsvc.delta_bucket(P)
+    B_node = fsvc.delta_bucket(N)
+    pod_slots = np.array([1, 5, 9], np.int64)
+    node_slots = np.array([2, 7], np.int64)
+    pi, pv = ds._gather_padded(cluster.pods, pod_slots, B_pod, P, ds._POD_PAD)
+    ni, nv = ds._gather_padded(cluster.nodes, node_slots, B_node, N,
+                               ds._NODE_PAD)
+    pi0, pv0 = ds._gather_padded(fsvc._empty_pods(0), np.zeros(0, np.int64),
+                                 B_pod, P, ds._POD_PAD)
+    ni0, nv0 = ds._gather_padded(fsvc._empty_nodes(0), np.zeros(0, np.int64),
+                                 B_node, N, ds._NODE_PAD)
+    stack = lambda soas: type(soas[0])(  # noqa: E731
+        **{f.name: np.stack([getattr(s, f.name) for s in soas])
+           for f in dataclasses.fields(soas[0])})
+    touched = np.zeros(G, bool)
+    touched[np.unique(cluster.pods.group[pod_slots])] = True
+    touched[np.unique(cluster.nodes.group[node_slots])] = True
+    dirty = kernel.fleet_dirty_indices([touched, np.zeros(G, bool)], G)
+    rows = np.array([row, C], np.int32)
+    nows = np.array([NOW + 60, 0], np.int64)
+    return (*state, rows, stack([cluster.groups, fsvc._empty_groups(G)]),
+            np.stack([pi, pi0]), stack([pv, pv0]),
+            np.stack([ni, ni0]), stack([nv, nv0]), dirty, nows)
+
+
+def _build_fleet_step_drain() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    args = _fleet_step_drain_args()
+    return TracedEntry(fn=ds._fleet_step_core, args=args,
+                       jitted=ds._fleet_step)
+
+
+def _probe_fleet_step_drain_retraces() -> int:
+    """Two drain-shaped micro-batches with different dirty slots and
+    contents at the same bucket shapes: the drain path must hit the same
+    compiled program (slot indices are content, never a cache key)."""
+    from escalator_tpu.ops import device_state as ds
+
+    before = ds._fleet_step._cache_size()
+    for seed, row in ((75, 0), (76, 1)):
+        state_out, out = ds._fleet_step(
+            *_fleet_step_drain_args(seed=seed, row=row))
+        jax.block_until_ready(out)
+    return ds._fleet_step._cache_size() - before
+
+
+def _fleet_order_tail_args(seed: int = 27, rows=(0,)):
+    """Batched order-repair operands: the resident arenas after one fleet
+    step (real node/aggregate content) plus the order-needing tenant row
+    vector, padded to ``kernel.fleet_order_bucket`` with the scratch row —
+    exactly what ``FleetEngine._batched_order_tail`` feeds the fused
+    dispatch."""
+    from jax import tree_util
+
+    from escalator_tpu.ops import device_state as ds
+    from escalator_tpu.ops import kernel
+
+    C = 2
+    state_out, _out = ds._fleet_step(*_fleet_step_args(seed=seed))
+    _pods, nodes, groups, aggs, _cols = tree_util.tree_map(
+        np.asarray, state_out)
+    T2 = kernel.fleet_order_bucket(len(rows), C + 1)
+    row_vec = np.full(T2, C, np.int32)
+    row_vec[: len(rows)] = rows
+    return (nodes, groups, aggs, row_vec)
+
+
+def _fleet_order_tail_sharded_args(seed: int = 27,
+                                   rows_per_shard=((0,), (1,))):
+    from jax import tree_util
+
+    parts = [_fleet_order_tail_args(seed=seed + 10 * s,
+                                    rows=rows_per_shard[s])
+             for s in range(_FLEET_SHARDS)]
+    return tree_util.tree_map(lambda *xs: np.stack(xs), *parts)
+
+
+def _build_fleet_order_tail_sharded() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    fn = ds.make_fleet_order_tail_sharded(_fleet_shard_mesh())
+    return TracedEntry(fn=fn, args=_fleet_order_tail_sharded_args(),
+                       jitted=fn)
+
+
+def _probe_fleet_order_tail_sharded_retraces() -> int:
+    """Different order-needing rows per shard (tenant membership moves
+    between micro-batches), identical T2/N buckets: one compile."""
+    from escalator_tpu.ops import device_state as ds
+
+    fn = ds.make_fleet_order_tail_sharded(_fleet_shard_mesh())
+    before = fn._cache_size()
+    for seed, rows in ((91, ((0,), (1,))), (92, ((1,), (0,)))):
+        out = fn(*_fleet_order_tail_sharded_args(seed=seed,
+                                                 rows_per_shard=rows))
+        jax.block_until_ready(out)
+    return fn._cache_size() - before
+
+
 def _build_fleet_decide_sharded() -> TracedEntry:
     fn = _fleet_decide_sharded_fn()
     cluster = _fleet_stacked_cluster(2 * _FLEET_SHARDS)
@@ -1199,6 +1318,33 @@ def default_registry() -> List[KernelEntry]:
             donate_expected=True,  # R5: donation survives the shard_map wrap
             retrace_budget=1,      # shard/row moves are content, not shape
             retrace_probe=_probe_fleet_step_sharded_retraces,
+        ),
+        e(
+            name="device_state.fleet_step_drain",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_fleet_step_drain,
+            global_axes={"pods": 24, "nodes": 12},
+            output_dtypes=DECISION_DTYPES,
+            output_select=lambda out: out[1],
+            collective_budget=0,   # tenant drains are row-local scatters
+            donate_expected=True,  # R5: same arenas as fleet_step
+            retrace_budget=1,      # dirty slots are content, not shape
+            retrace_probe=_probe_fleet_step_drain_retraces,
+        ),
+        e(
+            name="device_state.fleet_order_tail_sharded",
+            module="escalator_tpu.ops.device_state",
+            kind="shard_map",
+            build=_build_fleet_order_tail_sharded,
+            mapped=True,
+            min_devices=_FLEET_SHARDS,
+            global_axes={"nodes": 12},
+            output_dtypes={"0": "int32", "1": "int32"},
+            collective_budget=0,    # per-shard vmap over resident rows
+            donate_expected=False,  # read-only: arenas stay resident
+            retrace_budget=1,       # row membership is content, not shape
+            retrace_probe=_probe_fleet_order_tail_sharded_retraces,
         ),
         e(
             name="kernel.delta_decide",

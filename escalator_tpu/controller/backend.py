@@ -770,10 +770,15 @@ class IncrementalJaxBackend(ComputeBackend):
         exact decide configuration (refresh cadence, overlap, checkpoint
         dir) and flips ``needs_objects`` False (the controller then skips
         its per-tick lister walk). Flight records keep this backend's name.
-        Trade-off inherited from the native engine: checkpoints still
-        write, but warm RESTORE is unavailable (slot layout is
-        ingestion-ordered — docs/ha.md); a standby that must warm-start
-        should stay on the repack path instead."""
+
+        Round 18 closes the warm-restore caveat this method used to carry:
+        when checkpointing is configured, the native engine's snapshots
+        include a slot->key sidecar and the constructed stream passes
+        ``warm_restore=True`` — after a restart it replays the snapshot's
+        ingestion-ordered slot layout into a fresh store, adopts the device
+        state, and resyncs only what changed while no leader ran, so a
+        standby no longer has to stay on the repack path to warm-start
+        (docs/ha.md)."""
         from escalator_tpu.controller.native_backend import (
             NativeJaxBackend,
             group_filters_from_options,
@@ -786,6 +791,7 @@ class IncrementalJaxBackend(ComputeBackend):
             overlap=self._overlap, snapshot_dir=self._snapshot_dir,
             snapshot_every=self._snapshot_every, store_kind=store_kind,
             relist_audit_every=relist_audit_every,
+            warm_restore=bool(self._snapshot_dir),
         )
         stream.name = self.name   # one logical backend in records/metrics
         self._stream = stream

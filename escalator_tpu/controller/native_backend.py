@@ -60,7 +60,8 @@ class NativeJaxBackend(ComputeBackend):
                  snapshot_dir: "str | None" = None,
                  snapshot_every: "int | None" = None,
                  store_kind: str = "auto",
-                 relist_audit_every: "int | str | None" = None):
+                 relist_audit_every: "int | str | None" = None,
+                 warm_restore: bool = False):
         import os
 
         from escalator_tpu.native.statestore import make_state_store
@@ -77,7 +78,9 @@ class NativeJaxBackend(ComputeBackend):
         )
         self._client = client
         self.bridge = WatchBridge(self.store, groups)
-        client.subscribe(self.bridge.apply, replay=True)
+        # NOTE: the watch subscription happens at the END of __init__ — a
+        # warm restore (round 18) must seed the store twin from the
+        # checkpoint before the first event can land
         # re-list reconciliation audit (round 12): every N ticks, re-list the
         # client world through bridge.resync — the O(cluster) walk demoted to
         # an audit cadence; off by default ("off"/unset/0 via env
@@ -151,12 +154,13 @@ class NativeJaxBackend(ComputeBackend):
         self._ticks_since_fallback = 0
         self._dispatches_this_tick = 0
         # failover checkpoints (round 11): the incremental decider's state
-        # checkpoints to disk on a cadence. Warm RESTORE is not wired for
-        # this backend — the C++ store assigns slots by ingestion order, so
-        # a restarted process's slot layout need not match the snapshot's
-        # (docs/ha.md: the repack incremental backend owns warm starts; a
-        # native snapshot still powers offline debug-replay of that
-        # process's own recorded ring).
+        # checkpoints to disk on a cadence. Round 18 closes the warm-RESTORE
+        # caveat: the checkpoint now carries a slot->key sidecar
+        # (``store.keys``, see WatchBridge.slot_key_tables), and because the
+        # store assigns slots freelist-then-sequential, ordered upserts on a
+        # fresh store replay the snapshot's exact ingestion-ordered layout —
+        # so a restarted process can adopt the device state and resync only
+        # what changed since (docs/ha.md).
         from escalator_tpu.controller.backend import _snapshot_config
 
         snapshot_dir, snapshot_every = _snapshot_config(
@@ -166,7 +170,200 @@ class NativeJaxBackend(ComputeBackend):
             from escalator_tpu.ops.snapshot import SnapshotWriter
 
             self._writer = SnapshotWriter(snapshot_dir, every=snapshot_every)
+        # warm restore (round 18, opt-in — attach_event_source passes
+        # warm_restore=True when checkpointing is on): seed the store twin +
+        # bridge maps + device state from the rolling checkpoint BEFORE
+        # subscribing. Any failure cold-starts on a fresh store, exactly
+        # today's bootstrap.
+        warm = False
+        if warm_restore and self._writer is not None:
+            warm = self._try_warm_restore(
+                pod_capacity, node_capacity, store_kind)
+        # cold: list-then-watch replay (the O(cluster) bootstrap). warm: the
+        # store already holds the checkpoint world — subscribe without
+        # replay, then ONE resync audit reconciles everything that changed
+        # while no leader ran into the first tick's delta batch (unchanged
+        # objects match their seeded records and stay clean).
+        client.subscribe(self.bridge.apply, replay=not warm)
+        if warm:
+            self.bridge.resync(client)
         obs.jaxmon.install()
+
+    # -- warm restore (round 18) ---------------------------------------------
+    def _checkpoint_extra(self) -> Dict[str, np.ndarray]:
+        """Slot->key sidecar leaves for the rolling checkpoint: the store
+        assigns slots by ingestion order, so the snapshot's layout is only
+        reproducible with the key tables that produced it. One msgpack blob
+        as a uint8 leaf; ``leaves_to_state`` pulls leaves by name, so repack
+        consumers of the same snapshot dir ignore it."""
+        import msgpack
+
+        with self.store.lock:
+            pod_keys, node_keys = self.bridge.slot_key_tables()
+        blob = msgpack.packb({"pod_keys": pod_keys, "node_keys": node_keys})
+        return {"store.keys": np.frombuffer(blob, np.uint8)}
+
+    def _note_corrupt_snapshot(self, path: str, err: Exception) -> None:
+        import logging
+
+        metrics.snapshot_restores.labels("corrupt").inc()
+        dump = obs.dump_on_incident("snapshot-corrupt")
+        logging.getLogger("escalator_tpu.native").error(
+            "snapshot %s failed validation (%s); cold-starting instead "
+            "(flight record: %s)", path, err, dump or "dump failed")
+
+    def _try_warm_restore(self, pod_capacity: int, node_capacity: int,
+                          store_kind: str) -> bool:
+        """Warm start for the streaming path: adopt the checkpoint's device
+        state (exactly the repack backend's ``_try_restore``), then replay
+        the snapshot's slot layout into the still-empty store twin from the
+        ``store.keys`` sidecar and seed the bridge's record maps, so the
+        post-subscribe resync marks only objects that changed while no
+        leader ran. Returns True on success; every failure path leaves a
+        fresh cold-start store behind."""
+        import logging
+
+        import msgpack
+
+        from escalator_tpu.native.statestore import make_state_store
+        from escalator_tpu.ops import snapshot as snaplib
+        from escalator_tpu.ops.device_state import restore_decider
+
+        log = logging.getLogger("escalator_tpu.native")
+        path = self._writer.path
+        with obs.span("snapshot_load"):
+            try:
+                leaves, meta = snaplib.read_snapshot(path)
+            except FileNotFoundError:
+                return False
+            except snaplib.SnapshotCorruptError as e:
+                self._note_corrupt_snapshot(path, e)
+                return False
+        raw = leaves.pop("store.keys", None)
+        if raw is None:
+            metrics.snapshot_restores.labels("stale").inc()
+            log.warning(
+                "snapshot %s carries no slot-key sidecar (pre-round-18 "
+                "writer): the ingestion-ordered slot layout cannot be "
+                "replayed — cold-starting the streaming store instead", path)
+            return False
+        try:
+            keys = msgpack.unpackb(np.asarray(raw).tobytes())
+            pod_keys = [str(k) for k in keys["pod_keys"]]
+            node_keys = [str(k) for k in keys["node_keys"]]
+        except Exception as e:
+            self._note_corrupt_snapshot(path, e)
+            return False
+        try:
+            cache, inc = restore_decider(
+                leaves, meta, impl="xla", refresh_every=self._refresh_every,
+                on_mismatch="repair", overlap=self._overlap)
+        except snaplib.SnapshotCorruptError as e:
+            self._note_corrupt_snapshot(path, e)
+            return False
+        if (cache.pod_capacity < self.store.pod_capacity
+                or cache.node_capacity < self.store.node_capacity):
+            metrics.snapshot_restores.labels("stale").inc()
+            log.warning(
+                "snapshot %s capacities (%dP/%dN) are smaller than the "
+                "configured store (%dP/%dN); slot layout cannot be replayed "
+                "— cold-starting", path, cache.pod_capacity,
+                cache.node_capacity, self.store.pod_capacity,
+                self.store.node_capacity)
+            return False
+        try:
+            if (cache.pod_capacity > self.store.pod_capacity
+                    or cache.node_capacity > self.store.node_capacity):
+                self.store.grow(cache.pod_capacity, cache.node_capacity)
+            self._seed_store(cache, pod_keys, node_keys)
+        except Exception as e:
+            # the store may be half-seeded: rebuild it (and the bridge)
+            # fresh so the cold bootstrap starts from a clean slate
+            metrics.snapshot_restores.labels("stale").inc()
+            log.warning(
+                "warm seed from %s failed (%s); cold-starting on a fresh "
+                "store", path, e)
+            self.store = make_state_store(
+                pod_capacity=pod_capacity, node_capacity=node_capacity,
+                kind=store_kind)
+            self.bridge = WatchBridge(self.store, self.bridge.groups)
+            return False
+        with self.store.lock:
+            self.bridge.seed_from_snapshot(
+                pod_keys, node_keys, *cache.host_views)
+        self._cache, self._inc = cache, inc
+        metrics.snapshot_restores.labels("warm").inc()
+        log.info(
+            "warm start: restored device state + store twin from %s "
+            "(tick %s)", path, meta.get("tick"))
+        return True
+
+    def _seed_store(self, cache, pod_keys: List[str],
+                    node_keys: List[str]) -> None:
+        """Replay the snapshot's slot layout into the empty store: slots
+        assign freelist-then-sequential, so upserting slot 0..last IN ORDER
+        on a fresh store reproduces any layout — holes get placeholder keys
+        (deleted afterwards, returning them to the freelist; DNS-1123 names
+        and ``ns/name`` uids cannot collide with them). Slots whose key
+        sidecar disagrees with the snapshot's valid column (an event landed
+        between the checkpointed tick's drain and the key-table capture)
+        seed as holes whose placeholder delete lands AFTER the dirty
+        discard — the first tick then scatters the invalidation to the
+        device, and the post-restore resync re-adds the object if it is
+        still live. Dirty marks from the replay itself are discarded: the
+        restored device state already holds every seeded row."""
+        hp, hn = cache.host_views
+        with self.store.lock:
+            dirty_deletes = []   # (delete_fn, slot): run AFTER the discard
+
+            def replay(keys, valid_col, real, hole, delete):
+                valid_col = np.asarray(valid_col)
+                last = max((s for s, k in enumerate(keys) if k), default=-1)
+                if valid_col.any():
+                    last = max(last, int(np.nonzero(valid_col)[0].max()))
+                clean_holes = []
+                for slot in range(last + 1):
+                    key = keys[slot]
+                    valid = bool(valid_col[slot])
+                    if key and valid:
+                        got = real(slot, key)
+                    else:
+                        got = hole(slot)
+                        if bool(key) != valid:
+                            keys[slot] = ""
+                            dirty_deletes.append((delete, slot))
+                        else:
+                            clean_holes.append(slot)
+                    if got != slot:
+                        raise RuntimeError(
+                            f"slot replay diverged at {slot} (got {got})")
+                for slot in clean_holes:
+                    delete(f"_warm-hole-{slot}")
+
+            replay(
+                node_keys, hn.valid,
+                lambda slot, name: self.store.upsert_node(
+                    name, int(hn.group[slot]), int(hn.cpu_milli[slot]),
+                    int(hn.mem_bytes[slot]),
+                    creation_ns=int(hn.creation_ns[slot]),
+                    tainted=bool(hn.tainted[slot]),
+                    cordoned=bool(hn.cordoned[slot]),
+                    no_delete=bool(hn.no_delete[slot]),
+                    taint_time_sec=int(hn.taint_time_sec[slot])),
+                lambda slot: self.store.upsert_node(
+                    f"_warm-hole-{slot}", 0, 0, 0),
+                self.store.delete_node)
+            replay(
+                pod_keys, hp.valid,
+                lambda slot, uid: self.store.upsert_pod(
+                    uid, int(hp.group[slot]), int(hp.cpu_milli[slot]),
+                    int(hp.mem_bytes[slot]), int(hp.node[slot])),
+                lambda slot: self.store.upsert_pod(
+                    f"_warm-hole-{slot}", 0, 0, 0, -1),
+                self.store.delete_pod)
+            self.store.drain_dirty()
+            for delete, slot in dirty_deletes:
+                delete(f"_warm-hole-{slot}")
 
     def _refresh_cached_capacity(self, group_inputs, nodes: NodeArrays) -> None:
         """First live node per group -> GroupState cached capacity
@@ -455,7 +652,8 @@ class NativeJaxBackend(ComputeBackend):
                     )
             if self._writer is not None:
                 with obs.span("checkpoint"):
-                    self._writer.maybe_checkpoint(self._inc)
+                    self._writer.maybe_checkpoint(
+                        self._inc, extra=self._checkpoint_extra)
             return results
         # blocks on the result itself: an async device failure must surface
         # inside the resilient wrapper, not here. The lazy protocol sorts

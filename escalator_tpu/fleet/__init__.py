@@ -27,6 +27,7 @@ from escalator_tpu.fleet.scheduler import (
 )
 from escalator_tpu.fleet.service import (
     DecideRequest,
+    DeltaFrame,
     EvictAck,
     EvictRequest,
     FleetDecision,
@@ -37,7 +38,8 @@ from escalator_tpu.fleet.service import (
 )
 
 __all__ = [
-    "AdmissionError", "DEFAULT_CLASSES", "DecideRequest", "EvictAck",
-    "EvictRequest", "FleetDecision", "FleetEngine", "FleetScheduler",
-    "PriorityClass", "StaleBatchError", "TenantError", "validate_tenant_id",
+    "AdmissionError", "DEFAULT_CLASSES", "DecideRequest", "DeltaFrame",
+    "EvictAck", "EvictRequest", "FleetDecision", "FleetEngine",
+    "FleetScheduler", "PriorityClass", "StaleBatchError", "TenantError",
+    "validate_tenant_id",
 ]

@@ -144,6 +144,18 @@ class AdmissionError(Exception):
         self.retry_after_ms = float(retry_after_ms)
 
 
+def _noop_shaped(req) -> bool:
+    """A decide carrying an EMPTY delta frame — the streaming twin's
+    "nothing changed" shape, which the digest fast path answers from the
+    per-tenant decision cache without a device lane. Static on the
+    request (no engine state read — the take loop runs concurrently with
+    the PREP thread's cache probes), so it is a shape test, not a hit
+    prediction: a miss still decides correctly, it just occupies a lane."""
+    delta = getattr(req, "delta", None)
+    return (delta is not None and len(delta.pod_idx) == 0
+            and len(delta.node_idx) == 0 and delta.groups is None)
+
+
 @dataclass
 class _Pending:
     request: Union[DecideRequest, EvictRequest]
@@ -230,6 +242,12 @@ class FleetScheduler:
         # index (scanning every queued request under the cv put an
         # O(queue_limit) walk on the lock that serializes submit)
         self._queued_classes: Dict[str, Dict[str, int]] = {}
+        # rolling fraction of decides the digest fast path answered
+        # (round 18): an EMA updated on the respond side — the retry-after
+        # estimate discounts the backlog by it, because cached requests
+        # never consume a batch slot. Starts at 0.0 so a fleet with no
+        # cache hits computes EXACTLY the old estimate.
+        self._cache_hit_ema = 0.0
         self.pipelined = bool(pipeline) and hasattr(engine, "prepare_batch")
         # pipelined-mode plumbing: the depth-1 staged slot between the two
         # workers, and the recent dispatch windows the overlap accounting
@@ -276,9 +294,18 @@ class FleetScheduler:
                 tenant: Optional[str] = None):
         self.rejected_total += 1
         metrics.fleet_admission_rejects.labels(reason).inc()
+        # the estimate INPUTS ride the journal event (round 18): a flat
+        # overestimate under a mostly-idle fleet was only diagnosable by
+        # reconstructing the formula — now the reject record carries the
+        # terms the backoff was computed from
         obs.journal.JOURNAL.event("admission-reject", reason=reason,
                                   klass=klass, tenant=tenant,
-                                  retry_after_ms=round(retry_after_ms, 1))
+                                  retry_after_ms=round(retry_after_ms, 1),
+                                  queue_depth=self.queue_depth,
+                                  max_batch=self.max_batch,
+                                  flush_ms=round(self.flush_sec * 1e3, 3),
+                                  cache_hit_frac=round(
+                                      self._cache_hit_ema, 4))
         raise AdmissionError(reason, retry_after_ms)
 
     def _retry_after_ms(self, extra_batches: float) -> float:
@@ -286,9 +313,16 @@ class FleetScheduler:
         interval; ``extra_batches`` rides on top (a tenant-inflight
         rejection adds the tenant's own depth — each of its requests must
         ride a SEPARATE batch, so its backlog clears serially even when
-        the queue is empty)."""
+        the queue is empty). Both terms discount by the rolling digest
+        cache-hit fraction (round 18): a cached-capable request never
+        consumes a batch slot — it answers at prep time — so under a
+        mostly-idle fleet the undiscounted estimate inflated client
+        backoff by up to the idle fraction. At a 0.0 hit fraction this is
+        bit-for-bit the old formula."""
+        live = 1.0 - min(max(self._cache_hit_ema, 0.0), 1.0)
         backlog = self.queue_depth / max(self.max_batch, 1)
-        return (extra_batches + backlog + 1.0) * self.flush_sec * 1e3
+        return (extra_batches * live + backlog * live + 1.0) \
+            * self.flush_sec * 1e3
 
     def resolve_class(self, klass: Optional[str]) -> str:
         """Map a request's (optional) class name to a configured class —
@@ -302,15 +336,19 @@ class FleetScheduler:
         return klass
 
     def submit(self, tenant_id: str, cluster, now_sec: int,
-               klass: Optional[str] = None) -> Future:
-        """Admit one decide. Raises :class:`TenantError` on a malformed
-        tenant id or unknown priority class (before anything queues — a
-        bad request never poisons a batch) and :class:`AdmissionError` on
-        backpressure."""
+               klass: Optional[str] = None, delta=None) -> Future:
+        """Admit one decide. ``delta`` (round 18) is a
+        :class:`~escalator_tpu.fleet.service.DeltaFrame` replacing the
+        full cluster — ``cluster`` is then None and the engine scatters
+        the drain instead of diffing. Raises :class:`TenantError` on a
+        malformed tenant id or unknown priority class (before anything
+        queues — a bad request never poisons a batch) and
+        :class:`AdmissionError` on backpressure."""
         validate_tenant_id(tenant_id)
         klass = self.resolve_class(klass)
         return self._admit(
-            DecideRequest(tenant_id, cluster, int(now_sec)), klass)
+            DecideRequest(tenant_id, cluster, int(now_sec), delta=delta),
+            klass)
 
     def evict(self, tenant_id: str) -> Future:
         """Admit an eviction (serialized with the decide stream, so a
@@ -437,10 +475,21 @@ class FleetScheduler:
         a skipped request keeps its queue position (a taken tenant stays
         taken for the whole flush, so passing it once is final) and counts
         ``fleet_batch_deferred_total``. Within a class requests leave
-        oldest-first."""
+        oldest-first. No-op-shaped requests (empty delta frames) are
+        taken WITHOUT consuming a batch slot — see ``_noop_shaped``."""
         batch: List[_Pending] = []
         taken: set = set()
         deferred = 0
+        # micro-batch (device-lane) slots consumed: no-op-shaped requests
+        # (empty delta frames — the streaming twin's idle shape, the
+        # digest fast path's target) ride the flush WITHOUT a slot.
+        # Counting them against max_batch would cap a mostly-idle fleet
+        # at max_batch cached answers per device dispatch; slot-free they
+        # all drain in one flush and only real churn pays dispatches.
+        # (An idle-shaped request that then MISSES the digest probe — a
+        # clock edge, an eviction — still decides correctly; the batch
+        # just runs a few lanes over max_batch that flush.)
+        slots = 0
         # one clock read per flush: every request this batch takes closes
         # its admission (queue-wait) stage at the same flush instant
         now_take = time.monotonic()
@@ -466,7 +515,9 @@ class FleetScheduler:
             cursor[name] = i
             return None
 
-        def take_at(name: str, i: int) -> None:
+        def take_at(name: str, i: int) -> bool:
+            """Take the request; returns True when it consumed a slot."""
+            nonlocal slots
             p = items[name][i]
             consumed[name][i] = True
             cursor[name] = i + 1
@@ -474,6 +525,10 @@ class FleetScheduler:
             p.taken = now_take          # journey: admission stage closes
             batch.append(p)
             self._drop_queued_class(p.request.tenant_id, name)
+            if _noop_shaped(p.request):
+                return False
+            slots += 1
+            return True
 
         total_w = sum(self.classes[n].weight for n in names)
         # phase 1: weighted quotas, heaviest classes first (every active
@@ -488,12 +543,12 @@ class FleetScheduler:
                                key=lambda n: -self.classes[n].weight):
                 quota = max(1, (self.max_batch * self.classes[name].weight)
                             // max(total_w, 1))
-                while quota > 0 and len(batch) < self.max_batch:
+                while quota > 0 and slots < self.max_batch:
                     i = next_free(name)
                     if i is None:
                         break
-                    take_at(name, i)
-                    quota -= 1
+                    if take_at(name, i):
+                        quota -= 1
         # phase 2: leftover capacity fills oldest-first across classes — a
         # heap merge over the class cursors. A tenant can queue in more
         # than one class, so a popped head re-ranks (re-push) when the
@@ -503,7 +558,7 @@ class FleetScheduler:
             i = next_free(name)
             if i is not None:
                 heapq.heappush(heap, (items[name][i].enqueued, name))
-        while heap and len(batch) < self.max_batch:
+        while heap and slots < self.max_batch:
             key, name = heapq.heappop(heap)
             i = next_free(name)
             if i is None:
@@ -515,6 +570,21 @@ class FleetScheduler:
             j = next_free(name)
             if j is not None:
                 heapq.heappush(heap, (items[name][j].enqueued, name))
+        # phase 3: the slot cap above stops REAL takes only — vacuum any
+        # remaining no-op-shaped requests (slot-free by definition) so an
+        # idle backlog drains this flush instead of trickling out
+        # max_batch per dispatch behind real churn; real requests keep
+        # their queue positions for the next flush.
+        if slots >= self.max_batch:
+            for name in names:
+                for i, p in enumerate(items[name]):
+                    if consumed[name][i] or not _noop_shaped(p.request):
+                        continue
+                    if p.request.tenant_id in taken:
+                        deferred += 1
+                        p.deferrals += 1
+                        continue
+                    take_at(name, i)
         # rebuild the queues without the consumed entries, order preserved
         for name in names:
             q = self._queues[name]
@@ -657,7 +727,14 @@ class FleetScheduler:
     def _complete(self, batch: List[_Pending], results: list) -> None:
         from escalator_tpu.fleet.service import EvictAck
 
-        metrics.fleet_batch_size.observe(len(batch))
+        # the micro-batch size is the DISPATCHED lane count: cached
+        # answers never entered the device program (slot-free take), so
+        # counting them would both pollute the coalescing signal and
+        # break the dashboard's hit-fraction denominator
+        n_dispatched = sum(
+            1 for r in results if not getattr(r, "cached", False))
+        if n_dispatched:
+            metrics.fleet_batch_size.observe(n_dispatched)
         done = time.monotonic()
         slo_checks = []
         with self._cv:
@@ -674,6 +751,14 @@ class FleetScheduler:
                     # them would fold queue wait on a failed batch into the
                     # tenant/class SLO series
                     continue
+                if not isinstance(res, EvictAck):
+                    # cache-hit EMA for the retry-after discount: decides
+                    # only (evicts can never hit), alpha 0.05 ≈ the last
+                    # ~20 decides dominate
+                    hit = 1.0 if getattr(res, "cached", False) else 0.0
+                    self._cache_hit_ema += 0.05 * (hit - self._cache_hit_ema)
+                    if hit:
+                        metrics.fleet_cache_hits.labels(p.klass).inc()
                 self._class_served[p.klass] += 1
                 if self._class_served[p.klass] % _SLO_CHECK_EVERY == 0:
                     slo_checks.append(p.klass)
@@ -731,21 +816,38 @@ class FleetScheduler:
         st = getattr(res, "stages", None) or {}
         t0 = p.enqueued
         t1 = p.taken or t0
-        t2 = st.get("dispatch_t0") or t1
-        t3 = st.get("dispatch_t1") or t2
-        # a stale dispatch window (engine stamped an earlier batch) must
-        # not produce negative stages: clamp into [t1, done]
-        t2 = min(max(t2, t1), done)
-        t3 = min(max(t3, t2), done)
-        tail_ms = float(st.get("ordered_tail_ms") or 0.0)
-        tail_ms = min(tail_ms, max(0.0, (done - t3) * 1e3))
-        stages_ms = {
-            "admission": (t1 - t0) * 1e3,
-            "batch_assembly": (t2 - t1) * 1e3,
-            "dispatch": (t3 - t2) * 1e3,
-            "ordered_tail": tail_ms,
-            "unpack": (done - t3) * 1e3 - tail_ms,
-        }
+        if getattr(res, "cached", False):
+            # digest fast path (round 18): the request never entered the
+            # micro-batch — everything after the flush took it is the ONE
+            # ``cached`` stage (prep-side digest check + answer), and the
+            # batch/device stages are honestly zero. The contiguous-
+            # segments sum identity still holds: admission + cached ==
+            # e2e exactly.
+            stages_ms = {
+                "admission": (t1 - t0) * 1e3,
+                "batch_assembly": 0.0,
+                "dispatch": 0.0,
+                "ordered_tail": 0.0,
+                "unpack": 0.0,
+                "cached": (done - t1) * 1e3,
+            }
+        else:
+            t2 = st.get("dispatch_t0") or t1
+            t3 = st.get("dispatch_t1") or t2
+            # a stale dispatch window (engine stamped an earlier batch)
+            # must not produce negative stages: clamp into [t1, done]
+            t2 = min(max(t2, t1), done)
+            t3 = min(max(t3, t2), done)
+            tail_ms = float(st.get("ordered_tail_ms") or 0.0)
+            tail_ms = min(tail_ms, max(0.0, (done - t3) * 1e3))
+            stages_ms = {
+                "admission": (t1 - t0) * 1e3,
+                "batch_assembly": (t2 - t1) * 1e3,
+                "dispatch": (t3 - t2) * 1e3,
+                "ordered_tail": tail_ms,
+                "unpack": (done - t3) * 1e3 - tail_ms,
+                "cached": 0.0,
+            }
         journey = {
             "tenant": p.request.tenant_id,
             "klass": p.klass,

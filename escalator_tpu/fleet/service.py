@@ -67,17 +67,27 @@ arrays between ``submit`` and completion — the engine copies each section
 into the arena-bucket twin during prep (``_repad_copy``), so the window is
 the prep call itself.
 
-Orders run the lazy protocol PER TENANT: the batch dispatch is the light
-program; a tenant whose decision consumes an order (tainted nodes exist, or
-some group scales down) gets a single-tenant ordered re-dispatch fed its
-maintained aggregates (``device_state._fleet_tenant_state_local`` over the
-tenant's own shard block +
-``kernel.decide_jit(aggregates=…)``) — steady fleets sort never, drains sort
-per draining tenant.
+Orders run the lazy protocol per MICRO-BATCH (round 18): the batch dispatch
+is the light program; every tenant whose decision consumes an order
+(tainted nodes exist, or some group scales down) rides ONE batched
+order-repair dispatch (``device_state.make_fleet_order_tail_sharded`` —
+the kernel's exact ordered branch vmapped over the order-needing rows,
+fed the resident post-step state) whose ``untaint_order``/
+``scale_down_order`` graft into the already-unpacked decisions. Steady
+fleets sort never; a drain-heavy batch pays one fused sort dispatch, not
+one 55 ms O(arena) re-dispatch per draining tenant.
+
+Round 18 also adds the host-side fast paths: a per-tenant input DIGEST
+answers unchanged requests straight from the cached decision columns
+(never entering the micro-batch), and tenant DELTA FRAMES
+(:class:`DeltaFrame` — state-store-twin dirty drains shipped over the
+wire) replace the per-tenant positional diff with a direct scatter, so
+steady prep cost is O(churn) rather than O(cluster).
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import threading
 import time
@@ -133,13 +143,40 @@ def validate_tenant_id(tenant_id) -> str:
 
 
 @dataclass
+class DeltaFrame:
+    """A tenant's packed dirty drain (round 18 streaming ingestion): the
+    ``(idx, values)`` batches a state-store twin's ``drain_dirty_packed``
+    emits, trimmed of padding, plus the request's padded shapes. The engine
+    scatters these straight into the tenant's host twin and feeds them to
+    the fused step as the delta batch — no per-tenant positional diff runs
+    at all (``prepare_batch``'s ``_changed_rows`` is the O(cluster) host
+    cost this replaces). ``groups`` ships the full section only when the
+    group options changed (``set_groups``/reload); ``None`` means
+    unchanged. Slot indices address the tenant's resident lanes — the
+    client and engine agree on slot identity because BOTH sides run the
+    same state-store slot allocator (the store twin is the contract)."""
+
+    shapes: Tuple[int, int, int]          # the request's (G, P, N) paddings
+    pod_idx: np.ndarray                   # int [dp] changed pod slots
+    pod_vals: PodArrays                   # [dp] packed rows at those slots
+    node_idx: np.ndarray                  # int [dn]
+    node_vals: NodeArrays                 # [dn]
+    groups: Optional[GroupArrays] = None  # full section iff options changed
+
+
+@dataclass
 class DecideRequest:
     """One tenant's decide: a packed cluster (any padding at or under the
-    arena caps) + the timestamp the decision evaluates at."""
+    arena caps) + the timestamp the decision evaluates at. ``delta``
+    (round 18) replaces the full cluster with a packed dirty drain against
+    the tenant's resident twin — ``cluster`` is then None and the tenant
+    must already be resident (a delta before any full frame is a
+    TenantError; growth past the arena buckets requires a full frame)."""
 
     tenant_id: str
-    cluster: ClusterArrays
+    cluster: Optional[ClusterArrays]
     now_sec: int
+    delta: Optional[DeltaFrame] = None
 
 
 @dataclass
@@ -179,6 +216,12 @@ class FleetDecision:
     #: respond side (stage durations summing to the endpoint e2e) — the
     #: gRPC edge ships it back to the caller as span phases + fleet sidecar
     journey: Optional[dict] = None
+    #: round 18: True when the digest fast path answered this request from
+    #: the tenant's cached decision columns without entering the
+    #: micro-batch (``batch_size`` is then 0 — the request rode no batch).
+    #: The arrays are bit-equal to what a dispatch would have produced
+    #: (locked by the churn soak); callers must not mutate them.
+    cached: bool = False
 
 
 def _pow2(n: int, lo: int = 1) -> int:
@@ -251,6 +294,23 @@ def _changed_rows(old, new) -> np.ndarray:
         d = np.asarray(getattr(old, f.name)) != np.asarray(getattr(new, f.name))
         changed = d if changed is None else (changed | d)
     return np.nonzero(changed)[0].astype(np.int64)
+
+
+def _request_digest(cluster: ClusterArrays, now_sec: int) -> bytes:
+    """Content digest of one full-frame request (round 18 fast path): every
+    section's raw column bytes plus shapes/dtypes plus ``now_sec``. Two
+    requests with equal digests produce bit-identical decisions (decide is
+    deterministic in content + now, and the answer's slicing depends only
+    on the request shapes, which the digest covers)."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.int64(now_sec).tobytes())
+    for section in (cluster.groups, cluster.pods, cluster.nodes):
+        for f in fields(section):
+            a = np.ascontiguousarray(getattr(section, f.name))
+            h.update(f.name.encode())
+            h.update(repr((a.shape, a.dtype.str)).encode())
+            h.update(a.tobytes())
+    return h.digest()
 
 
 #: The persistent-decision-column dtypes, in kernel.GROUP_DECISION_FIELDS
@@ -328,6 +388,18 @@ class _Tenant:
     dirty: np.ndarray        # bool [G] — pending dirty groups (host mirror)
     shapes: Tuple[int, int, int]   # the LAST request's (G, P, N) paddings
     ticks: int = 0
+    #: round-18 digest fast path: the answer the last dispatch produced for
+    #: this tenant (COPIED slices — never views pinning the [S,T,…] batch
+    #: output), the full-frame digest that produced it (None when it came
+    #: off the delta path), the now it evaluated at, and the arena epoch it
+    #: is valid under. Any reshape/rebuild bumps the epoch and the whole
+    #: entry goes stale; evict→re-register makes a fresh _Tenant, so a
+    #: recycled id can never see the old tenant's columns.
+    cache_digest: Optional[bytes] = None
+    cache_now: int = 0
+    cache_arrays: Optional[object] = None   # kernel.DecisionArrays, numpy
+    cache_ordered: bool = False
+    cache_epoch: int = -1
 
 
 @dataclass
@@ -356,6 +428,14 @@ class _Entry:
     old_dirty: Optional[np.ndarray]
     old_shapes: Optional[tuple]
     t_index: int = -1        # position within the shard's batch slice
+    #: full-frame request digest (None for delta/evict entries) — written
+    #: into the tenant's cache entry after the dispatch answers
+    digest: Optional[bytes] = None
+    #: delta-path rollback record: (pod_idx, old_pod_rows, node_idx,
+    #: old_node_rows, old_groups_or_None) — delta prep scatters into the
+    #: live twin IN PLACE, so the undo is the gathered old rows, not a
+    #: twin reference swap (old_twins is None for delta entries)
+    delta_undo: Optional[tuple] = None
 
 
 @dataclass
@@ -419,6 +499,7 @@ class FleetEngine:
         self._S = S
         self._mesh = self._make_mesh(self._devices)
         self._step_fn = ds.make_fleet_step_sharded(self._mesh)
+        self._order_tail_fn = ds.make_fleet_order_tail_sharded(self._mesh)
         self._G = _pow2(num_groups, 4)
         self._P = _pow2(pod_capacity, 16)
         self._N = _pow2(node_capacity, 8)
@@ -437,7 +518,15 @@ class FleetEngine:
         self._staged: Optional[_PreparedBatch] = None
         self.batches = 0
         self.decisions = 0
+        #: order-consuming tenants served (kept name: it now counts tenants
+        #: REPAIRED by the batched tail, not separate device dispatches)
         self.ordered_redispatches = 0
+        #: batched order-tail device dispatches (round 18): at most ONE per
+        #: micro-batch regardless of how many tenants consume orders
+        self.tail_dispatches = 0
+        #: requests answered by the digest fast path without entering a
+        #: micro-batch
+        self.cache_hits = 0
         self._init_state()
 
     # -- arena construction / reshaping --------------------------------------
@@ -731,13 +820,17 @@ class FleetEngine:
         t0 = time.perf_counter()
         results: List[object] = [None] * len(requests)
         entries: List[_Entry] = []
+        journeys: list = []
         with obs.span("fleet_prep"), self._host:
             with obs.span("fleet_diff"):
                 # pass 1: grow the lane buckets for EVERY request up front —
                 # a grow mid-batch would invalidate sections staged at the
-                # old shapes (a cap breach rejects that request alone)
+                # old shapes (a cap breach rejects that request alone).
+                # Delta frames never grow (growth requires a full frame —
+                # _prepare_entry rejects an oversized one per request).
                 for pos, r in enumerate(requests):
-                    if isinstance(r, EvictRequest):
+                    if (isinstance(r, EvictRequest)
+                            or getattr(r, "delta", None) is not None):
                         continue
                     try:
                         self._ensure_buckets(r.cluster)
@@ -749,8 +842,15 @@ class FleetEngine:
                         if results[pos] is not None:
                             continue
                         try:
-                            entries.append(
-                                self._prepare_entry(pos, r, pending_free))
+                            digest = None
+                            if not isinstance(r, EvictRequest):
+                                digest, hit = self._cache_probe(r)
+                                if hit:
+                                    results[pos] = self._cache_answer(
+                                        r, journeys)
+                                    continue
+                            entries.append(self._prepare_entry(
+                                pos, r, pending_free, digest))
                         except TenantError as e:
                             results[pos] = e
                     operands = (self._assemble(entries) if entries
@@ -778,16 +878,77 @@ class FleetEngine:
             pb = _PreparedBatch(
                 epoch=self._epoch, requests=list(requests), results=results,
                 entries=entries, operands=operands,
-                prep_ms=(time.perf_counter() - t0) * 1e3)
+                prep_ms=(time.perf_counter() - t0) * 1e3,
+                journeys=journeys)
             self._staged = pb
         return pb
 
-    def _prepare_entry(self, pos: int, r, pending_free) -> _Entry:
+    # -- the digest fast path (round 18) --------------------------------------
+
+    def _cache_probe(self, r: DecideRequest
+                     ) -> Tuple[Optional[bytes], bool]:
+        """(digest, hit) for one decide request; caller holds ``_host``.
+        A hit means the tenant's cached decision columns are bit-equal to
+        what a dispatch would produce: same input content at the same
+        ``now_sec`` under the same arena epoch (decide is deterministic in
+        content + now; an unchanged tenant's persistent columns survive a
+        dispatch untouched, and the ordered tail recomputes
+        deterministically). A full frame matches by content digest; a delta
+        frame matches only when EMPTY (no changed slots, no group reload)
+        at the cached now. The ``fleet_digest`` chaos site forces a miss —
+        the request then rides the batch and the soak's bit-parity check
+        proves the cache would have answered identically."""
+        tenant = self._tenants.get(r.tenant_id)
+        delta = getattr(r, "delta", None)
+        if delta is not None:
+            digest = None
+            hit = (tenant is not None
+                   and tenant.cache_arrays is not None
+                   and tenant.cache_epoch == self._epoch
+                   and int(r.now_sec) == tenant.cache_now
+                   and not tenant.dirty.any()
+                   and len(np.asarray(delta.pod_idx)) == 0
+                   and len(np.asarray(delta.node_idx)) == 0
+                   and delta.groups is None
+                   and tuple(delta.shapes) == tuple(tenant.shapes))
+        else:
+            digest = _request_digest(r.cluster, r.now_sec)
+            hit = (tenant is not None
+                   and tenant.cache_arrays is not None
+                   and tenant.cache_epoch == self._epoch
+                   and tenant.cache_digest == digest
+                   and not tenant.dirty.any())
+        if hit:
+            from escalator_tpu.chaos import CHAOS
+
+            if CHAOS.should_fire("fleet_digest"):
+                hit = False
+        return digest, hit
+
+    def _cache_answer(self, r: DecideRequest, journeys: list
+                      ) -> FleetDecision:
+        """Serve one digest hit from the tenant's cached columns — no
+        entry, no batch slot, no device work. ``batch_size`` is 0: the
+        request rode no micro-batch."""
+        t = self._tenants[r.tenant_id]
+        self.cache_hits += 1
+        obs.journal.JOURNAL.event(
+            "fleet-cache-hit", tenant=r.tenant_id, now=int(r.now_sec))
+        return FleetDecision(
+            tenant_id=r.tenant_id, arrays=t.cache_arrays,
+            ordered=t.cache_ordered, batch_size=0, shard=t.shard,
+            cached=True, stages={"sink": journeys})
+
+    def _prepare_entry(self, pos: int, r, pending_free,
+                       digest: Optional[bytes] = None) -> _Entry:
         """Validate + stage one request: resolve its tenant (registering a
         new one / unregistering an evict), diff against the host twin, fold
         the dirty mask, ADOPT the new twins (rollback records kept), and
-        return the entry execute will slice."""
+        return the entry execute will slice. A delta-frame request skips
+        the positional diff entirely (:meth:`_prepare_delta_entry`)."""
         validate_tenant_id(r.tenant_id)
+        if getattr(r, "delta", None) is not None:
+            return self._prepare_delta_entry(pos, r)
         evict = isinstance(r, EvictRequest)
         registered = False
         if evict:
@@ -849,7 +1010,85 @@ class FleetEngine:
             new_secs=(new_p, new_n, new_g), now=now,
             pod_slots=pod_slots, node_slots=node_slots, dirty_mask=touched,
             tainted_any=tainted_any, evict=evict, registered=registered,
-            old_twins=old_twins, old_dirty=old_dirty, old_shapes=old_shapes)
+            old_twins=old_twins, old_dirty=old_dirty, old_shapes=old_shapes,
+            digest=digest)
+
+    def _prepare_delta_entry(self, pos: int, r: DecideRequest) -> _Entry:
+        """Stage one STREAMED request (round 18): scatter the client's
+        packed dirty drain straight into the tenant's live twin — the
+        changed-slot lists ARE the delta batch, so no O(cluster) positional
+        diff runs. The undo record is the gathered old rows (the twin
+        mutates in place; a later prep may swap the twin REFERENCES, but
+        in-order execution plus the depth-1 pipeline mean at most this one
+        staged batch can need unwinding, and its undo targets the arrays it
+        scattered into)."""
+        delta = r.delta
+        tenant = self._tenants.get(r.tenant_id)
+        if tenant is None:
+            raise TenantError(
+                f"tenant {r.tenant_id!r} sent a delta frame before any "
+                "full frame; send a full frame first")
+        G_c, P_c, N_c = (int(x) for x in delta.shapes)
+        if G_c > self._G or P_c > self._P or N_c > self._N:
+            raise TenantError(
+                f"delta frame shapes (G={G_c}, P={P_c}, N={N_c}) exceed "
+                f"the arena buckets (G={self._G}, P={self._P}, "
+                f"N={self._N}); arena growth requires a full frame")
+        pod_idx = np.asarray(delta.pod_idx, np.int64).ravel()
+        node_idx = np.asarray(delta.node_idx, np.int64).ravel()
+        for name, idx, cap in (("pod", pod_idx, self._P),
+                               ("node", node_idx, self._N)):
+            if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= cap):
+                raise TenantError(
+                    f"delta frame {name} slot out of range (bucket {cap})")
+        old_dirty = tenant.dirty
+        old_shapes = tenant.shapes
+        G = self._G
+        # undo = the old rows at the scattered slots, gathered BEFORE the
+        # scatter; plus the old groups reference when the section reloads
+        gather = lambda soa, idx: type(soa)(  # noqa: E731
+            **{f.name: np.array(np.asarray(getattr(soa, f.name))[idx])
+               for f in fields(soa)})
+        undo_p = gather(tenant.pods, pod_idx)
+        undo_n = gather(tenant.nodes, node_idx)
+        # dirty-group bookkeeping, identical superset rule to the diff
+        # path: groups the changed slots pointed at before OR after, plus
+        # every changed group row when the section reloads
+        touched = old_dirty.copy()
+        for soa, idx in ((tenant.pods, pod_idx), (tenant.nodes, node_idx)):
+            gids = np.asarray(soa.group)[idx]
+            touched[np.clip(gids, 0, G - 1)] = True
+        for vals, idx in ((delta.pod_vals, pod_idx),
+                          (delta.node_vals, node_idx)):
+            gids = np.asarray(vals.group)[: len(idx)]
+            touched[np.clip(gids, 0, G - 1)] = True
+        old_groups = None
+        if delta.groups is not None:
+            new_g = _repad_copy(delta.groups, G, _empty_groups)
+            touched[_changed_rows(tenant.groups, new_g)] = True
+            old_groups = tenant.groups
+            tenant.groups = new_g
+        # scatter the drain into the live twin (in place — the adopt)
+        for f in fields(tenant.pods):
+            np.asarray(getattr(tenant.pods, f.name))[pod_idx] = \
+                np.asarray(getattr(delta.pod_vals, f.name))[: len(pod_idx)]
+        for f in fields(tenant.nodes):
+            np.asarray(getattr(tenant.nodes, f.name))[node_idx] = \
+                np.asarray(getattr(delta.node_vals, f.name))[: len(node_idx)]
+        tenant.dirty = np.zeros(G, bool)
+        tenant.ticks += 1
+        tenant.shapes = (G_c, P_c, N_c)
+        tainted_any = bool((np.asarray(tenant.nodes.valid)
+                            & np.asarray(tenant.nodes.tainted)).any())
+        return _Entry(
+            pos=pos, request=r, tenant=tenant, shard=tenant.shard,
+            row=tenant.row, shapes=tenant.shapes,
+            new_secs=(tenant.pods, tenant.nodes, tenant.groups),
+            now=int(r.now_sec), pod_slots=pod_idx, node_slots=node_idx,
+            dirty_mask=touched, tainted_any=tainted_any, evict=False,
+            registered=False, old_twins=None, old_dirty=old_dirty,
+            old_shapes=old_shapes,
+            delta_undo=(pod_idx, undo_p, node_idx, undo_n, old_groups))
 
     def _assemble(self, entries: List[_Entry]) -> tuple:
         """Build the ``[S, T, …]`` batched operands: each entry lands in
@@ -979,11 +1218,15 @@ class FleetEngine:
                     # read AFTER _dispatch's host conversion blocked on the
                     # program: the window is device time, not dispatch time
                     pb.dispatch_t1 = time.monotonic()
+                    order_pending: list = []
                     with obs.span("fleet_unpack"):
                         for e in pb.entries:
                             results[e.pos] = self._finish(
-                                e, pb, out_host, len(pb.entries), ds,
-                                _kernel)
+                                e, pb, out_host, len(pb.entries),
+                                _kernel, order_pending)
+                    if order_pending:
+                        self._batched_order_tail(order_pending, _kernel)
+                    self._write_cache(pb)
                 self.batches += 1
                 obs.annotate(
                     tenants=[r.tenant_id for r in pb.requests],
@@ -1040,6 +1283,7 @@ class FleetEngine:
                     t.nodes = _empty_nodes(self._N)
                     t.groups = _empty_groups(self._G)
                     t.dirty = np.ones(self._G, bool)
+                    t.cache_digest, t.cache_arrays = None, None
                 self._epoch += 1
                 if self._staged is pb:
                     self._staged = None
@@ -1047,10 +1291,12 @@ class FleetEngine:
             raise
 
     def _finish(self, e: _Entry, pb: _PreparedBatch, out_host, batch_size,
-                ds, _kernel):
+                _kernel, order_pending: list):
         """Slice the entry's ``[shard, t]`` batch row back to its request's
-        shapes and run the per-tenant lazy-orders tail (ordered re-dispatch
-        when consumed)."""
+        shapes. An order-consuming tenant (tainted nodes exist / some group
+        scales down) is queued on ``order_pending`` — the batched tail
+        (:meth:`_batched_order_tail`) grafts its real orders in ONE extra
+        dispatch per micro-batch after the unpack loop."""
         if e.evict:
             # slot freeing happened at prep (visible to the next prepare);
             # the ack just confirms the zeroing dispatch went out
@@ -1065,60 +1311,105 @@ class FleetEngine:
                 sliced[f.name] = col[:G_c]
             else:
                 sliced[f.name] = col[:N_c]
-        needs_orders = e.tainted_any or bool(
-            (sliced["nodes_delta"] < 0).any())
-        ordered = False
-        tail_ms = 0.0
-        if needs_orders:
-            t_tail = time.monotonic()
-            sliced = self._ordered_redispatch(e, G_c, N_c, ds, _kernel)
-            tail_ms = (time.monotonic() - t_tail) * 1e3
-            ordered = True
         out = _kernel.DecisionArrays(**sliced)
         self.decisions += 1
-        return FleetDecision(
-            tenant_id=e.request.tenant_id, arrays=out, ordered=ordered,
+        dec = FleetDecision(
+            tenant_id=e.request.tenant_id, arrays=out, ordered=False,
             batch_size=batch_size, shard=e.shard,
             # journey raw material: the batch's fenced dispatch window,
-            # THIS tenant's ordered-tail cost (other tenants' tails land
-            # in the request's unpack stage — they are real wait time on
-            # this thread), and the record's journey sink
+            # the batched ordered-tail cost when this tenant consumed it
+            # (grafted below; other tenants' tail lands in the request's
+            # unpack stage — real wait time on this thread), and the
+            # record's journey sink
             stages={"dispatch_t0": pb.dispatch_t0,
                     "dispatch_t1": pb.dispatch_t1,
-                    "ordered_tail_ms": tail_ms,
+                    "ordered_tail_ms": 0.0,
                     "sink": pb.journeys})
+        if e.tainted_any or bool((sliced["nodes_delta"] < 0).any()):
+            order_pending.append((e, dec))
+        return dec
 
-    def _ordered_redispatch(self, e: _Entry, G_c, N_c, ds, _kernel):
-        """The lazy protocol's ordered tail for ONE tenant: gather its
-        resident row off its shard and run the full ordered decide fed its
-        maintained aggregates — windows bit-exact vs the tenant's
-        standalone ordered decide (invalid bucket lanes sort behind every
-        selected lane, so the leading windows are unchanged by the arena
-        padding)."""
-        with obs.span("fleet_ordered_redispatch", kind="device"), \
+    def _batched_order_tail(self, order_pending: list, _kernel) -> None:
+        """The lazy protocol's ordered tail for EVERY order-consuming
+        tenant of the micro-batch, as ONE fused dispatch (round 18 —
+        replaces the per-tenant ``fleet_shard_local`` + ordered
+        ``decide_jit`` re-dispatch, which paid an O(arena)-gather cost per
+        draining tenant): each shard vmaps the kernel's exact ordered
+        branch (``ops.order_tail`` keys + the single 4-key sort) over its
+        order-needing rows, fed the RESIDENT post-step nodes/groups/
+        aggregates — the same inputs the ordered re-dispatch read — so the
+        grafted ``untaint_order``/``scale_down_order`` are bit-identical
+        to a standalone ordered decide (every other field already is, per
+        ``decide``'s with_orders contract). Rows pad to the shared
+        ``kernel.fleet_order_bucket`` width with scratch-row no-ops, so
+        the jit cache keys on bucket shapes alone."""
+        t_tail = time.monotonic()
+        S, C = self._S, self._C
+        counts = [0] * S
+        for e, _dec in order_pending:
+            counts[e.shard] += 1
+        T2 = _kernel.fleet_order_bucket(max(counts), C + 1)
+        rows = np.full((S, T2), C, np.int32)
+        slot = [0] * S
+        placed = []
+        for e, dec in order_pending:
+            k = slot[e.shard]
+            slot[e.shard] += 1
+            rows[e.shard, k] = e.row
+            placed.append((e, dec, k))
+        with obs.span("fleet_order_tail", kind="device"), \
                 self._device_lock:
             pods, nodes, groups, aggs, _cols = self._state
-            # O(row) on the tenant's OWN shard device: a traced gather on
-            # the sharded axis would lower to an O(arena) SPMD program
-            local = ds.fleet_shard_local(
-                (pods, nodes, groups, aggs), e.shard)
-            cluster, aggs_row = ds._fleet_tenant_state_local(
-                *local, np.int32(e.row))
-            out = obs.fence(_kernel.decide_jit(
-                cluster, np.int64(e.now),
-                aggregates=_kernel.aggregates_tuple(aggs_row),
-                with_orders=True))
-        self.ordered_redispatches += 1
-        sliced = {}
-        for f in fields(_kernel.DecisionArrays):
-            col = np.asarray(getattr(out, f.name))
-            if f.name in ("untainted_offsets", "tainted_offsets"):
-                sliced[f.name] = col[: G_c + 1]
-            elif f.name in _kernel.GROUP_DECISION_FIELDS:
-                sliced[f.name] = col[:G_c]
-            else:
-                sliced[f.name] = col[:N_c]
-        return sliced
+            unt, sdn = self._order_tail_fn(nodes, groups, aggs, rows)
+            obs.fence((unt, sdn))
+            unt, sdn = np.asarray(unt), np.asarray(sdn)
+        tail_ms = (time.monotonic() - t_tail) * 1e3
+        self.tail_dispatches += 1
+        metrics.fleet_tail_batch_size.observe(len(order_pending))
+        from dataclasses import replace as _dc_replace
+
+        for e, dec, k in placed:
+            _G_c, _P_c, N_c = e.shapes
+            dec.arrays = _dc_replace(
+                dec.arrays,
+                untaint_order=unt[e.shard, k, :N_c],
+                scale_down_order=sdn[e.shard, k, :N_c])
+            dec.ordered = True
+            dec.stages["ordered_tail_ms"] = tail_ms
+            self.ordered_redispatches += 1
+
+    def _write_cache(self, pb: _PreparedBatch) -> None:
+        """Stash each served entry's answer on its tenant for the digest
+        fast path — AFTER the ordered tails grafted, so a cached answer
+        carries real orders. Copies the sliced columns (views would pin
+        the whole [S, T, …] batch output). Runs under ``_exec_lock``;
+        takes ``_host`` briefly (legal: _exec_lock → _host). Writing is
+        correct even when a pipelined prep already adopted newer twins for
+        the tenant: the cache maps (input digest / empty delta at now) →
+        answer, and decide's determinism makes that mapping globally
+        valid regardless of interleaving."""
+        updates = []
+        for e in pb.entries:
+            if e.evict:
+                continue
+            dec = pb.results[e.pos]
+            if not isinstance(dec, FleetDecision):
+                continue
+            arr = dec.arrays
+            copied = type(arr)(**{
+                f.name: np.array(getattr(arr, f.name))
+                for f in fields(arr)})
+            updates.append((e, dec, copied))
+        if not updates:
+            return
+        with self._host:
+            for e, dec, copied in updates:
+                t = e.tenant
+                t.cache_digest = e.digest
+                t.cache_now = e.now
+                t.cache_arrays = copied
+                t.cache_ordered = dec.ordered
+                t.cache_epoch = pb.epoch
 
     # -- the sequential convenience + release --------------------------------
 
@@ -1171,6 +1462,7 @@ class FleetEngine:
             t.dirty = e.old_dirty
             t.shapes = e.old_shapes
             t.ticks -= 1
+            t.cache_digest, t.cache_arrays = None, None
             self._tenants[tid] = t
             if t.row in self._free[t.shard]:
                 self._free[t.shard].remove(t.row)
@@ -1182,10 +1474,29 @@ class FleetEngine:
             self._free[e.shard].sort()
             return
         t = e.tenant
+        if e.delta_undo is not None:
+            # delta prep scattered in place: scatter the gathered old rows
+            # back (the reverse order of a reversed-entries unwind keeps
+            # later scatters from clobbering this one's restore)
+            pidx, undo_p, nidx, undo_n, old_groups = e.delta_undo
+            for f in fields(t.pods):
+                np.asarray(getattr(t.pods, f.name))[pidx] = \
+                    np.asarray(getattr(undo_p, f.name))
+            for f in fields(t.nodes):
+                np.asarray(getattr(t.nodes, f.name))[nidx] = \
+                    np.asarray(getattr(undo_n, f.name))
+            if old_groups is not None:
+                t.groups = old_groups
+            t.dirty = e.old_dirty
+            t.shapes = e.old_shapes
+            t.ticks -= 1
+            t.cache_digest, t.cache_arrays = None, None
+            return
         t.pods, t.nodes, t.groups = e.old_twins
         t.dirty = e.old_dirty
         t.shapes = e.old_shapes
         t.ticks -= 1
+        t.cache_digest, t.cache_arrays = None, None
 
     # -- self-audit ----------------------------------------------------------
 

@@ -115,6 +115,11 @@ class EventfulClient(InMemoryKubernetesClient):
             self._emit(WatchEvent("pod", DELETED, pod))
 
 
+#: provider_id stamped on placeholder Node objects seeded by a warm restore —
+#: never equal to any live node, so the first resync audit re-applies them
+_WARM_RESTORE_SENTINEL = "escalator-tpu://warm-restore-placeholder"
+
+
 @dataclass
 class GroupFilters:
     """One nodegroup's membership filters (from controller.node_group)."""
@@ -140,6 +145,10 @@ class WatchBridge:
         # after node deletion can never leave stale slot references
         self._pod_records: Dict[str, Tuple[int, int, int, str]] = {}  # uid -> (gi, cpu, mem, node_name)
         self._pods_on_node: Dict[str, set] = {}  # node name -> pod uids
+        # slot -> uid, the pod analogue of _node_slot_names (round 18): the
+        # snapshot key sidecar needs a per-slot key table so a warm restore
+        # can reproduce the ingestion-ordered slot layout byte-for-byte
+        self._pod_slot_uids: Dict[int, str] = {}
         self.events_applied = 0
         self.events_ignored = 0
 
@@ -183,7 +192,9 @@ class WatchBridge:
         uid = f"{pod.namespace}/{pod.name}"
         if event.type == DELETED:
             self._forget_pod(uid)
-            if self.store.delete_pod(uid) >= 0:
+            slot = self.store.delete_pod(uid)
+            if slot >= 0:
+                self._pod_slot_uids.pop(slot, None)
                 self.events_applied += 1
             return
         gi = self._pod_group(pod)
@@ -191,7 +202,9 @@ class WatchBridge:
             # not in any nodegroup (daemonset/static/unmatched): keep it out of
             # the store, and evict any stale prior version
             self._forget_pod(uid)
-            if self.store.delete_pod(uid) >= 0:
+            slot = self.store.delete_pod(uid)
+            if slot >= 0:
+                self._pod_slot_uids.pop(slot, None)
                 self.events_applied += 1
             else:
                 self.events_ignored += 1
@@ -204,7 +217,9 @@ class WatchBridge:
         node_slot = (
             self.store.node_slot(pod.node_name) if pod.node_name else -1
         )
-        self.store.upsert_pod(uid, gi, req.cpu_milli, req.mem_bytes, node_slot)
+        slot = self.store.upsert_pod(
+            uid, gi, req.cpu_milli, req.mem_bytes, node_slot)
+        self._pod_slot_uids[slot] = uid
         self.events_applied += 1
 
     def _rebind_pods(self, node_name: str, node_slot: int) -> None:
@@ -246,6 +261,7 @@ class WatchBridge:
                 taint_time = int(taint.value)
             except ValueError:
                 taint_time = None
+        prev_slot = self.store.node_slot(node.name)
         slot = self.store.upsert_node(
             node.name, gi, node.cpu_allocatable_milli, node.mem_allocatable_bytes,
             creation_ns=node.creation_time_ns,
@@ -258,14 +274,64 @@ class WatchBridge:
         )
         self._node_slot_names[slot] = node.name
         self.node_objects[node.name] = node
-        # heal pods that arrived before this node (or rebind after slot change)
-        self._rebind_pods(node.name, slot)
+        # heal pods that arrived before this node (prev_slot -1) or rebind
+        # after a slot change; a same-slot re-apply (resync audit, label-only
+        # node update, warm-restore re-apply) leaves its pods' rows clean —
+        # they are already bound to this slot, and re-upserting them would
+        # turn every node touch into an O(pods-on-node) dirty cascade
+        if slot != prev_slot:
+            self._rebind_pods(node.name, slot)
         self.events_applied += 1
 
     # -- lookups for executors -----------------------------------------------
     def node_at_slot(self, slot: int) -> Optional[k8s.Node]:
         name = self._node_slot_names.get(slot)
         return self.node_objects.get(name) if name is not None else None
+
+    # -- snapshot key sidecars (round 18: native warm restore) ----------------
+    def slot_key_tables(self) -> Tuple[List[str], List[str]]:
+        """Per-slot ``(pod_keys, node_keys)`` tables, ``""`` at free slots,
+        sized to the store capacities. Checkpointed alongside the decider
+        leaves so a restarted process can re-seed a fresh store in the
+        snapshot's exact slot order (slots assign freelist-then-sequential,
+        so ordered upserts on an empty store reproduce any layout). Caller
+        holds the store lock."""
+        pod_keys = [""] * self.store.pod_capacity
+        for slot, uid in self._pod_slot_uids.items():
+            pod_keys[slot] = uid
+        node_keys = [""] * self.store.node_capacity
+        for slot, name in self._node_slot_names.items():
+            node_keys[slot] = name
+        return pod_keys, node_keys
+
+    def seed_from_snapshot(self, pod_keys: List[str], node_keys: List[str],
+                           pods, nodes) -> None:
+        """Rebuild the bridge's record maps from a snapshot's host columns +
+        key sidecars, so the first :meth:`resync` audit compares live objects
+        against the CHECKPOINT baseline — an object unchanged since the
+        checkpoint skips its upsert and stays clean, leaving the first warm
+        tick's delta batch O(changed-since-checkpoint). Node objects get a
+        sentinel placeholder (no live node carries the sentinel provider_id,
+        and the dataclass equality includes it), so the first resync
+        re-applies every live node — N << P, cheap — while stale-node
+        deletion still works by name. Caller holds the store lock."""
+        for slot, name in enumerate(node_keys):
+            if not name:
+                continue
+            self._node_slot_names[slot] = name
+            self.node_objects[name] = k8s.Node(
+                name=name, provider_id=_WARM_RESTORE_SENTINEL)
+        for slot, uid in enumerate(pod_keys):
+            if not uid:
+                continue
+            node = int(pods.node[slot])
+            node_name = node_keys[node] if 0 <= node < len(node_keys) else ""
+            self._pod_records[uid] = (
+                int(pods.group[slot]), int(pods.cpu_milli[slot]),
+                int(pods.mem_bytes[slot]), node_name)
+            if node_name:
+                self._pods_on_node.setdefault(node_name, set()).add(uid)
+            self._pod_slot_uids[slot] = uid
 
     # -- re-list reconciliation (round 12) -----------------------------------
     def set_groups(self, groups: Sequence[GroupFilters],
@@ -323,7 +389,9 @@ class WatchBridge:
                           if uid not in live_pod_uids]
             for uid in stale_pods:
                 self._forget_pod(uid)
-                self.store.delete_pod(uid)
+                slot = self.store.delete_pod(uid)
+                if slot >= 0:
+                    self._pod_slot_uids.pop(slot, None)
             stale_nodes = [name for name in list(self.node_objects)
                            if name not in live_node_names]
             for name in stale_nodes:

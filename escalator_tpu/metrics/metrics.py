@@ -299,6 +299,24 @@ fleet_slo_budget_burn = Gauge(
     "a profiler capture)",
     ["klass"], namespace="escalator_tpu", registry=registry,
 )
+fleet_cache_hits = Counter(
+    "fleet_cache_hits_total",
+    "fleet decide requests answered from the per-tenant input-digest cache "
+    "(round 18) without entering the micro-batch: the request's packed "
+    "sections (or empty delta frame) hashed equal to the tenant's last "
+    "dispatched input at the same now_sec, so the persistent decision "
+    "columns answer bit-identically — the mostly-idle-fleet fast path",
+    ["klass"], namespace="escalator_tpu", registry=registry,
+)
+fleet_tail_batch_size = Histogram(
+    "fleet_tail_batch_size",
+    "order-consuming tenants repaired by ONE batched order-tail dispatch "
+    "after a fleet micro-batch (round 18; replaces the per-tenant 55 ms "
+    "O(arena) re-dispatch) — a p50 stuck at 1 under scale-down-heavy load "
+    "just means few tenants need orders per batch, not a regression",
+    namespace="escalator_tpu", registry=registry,
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
 fleet_class_p99_breach = Counter(
     "fleet_class_p99_breach_total",
     "per-priority-class SLO breach checks that found the class's RECENT "

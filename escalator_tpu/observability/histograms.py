@@ -295,7 +295,7 @@ TICKS = HistogramSet()
 #: bench asserts on them; everyone imports THIS tuple (hand-copies drift:
 #: a sixth stage added in one place would silently never render elsewhere)
 JOURNEY_STAGES = ("admission", "batch_assembly", "dispatch", "ordered_tail",
-                  "unpack")
+                  "unpack", "cached")
 
 #: fleet request-journey stage series keyed (class, stage) — fed from the
 #: scheduler's respond-side journey bookkeeping (round 17), NOT from the

@@ -126,6 +126,8 @@ def _journey_events(rec: Dict[str, Any], pid: int,
             stages = j.get("stages_ms") or {}
             cursor = t_enq
             for stage in JOURNEY_STAGE_ORDER:
+                if stage not in stages:
+                    continue   # unrecorded stage ("cached" on miss paths)
                 dur_us = float(stages.get(stage, 0.0)) * 1e3
                 if stage == "ordered_tail" and dur_us <= 0:
                     continue   # most tenants never sort: keep tracks clean

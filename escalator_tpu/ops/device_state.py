@@ -499,6 +499,93 @@ def make_fleet_step_sharded(mesh):
     return fn
 
 
+def _fleet_order_tail_core(nodes, groups, aggs, tenant_rows):
+    """Batched lazy-order repair (round 18): for the ``T2`` tenant rows in
+    ``tenant_rows`` (pad entries = the scratch row ``C``), recompute the
+    two order permutations of :func:`kernel.decide`'s ordered branch —
+    ``ops.order_tail.node_selection_masks`` + the single 4-key
+    ``combined_order_sort`` + the tainted-block roll — vmapped over the
+    rows, fed the RESIDENT post-step nodes/groups/aggregates. This is
+    literally the ordered-vs-light field difference: ``decide``'s
+    with_orders contract says every field EXCEPT ``untaint_order``/
+    ``scale_down_order`` is bit-identical between the two programs, so
+    grafting these two columns over the light batch output reproduces the
+    full ordered decide bit-for-bit (the victim primary reads the same
+    maintained ``node_pods_remaining`` the ordered re-dispatch fed through
+    ``aggregates_tuple``; ``jnp.sum(tainted_sel)`` equals the
+    ``tainted_offsets[G]`` roll amount by construction).
+
+    Returns ``(untaint_order, scale_down_order)`` int32 ``[T2, N+1]``.
+    Read-only — no donation: the arenas stay resident."""
+    from escalator_tpu.ops.order_tail import (
+        combined_order_sort,
+        node_selection_masks,
+    )
+
+    G = groups.valid.shape[-1]
+    nodes_T = tree_util.tree_map(lambda a: a[tenant_rows], nodes)
+    empt_T = groups.emptiest[tenant_rows]
+    npr_T = aggs.node_pods_remaining[tenant_rows]
+
+    def one(n, empt, npr):
+        ngroup, untainted_sel, tainted_sel = node_selection_masks(
+            n.valid, n.group, n.tainted, n.cordoned)
+        victim_primary = jnp.where(empt[ngroup], npr, jnp.int64(0))
+        N = n.valid.shape[0]
+        # the same variance tie as decide(): under shard_map the sorted
+        # branch is device-varying and cond requires both branches to match
+        trivial = jnp.arange(N, dtype=jnp.int32) + ngroup.astype(jnp.int32) * 0
+
+        def _combined(_):
+            iota = jax.lax.iota(jnp.int64, N)
+            _, perm = combined_order_sort(
+                ngroup, tainted_sel, untainted_sel, victim_primary,
+                n.creation_ns, G, iota)
+            return perm.astype(jnp.int32)
+
+        untaint = jax.lax.cond(
+            jnp.any(untainted_sel | tainted_sel), _combined,
+            lambda _: trivial, None)
+        scale_down = jnp.roll(untaint, -jnp.sum(tainted_sel))
+        return untaint, scale_down
+
+    return jax.vmap(one)(nodes_T, empt_T, npr_T)
+
+
+_fleet_order_tail_sharded_cache: dict = {}
+
+
+def make_fleet_order_tail_sharded(mesh):
+    """:func:`_fleet_order_tail_core` partitioned over the fleet mesh: each
+    shard repairs ITS order-needing rows (``tenant_rows [S, T2]``, scratch-
+    row pads) against its own arena slice — zero collectives, like the
+    fleet step (jaxlint pins the 0-psum budget on the
+    ``device_state.fleet_order_tail_sharded`` entry). ONE dispatch per
+    micro-batch replaces the per-tenant ``fleet_shard_local`` + ordered
+    ``decide_jit`` re-dispatch (55 ms O(arena) per draining tenant at the
+    cfg17 arena). No donation: the tail only READS the resident arenas.
+    Cached per mesh, same key policy as :func:`make_fleet_step_sharded`."""
+    key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+    fn = _fleet_order_tail_sharded_cache.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec
+
+        from escalator_tpu.jaxconfig import shard_map
+
+        def per_shard(*args):
+            local = tree_util.tree_map(lambda a: a[0], args)
+            out = _fleet_order_tail_core(*local)
+            return tree_util.tree_map(lambda a: a[None], out)
+
+        spec = PartitionSpec(mesh.axis_names[0])
+        body = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=tuple([spec] * 4), out_specs=spec)
+        fn = jax.jit(body)
+        _fleet_order_tail_sharded_cache[key] = fn
+    return fn
+
+
 def fleet_shard_local(tree, shard: int):
     """The per-device block of a ``[S, …]``-sharded arena tree for mesh
     row ``shard``: zero-copy references to the committed per-device

@@ -988,6 +988,20 @@ def fleet_dirty_bucket(widest: int, G: int,
     return max(bucket, int(widest))
 
 
+def fleet_order_bucket(widest: int, rows: int, min_bucket: int = 1) -> int:
+    """The :func:`fleet_dirty_bucket` policy applied to the ORDER-NEEDING
+    tenant axis (round 18 batched order tails): the busiest shard's
+    order-consuming tenant count, rounded to a power of two, floored at
+    ``min_bucket`` and capped at ``rows`` (the shard's tenant rows + the
+    scratch row, which pads the bucket with bitwise-inert no-ops). One
+    place, shared by the engine and the jaxlint fixture, so the batched
+    order-repair program compiles a handful of widths as drain pressure
+    fluctuates — never one shape per batch."""
+    bucket = min(int(rows),
+                 max(min_bucket, 1 << max(int(widest) - 1, 0).bit_length()))
+    return max(bucket, int(widest))
+
+
 def fleet_dirty_indices(dirty_masks, G: int, min_bucket: int = _MIN_DIRTY_BUCKET):
     """Per-tenant dirty-row compaction into ONE shared ``[T, D]`` bucket:
     the fleet analog of :func:`dirty_indices`, padded to the widest

@@ -359,9 +359,18 @@ class SnapshotWriter:
             kind="host")
         os.makedirs(directory, exist_ok=True)
 
-    def maybe_checkpoint(self, inc, force: bool = False) -> bool:
+    def maybe_checkpoint(self, inc, force: bool = False, extra=None) -> bool:
         """Checkpoint when the cadence says so (or ``force``). Returns True
-        when a checkpoint was STARTED this call."""
+        when a checkpoint was STARTED this call.
+
+        ``extra`` is an optional zero-arg callable returning additional
+        ``{name: np.ndarray}`` leaves merged into the snapshot — evaluated
+        only when a checkpoint actually starts, so a caller can attach
+        sidecar state (e.g. the native backend's slot->key tables, which
+        make warm restore possible on an ingestion-ordered store) without
+        paying its build cost on every tick. Sidecar names must not collide
+        with the decider's own leaves; a prefix like ``store.`` keeps them
+        out of :func:`leaves_to_state`'s required set."""
         self._ticks_seen += 1
         if not force and (
                 self.every <= 0 or self._ticks_seen % self.every != 0):
@@ -370,6 +379,8 @@ class SnapshotWriter:
         if state is None:   # nothing decided yet: nothing worth persisting
             return False
         leaves, meta = state
+        if extra is not None:
+            leaves = {**leaves, **extra()}
         self._submit(leaves, meta)
         return True
 

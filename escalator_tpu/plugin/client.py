@@ -318,6 +318,128 @@ class ComputeClient:
         self._channel.close()
 
 
+class FleetStreamSession:
+    """Client-side streaming ingestion for ONE fleet tenant (round 18).
+
+    Holds a state-store twin (``native.statestore.make_state_store`` — the
+    same store the event-driven backend ingests watches into) of the
+    tenant's cluster; callers apply their watch events to ``.store``
+    (``upsert_pod`` / ``delete_node`` / batch variants) and call
+    :meth:`decide`. The first decide — and any decide after the store grew
+    (``generation`` changed) or an RPC failed — ships a FULL cluster frame
+    (registering/resyncing the tenant server-side, byte-identical to the
+    non-streaming path); every other decide ships only the packed dirty
+    drain as a delta frame (``codec.encode_delta``), so the wire and the
+    server's host work are O(churn) instead of O(arena). Group options ride
+    along only when :meth:`set_groups` marked them dirty.
+
+    NOT thread-safe (one session = one tenant's synchronous decide loop,
+    exactly like a controller tick). Against an OLD server a delta frame
+    fails loudly with the codec's named missing-array error — resync then
+    pins the session to full frames one failure at a time, so a
+    mixed-version fleet degrades to the diff path instead of wrong answers.
+    """
+
+    def __init__(self, client: ComputeClient, tenant_id: str,
+                 pod_capacity: int = 1 << 12, node_capacity: int = 1 << 10,
+                 store_kind: str = "auto", klass: Optional[str] = None):
+        from escalator_tpu.native.statestore import make_state_store
+
+        self.client = client
+        self.tenant_id = tenant_id
+        self.klass = klass
+        self.store = make_state_store(
+            pod_capacity=pod_capacity, node_capacity=node_capacity,
+            kind=store_kind)
+        self._groups = None
+        self._groups_dirty = True
+        #: store generation the server last saw a FULL frame for; None
+        #: forces a full frame (first contact, post-error resync)
+        self._synced_generation: "int | None" = None
+        #: full frames / delta frames sent (bench + test surface)
+        self.full_frames = 0
+        self.delta_frames = 0
+
+    def set_groups(self, groups) -> None:
+        """(Re)load the tenant's group options (a ``GroupArrays``). The next
+        decide ships them — as part of the full frame, or as the delta
+        frame's optional ``g.`` section (which invalidates the server's
+        digest cache: a group reload MUST miss, test-locked)."""
+        self._groups = groups
+        self._groups_dirty = True
+
+    def _trim(self, idx, vals, capacity: int):
+        """Drop the drain's pad lanes (pad idx == capacity) before encode:
+        the wire carries only real entries, and the server validates every
+        slot against the tenant's logical widths."""
+        from dataclasses import fields as dfields
+
+        keep = idx < capacity
+        if keep.all():
+            return idx, vals
+        return idx[keep], type(vals)(**{
+            f.name: getattr(vals, f.name)[keep] for f in dfields(vals)})
+
+    def decide(self, now_sec: int,
+               span_ctx: Optional[dict] = None,
+               max_attempts: Optional[int] = None):
+        """One streamed decide: ``(decision, server_phases, fleet_meta)``,
+        exactly :meth:`ComputeClient.decide_arrays_fleet`'s contract. Any
+        transport/application error marks the session for a full-frame
+        resync (the server may have rolled the delta back, or never seen
+        it) and re-raises."""
+        from escalator_tpu.core.arrays import ClusterArrays
+
+        if self._groups is None:
+            raise ValueError(
+                "FleetStreamSession.set_groups must run before decide "
+                "(the tenant frame needs a group-options section)")
+        tenant: dict = {"id": self.tenant_id}
+        if self.klass is not None:
+            tenant["class"] = self.klass
+        pods, nodes = self.store.as_pod_node_arrays()
+        shapes = (len(self._groups.valid), self.store.pod_capacity,
+                  self.store.node_capacity)
+        try:
+            if self._synced_generation != self.store.generation:
+                # first contact, growth, or resync: the full frame both
+                # (re)registers the tenant and rebases the server twin;
+                # drain the dirty sets so the next delta is post-full only
+                frame = codec.encode_cluster(
+                    ClusterArrays(groups=self._groups, pods=pods,
+                                  nodes=nodes),
+                    now_sec, span_ctx=span_ctx, tenant=tenant)
+                self.store.drain_dirty()
+                self.full_frames += 1
+            else:
+                pidx, pvals, nidx, nvals = self.store.drain_dirty_packed()
+                pidx, pvals = self._trim(pidx, pvals, self.store.pod_capacity)
+                nidx, nvals = self._trim(nidx, nvals, self.store.node_capacity)
+                frame = codec.encode_delta(
+                    now_sec, shapes, pidx, pvals, nidx, nvals,
+                    groups=self._groups if self._groups_dirty else None,
+                    span_ctx=span_ctx, tenant=tenant)
+                self.delta_frames += 1
+            resp = self.client._decide_with_retry(
+                frame, max_attempts=max_attempts)
+        except Exception:
+            self._synced_generation = None
+            self._groups_dirty = True
+            raise
+        self._synced_generation = self.store.generation
+        self._groups_dirty = False
+        return codec.decode_decision_full(resp)
+
+    def evict(self) -> dict:
+        """Deregister the tenant server-side; the session then needs a full
+        frame again (and the server's digest cache for a recycled id starts
+        empty — an evict→re-register MUST miss, test-locked)."""
+        ack = self.client.evict_tenant(self.tenant_id)
+        self._synced_generation = None
+        self._groups_dirty = True
+        return ack
+
+
 class GrpcBackend(ComputeBackend):
     """ComputeBackend over the plugin service, with automatic local fallback
     behind the retry ladder and a consecutive-failure circuit breaker."""

@@ -45,6 +45,20 @@ _SPANS_KEY = "__spans__"
 _TENANT_KEY = "__tenant__"
 _FLEET_KEY = "__fleet__"
 
+#: Tenant delta-frame sidecar (round 18). A streaming client that keeps a
+#: state-store twin of its cluster sends, after the first full frame, only
+#: the packed dirty drain: ``__delta__`` (msgpack ``{"shapes": [G, P, N]}``
+#: — the logical section widths the slots index into) plus ``dp.idx`` /
+#: ``dp.<field>`` (pod scatter batch) and ``dn.idx`` / ``dn.<field>``
+#: (node scatter batch), with an OPTIONAL full ``g.`` section when group
+#: options changed. Mixed-version behavior is deliberate and documented:
+#: a delta frame has no ``p.``/``n.`` sections, so an OLD server raises
+#: its existing named missing-array ValueError ("frame is missing required
+#: array 'p.group' ...") — a loud incompatible-revision signal, never a
+#: silent wrong answer — and untagged full frames stay byte-identical
+#: (test-locked), so non-streaming tenants are unaffected.
+_DELTA_KEY = "__delta__"
+
 #: Fields added to the wire format after v1 frames shipped, with the default a
 #: decoder must assume when a peer's frame predates them. Keyed by frame array
 #: name; the value is (dtype, fill) — the array is materialised against the
@@ -137,6 +151,37 @@ def encode_cluster(cluster: ClusterArrays, now_sec: int,
     return _encode_arrays(named)
 
 
+def encode_delta(now_sec: int, shapes: Tuple[int, int, int],
+                 pod_idx: np.ndarray, pod_vals: PodArrays,
+                 node_idx: np.ndarray, node_vals: NodeArrays,
+                 groups: Optional[GroupArrays] = None,
+                 span_ctx: Optional[Dict[str, Any]] = None,
+                 tenant: Optional[Dict[str, Any]] = None) -> bytes:
+    """Encode a tenant delta frame (see ``_DELTA_KEY``): the packed dirty
+    drain of a client-side state-store twin instead of the full cluster.
+    ``shapes`` is ``(G, P, N)`` — the logical widths the server validates
+    the scatter slots against (growth past the server's buckets requires a
+    full frame). ``groups`` rides along only when group options changed;
+    omitting it means "groups unchanged since my last frame"."""
+    named = [("__now__", np.array([now_sec], np.int64))]
+    if span_ctx:
+        named.append((_SPAN_CTX_KEY, _msgpack_array(span_ctx)))
+    if tenant:
+        named.append((_TENANT_KEY, _msgpack_array(tenant)))
+    named.append((_DELTA_KEY, _msgpack_array(
+        {"shapes": [int(s) for s in shapes]})))
+    named.append(("dp.idx", np.asarray(pod_idx, np.int32)))
+    for f in fields(pod_vals):
+        named.append(("dp." + f.name, getattr(pod_vals, f.name)))
+    named.append(("dn.idx", np.asarray(node_idx, np.int32)))
+    for f in fields(node_vals):
+        named.append(("dn." + f.name, getattr(node_vals, f.name)))
+    if groups is not None:
+        for f in fields(groups):
+            named.append(("g." + f.name, getattr(groups, f.name)))
+    return _encode_arrays(named)
+
+
 def _section(arrays: Dict[str, np.ndarray], prefix: str, cls):
     """Build one SoA section, filling documented defaults for fields an older
     peer's frame predates (see _OPTIONAL_DEFAULTS). A missing field with no
@@ -209,6 +254,60 @@ def decode_cluster_full(
     p = _section(arrays, "p.", PodArrays)
     n = _section(arrays, "n.", NodeArrays)
     return ClusterArrays(groups=g, pods=p, nodes=n), now_sec, span_ctx, tenant
+
+
+def decode_request_full(
+    data: bytes,
+) -> Tuple[Optional[ClusterArrays], int, Optional[Dict[str, Any]],
+           Optional[Dict[str, Any]], Optional[Dict[str, Any]]]:
+    """:func:`decode_cluster_full` generalised to BOTH request frame kinds
+    (round 18): returns ``(cluster, now_sec, span_ctx, tenant, delta)``
+    where exactly one of ``cluster`` / ``delta`` is non-None. ``delta`` is
+    a dict — ``{"shapes": (G, P, N), "pod_idx", "pod_vals": PodArrays,
+    "node_idx", "node_vals": NodeArrays, "groups": GroupArrays | None}``
+    — mirroring ``fleet.service.DeltaFrame``; the server owns turning it
+    into one (and rejecting deltas when fleet mode is off), the same way
+    it owns tenant validation. A torn ``__delta__`` sidecar is a hard
+    named error, not a fallback: silently decoding a delta frame as a
+    (sectionless) full frame would hand the engine an empty cluster."""
+    arrays = _decode_arrays(data)
+    now_sec = int(arrays.pop("__now__")[0])
+    span_ctx = _unpack_sidecar(arrays, _SPAN_CTX_KEY)
+    raw_tenant = arrays.get(_TENANT_KEY)
+    if raw_tenant is None:
+        tenant = None
+    else:
+        try:
+            tenant = msgpack.unpackb(raw_tenant.tobytes())
+        except Exception:  # noqa: BLE001 - torn sidecar: present but invalid
+            tenant = {"id": None}
+    raw_delta = arrays.get(_DELTA_KEY)
+    if raw_delta is None:
+        g = _section(arrays, "g.", GroupArrays)
+        p = _section(arrays, "p.", PodArrays)
+        n = _section(arrays, "n.", NodeArrays)
+        return (ClusterArrays(groups=g, pods=p, nodes=n), now_sec, span_ctx,
+                tenant, None)
+    try:
+        meta = msgpack.unpackb(raw_delta.tobytes())
+        shapes = tuple(int(s) for s in meta["shapes"])
+        assert len(shapes) == 3
+    except Exception as e:  # noqa: BLE001 - torn delta header is fatal
+        raise ValueError(
+            "frame carries a torn __delta__ sidecar (cannot fall back to "
+            "full-frame decode: a delta frame has no p./n. sections)"
+        ) from e
+    groups = (_section(arrays, "g.", GroupArrays)
+              if any(k.startswith("g.") for k in arrays) else None)
+    delta = {
+        "shapes": shapes,
+        "pod_idx": arrays["dp.idx"],
+        "pod_vals": _section(arrays, "dp.", PodArrays),
+        "node_idx": arrays["dn.idx"],
+        "node_vals": _section(arrays, "dn.", NodeArrays),
+        "groups": groups,
+    }
+    return None, now_sec, span_ctx, tenant, delta
 
 
 def encode_decision(out, span_phases: Optional[List[Dict[str, Any]]] = None,

@@ -116,6 +116,10 @@ def _journey_span_phases(journey: dict) -> list:
     from escalator_tpu.observability.histograms import JOURNEY_STAGES
 
     for stage in JOURNEY_STAGES:
+        if stage not in stages:
+            # a journey records only the stages it ran ("cached" appears
+            # solely on cache-hit answers) — don't ship phantom phases
+            continue
         ms = float(stages.get(stage, 0.0))
         phases.append({
             "name": stage, "path": f"journey/{stage}", "ms": round(ms, 4),
@@ -198,8 +202,23 @@ class _ComputeService:
 
     def decide(self, request: bytes, context) -> bytes:
         t0 = time.perf_counter()
-        cluster, now_sec, span_ctx, tenant = codec.decode_cluster_full(request)
+        cluster, now_sec, span_ctx, tenant, delta = (
+            codec.decode_request_full(request))
         t_decode = time.perf_counter() - t0
+        if delta is not None:
+            # streaming tenants only (round 18): a delta frame indexes into
+            # server-side per-tenant state, which exists nowhere but the
+            # fleet engine — on a fleet-disabled server (or without a
+            # tenant to look the state up under) it has no meaning, so
+            # reject loudly rather than decide on an empty cluster
+            if self._fleet is None or tenant is None:
+                metrics.fleet_admission_rejects.labels("invalid-tenant").inc()
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "delta frames require a fleet-mode server and a tenant "
+                    "sidecar (send full frames to this endpoint)")
+            return self._fleet_decide(None, now_sec, tenant, context,
+                                      delta=delta)
         if tenant is not None and self._fleet is not None:
             return self._fleet_decide(cluster, now_sec, tenant, context)
         # no tenant sidecar (mixed-version peer), or fleet mode off: the
@@ -232,24 +251,34 @@ class _ComputeService:
             return resp
 
     def _fleet_decide(self, cluster, now_sec: int, tenant: dict,
-                      context) -> bytes:
+                      context, delta: "dict | None" = None) -> bytes:
         """One tenant's decide through the continuous batcher. Validation
         runs HERE, before anything queues: a malformed tenant id aborts
         this RPC alone (INVALID_ARGUMENT) and the batch it would have
-        ridden in never sees it."""
-        from escalator_tpu.fleet import AdmissionError, TenantError
+        ridden in never sees it. ``delta`` (a ``codec.decode_request_full``
+        delta dict) replaces ``cluster`` for streaming tenants — the
+        engine applies the packed drain to its resident twin instead of
+        diffing a full repack."""
+        from escalator_tpu.fleet import AdmissionError, DeltaFrame, TenantError
 
         if not isinstance(tenant, dict):
             metrics.fleet_admission_rejects.labels("invalid-tenant").inc()
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           "tenant sidecar must be a msgpack map")
+        frame = None
+        if delta is not None:
+            frame = DeltaFrame(
+                shapes=delta["shapes"], pod_idx=delta["pod_idx"],
+                pod_vals=delta["pod_vals"], node_idx=delta["node_idx"],
+                node_vals=delta["node_vals"], groups=delta["groups"])
         try:
             if tenant.get("evict"):
                 fut = self._fleet.evict(tenant.get("id"))
             else:
                 fut = self._fleet.submit(tenant.get("id"), cluster,
                                          int(now_sec),
-                                         klass=tenant.get("class"))
+                                         klass=tenant.get("class"),
+                                         delta=frame)
         except TenantError as e:
             metrics.fleet_admission_rejects.labels("invalid-tenant").inc()
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -284,6 +313,9 @@ class _ComputeService:
             "tenant": result.tenant_id,
             "batch_size": int(result.batch_size),
             "shard": int(result.shard),
+            # digest fast path (round 18): True when this answer came from
+            # the per-tenant decision cache without entering the micro-batch
+            "cached": bool(getattr(result, "cached", False)),
         }
         # journey propagation (round 17): the server-side journey rides the
         # response both as structured data (the fleet sidecar, for

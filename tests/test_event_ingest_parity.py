@@ -431,3 +431,121 @@ def test_predrain_pending_batches_apply_next_tick():
     for g, gd in enumerate(results):
         assert gd.decision.nodes_delta == int(want[g]), g
     assert not backend._pending_batches
+
+
+# ------------------------------------------------- warm restore (round 18)
+def _stream_opts():
+    return [
+        ngmod.NodeGroupOptions(
+            name=v, label_key="customer", label_value=v,
+            cloud_provider_group_name=f"{v}-asg", min_nodes=0, max_nodes=100,
+            taint_upper_capacity_threshold_percent=45,
+            taint_lower_capacity_threshold_percent=30,
+            scale_up_threshold_percent=70,
+            slow_node_removal_rate=1, fast_node_removal_rate=2,
+            soft_delete_grace_period="5m", hard_delete_grace_period="15m",
+            scale_up_cool_down_period="10m",
+        )
+        for v in GROUPS
+    ]
+
+
+def _attach_stream(client, snapdir):
+    from escalator_tpu.controller.backend import IncrementalJaxBackend
+
+    backend = IncrementalJaxBackend(
+        refresh_every=0, snapshot_dir=snapdir, snapshot_every=1)
+    backend.attach_event_source(client, _stream_opts(), pod_capacity=256,
+                                node_capacity=64, store_kind="numpy")
+    return backend
+
+
+def test_streaming_warm_restore_parity(tmp_path):
+    """Round-18 regression for the PR-7/round-11 caveat: after a snapshot
+    restore, attach_event_source seeds the store twin from the checkpoint's
+    slot-key sidecar instead of falling back to the O(cluster) repack/replay
+    bootstrap — the restored process adopts the device state (no rebuild on
+    its first tick), its resync marks only objects that changed while no
+    leader ran, and every post-restore streamed decision stays parity-exact
+    with a cold re-list reference."""
+    from escalator_tpu.controller.backend import IncrementalJaxBackend
+
+    snapdir = str(tmp_path / "snaps")
+    client = make_world()
+    configs = make_configs(2)
+    states = [sem.GroupState() for _ in range(2)]
+    gi = [([], [], configs[g], states[g]) for g in range(2)]
+    now = 1_700_000_000
+
+    first = _attach_stream(client, snapdir)
+    for t in range(3):
+        first.decide(gi, now + t)
+    first._stream._writer.drain()
+    assert first._stream._writer.checkpoints >= 1
+
+    # the world moves while no leader runs: one changed pod, one new pod,
+    # one deleted node (its pods must rebind to slot -1 on resync)
+    client.update_pod(pod("alpha-p0", "alpha", cpu=1500, node="alpha-n0"))
+    client.add_pod(pod("beta-late", "beta", cpu=2000))
+    client.delete_node("beta-n3")
+
+    second = _attach_stream(client, snapdir)
+    stream = second._stream
+    assert stream._cache is not None, "warm restore did not adopt the state"
+    adopted = stream._cache
+    # the resync folded ONLY the changed objects into the first delta batch:
+    # 2 changed pods + the deleted node's rebinds, plus every live node
+    # (seeded node objects are sentinels; N << P) — NOT the whole pod world
+    assert stream.store.pod_dirty_count <= 8
+    repack = IncrementalJaxBackend(refresh_every=0)
+    states_b = [sem.GroupState() for _ in range(2)]
+    for t in range(3, 6):
+        if t == 4:
+            client.add_pod(pod("alpha-post", "alpha", cpu=900,
+                               node="alpha-n1"))
+        got = second.decide(gi, now + t)
+        gi_obj = relist_group_inputs(
+            client, make_filters(), configs, states_b)
+        want = repack.decide(gi_obj, now + t)
+        for gd_got, gd_want in zip(got, want, strict=True):
+            assert gd_got.decision.status == gd_want.decision.status, t
+            assert (gd_got.decision.nodes_delta
+                    == gd_want.decision.nodes_delta), t
+            assert (gd_got.decision.num_pods
+                    == gd_want.decision.num_pods), t
+    assert stream._cache is adopted, "first warm tick rebuilt instead of adopting"
+
+
+def test_streaming_warm_restore_sidecar_missing_cold_starts(tmp_path):
+    """A checkpoint written without the slot-key sidecar (pre-round-18
+    writer) cannot replay the store layout: the stream must cold-start —
+    loudly, not silently wrong — and still decide parity-exact."""
+    from escalator_tpu.ops import snapshot as snaplib
+
+    snapdir = str(tmp_path / "snaps")
+    client = make_world()
+    configs = make_configs(2)
+    states = [sem.GroupState() for _ in range(2)]
+    gi = [([], [], configs[g], states[g]) for g in range(2)]
+
+    first = _attach_stream(client, snapdir)
+    first.decide(gi, 1_700_000_000)
+    first._stream._writer.drain()
+    path = first._stream._writer.path
+    leaves, meta = snaplib.read_snapshot(path)
+    assert "store.keys" in leaves, "checkpoint lost its slot-key sidecar"
+    del leaves["store.keys"]
+    snaplib.write_snapshot(path, leaves, meta)
+
+    second = _attach_stream(client, snapdir)
+    assert second._stream._cache is None   # cold bootstrap
+    got = second.decide(gi, 1_700_000_060)
+    repack_gi = relist_group_inputs(
+        client, make_filters(), configs,
+        [sem.GroupState() for _ in range(2)])
+    from escalator_tpu.controller.backend import IncrementalJaxBackend
+
+    want = IncrementalJaxBackend(refresh_every=0).decide(
+        repack_gi, 1_700_000_060)
+    for gd_got, gd_want in zip(got, want, strict=True):
+        assert gd_got.decision.nodes_delta == gd_want.decision.nodes_delta

@@ -320,6 +320,8 @@ def test_engine_randomized_multi_tenant_soak(num_shards):
     eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
                       max_tenants=2, num_shards=num_shards)
     world: dict = {}
+    resident: dict = {}
+    pyrng18 = random.Random(180 + num_shards)
     next_id = 0
     # 9 ticks is the fewest that still covers ALL lifecycle paths with this
     # seed: 5 registrations, 3 evicts, and one 4x-node-bucket tenant (the
@@ -341,22 +343,69 @@ def test_engine_randomized_multi_tenant_soak(num_shards):
                     G, P, N * 4, seed=100 + next_id)
             else:
                 world[tid] = tiny_cluster(100 + next_id)
-        # content churn on every live tenant, fresh arrays per tick
+        # content churn on every live tenant, fresh arrays per tick.
+        # round 18: resident tenants randomly ship the churn as a DELTA
+        # frame (the streaming-ingestion wire form — changed rows only)
+        # instead of a full frame; the parity contract is identical.
+        # ``resident`` tracks the content the engine last acknowledged per
+        # tenant (the twin the delta applies against); separate rngs so the
+        # pre-round-18 lifecycle draw sequence is untouched.
         for tid in sorted(world):
             c = world[tid]
             fresh = type(c)(groups=_copy_soa(c.groups),
                             pods=_copy_soa(c.pods),
                             nodes=_copy_soa(c.nodes))
             world[tid] = mutate(fresh, rng)
-            reqs.append(DecideRequest(tid, world[tid], now))
+            prev = resident.get(tid)
+            if (prev is not None and pyrng18.random() < 0.5
+                    and _shapes_of(prev) == _shapes_of(world[tid])):
+                reqs.append(DecideRequest(
+                    tid, None, now, delta=_delta_from(prev, world[tid])))
+            else:
+                reqs.append(DecideRequest(tid, world[tid], now))
         results = eng.step(reqs)
         for r, res in zip(reqs, results, strict=True):
             if isinstance(r, EvictRequest):
                 assert isinstance(res, EvictAck)
+                resident.pop(r.tenant_id, None)
             else:
-                assert_column_parity(res.arrays, r.cluster, now,
+                assert_column_parity(res.arrays, world[r.tenant_id], now,
                                      msg=f"soak tick {tick} {r.tenant_id}")
+                resident[r.tenant_id] = world[r.tenant_id]
+        # round-18 digest fast path: re-ask every tenant the SAME question
+        # (a repeated full frame or an empty delta) at the same now — the
+        # answer must be bit-equal to this tick's dispatch whether it came
+        # from the cache or (chaos-forced miss on tick 4) a re-dispatch
+        if pyrng18.random() < 0.8:
+            if tick == 4:
+                from escalator_tpu.chaos import CHAOS
+
+                CHAOS.arm("fleet_digest", times=1)
+            try:
+                reqs2, expect = [], []
+                for r, res in zip(reqs, results, strict=True):
+                    if isinstance(r, EvictRequest):
+                        continue
+                    tid = r.tenant_id
+                    if pyrng18.random() < 0.5:
+                        reqs2.append(DecideRequest(tid, world[tid], now))
+                    else:
+                        reqs2.append(DecideRequest(
+                            tid, None, now,
+                            delta=_delta_from(world[tid], world[tid])))
+                    expect.append(res)
+                for res2, res1 in zip(eng.step(reqs2), expect, strict=True):
+                    for f in kernel.GROUP_DECISION_FIELDS:
+                        np.testing.assert_array_equal(
+                            np.asarray(getattr(res2.arrays, f)),
+                            np.asarray(getattr(res1.arrays, f)),
+                            err_msg=f"cached tick {tick} "
+                                    f"{res1.tenant_id}:{f}")
+            finally:
+                if tick == 4:
+                    CHAOS.disarm("fleet_digest")
     assert eng.audit() == [], "maintained fleet aggregates diverged"
+    assert eng.cache_hits > 0, "the soak never exercised the digest cache"
 
 
 def _copy_soa(soa):
@@ -364,6 +413,172 @@ def _copy_soa(soa):
 
     return type(soa)(**{f.name: np.array(getattr(soa, f.name))
                         for f in fields(soa)})
+
+
+def _shapes_of(cluster) -> tuple:
+    return (int(cluster.groups.valid.shape[0]),
+            int(cluster.pods.valid.shape[0]),
+            int(cluster.nodes.valid.shape[0]))
+
+
+def _delta_from(prev, new) -> "service_mod.DeltaFrame":
+    """The delta frame a streaming client would ship for prev -> new: the
+    positional diff's changed rows per section, groups riding along only
+    when the options changed (prev is new -> an EMPTY delta, the digest
+    fast path's no-op form)."""
+    from dataclasses import fields
+
+    def take(soa, idx):
+        return type(soa)(**{f.name: np.asarray(getattr(soa, f.name))[idx]
+                            for f in fields(soa)})
+
+    pidx = service_mod._changed_rows(prev.pods, new.pods)
+    nidx = service_mod._changed_rows(prev.nodes, new.nodes)
+    groups_changed = (prev is not new and
+                      len(service_mod._changed_rows(prev.groups,
+                                                    new.groups)) > 0)
+    return service_mod.DeltaFrame(
+        shapes=_shapes_of(new),
+        pod_idx=pidx.astype(np.int32), pod_vals=take(new.pods, pidx),
+        node_idx=nidx.astype(np.int32), node_vals=take(new.nodes, nidx),
+        groups=new.groups if groups_changed else None)
+
+
+# ---------------------------------------------------------------------------
+# digest fast path (round 18): hits, and every invalidation edge
+# ---------------------------------------------------------------------------
+
+
+def _assert_bit_equal(a, b, msg=""):
+    from dataclasses import fields
+
+    for f in fields(a):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name)),
+            err_msg=f"{msg}:{f.name}")
+
+
+def test_engine_digest_cache_hit_serves_bit_equal_columns():
+    """An unchanged request (same content, same now) answers from the
+    tenant's cached decision columns: cached=True, batch_size=0 (it rode
+    no micro-batch), arrays bit-equal to the dispatch that populated the
+    cache AND to a standalone decide. A new now misses; an EMPTY delta
+    frame at the cached now hits."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=4)
+    c = tiny_cluster(70)
+    r1 = eng.step([DecideRequest("dig", c, int(NOW))])[0]
+    assert not r1.cached and r1.batch_size == 1
+    r2 = eng.step([DecideRequest("dig", _copy_cluster(c), int(NOW))])[0]
+    assert r2.cached and r2.batch_size == 0 and eng.cache_hits == 1
+    _assert_bit_equal(r2.arrays, r1.arrays, "cached-vs-dispatch")
+    assert_column_parity(r2.arrays, c, NOW, msg="cached-vs-standalone")
+    # same content at a NEW now: decisions are now-dependent -> miss
+    r3 = eng.step([DecideRequest("dig", _copy_cluster(c), int(NOW) + 60)])[0]
+    assert not r3.cached and eng.cache_hits == 1
+    # empty delta at the (new) cached now: the streaming no-op form -> hit
+    r4 = eng.step([DecideRequest("dig", None, int(NOW) + 60,
+                                 delta=_delta_from(c, c))])[0]
+    assert r4.cached and eng.cache_hits == 2
+    _assert_bit_equal(r4.arrays, r3.arrays, "empty-delta-hit")
+    # a NON-empty delta never hits, and its answer reflects the change
+    c2 = _copy_cluster(c)
+    c2.pods.cpu_milli[3] += 500
+    r5 = eng.step([DecideRequest("dig", None, int(NOW) + 60,
+                                 delta=_delta_from(c, c2))])[0]
+    assert not r5.cached
+    assert_column_parity(r5.arrays, c2, int(NOW) + 60, msg="delta-churn")
+    assert eng.audit() == []
+
+
+def test_engine_digest_cache_evict_reregister_and_group_reload_miss():
+    """Invalidation edges that must NEVER serve stale columns: a tenant
+    evicted and re-registered under the same id starts cold (its cache
+    died with the registration), and a delta frame carrying a groups
+    section (set_groups/options reload) misses even when every group
+    value is identical — the reload is a semantic barrier."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=4)
+    c = tiny_cluster(71)
+    eng.step([DecideRequest("ev", c, int(NOW))])
+    assert eng.step([DecideRequest("ev", _copy_cluster(c),
+                                   int(NOW))])[0].cached
+    # evict -> re-register same id, same content, same now
+    assert isinstance(eng.step([EvictRequest("ev")])[0], EvictAck)
+    r = eng.step([DecideRequest("ev", _copy_cluster(c), int(NOW))])[0]
+    assert not r.cached, "stale columns served across evict/re-register"
+    assert_column_parity(r.arrays, c, NOW, msg="re-register")
+    # group-options reload: an otherwise-empty delta WITH a groups section
+    hits = eng.cache_hits
+    reload_frame = _delta_from(c, _copy_cluster(c))
+    reload_frame = service_mod.DeltaFrame(
+        shapes=reload_frame.shapes, pod_idx=reload_frame.pod_idx,
+        pod_vals=reload_frame.pod_vals, node_idx=reload_frame.node_idx,
+        node_vals=reload_frame.node_vals, groups=_copy_soa(c.groups))
+    r = eng.step([DecideRequest("ev", None, int(NOW),
+                                delta=reload_frame)])[0]
+    assert not r.cached and eng.cache_hits == hits
+    assert_column_parity(r.arrays, c, NOW, msg="group-reload")
+    # after the reload dispatched, the no-op form hits again
+    assert eng.step([DecideRequest("ev", None, int(NOW),
+                                   delta=_delta_from(c, c))])[0].cached
+
+
+def test_engine_digest_cache_chaos_site_forces_miss_bit_equal():
+    """The ``fleet_digest`` chaos site fires between the digest check and
+    the answer: the request must ride the micro-batch (a full dispatch)
+    and produce EXACTLY the columns the cache would have served."""
+    from escalator_tpu.chaos import CHAOS
+
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=4)
+    c = tiny_cluster(72)
+    r1 = eng.step([DecideRequest("chz", c, int(NOW))])[0]
+    CHAOS.arm("fleet_digest", times=1)
+    try:
+        r2 = eng.step([DecideRequest("chz", _copy_cluster(c), int(NOW))])[0]
+        assert not r2.cached and r2.batch_size == 1, \
+            "chaos-armed digest check still answered from cache"
+    finally:
+        CHAOS.disarm("fleet_digest")
+    _assert_bit_equal(r2.arrays, r1.arrays, "chaos-miss-vs-cache")
+    # the rule consumed itself: the next repeat hits again
+    assert eng.step([DecideRequest("chz", _copy_cluster(c),
+                                   int(NOW))])[0].cached
+
+
+def test_engine_digest_cache_grow_and_compact_invalidate():
+    """Arena reshapes between a cache write and the next probe: a tenant-
+    axis grow and a compact both bump the epoch — the probe must miss
+    (the cached columns predate the reshape) and the re-dispatch must
+    stay parity-exact. C-axis growth only (the lane-growth compiles live
+    in the slow-marked grow test)."""
+    eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
+                      max_tenants=2)
+    c = tiny_cluster(73)
+    eng.step([DecideRequest("gc0", c, int(NOW))])
+    assert eng.step([DecideRequest("gc0", _copy_cluster(c),
+                                   int(NOW))])[0].cached
+    # third tenant doubles the tenant axis: epoch bump
+    eng.step([DecideRequest("gc1", tiny_cluster(74), int(NOW)),
+              DecideRequest("gc2", tiny_cluster(75), int(NOW))])
+    r = eng.step([DecideRequest("gc0", _copy_cluster(c), int(NOW))])[0]
+    assert not r.cached, "stale columns served across an arena grow"
+    assert_column_parity(r.arrays, c, NOW, msg="post-grow")
+    assert eng.step([DecideRequest("gc0", _copy_cluster(c),
+                                   int(NOW))])[0].cached
+    # compact after evictions: epoch bump again
+    eng.step([EvictRequest("gc1"), EvictRequest("gc2")])
+    eng.compact()
+    r = eng.step([DecideRequest("gc0", _copy_cluster(c), int(NOW))])[0]
+    assert not r.cached, "stale columns served across a compact"
+    assert_column_parity(r.arrays, c, NOW, msg="post-compact")
+    assert eng.audit() == []
+
+
+def _copy_cluster(c):
+    return type(c)(groups=_copy_soa(c.groups), pods=_copy_soa(c.pods),
+                   nodes=_copy_soa(c.nodes))
 
 
 # ---------------------------------------------------------------------------
@@ -659,6 +874,30 @@ def test_scheduler_coalescing_and_oldest_first_fairness():
         assert len(eng.batches) == 2, eng.batches
         assert eng.batches[0] == ["c0", "c1", "c2", "c3"]  # oldest-first
         assert eng.batches[1] == ["c0"]                    # the dup, next batch
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_noop_shaped_requests_are_slot_free():
+    """Empty-delta requests (the streaming twin's idle shape) must not
+    count against max_batch: a backlog of 6 no-ops + 2 real requests
+    drains in ONE flush at max_batch=2, not ⌈8/2⌉ — the digest fast
+    path's throughput depends on idle requests riding the take for
+    free (round 18)."""
+    c = tiny_cluster(93)
+    noop = _delta_from(c, c)
+    eng = _FakeEngine()
+    sched = FleetScheduler(eng, max_batch=2, flush_ms=20.0, queue_limit=64)
+    try:
+        sched.pause()
+        futs = [sched.submit(f"real{i}", c, 0) for i in range(2)]
+        futs += [sched.submit(f"idle{i}", None, 0, delta=noop)
+                 for i in range(6)]
+        sched.resume()
+        for f in futs:
+            f.result(timeout=10)
+        assert len(eng.batches) == 1, eng.batches
+        assert len(eng.batches[0]) == 8
     finally:
         sched.shutdown()
 
@@ -1321,3 +1560,74 @@ def test_grpc_backend_fleet_tenant_mode(fleet_plugin):
     assert out[0].decision.status == sem.DecisionStatus.OK
     assert out[0].decision.nodes_delta == 1   # 2000/2000=100% -> ceil(2*30/70)
     assert server._escalator_service.fleet.engine.has_tenant("controller-a")
+
+
+def test_grpc_fleet_stream_session_delta_and_cache(fleet_plugin):
+    """Round-18 streaming ingestion end to end through the real server:
+    the FleetStreamSession's first decide ships a full frame, churned
+    decides ship delta frames, and both stay bit-identical to a standalone
+    decide on the session store's content. A repeated decide answers from
+    the digest cache (``cached`` fleet sidecar + ``cached`` journey stage,
+    batch_size 0); a set_groups reload and an evict both force misses."""
+    import jax
+
+    server, client = fleet_plugin
+    from escalator_tpu.plugin.client import FleetStreamSession
+
+    engine = server._escalator_service.fleet.engine
+
+    def reference(sess, groups, now):
+        from escalator_tpu.core.arrays import ClusterArrays
+
+        pods, nodes = sess.store.as_pod_node_arrays()
+        c = ClusterArrays(groups=_copy_soa(groups), pods=_copy_soa(pods),
+                          nodes=_copy_soa(nodes))
+        return kernel.decide_jit(jax.device_put(c), np.int64(now))
+
+    groups = _copy_soa(tiny_cluster(80).groups)
+    sess = FleetStreamSession(client, "stream-t", pod_capacity=P,
+                              node_capacity=N, store_kind="numpy")
+    sess.set_groups(groups)
+    for i in range(6):
+        sess.store.upsert_pod(f"p{i}", i % G, 500 + 10 * i, 10 ** 9, i % 4)
+    for i in range(4):
+        sess.store.upsert_node(f"n{i}", i % G, 4000, 16 * 10 ** 9)
+    now = int(NOW)
+    dec, _phases, fleet = sess.decide(now)
+    assert sess.full_frames == 1 and sess.delta_frames == 0
+    assert not fleet["cached"]
+    ref = reference(sess, groups, now)
+    for f in kernel.GROUP_DECISION_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dec, f)), np.asarray(getattr(ref, f)),
+            err_msg=f"full-frame {f}")
+    # churn -> delta frame, still bit-exact
+    sess.store.upsert_pod("p1", 1, 2000, 2 * 10 ** 9, 2)
+    sess.store.delete_pod("p4")
+    sess.store.upsert_node("n4", 4, 8000, 32 * 10 ** 9)
+    dec, _phases, fleet = sess.decide(now + 60)
+    assert sess.delta_frames == 1 and not fleet["cached"]
+    ref = reference(sess, groups, now + 60)
+    for f in kernel.GROUP_DECISION_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dec, f)), np.asarray(getattr(ref, f)),
+            err_msg=f"delta-frame {f}")
+    # unchanged repeat -> digest cache answers (empty delta, no dispatch)
+    hits = engine.cache_hits
+    dec2, _phases, fleet = sess.decide(now + 60)
+    assert fleet["cached"] and fleet["batch_size"] == 0
+    assert engine.cache_hits == hits + 1
+    assert "cached" in fleet["journey"]["stages_ms"]
+    for f in kernel.GROUP_DECISION_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dec2, f)), np.asarray(getattr(dec, f)),
+            err_msg=f"cached {f}")
+    # group reload: identical values still miss (semantic barrier)
+    sess.set_groups(_copy_soa(groups))
+    _dec, _phases, fleet = sess.decide(now + 60)
+    assert not fleet["cached"] and engine.cache_hits == hits + 1
+    # evict -> the session resyncs with a full frame and starts cold
+    sess.evict()
+    full_before = sess.full_frames
+    _dec, _phases, fleet = sess.decide(now + 60)
+    assert sess.full_frames == full_before + 1 and not fleet["cached"]

@@ -222,6 +222,47 @@ def fleet_slo_cycle(ticks_per_window=30, window=3):
     return p99, overlap
 
 
+def fleet_cache_cycle():
+    """Synthetic round-18 digest-cache panel: the hit fraction IS the
+    fleet's live idle fraction — high in the trough (steady tenants
+    re-send unchanged frames, answered from the per-tenant cache without
+    a dispatch), dipping under the burst (churn invalidates digests) —
+    plus per-class hit rates scaled by the 10/60/30 class mix."""
+    rnd = random.Random(89)
+    frac_pct, crit, std = [], [], []
+    for i in range(T):
+        b = _burst(i)
+        f = max(0.05, min(0.97, 0.9 - 0.62 * b + rnd.gauss(0, 0.015)))
+        served = 40.0 + 140.0 * b   # decides/s offered
+        frac_pct.append(100.0 * f)
+        crit.append(0.1 * served * f)
+        std.append(0.6 * served * f)
+    return frac_pct, crit, std
+
+
+def fleet_tail_cycle():
+    """Synthetic round-18 batched-order-tail panel: per-window tail batch
+    size p50/p99 (order statistics — they are counts, not latencies, so
+    no log-bucket engine) tracking the scale-down drain wave, plus the
+    tail dispatch rate — AT MOST one per micro-batch, so it follows the
+    batch cadence only while anything drains and sits at zero for a
+    steady fleet."""
+    rnd = random.Random(144)
+    p50, p99, rate = [], [], []
+    for i in range(T):
+        x = i / (T - 1)
+        drain = math.exp(-((x - 0.72) / 0.10) ** 2)  # post-burst drain
+        lam = 1.0 + 46.0 * drain
+        samples = sorted(max(1, int(rnd.gauss(lam, 0.4 * lam + 0.5)))
+                         for _ in range(40))
+        p50.append(float(samples[len(samples) // 2]))
+        p99.append(float(samples[min(len(samples) - 1,
+                                     int(len(samples) * 0.99))]))
+        rate.append(max(0.0, rnd.gauss(2.0 + 18.0 * drain, 0.8))
+                    if drain > 0.04 else 0.0)
+    return p50, p99, rate
+
+
 def journey_cycle(ticks_per_window=30, window=3):
     """Synthetic per-stage request-journey p99s THROUGH THE REAL HISTOGRAM
     ENGINE (the round-17 panel): the critical class's five journey stages
@@ -374,6 +415,8 @@ def main():
     fleet_p50, fleet_p99, fleet_tenants, fleet_rejects = fleet_cycle()
     slo_p99, slo_overlap = fleet_slo_cycle()
     stage_p99, budget_burn = journey_cycle()
+    cache_frac, cache_crit, cache_std = fleet_cache_cycle()
+    tail_p50, tail_p99, tail_rate = fleet_tail_cycle()
     panels, grid = [], [
         ("Node counts by state",
          [(s["nodes"], S1, "total"), (s["untainted"], S2, "untainted"),
@@ -434,6 +477,19 @@ def main():
           (stage_p99["dispatch"], S2, "dispatch"),
           (stage_p99["batch_assembly"], S3, "batch_assembly"),
           (budget_burn, S4, "budget burn (x allotment)")], "", (3,)),
+        # round 18: the digest-cache panel — hit fraction (= the fleet's
+        # live idle fraction) + per-class hit rates (see fleet_cache_cycle)
+        ("Fleet: digest cache hit rate",
+         [(cache_frac, S1, "hit fraction (%)"),
+          (cache_crit, S2, "critical hits/s"),
+          (cache_std, S3, "standard hits/s")], "", (0,)),
+        # round 18: the batched order-tail panel — tail batch size
+        # quantiles + the at-most-one-per-micro-batch dispatch rate
+        # (see fleet_tail_cycle)
+        ("Fleet: order-tail batch size / dispatches",
+         [(tail_p50, S1, "tail batch p50"),
+          (tail_p99, S2, "tail batch p99"),
+          (tail_rate, S3, "tail dispatches/s")], "", ()),
     ]
     for i, (title, series, unit, labels) in enumerate(grid):
         x = PAD + (i % 2) * (PANEL_W + PAD)
