@@ -24,9 +24,12 @@ typecheck:
 	mypy
 
 # jaxlint: jaxpr/HLO-level invariant analysis over every kernel entry point
-# (rules R1-R6, docs/static-analysis.md). Pins cpu + 8 virtual devices
-# itself; nonzero exit on any unwaived finding — a blocking CI step.
+# both static-analysis passes (docs/static-analysis.md): threadlint first
+# (rules T1-T4 — pure AST, no jax import, fails in milliseconds), then
+# jaxlint (rules R1-R8 — pins cpu + 8 virtual devices itself). Nonzero
+# exit on any unwaived finding — a blocking CI step.
 analyze:
+	python -m escalator_tpu.analysis --threadlint
 	python -m escalator_tpu.analysis
 
 # the C++ state store builds lazily on first use; this forces a fresh build

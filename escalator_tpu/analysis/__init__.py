@@ -9,7 +9,7 @@ unwaived findings), ``make analyze``, a CI job, and the
 ``tests/test_jaxlint.py`` gate.
 
 Layout: ``registry`` (what to trace: entries + shapes + budgets),
-``walker`` (the context-carrying jaxpr equation stream), ``rules`` (R1-R6 +
+``walker`` (the context-carrying jaxpr equation stream), ``rules`` (R1-R8 +
 engine), ``waivers`` (the visible-debt ledger).
 
 Exports resolve LAZILY (PEP 562): ``python -m escalator_tpu.analysis``
@@ -33,7 +33,12 @@ _EXPORTS = {
     "analyze_entry": "escalator_tpu.analysis.rules",
     "run_analysis": "escalator_tpu.analysis.rules",
     "WAIVERS": "escalator_tpu.analysis.waivers",
+    "THREAD_WAIVERS": "escalator_tpu.analysis.waivers",
     "load_waivers": "escalator_tpu.analysis.waivers",
+    "ThreadFinding": "escalator_tpu.analysis.threadlint",
+    "ThreadlintReport": "escalator_tpu.analysis.threadlint",
+    "run_threadlint": "escalator_tpu.analysis.threadlint",
+    "LockOrderViolation": "escalator_tpu.analysis.lockwitness",
 }
 
 __all__ = sorted(_EXPORTS)

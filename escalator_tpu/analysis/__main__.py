@@ -41,7 +41,7 @@ def _pin_cpu_mesh() -> None:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m escalator_tpu.analysis",
-        description="jaxpr/HLO-level invariant analyzer (rules R1-R6) over "
+        description="jaxpr/HLO-level invariant analyzer (rules R1-R8) over "
                     "every registered kernel entry point",
     )
     parser.add_argument("--json", action="store_true",
@@ -55,9 +55,19 @@ def main(argv=None) -> int:
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip rule R6's compile probes (fast mode for "
                              "inner-loop use; CI runs the full set)")
+    parser.add_argument("--no-execute", action="store_true",
+                        help="skip rule R7's transfer-guarded executions "
+                             "(fast mode; CI runs the full set)")
     parser.add_argument("--list", action="store_true",
                         help="list registered entries and exit")
+    parser.add_argument("--threadlint", action="store_true",
+                        help="run the host-side concurrency analyzer "
+                             "(rules T1-T4) instead of jaxlint — no jax "
+                             "import, source-level, milliseconds")
     args = parser.parse_args(argv)
+
+    if args.threadlint:
+        return _threadlint_main(args)
 
     _pin_cpu_mesh()
     import jax
@@ -86,7 +96,8 @@ def main(argv=None) -> int:
 
     extra = load_waivers(args.waivers) if args.waivers else None
     report = run_analysis(entries=entries, extra_waivers=extra,
-                          with_retrace=not args.no_retrace)
+                          with_retrace=not args.no_retrace,
+                          with_execute=not args.no_execute)
 
     if args.json:
         print(json.dumps(report.to_dict(), indent=2))
@@ -114,6 +125,31 @@ def main(argv=None) -> int:
         print(f"GATE INCOMPLETE: entries skipped: {', '.join(skipped)}",
               file=sys.stderr)
         return 1
+    return 1 if report.unwaived else 0
+
+
+def _threadlint_main(args) -> int:
+    """The --threadlint half of the gate: pure AST analysis, so jax (and
+    the cpu-mesh pin) never enters the process."""
+    from escalator_tpu.analysis.threadlint import run_threadlint
+    from escalator_tpu.analysis.waivers import load_waivers
+
+    extra = (load_waivers(args.waivers, site_key="site")
+             if args.waivers else None)
+    report = run_threadlint(extra_waivers=extra)
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        for f in report.findings:
+            flag = "waived" if f.waived else f.rule
+            print(f"[{flag:6s}] {f.site}:{f.line}  {f.summary}")
+            if f.detail:
+                print(f"        {f.detail}")
+            if f.waived and f.waiver_reason:
+                print(f"        waiver: {f.waiver_reason}")
+        print(f"\n{len(report.unwaived)} unwaived finding(s) over "
+              f"{len(report.modules)} covered modules")
     return 1 if report.unwaived else 0
 
 

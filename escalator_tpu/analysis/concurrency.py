@@ -1,0 +1,359 @@
+"""The concurrency contract registry: every lock, rank, thread and guarded
+attribute on the host path, DECLARED — the single source both checkers read.
+
+jaxlint made the device-side invariants machine-checked; the host-side
+concurrency contracts (the PR-11 ``exec -> host(condition) -> device`` lock
+order, the unlocked epoch read, the observability leaf locks) lived only in
+comments and CHANGES.md war stories until this module. It is imported by
+
+- ``analysis/threadlint.py`` — the static AST pass (rules T1-T4), and
+- ``analysis/lockwitness.py`` — the runtime ranked-lock witness
+  (``ESCALATOR_TPU_LOCK_WITNESS=1``),
+
+and by every covered production module, whose locks are constructed through
+:mod:`escalator_tpu.analysis.lockwitness` so construction itself names the
+contract (rule T4 flags any bare ``threading.Lock()`` left behind).
+
+This module must stay stdlib-only: the fleet engine imports it (via
+lockwitness) at construction time, and a jax import here would defeat the
+analysis CLI's pin-before-import dance AND put jax on the plugin server's
+golden-only path.
+
+Ranks
+-----
+Ranks ascend in acquisition order: a thread may only acquire a lock whose
+rank is STRICTLY greater than every lock it already holds. The documented
+FleetEngine order ``_exec_lock -> _host -> _device_lock`` (fleet/service.py
+module docstring) becomes 20 -> 30 -> 40; the scheduler condition sits below
+(rank 10: ``_reject`` emits a journal event while holding it, so the
+journal — like every observability lock — ranks above the whole fleet
+path); the observability locks are leaves that never nest with each other
+(verified by threadlint T1 on every run).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "LockContract",
+    "ThreadContract",
+    "CONTRACTS",
+    "CONTRACTS_BY_NAME",
+    "COVERED_MODULES",
+    "THREADS",
+    "ASSUME_HELD",
+    "GRPC_RECEIVERS",
+    "EXTERNAL_RECEIVERS",
+    "resolve_lock",
+]
+
+
+@dataclass(frozen=True)
+class LockContract:
+    """One named lock/condition and its place in the global order.
+
+    ``holder`` locates the attribute the contract binds to:
+    ``"ClassName._attr"`` for instance locks, ``"_name"`` for module
+    globals — always within ``module`` (repo-relative path).  ``guarded``
+    lists instance attributes that may only be WRITTEN while this lock is
+    held (rule T3); construction in ``__init__`` is exempt (no other thread
+    can hold a reference yet).
+    """
+
+    name: str
+    rank: int
+    module: str
+    holder: str
+    kind: str                      # "lock" | "rlock" | "condition"
+    doc: str
+    guarded: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ThreadContract:
+    """One declared worker thread (rule T4 flags undeclared/unnamed ones).
+
+    ``name_pattern`` is an fnmatch pattern over the ``name=`` passed to
+    ``threading.Thread`` at the spawn site in ``module``.
+    """
+
+    name_pattern: str
+    module: str
+    doc: str
+
+
+#: Repo-relative paths threadlint analyzes (the host-side concurrency
+#: surface; k8s/, native/ and the controller keep their own single-threaded
+#: or RLock-trivial disciplines and stay out of scope — see
+#: docs/static-analysis.md).
+COVERED_MODULES: Tuple[str, ...] = (
+    "escalator_tpu/fleet/scheduler.py",
+    "escalator_tpu/fleet/service.py",
+    "escalator_tpu/plugin/server.py",
+    "escalator_tpu/plugin/client.py",
+    "escalator_tpu/ops/snapshot.py",
+    "escalator_tpu/chaos.py",
+    "escalator_tpu/observability/flightrecorder.py",
+    "escalator_tpu/observability/tail.py",
+    "escalator_tpu/observability/histograms.py",
+    "escalator_tpu/observability/journal.py",
+    "escalator_tpu/observability/jaxmon.py",
+    "escalator_tpu/observability/replay.py",
+    "escalator_tpu/observability/resources.py",
+)
+
+
+CONTRACTS: List[LockContract] = [
+    # -- the fleet path (the PR-11 deadlock class lives here) ---------------
+    LockContract(
+        name="scheduler.cv", rank=10,
+        module="escalator_tpu/fleet/scheduler.py",
+        holder="FleetScheduler._cv", kind="condition",
+        doc="admission/batching condition: queues, inflight, staged slot, "
+            "SLO windows. Ranks BELOW the engine locks and the journal: "
+            "_reject emits a journal event while holding it, and the "
+            "dispatch thread never calls the engine under it.",
+        guarded=(
+            "_queues", "_inflight", "_paused", "_closed", "_staged_slot",
+            "_dispatch_windows", "_dispatch_busy_since", "_queued_classes",
+            "admitted_total", "rejected_total", "deferred_total",
+            "class_breaches", "_class_served", "_slo_windows",
+            "_slo_burn_counts", "_slo_fast_streak", "_slo_escalated",
+            "_cache_hit_ema",
+        ),
+    ),
+    LockContract(
+        name="engine.exec", rank=20,
+        module="escalator_tpu/fleet/service.py",
+        holder="FleetEngine._exec_lock", kind="lock",
+        doc="serializes execute/compact (fleet/service.py docstring: "
+            "exec -> host -> device).",
+    ),
+    LockContract(
+        name="engine.host", rank=30,
+        module="escalator_tpu/fleet/service.py",
+        holder="FleetEngine._host", kind="condition",
+        doc="twins/slots/staged batch + the drain condition; grow/compact "
+            "wait on it, execute's epoch check deliberately does NOT take "
+            "it (the documented unlocked read, waived at site).",
+        guarded=("_staged", "_epoch"),
+    ),
+    LockContract(
+        name="engine.device", rank=40,
+        module="escalator_tpu/fleet/service.py",
+        holder="FleetEngine._device_lock", kind="lock",
+        doc="the resident arena swap (self._state donation window).",
+        guarded=("_state",),
+    ),
+    # -- the serving shell --------------------------------------------------
+    LockContract(
+        name="server.stats", rank=50,
+        module="escalator_tpu/plugin/server.py",
+        holder="_ComputeService._stats_lock", kind="lock",
+        doc="served-tick counters on the gRPC worker pool; leaf.",
+        guarded=("_last_decide_unix", "_ticks_served"),
+    ),
+    # -- observability leaves (never nest with each other; each protects one
+    #    ring/dict and calls nothing lock-taking while held) ----------------
+    LockContract(
+        name="recorder.ring", rank=60,
+        module="escalator_tpu/observability/flightrecorder.py",
+        holder="FlightRecorder._lock", kind="lock",
+        doc="the flight-recorder deque; record_timeline releases before "
+            "the root-complete fan-out runs.",
+    ),
+    LockContract(
+        name="tail.watchdog", rank=62,
+        module="escalator_tpu/observability/tail.py",
+        holder="TailWatchdog._lock", kind="lock",
+        doc="tail-breach rate-limit claims + worker handoff; the journal "
+            "event and the profiler arm run OUTSIDE it.",
+        guarded=("_last_dump_mono", "_worker"),
+    ),
+    LockContract(
+        name="histograms.set", rank=64,
+        module="escalator_tpu/observability/histograms.py",
+        holder="HistogramSet._lock", kind="lock",
+        doc="the series dict; observe() releases it before recording into "
+            "the series lock (no nesting, sequential).",
+    ),
+    LockContract(
+        name="histograms.series", rank=66,
+        module="escalator_tpu/observability/histograms.py",
+        holder="LogHistogram._lock", kind="lock",
+        doc="one log-bucket series; pure counter math under it.",
+    ),
+    LockContract(
+        name="journal.ring", rank=68,
+        module="escalator_tpu/observability/journal.py",
+        holder="OpsJournal._lock", kind="lock",
+        doc="the ops-event ring. Ranks above scheduler.cv because _reject "
+            "journals while holding the cv.",
+    ),
+    LockContract(
+        name="jaxmon.state", rank=70,
+        module="escalator_tpu/observability/jaxmon.py",
+        holder="_lock", kind="lock",
+        doc="compile/transfer counters + the compile ring (module global).",
+    ),
+    LockContract(
+        name="replay.ring", rank=72,
+        module="escalator_tpu/observability/replay.py",
+        holder="TickInputLog._lock", kind="lock",
+        doc="the tick-input replay ring.",
+    ),
+    LockContract(
+        name="resources.caps", rank=74,
+        module="escalator_tpu/observability/resources.py",
+        holder="_caps_lock", kind="lock",
+        doc="the probed-capabilities memo (module global).",
+    ),
+    LockContract(
+        name="resources.memwatch", rank=76,
+        module="escalator_tpu/observability/resources.py",
+        holder="MemoryWatchdog._lock", kind="lock",
+        doc="growth-window samples + dump rate limit; the registry sample "
+            "and the journal event run OUTSIDE it.",
+        guarded=("_last_dump_mono", "_worker"),
+    ),
+    LockContract(
+        name="resources.registry", rank=78,
+        module="escalator_tpu/observability/resources.py",
+        holder="ResourceRegistry._lock", kind="lock",
+        doc="registered-buffer weakref table; metadata walks only.",
+    ),
+    LockContract(
+        name="resources.profiler", rank=80,
+        module="escalator_tpu/observability/resources.py",
+        holder="ProfileCapture._lock", kind="lock",
+        doc="profiler-capture state machine; stop runs on its own worker.",
+    ),
+    LockContract(
+        name="chaos.rules", rank=90,
+        module="escalator_tpu/chaos.py",
+        holder="ChaosMonkey._lock", kind="lock",
+        doc="armed fault sites; hooks fire from tick/gRPC/audit threads "
+            "alike, possibly while holding any production lock — highest "
+            "rank so should_fire can be called from anywhere.",
+    ),
+]
+
+CONTRACTS_BY_NAME: Dict[str, LockContract] = {c.name: c for c in CONTRACTS}
+
+_BY_SITE: Dict[Tuple[str, str], LockContract] = {
+    (c.module, c.holder): c for c in CONTRACTS
+}
+
+if len(CONTRACTS_BY_NAME) != len(CONTRACTS):
+    raise RuntimeError("duplicate lock contract names")
+if len({c.rank for c in CONTRACTS}) != len(CONTRACTS):
+    raise RuntimeError("duplicate lock contract ranks")
+
+
+#: Declared worker threads in the covered modules. Rule T4 requires every
+#: ``threading.Thread(...)`` spawn in a covered module to carry a ``name=``
+#: matching one of these patterns — an anonymous thread is an undeclared
+#: concurrency surface exactly like an unranked lock.
+THREADS: List[ThreadContract] = [
+    ThreadContract("escalator-tpu-fleet-prep",
+                   "escalator_tpu/fleet/scheduler.py",
+                   "pipelined prep stage: stages batch N+1 while N runs"),
+    ThreadContract("escalator-tpu-fleet-dispatch",
+                   "escalator_tpu/fleet/scheduler.py",
+                   "pipelined dispatch stage: executes staged batches"),
+    ThreadContract("escalator-tpu-fleet",
+                   "escalator_tpu/fleet/scheduler.py",
+                   "single-stage batcher loop (pipelining off)"),
+    ThreadContract("escalator-slo-profile",
+                   "escalator_tpu/fleet/scheduler.py",
+                   "one-shot SLO-escalation profiler arm"),
+    ThreadContract("escalator-tail-dump",
+                   "escalator_tpu/observability/tail.py",
+                   "tail-breach dump serializer (daemon, off the tick)"),
+    ThreadContract("escalator-memory-dump",
+                   "escalator_tpu/observability/resources.py",
+                   "memory-breach dump serializer (daemon, off the tick)"),
+    ThreadContract("escalator-profile-stop",
+                   "escalator_tpu/observability/resources.py",
+                   "profiler stop worker (jax.profiler.stop_trace blocks)"),
+]
+
+
+#: Functions whose CALLERS own a declared lock for them: the body is
+#: analyzed as if the named locks were held (rules T1/T3 context). This is
+#: a contract statement, not a waiver — the witness enforces it at runtime
+#: and a new unlocked caller shows up as a T3 finding on the callee's
+#: writes. Keys are ``(module, qualname)``.
+ASSUME_HELD: Dict[Tuple[str, str], Tuple[str, ...]] = {
+    # _dispatch holds engine.device when it swaps self._state; _init_state
+    # is called from inside that with-block (and from __init__/rebuild,
+    # both under the same lock).
+    ("escalator_tpu/fleet/service.py", "FleetEngine._init_state"):
+        ("engine.device",),
+    # the prep path: prepare_batch opens `with obs.span("fleet_prep"),
+    # self._host:` and everything it calls — tenant registration, bucket
+    # growth, the staged-batch drain wait — runs under that condition.
+    ("escalator_tpu/fleet/service.py", "FleetEngine._grow"):
+        ("engine.host",),
+    ("escalator_tpu/fleet/service.py", "FleetEngine._register"):
+        ("engine.host",),
+    ("escalator_tpu/fleet/service.py", "FleetEngine._ensure_buckets"):
+        ("engine.host",),
+    ("escalator_tpu/fleet/service.py", "FleetEngine._await_staged_drain"):
+        ("engine.host",),
+    # compact's drain-then-lock loop calls this only from inside
+    # `with self._exec_lock, self._host:` (fleet/service.py compact()).
+    ("escalator_tpu/fleet/service.py", "FleetEngine._compact_locked"):
+        ("engine.exec", "engine.host"),
+    # admission helpers: submit() holds the cv around every _reject and the
+    # batcher loops hold it around _take_batch (the journal event inside
+    # _reject is why journal.ring ranks above scheduler.cv).
+    ("escalator_tpu/fleet/scheduler.py", "FleetScheduler._reject"):
+        ("scheduler.cv",),
+    ("escalator_tpu/fleet/scheduler.py", "FleetScheduler._take_batch"):
+        ("scheduler.cv",),
+}
+
+
+#: Attribute-chain tails that mark a call as a gRPC round-trip (rule T2:
+#: never inside a lock body — a stuck peer would turn a lock hold into a
+#: cluster-wide stall).
+GRPC_RECEIVERS: Tuple[str, ...] = ("_stub", "stub", "_channel")
+
+
+#: Cross-module singleton receivers the T1 call graph resolves: a call
+#: ``RECV.method(...)`` (any attribute path ending in RECV) binds to
+#: ``(module, class)`` so lock acquisitions inside the callee are charged
+#: to the calling context.
+EXTERNAL_RECEIVERS: Dict[str, Tuple[str, str]] = {
+    "JOURNAL": ("escalator_tpu/observability/journal.py", "OpsJournal"),
+    "RECORDER": ("escalator_tpu/observability/flightrecorder.py",
+                 "FlightRecorder"),
+    "WATCHDOG": ("escalator_tpu/observability/tail.py", "TailWatchdog"),
+    "PHASES": ("escalator_tpu/observability/histograms.py", "HistogramSet"),
+    "TICKS": ("escalator_tpu/observability/histograms.py", "HistogramSet"),
+    "RESOURCES": ("escalator_tpu/observability/resources.py",
+                  "ResourceRegistry"),
+    "MEMORY_WATCHDOG": ("escalator_tpu/observability/resources.py",
+                        "MemoryWatchdog"),
+    "PROFILER": ("escalator_tpu/observability/resources.py",
+                 "ProfileCapture"),
+    "MONKEY": ("escalator_tpu/chaos.py", "ChaosMonkey"),
+    "INPUT_LOG": ("escalator_tpu/observability/replay.py", "TickInputLog"),
+}
+
+
+def resolve_lock(module: str, scope_class: Optional[str],
+                 attr_expr: str) -> Optional[LockContract]:
+    """Map a lock expression at an AST site to its contract.
+
+    ``attr_expr`` is either ``self.X`` (resolved against ``scope_class`` in
+    ``module``) or a bare module-global name. Returns None for expressions
+    no contract covers (threadlint treats acquiring an unknown lock inside
+    a covered module as a T4 finding at the construction site, not here).
+    """
+    if attr_expr.startswith("self.") and scope_class:
+        return _BY_SITE.get((module, f"{scope_class}.{attr_expr[5:]}"))
+    return _BY_SITE.get((module, attr_expr))

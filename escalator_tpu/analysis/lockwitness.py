@@ -1,0 +1,228 @@
+"""The lock witness: runtime enforcement of the declared lock ranks.
+
+Every lock in a threadlint-covered module is constructed through
+:func:`make_lock` / :func:`make_rlock` / :func:`make_condition`, naming its
+:mod:`~escalator_tpu.analysis.concurrency` contract. Disarmed (the default)
+the factories return plain ``threading`` primitives — zero steady-state
+overhead, one env read at construction. With ``ESCALATOR_TPU_LOCK_WITNESS=1``
+they return ranked wrappers that keep a per-thread acquisition stack and
+raise :class:`LockOrderViolation` BEFORE acquiring out of rank — the PR-11
+deadlock class surfaces as a stack-carrying exception at the first inverted
+acquisition instead of as a hung process. The check runs before the
+underlying ``acquire`` precisely so an actual deadlock cannot swallow it.
+
+Armed in the fleet soak, the pipelined-shutdown test and the chaos-soak CI
+job (tests/test_threadlint.py, .github/workflows/ci.yml). Worker threads
+often run under broad excepthooks, so every violation is ALSO appended to
+:data:`VIOLATIONS` — tests assert that list is empty after a soak even if
+the raising thread's exception went into a log.
+
+stdlib-only: the fleet engine constructs its locks through this module on
+every import, including in processes that must never load jax.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import List, Optional, Union
+
+from escalator_tpu.analysis import concurrency
+
+__all__ = [
+    "LockOrderViolation",
+    "VIOLATIONS",
+    "armed",
+    "make_lock",
+    "make_rlock",
+    "make_condition",
+    "held_stack",
+]
+
+_ENV = "ESCALATOR_TPU_LOCK_WITNESS"
+
+
+class LockOrderViolation(RuntimeError):
+    """An acquisition out of declared rank order (see concurrency.py)."""
+
+
+#: Every violation observed process-wide, newest last (the raise can be
+#: swallowed by a worker thread's catch-all; this list cannot). Appends are
+#: GIL-atomic; tests read it after joining their workers.
+VIOLATIONS: List[dict] = []
+
+
+def armed() -> bool:
+    return os.environ.get(_ENV, "").lower() in ("1", "true", "yes")
+
+
+class _PerThread(threading.local):
+    def __init__(self) -> None:
+        self.stack: List["_Ranked"] = []
+
+
+_state = _PerThread()
+
+
+def held_stack() -> List[str]:
+    """Names of ranked locks the calling thread holds, outermost first."""
+    return [r.name for r in _state.stack]
+
+
+class _Ranked:
+    """Shared rank bookkeeping for ranked locks and conditions."""
+
+    def __init__(self, name: str, rank: int, kind: str) -> None:
+        self.name = name
+        self.rank = rank
+        self.kind = kind
+
+    # -- the witness check --------------------------------------------------
+    def _check(self) -> None:
+        stack = _state.stack
+        if not stack:
+            return
+        top = stack[-1]
+        if top is self and self.kind == "rlock":
+            return  # declared-reentrant self-acquisition
+        if self.rank > top.rank:
+            return
+        held = " -> ".join(f"{r.name}(rank {r.rank})" for r in stack)
+        record = {
+            "thread": threading.current_thread().name,
+            "acquiring": self.name,
+            "acquiring_rank": self.rank,
+            "held": [r.name for r in stack],
+            "stack": "".join(traceback.format_stack(limit=12)),
+        }
+        VIOLATIONS.append(record)
+        raise LockOrderViolation(
+            f"out-of-rank acquisition of {self.name!r} (rank {self.rank}) "
+            f"while holding [{held}] in thread "
+            f"{threading.current_thread().name!r} — the declared order is "
+            "ascending ranks only (escalator_tpu/analysis/concurrency.py)"
+        )
+
+    def _push(self) -> None:
+        _state.stack.append(self)
+
+    def _pop(self) -> None:
+        # release order can legally differ from acquire order (e.g.
+        # ``with a, b:`` bodies that release a first); drop the newest
+        # matching frame rather than asserting LIFO
+        stack = _state.stack
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                return
+
+
+class RankedLock(_Ranked):
+    def __init__(self, name: str, rank: int, kind: str = "lock") -> None:
+        super().__init__(name, rank, kind)
+        self._lock: Union[threading.Lock, threading.RLock] = (
+            threading.RLock() if kind == "rlock" else threading.Lock())
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._check()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._push()
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        self._pop()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "RankedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class RankedCondition(_Ranked):
+    """A ``threading.Condition`` with the same witness on its lock.
+
+    ``wait`` keeps the frame on the per-thread stack even though the
+    underlying lock is released for the duration: the waiting thread is
+    blocked, so it cannot acquire anything else meanwhile, and keeping the
+    frame preserves the rank context for the re-acquire on wakeup.
+    """
+
+    def __init__(self, name: str, rank: int) -> None:
+        super().__init__(name, rank, "condition")
+        self._cond = threading.Condition()
+
+    def acquire(self, *args) -> bool:
+        self._check()
+        got = self._cond.acquire(*args)
+        if got:
+            self._push()
+        return got
+
+    def release(self) -> None:
+        self._cond.release()
+        self._pop()
+
+    def __enter__(self) -> "RankedCondition":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._cond.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        return self._cond.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+def _contract(name: str, kind: str) -> concurrency.LockContract:
+    try:
+        c = concurrency.CONTRACTS_BY_NAME[name]
+    except KeyError:
+        raise KeyError(
+            f"lock {name!r} has no contract — declare it (name, rank, "
+            "holder, guarded attrs) in escalator_tpu/analysis/concurrency.py "
+            "before constructing it"
+        ) from None
+    if c.kind != kind:
+        raise TypeError(
+            f"lock {name!r} is declared as a {c.kind}, constructed as a "
+            f"{kind}")
+    return c
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` bound to contract ``name`` (ranked when armed)."""
+    c = _contract(name, "lock")
+    if armed():
+        return RankedLock(name, c.rank, "lock")
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    c = _contract(name, "rlock")
+    if armed():
+        return RankedLock(name, c.rank, "rlock")
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    c = _contract(name, "condition")
+    if armed():
+        return RankedCondition(name, c.rank)
+    return threading.Condition()

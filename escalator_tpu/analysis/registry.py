@@ -130,6 +130,11 @@ class TracedEntry:
     jitted: Optional[Any] = None   # has .lower(*args) when donation is checked
     lower: Optional[Callable[[], Any]] = None  # overrides jitted.lower(*args)
                                                # (entries with static argnames)
+    execute: Optional[Callable[[Tuple[Any, ...]], Any]] = None
+    # overrides rule R7's execution: called with the device-placed arg tuple.
+    # Needed only when neither ``fn`` nor ``jitted(*args)`` runs the compiled
+    # program (e.g. ``fn`` is the EAGER impl and the jit takes static kwargs
+    # absent from ``args``, as in ops/simulate.py).
 
 
 def _identity(out: Any) -> Any:
@@ -151,6 +156,15 @@ class KernelEntry:
     donate_expected: bool = False
     retrace_budget: Optional[int] = None
     retrace_probe: Optional[Callable[[], int]] = None
+    #: R7 escape hatch: transfer directions this entry is ALLOWED to perform
+    #: while executing ("host_to_device" / "device_to_host"). Empty means the
+    #: entry must run fully device-resident under jax.transfer_guard.
+    transfer_allow: Tuple[str, ...] = ()
+    #: R8: name of the fenced=False observability span (observability/spans.py)
+    #: this program runs under on the hot path. Entries claiming async overlap
+    #: must lower to a program with no forced host sync (infeed/outfeed/
+    #: host callbacks) — a sync op there silently serializes the overlap.
+    overlap_span: Optional[str] = None
 
 
 def representative_cluster(G: int = GROUPS, P: int = PODS, N: int = NODES,
@@ -1037,7 +1051,12 @@ def _build_simulate_sweep() -> TracedEntry:
 
     cluster = representative_cluster(seed=9)
     fn = lambda c: simulate.sweep_deltas(c, 9)  # noqa: E731
-    return TracedEntry(fn=fn, args=(cluster,), jitted=simulate._sweep_deltas_raw)
+    return TracedEntry(
+        fn=fn, args=(cluster,), jitted=simulate._sweep_deltas_raw,
+        # fn is the EAGER impl (traceable, but host-dispatched op by op —
+        # useless for R7) and the jit's num_candidates is a static kwarg
+        execute=lambda a: simulate._sweep_deltas_raw(a[0], num_candidates=9),
+    )
 
 
 def _build_simulate_sweep_by_type() -> TracedEntry:
@@ -1050,6 +1069,8 @@ def _build_simulate_sweep_by_type() -> TracedEntry:
     return TracedEntry(
         fn=fn, args=(cluster, type_cpu, type_mem),
         jitted=simulate._sweep_deltas_by_type_raw,
+        execute=lambda a: simulate._sweep_deltas_by_type_raw(
+            *a, num_candidates=9),
     )
 
 
@@ -1133,6 +1154,7 @@ def default_registry() -> List[KernelEntry]:
             collective_budget=0,
             retrace_budget=2,  # ordered + lazy-orders light program
             retrace_probe=_probe_kernel_retraces,
+            overlap_span="decide",  # plugin/server.py unfenced device span
         ),
         e(
             name="mesh.sharded_decider",
@@ -1358,6 +1380,7 @@ def default_registry() -> List[KernelEntry]:
             donate_expected=True,  # persistent aggregates + decision columns
             retrace_budget=1,      # dirty CONTENTS are not a cache key
             retrace_probe=_probe_delta_decide_retraces,
+            overlap_span="delta_decide",  # ops/device_state.py:1250
         ),
         e(
             name="device_state.scatter_update_aggs",
@@ -1420,6 +1443,7 @@ def default_registry() -> List[KernelEntry]:
             donate_expected=True,  # old key columns + replaced permutation
             retrace_budget=1,      # dirty-lane CONTENTS are not a cache key
             retrace_probe=_probe_order_update_retraces,
+            overlap_span="order_repair",  # ops/device_state.py:1372
         ),
         e(
             name="kernel.ordered_delta_decide",
@@ -1433,6 +1457,7 @@ def default_registry() -> List[KernelEntry]:
             donate_expected=True,  # aggs + decision columns + order state
             retrace_budget=1,      # dirty/order CONTENTS are not cache keys
             retrace_probe=_probe_ordered_delta_retraces,
+            overlap_span="decide_ordered_incremental",  # device_state.py:1328
         ),
         e(
             name="device_state.audit_snapshot",

@@ -25,6 +25,23 @@ R6  retrace budget        — each registered entry compiles at most its
                             pinned number of times across a two-tick
                             representative sweep (catches static-argnum /
                             weak-type churn that melts the jit cache).
+R7  transfer hygiene      — every entry executes fully device-resident under
+                            ``jax.transfer_guard("disallow")``: a stray host
+                            scalar fed back into a jit (a debug ``float(x)``
+                            that survives review) becomes an implicit
+                            host->device transfer per tick, flagged here
+                            instead of shipping. Per-entry escapes via
+                            ``KernelEntry.transfer_allow``. Execution costs a
+                            compile per entry, so R7 runs only
+                            ``with_execute=True`` (the CLI gate / CI); the
+                            tier-1 clean-tree test stays trace-only.
+R8  host-sync-in-span     — entries declaring an ``overlap_span`` (they run
+                            under a fenced=False device span, i.e. the host
+                            path counts on async dispatch overlap) must lower
+                            to a program with no forced host sync — infeed/
+                            outfeed/host callbacks there would silently
+                            serialize the overlap the span accounting
+                            advertises.
 
 Findings carry the nesting path from the walker, so "where is this sort"
 is answered in the report, not by re-deriving the trace.
@@ -64,6 +81,17 @@ _DEMOTED_FLOATS = ("float32", "float16", "bfloat16")
 #: Lowering/compilation markers proving buffer donation survived (R5).
 _LOWERED_ALIAS_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
 _COMPILED_ALIAS_MARKER = "input_output_alias"
+
+#: Lowered-text markers of a forced host round-trip (R8): any of these inside
+#: a program that claims fenced=False overlap means the device blocks on the
+#: host mid-program. Checked against the StableHLO ``as_text()`` dump.
+_HOST_SYNC_MARKERS = (
+    "infeed", "outfeed", "send_to_host", "recv_from_host",
+    "SendToHost", "RecvFromHost", "callback",
+)
+
+#: The two guarded transfer directions R7 can disallow per entry.
+_TRANSFER_DIRECTIONS = ("host_to_device", "device_to_host")
 
 
 @dataclass
@@ -342,6 +370,121 @@ def rule_donation(entry: KernelEntry, traced: TracedEntry) -> List[Finding]:
     )]
 
 
+def _lowered_text(traced: TracedEntry) -> str:
+    lowered = (traced.lower() if traced.lower is not None
+               else traced.jitted.lower(*traced.args))
+    return lowered.as_text()
+
+
+def _place_args(traced: TracedEntry) -> Any:
+    """Device-commit the representative args, exactly as production holds
+    them (resident buffers), so R7 flags only transfers the PROGRAM forces —
+    never the fixture's own numpy staging."""
+    import jax
+
+    return jax.device_put(traced.args)
+
+
+def _r7_execute(traced: TracedEntry, placed: Any) -> None:
+    """Run the compiled program once on device-resident args. ``execute``
+    overrides; otherwise prefer the jit wrapper (``fn`` may be an eager body
+    or a host-working public wrapper), falling back to ``fn`` when the jit
+    takes static kwargs absent from ``args`` (a TypeError at binding, before
+    any tracing or transfer happens)."""
+    import jax
+
+    if traced.execute is not None:
+        out = traced.execute(placed)
+    elif traced.lower is not None or traced.jitted is None:
+        out = traced.fn(*placed)   # fn carries the static args / is the jit
+    else:
+        try:
+            out = traced.jitted(*placed)
+        except TypeError:
+            out = traced.fn(*placed)
+    jax.block_until_ready(out)
+
+
+def rule_transfer_hygiene(entry: KernelEntry,
+                          traced: TracedEntry) -> List[Finding]:
+    """R7: the entry executes fully device-resident under transfer guards."""
+    import jax
+
+    findings = []
+    for direction in entry.transfer_allow:
+        if direction not in _TRANSFER_DIRECTIONS:
+            findings.append(Finding(
+                rule="R7", entry=entry.name,
+                summary=f"unknown transfer_allow direction {direction!r}",
+                detail=f"valid directions: {_TRANSFER_DIRECTIONS}",
+            ))
+    if findings:
+        return findings
+    try:
+        placed = _place_args(traced)
+    except Exception as exc:
+        return [Finding(
+            rule="ERR", entry=entry.name,
+            summary=f"R7 device placement failed: {type(exc).__name__}",
+            detail=str(exc)[:500],
+        )]
+    h2d = ("allow" if "host_to_device" in entry.transfer_allow
+           else "disallow")
+    d2h = ("allow" if "device_to_host" in entry.transfer_allow
+           else "disallow")
+    try:
+        with jax.transfer_guard_host_to_device(h2d), \
+                jax.transfer_guard_device_to_host(d2h):
+            _r7_execute(traced, placed)
+    except Exception as exc:
+        msg = str(exc)
+        if "transfer" in msg.lower():
+            return [Finding(
+                rule="R7", entry=entry.name,
+                summary="entry forces a guarded transfer while executing "
+                        "on device-resident args",
+                detail=msg[:500] + " — a host value leaked into the hot "
+                       "path (stray float()/np coercion feeding a jit?); "
+                       "keep it resident or declare "
+                       "KernelEntry.transfer_allow with a bench note",
+            )]
+        return [Finding(
+            rule="ERR", entry=entry.name,
+            summary=f"R7 execution failed: {type(exc).__name__}",
+            detail=msg[:500],
+        )]
+    return []
+
+
+def rule_overlap_host_sync(entry: KernelEntry,
+                           traced: TracedEntry) -> List[Finding]:
+    """R8: a program running under a fenced=False span must not lower with
+    forced host sync — the span accounting claims async overlap."""
+    if entry.overlap_span is None:
+        return []
+    try:
+        text = _lowered_text(traced)
+    except Exception as exc:
+        return [Finding(
+            rule="ERR", entry=entry.name,
+            summary=f"R8 lowering failed: {type(exc).__name__}",
+            detail=str(exc)[:500],
+        )]
+    hits = sorted({m for m in _HOST_SYNC_MARKERS if m in text})
+    if not hits:
+        return []
+    return [Finding(
+        rule="R8", entry=entry.name,
+        summary=(
+            f"host-sync op(s) {hits} lowered into a program running under "
+            f"the fenced=False span {entry.overlap_span!r}"
+        ),
+        detail="the host path overlaps this dispatch (observability/spans.py "
+               "fenced flag); a forced host round-trip serializes it — drop "
+               "the callback or fence the span explicitly",
+    )]
+
+
 def rule_retrace_budget(entry: KernelEntry, compiles: int) -> List[Finding]:
     """R6: compile count across the representative two-tick sweep."""
     if entry.retrace_budget is None or compiles <= entry.retrace_budget:
@@ -362,7 +505,8 @@ def rule_retrace_budget(entry: KernelEntry, compiles: int) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
-def analyze_entry(entry: KernelEntry, with_retrace: bool = True) -> EntryReport:
+def analyze_entry(entry: KernelEntry, with_retrace: bool = True,
+                  with_execute: bool = False) -> EntryReport:
     """Run every applicable rule on one registry entry. Failures to build or
     trace are loud ERR findings, never silent skips — an entry that stops
     tracing is exactly the refactor this gate exists to catch."""
@@ -397,6 +541,9 @@ def analyze_entry(entry: KernelEntry, with_retrace: bool = True) -> EntryReport:
     compiles: Optional[int] = None
     try:
         findings += rule_donation(entry, traced)
+        findings += rule_overlap_host_sync(entry, traced)
+        if with_execute:
+            findings += rule_transfer_hygiene(entry, traced)
         if with_retrace and entry.retrace_probe is not None:
             compiles = entry.retrace_probe()
             findings += rule_retrace_budget(entry, compiles)
@@ -428,7 +575,8 @@ def analyze_entry(entry: KernelEntry, with_retrace: bool = True) -> EntryReport:
 
 def run_analysis(entries: Optional[Sequence[KernelEntry]] = None,
                  extra_waivers: Optional[Sequence[Mapping[str, str]]] = None,
-                 with_retrace: bool = True) -> AnalysisReport:
+                 with_retrace: bool = True,
+                 with_execute: bool = False) -> AnalysisReport:
     """Analyze ``entries`` (default: the full registry) and apply waivers.
 
     The gate condition is ``not report.unwaived``: waived findings print but
@@ -443,7 +591,8 @@ def run_analysis(entries: Optional[Sequence[KernelEntry]] = None,
 
     if entries is None:
         entries = default_registry()
-    reports = [analyze_entry(e, with_retrace=with_retrace) for e in entries]
+    reports = [analyze_entry(e, with_retrace=with_retrace,
+                             with_execute=with_execute) for e in entries]
     x64 = bool(jax.config.jax_enable_x64)
     if not x64:
         reports.append(EntryReport(

@@ -1,0 +1,578 @@
+"""threadlint: AST-level enforcement of the declared concurrency contracts.
+
+jaxlint's sibling for the host path. The subject is the covered modules'
+*source* (no imports, no jax, runs in milliseconds); the contract is
+:mod:`escalator_tpu.analysis.concurrency`. Four rules:
+
+T1  lock-order        — a ``with``-acquired lock whose body (directly, or
+                        transitively through same-package calls resolved
+                        via the AST call graph) acquires a lock of equal or
+                        lower rank. The PR-11 deadlock class, statically.
+T2  blocking-in-lock  — ``Condition.wait``/``wait_for`` without a timeout
+                        anywhere (a wait IS a lock body), and zero-timeout
+                        blocking calls (``Future.result()``, bare
+                        ``Thread.join()``) or gRPC round-trips
+                        (``*._stub.*``/``*.stub.*``/``*._channel.*``)
+                        inside a lock body — a stuck peer or worker must
+                        never extend a lock hold indefinitely.
+T3  guarded writes    — assignment to a registry-declared guarded attribute
+                        outside its owning lock's ``with`` body. ``__init__``
+                        is exempt (no concurrent reference exists yet);
+                        declared callee contracts (``ASSUME_HELD``) extend
+                        the lexical context; the documented unlocked epoch
+                        write carries an inline waiver.
+T4  undeclared        — bare ``threading.Lock()``/``RLock()``/
+                        ``Condition()`` construction in a covered module
+                        (locks are constructed through
+                        ``analysis.lockwitness`` so construction names a
+                        contract and a rank), and ``threading.Thread``
+                        spawns whose ``name=`` matches no declared
+                        ThreadContract (or is absent).
+
+Waivers, mirroring jaxlint's ledger: per-site inline
+``# threadlint: waive[T3] reason`` comments (same line or the line above),
+plus the ``THREAD_WAIVERS`` list in ``analysis/waivers.py``
+(``{rule, site, reason}``, site an fnmatch pattern over
+``path:qualname``). Waived findings stay in every report.
+
+Known static limits (the runtime witness covers them): a manual
+``lock.acquire()`` is checked as an acquisition against the lexical context
+but does not open a tracked hold region, and per-path reachability is not
+modeled — a callee's transitive acquisitions are charged to every call
+site.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from escalator_tpu.analysis import concurrency
+from escalator_tpu.analysis.concurrency import (
+    ASSUME_HELD,
+    COVERED_MODULES,
+    EXTERNAL_RECEIVERS,
+    GRPC_RECEIVERS,
+    LockContract,
+    THREADS,
+    resolve_lock,
+)
+
+__all__ = [
+    "ThreadFinding",
+    "ThreadlintReport",
+    "run_threadlint",
+]
+
+_WAIVE_MARK = "# threadlint: waive["
+
+
+@dataclass
+class ThreadFinding:
+    rule: str                 # "T1".."T4" (or "ERR" for unparsable source)
+    site: str                 # "path:qualname"
+    line: int
+    summary: str
+    detail: str = ""
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule, "site": self.site, "line": self.line,
+            "summary": self.summary, "detail": self.detail,
+            "waived": self.waived, "waiver_reason": self.waiver_reason,
+        }
+
+
+@dataclass
+class ThreadlintReport:
+    findings: List[ThreadFinding]
+    modules: List[str]
+
+    @property
+    def unwaived(self) -> List[ThreadFinding]:
+        return [f for f in self.findings if not f.waived]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "unwaived_findings": len(self.unwaived),
+            "modules": self.modules,
+            "contracts": [
+                {"name": c.name, "rank": c.rank, "module": c.module,
+                 "holder": c.holder, "kind": c.kind}
+                for c in concurrency.CONTRACTS
+            ],
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Per-function event extraction
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Event:
+    kind: str                 # acquire|call|wait|block|grpc|write|construct|thread
+    line: int
+    held: Tuple[str, ...]     # contract names lexically held, outermost first
+    data: Any = None
+
+
+@dataclass
+class _FuncInfo:
+    module: str
+    qualname: str
+    events: List[_Event] = field(default_factory=list)
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """['self', '_cv'] for ``self._cv``; None for non-trivial expressions."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if call.args:
+        return True  # wait(0.1) / join(5.0) / result(3) positional
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+class _FunctionVisitor(ast.NodeVisitor):
+    """Collects lock-relevant events with the lexical held-lock context."""
+
+    def __init__(self, module: str, class_name: Optional[str],
+                 qualname: str, out: _FuncInfo) -> None:
+        self.module = module
+        self.class_name = class_name
+        self.out = out
+        seeded = ASSUME_HELD.get((module, qualname), ())
+        self.held: List[str] = list(seeded)
+
+    # -- helpers ------------------------------------------------------------
+    def _lock_of(self, node: ast.AST) -> Optional[LockContract]:
+        chain = _attr_chain(node)
+        if chain is None:
+            return None
+        return resolve_lock(self.module, self.class_name, ".".join(chain))
+
+    def _emit(self, kind: str, line: int, data: Any = None) -> None:
+        self.out.events.append(
+            _Event(kind=kind, line=line, held=tuple(self.held), data=data))
+
+    # -- structure ----------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            c = self._lock_of(item.context_expr)
+            if c is not None:
+                self._emit("acquire", item.context_expr.lineno, c.name)
+                self.held.append(c.name)
+                pushed += 1
+            else:
+                # still scan the context expression (it may contain calls)
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs run on their own schedule (worker closures): they are
+        # indexed and analyzed separately with an empty context
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- writes -------------------------------------------------------------
+    def _record_write_target(self, target: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_write_target(elt)
+            return
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            self._emit("write", target.lineno, target.attr)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_write_target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_write_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_write_target(node.target)
+        self.generic_visit(node)
+
+    # -- calls --------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func)
+        if chain:
+            self._classify_call(node, chain)
+        self.generic_visit(node)
+
+    def _classify_call(self, node: ast.Call, chain: List[str]) -> None:
+        line = node.lineno
+        method = chain[-1]
+        # threading primitive / thread construction (T4 surface)
+        if len(chain) == 2 and chain[0] == "threading":
+            if method in ("Lock", "RLock", "Condition"):
+                self._emit("construct", line, method)
+                return
+            if method == "Thread":
+                name = None
+                for kw in node.keywords:
+                    if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                        name = kw.value.value
+                self._emit("thread", line, name)
+                return
+        # manual acquire on a contracted lock: rank-check without a region
+        if method in ("acquire", "release") and len(chain) >= 2:
+            c = resolve_lock(self.module, self.class_name,
+                             ".".join(chain[:-1]))
+            if c is not None and method == "acquire":
+                self._emit("acquire", line, c.name)
+            if c is not None:
+                return
+        # condition waits: a wait without timeout blocks forever while
+        # (by definition) holding the condition's lock
+        if method in ("wait", "wait_for"):
+            c = resolve_lock(self.module, self.class_name,
+                             ".".join(chain[:-1]))
+            if c is not None and c.kind == "condition":
+                timed = (_has_timeout(node) if method == "wait"
+                         else len(node.args) > 1
+                         or any(kw.arg == "timeout" for kw in node.keywords))
+                if not timed:
+                    self._emit("wait", line, c.name)
+                return
+        # zero-timeout blocking primitives inside a lock body
+        if method == "result" and not _has_timeout(node):
+            self._emit("block", line, f"{'.'.join(chain)}()")
+        elif method == "join" and not node.args and not node.keywords:
+            # bare .join(): Thread.join-forever shape (str.join always
+            # carries its iterable argument, so it never matches)
+            self._emit("block", line, f"{'.'.join(chain)}()")
+        # gRPC round-trips
+        if len(chain) >= 2 and any(r in chain[:-1] for r in GRPC_RECEIVERS):
+            self._emit("grpc", line, ".".join(chain))
+        # call-graph edge
+        callee = self._resolve_callee(chain)
+        if callee is not None:
+            self._emit("call", line, callee)
+
+    def _resolve_callee(self, chain: List[str]) -> Optional[Tuple[str, str]]:
+        if len(chain) == 1:
+            return (self.module, chain[0])
+        if len(chain) == 2 and chain[0] == "self" and self.class_name:
+            return (self.module, f"{self.class_name}.{chain[1]}")
+        if len(chain) >= 2 and chain[-2] in EXTERNAL_RECEIVERS:
+            mod, cls = EXTERNAL_RECEIVERS[chain[-2]]
+            return (mod, f"{cls}.{chain[-1]}")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Module indexing
+# ---------------------------------------------------------------------------
+
+
+def _index_module(module: str, source: str) -> Dict[str, _FuncInfo]:
+    tree = ast.parse(source, filename=module)
+    funcs: Dict[str, _FuncInfo] = {}
+
+    def collect(node: ast.AST, class_name: Optional[str],
+                prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                info = _FuncInfo(module=module, qualname=qual)
+                v = _FunctionVisitor(module, class_name, qual, info)
+                for stmt in child.body:
+                    v.visit(stmt)
+                funcs[qual] = info
+                # nested defs (worker closures): own context, own entry
+                collect(child, class_name, f"{qual}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                collect(child, child.name, f"{child.name}.")
+            elif not isinstance(child, (ast.Import, ast.ImportFrom)):
+                collect(child, class_name, prefix)
+
+    collect(tree, None, "")
+    # module-level statements (lock constructions at import time)
+    top = _FuncInfo(module=module, qualname="<module>")
+    v = _FunctionVisitor(module, None, "<module>", top)
+    for stmt in tree.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            v.visit(stmt)
+    funcs["<module>"] = top
+    return funcs
+
+
+# ---------------------------------------------------------------------------
+# Transitive lock summaries (the T1 call graph)
+# ---------------------------------------------------------------------------
+
+
+class _Summaries:
+    def __init__(self, index: Dict[Tuple[str, str], _FuncInfo]) -> None:
+        self.index = index
+        self.memo: Dict[Tuple[str, str], Dict[str, Tuple[str, ...]]] = {}
+
+    def acquired(self, key: Tuple[str, str],
+                 _seen: Optional[set] = None) -> Dict[str, Tuple[str, ...]]:
+        """lock name -> call chain (qualnames) that reaches the acquisition,
+        transitively from function ``key``. Cycle-safe, memoized."""
+        if key in self.memo:
+            return self.memo[key]
+        seen = _seen if _seen is not None else set()
+        if key in seen:
+            return {}
+        seen.add(key)
+        info = self.index.get(key)
+        out: Dict[str, Tuple[str, ...]] = {}
+        if info is not None:
+            for ev in info.events:
+                if ev.kind == "acquire":
+                    out.setdefault(ev.data, (info.qualname,))
+                elif ev.kind == "call":
+                    for name, chain in self.acquired(
+                            tuple(ev.data), _seen=seen).items():
+                        out.setdefault(name, (info.qualname,) + chain)
+        seen.discard(key)
+        if _seen is None:
+            self.memo[key] = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The rules
+# ---------------------------------------------------------------------------
+
+
+def _rank(name: str) -> int:
+    return concurrency.CONTRACTS_BY_NAME[name].rank
+
+
+def _kind(name: str) -> str:
+    return concurrency.CONTRACTS_BY_NAME[name].kind
+
+
+def _check_function(info: _FuncInfo, summaries: _Summaries,
+                    guarded_owner: Dict[Tuple[str, str], str],
+                    findings: List[ThreadFinding]) -> None:
+    site = f"{info.module}:{info.qualname}"
+    for ev in info.events:
+        if ev.kind == "acquire":
+            for held in ev.held:
+                if held == ev.data and _kind(held) == "rlock":
+                    continue
+                if _rank(ev.data) <= _rank(held):
+                    findings.append(ThreadFinding(
+                        rule="T1", site=site, line=ev.line,
+                        summary=(
+                            f"acquires {ev.data!r} (rank {_rank(ev.data)}) "
+                            f"while holding {held!r} (rank {_rank(held)})"
+                        ),
+                        detail="declared order is strictly ascending ranks "
+                               "(analysis/concurrency.py)",
+                    ))
+        elif ev.kind == "call" and ev.held:
+            acq = summaries.acquired(tuple(ev.data))
+            for name, chain in acq.items():
+                for held in ev.held:
+                    if name == held and _kind(held) == "rlock":
+                        continue
+                    if _rank(name) <= _rank(held):
+                        findings.append(ThreadFinding(
+                            rule="T1", site=site, line=ev.line,
+                            summary=(
+                                f"call while holding {held!r} (rank "
+                                f"{_rank(held)}) transitively acquires "
+                                f"{name!r} (rank {_rank(name)})"
+                            ),
+                            detail="via " + " -> ".join(chain),
+                        ))
+        elif ev.kind == "wait":
+            findings.append(ThreadFinding(
+                rule="T2", site=site, line=ev.line,
+                summary=f"untimed wait on condition {ev.data!r}",
+                detail="a wait without timeout pins the condition's lock "
+                       "slot forever if the notify is lost; every "
+                       "production wait is bounded and re-checks its "
+                       "predicate",
+            ))
+        elif ev.kind == "block" and ev.held:
+            findings.append(ThreadFinding(
+                rule="T2", site=site, line=ev.line,
+                summary=f"unbounded blocking call {ev.data} while holding "
+                        f"{ev.held[-1]!r}",
+                detail="held locks: " + ", ".join(ev.held),
+            ))
+        elif ev.kind == "grpc" and ev.held:
+            findings.append(ThreadFinding(
+                rule="T2", site=site, line=ev.line,
+                summary=f"gRPC call {ev.data} inside a lock body "
+                        f"(holding {ev.held[-1]!r})",
+                detail="a stuck peer must never extend a lock hold; move "
+                       "the round-trip outside the critical section",
+            ))
+        elif ev.kind == "write":
+            owner = guarded_owner.get((info.module, ev.data))
+            if owner is None:
+                continue
+            cls = concurrency.CONTRACTS_BY_NAME[owner].holder.split(".")[0]
+            # only writes on the owning class count (same attr name on an
+            # unrelated class in the same module is a different field)
+            if not info.qualname.startswith(f"{cls}."):
+                continue
+            if info.qualname == f"{cls}.__init__":
+                continue
+            if owner in ev.held:
+                continue
+            findings.append(ThreadFinding(
+                rule="T3", site=site, line=ev.line,
+                summary=f"write to guarded attribute self.{ev.data} outside "
+                        f"its owning lock {owner!r}",
+                detail="declare the lock hold (with-block or ASSUME_HELD) "
+                       "or waive the site inline with its argument",
+            ))
+        elif ev.kind == "construct":
+            findings.append(ThreadFinding(
+                rule="T4", site=site, line=ev.line,
+                summary=f"bare threading.{ev.data}() in a covered module",
+                detail="construct through analysis.lockwitness.make_* so "
+                       "the lock declares a contract name and rank",
+            ))
+        elif ev.kind == "thread":
+            if ev.data is None:
+                findings.append(ThreadFinding(
+                    rule="T4", site=site, line=ev.line,
+                    summary="threading.Thread without a literal name= in a "
+                            "covered module",
+                    detail="name the thread and declare it in "
+                           "concurrency.THREADS",
+                ))
+            elif not any(fnmatch.fnmatch(ev.data, t.name_pattern)
+                         for t in THREADS):
+                findings.append(ThreadFinding(
+                    rule="T4", site=site, line=ev.line,
+                    summary=f"undeclared worker thread {ev.data!r}",
+                    detail="declare it in concurrency.THREADS with its "
+                           "purpose",
+                ))
+
+
+# ---------------------------------------------------------------------------
+# Waivers
+# ---------------------------------------------------------------------------
+
+
+def _apply_inline_waivers(findings: Sequence[ThreadFinding],
+                          lines_by_module: Mapping[str, List[str]]) -> None:
+    for f in findings:
+        module = f.site.split(":", 1)[0]
+        lines = lines_by_module.get(module, [])
+        for ln in (f.line, f.line - 1):
+            if 1 <= ln <= len(lines):
+                text = lines[ln - 1]
+                mark = f"{_WAIVE_MARK}{f.rule}]"
+                idx = text.find(mark)
+                if idx >= 0:
+                    f.waived = True
+                    f.waiver_reason = text[idx + len(mark):].strip() or \
+                        "inline waiver"
+                    break
+
+
+def _apply_ledger_waivers(findings: Sequence[ThreadFinding],
+                          waivers: Sequence[Mapping[str, str]]) -> None:
+    for f in findings:
+        if f.waived:
+            continue
+        for w in waivers:
+            if w.get("rule") == f.rule and fnmatch.fnmatch(
+                    f.site, w.get("site", "")):
+                f.waived = True
+                f.waiver_reason = w.get("reason", "")
+                break
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def run_threadlint(
+    root: Optional[str] = None,
+    sources: Optional[Mapping[str, str]] = None,
+    extra_waivers: Optional[Sequence[Mapping[str, str]]] = None,
+) -> ThreadlintReport:
+    """Analyze the covered modules (plus/overridden-by ``sources``: a
+    ``{repo-relative-path: source-text}`` mapping — how the mutation tests
+    feed re-introduced bugs) and apply waivers."""
+    from escalator_tpu.analysis.waivers import THREAD_WAIVERS
+
+    if root is None:
+        # analysis/ -> escalator_tpu/ -> repo root
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    texts: Dict[str, str] = {}
+    findings: List[ThreadFinding] = []
+    modules = list(COVERED_MODULES)
+    for extra_mod in (sources or {}):
+        if extra_mod not in modules:
+            modules.append(extra_mod)
+    for module in modules:
+        if sources and module in sources:
+            texts[module] = sources[module]
+            continue
+        path = os.path.join(root, module)
+        try:
+            with open(path) as fh:
+                texts[module] = fh.read()
+        except OSError as e:
+            findings.append(ThreadFinding(
+                rule="ERR", site=f"{module}:<file>", line=0,
+                summary=f"covered module unreadable: {e}",
+            ))
+    index: Dict[Tuple[str, str], _FuncInfo] = {}
+    lines_by_module: Dict[str, List[str]] = {}
+    for module, text in texts.items():
+        lines_by_module[module] = text.splitlines()
+        try:
+            for qual, info in _index_module(module, text).items():
+                index[(module, qual)] = info
+        except SyntaxError as e:
+            findings.append(ThreadFinding(
+                rule="ERR", site=f"{module}:<parse>", line=e.lineno or 0,
+                summary=f"covered module failed to parse: {e.msg}",
+            ))
+    guarded_owner: Dict[Tuple[str, str], str] = {}
+    for c in concurrency.CONTRACTS:
+        for attr in c.guarded:
+            guarded_owner[(c.module, attr)] = c.name
+    summaries = _Summaries(index)
+    for info in index.values():
+        _check_function(info, summaries, guarded_owner, findings)
+    findings.sort(key=lambda f: (f.site, f.line, f.rule))
+    _apply_inline_waivers(findings, lines_by_module)
+    _apply_ledger_waivers(
+        findings, list(THREAD_WAIVERS) + list(extra_waivers or []))
+    return ThreadlintReport(findings=findings, modules=sorted(texts))

@@ -47,10 +47,11 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
+
+from escalator_tpu.analysis import lockwitness
 
 log = logging.getLogger("escalator_tpu.chaos")
 
@@ -83,7 +84,7 @@ class ChaosMonkey:
     on tick, gRPC worker, audit worker and renew threads alike)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("chaos.rules")
         self._rules: Dict[str, ChaosRule] = {}
         self._armed = False   # lock-free fast path for the disarmed case
 

@@ -69,6 +69,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple, Union
 
 from escalator_tpu import observability as obs
+from escalator_tpu.analysis import lockwitness
 from escalator_tpu.fleet.service import (
     DecideRequest,
     EvictRequest,
@@ -206,7 +207,7 @@ class FleetScheduler:
         self.default_class = default_class
         self._queues: Dict[str, deque] = {
             name: deque() for name in self.classes}
-        self._cv = threading.Condition()
+        self._cv = lockwitness.make_condition("scheduler.cv")
         self._inflight: Dict[str, int] = {}
         self._paused = False
         self._closed = False

@@ -29,10 +29,10 @@ from __future__ import annotations
 import collections
 import json
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from escalator_tpu.analysis import lockwitness
 from escalator_tpu.observability import histograms, jaxmon, spans
 
 DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_FLIGHT_RECORDER_SIZE",
@@ -74,7 +74,7 @@ class FlightRecorder:
         self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
             maxlen=self.capacity)
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("recorder.ring")
 
     # -- recording ---------------------------------------------------------
     def record_timeline(self, tl: spans.Timeline) -> Dict[str, Any]:

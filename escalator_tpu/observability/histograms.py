@@ -35,9 +35,10 @@ native histograms) is a pull-time collector in metrics/metrics.py.
 from __future__ import annotations
 
 import math
-import threading
 from array import array
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from escalator_tpu.analysis import lockwitness
 
 __all__ = [
     "BASE", "LO", "HI", "NUM_BUCKETS", "EDGES",
@@ -124,7 +125,7 @@ class LogHistogram:
         self._sum = 0.0
         self._max = 0.0
         self._min = math.inf
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("histograms.series")
 
     # -- writing -----------------------------------------------------------
     def record(self, seconds: float) -> None:
@@ -242,7 +243,7 @@ class HistogramSet:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("histograms.set")
         self._hists: Dict[Tuple[str, ...], LogHistogram] = {}
 
     def get(self, *key: str) -> LogHistogram:

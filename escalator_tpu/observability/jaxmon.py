@@ -44,11 +44,12 @@ from __future__ import annotations
 
 import collections
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
-_lock = threading.Lock()
+from escalator_tpu.analysis import lockwitness
+
+_lock = lockwitness.make_lock("jaxmon.state")
 _installed = False
 _install_failed: str = ""
 

@@ -44,9 +44,10 @@ from __future__ import annotations
 
 import collections
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from escalator_tpu.analysis import lockwitness
 
 __all__ = ["OpsJournal", "JOURNAL"]
 
@@ -91,7 +92,7 @@ class OpsJournal:
         self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
             maxlen=self.capacity)
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("journal.ring")
 
     # -- writing -----------------------------------------------------------
     def event(self, kind: str, **fields: Any) -> Optional[Dict[str, Any]]:

@@ -35,11 +35,12 @@ from __future__ import annotations
 import base64
 import collections
 import os
-import threading
 import zlib
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from escalator_tpu.analysis import lockwitness
 
 DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_INPUT_LOG_SIZE", "256"))
 
@@ -79,7 +80,7 @@ class TickInputLog:
         self.capacity = int(capacity)
         self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
             maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("replay.ring")
         self._enabled = os.environ.get(
             "ESCALATOR_TPU_RECORD_INPUTS", "0").lower() in ("1", "true", "yes")
 

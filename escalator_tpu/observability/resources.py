@@ -57,6 +57,8 @@ import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from escalator_tpu.analysis import lockwitness
+
 __all__ = [
     "RESOURCES", "MEMORY_WATCHDOG", "PROFILER",
     "ResourceRegistry", "MemoryWatchdog", "ProfileCapture",
@@ -88,7 +90,7 @@ DEFAULT_SAMPLE_EVERY = 8
 # Platform capability probe (the unavailable_reason() pattern)
 # ---------------------------------------------------------------------------
 
-_caps_lock = threading.Lock()
+_caps_lock = lockwitness.make_lock("resources.caps")
 _caps: Optional[Dict[str, Optional[str]]] = None
 
 
@@ -237,7 +239,7 @@ class ResourceRegistry:
     Prometheus series stays bounded. Dead referents prune lazily."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("resources.registry")
         self._entries: Dict[Tuple[str, int], Tuple[
             "weakref.ref", Callable[[Any], Any],
             Optional[Callable[[Any], Optional[int]]], str]] = {}
@@ -519,7 +521,7 @@ class MemoryWatchdog:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("resources.memwatch")
         self._samples: "collections.deque[int]" = collections.deque(
             maxlen=DEFAULT_WINDOW)
         self._last_dump_mono = -float("inf")
@@ -685,7 +687,7 @@ class ProfileCapture:
     STOP_TIMEOUT_SEC = 180.0
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("resources.profiler")
         self._active = False
         self._stopping = False
         self._remaining = 0

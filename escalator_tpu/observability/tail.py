@@ -52,6 +52,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from escalator_tpu.analysis import lockwitness
 from escalator_tpu.observability import histograms
 
 __all__ = ["TailWatchdog", "WATCHDOG", "parse_tail_capture"]
@@ -93,7 +94,7 @@ class TailWatchdog:
     """Per-process tail-breach detector (singleton :data:`WATCHDOG`)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("tail.watchdog")
         #: rate-limit claims PER ROOT FAMILY (see _root_family): a breach
         #: storm on fleet/<tenant> roots must not starve tick-root dumps
         self._last_dump_mono: Dict[str, float] = {}

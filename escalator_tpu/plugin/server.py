@@ -45,7 +45,6 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 import time
 from concurrent import futures
 from concurrent.futures import TimeoutError as FuturesTimeoutError
@@ -57,6 +56,7 @@ import numpy as np
 
 from escalator_tpu import __version__
 from escalator_tpu import observability as obs
+from escalator_tpu.analysis import lockwitness
 from escalator_tpu.metrics import metrics
 from escalator_tpu.plugin import codec
 
@@ -145,7 +145,7 @@ class _ComputeService:
         # handlers run on the gRPC worker pool: the served-tick stats are
         # read-modify-written under this lock so concurrent Decides (two
         # controllers, or controller + bench) never lose an increment
-        self._stats_lock = threading.Lock()
+        self._stats_lock = lockwitness.make_lock("server.stats")
         self._last_decide_unix: "float | None" = None
         self._ticks_served = 0
         self._fleet_cfg = fleet

@@ -13,6 +13,7 @@ import types
 import numpy as np
 import pytest
 
+from escalator_tpu.analysis import lockwitness
 from escalator_tpu.analysis.registry import representative_cluster
 from escalator_tpu.fleet import service as service_mod
 from escalator_tpu.fleet import (
@@ -303,7 +304,7 @@ def test_evict_retires_per_tenant_histogram_series():
     "num_shards",
     [pytest.param(1, marks=pytest.mark.slow), 2,
      pytest.param(4, marks=pytest.mark.slow)])
-def test_engine_randomized_multi_tenant_soak(num_shards):
+def test_engine_randomized_multi_tenant_soak(num_shards, monkeypatch):
     """The acceptance soak: randomized per-tick churn over a live fleet
     WITH tenant lifecycle churn (add/evict/grow mid-run); every tenant's
     13 columns bit-identical to its standalone decide — the unsharded
@@ -315,6 +316,12 @@ def test_engine_randomized_multi_tenant_soak(num_shards):
     every grown-shape compile against the tier-1 870 s budget, and the
     S=1 squeeze path rides every default-engine test in this file) —
     CI's unfiltered suite runs all three."""
+    # the soak runs under the armed lock witness: every engine lock is a
+    # ranked primitive, and any out-of-rank acquisition anywhere in the
+    # churn (grow, evict, digest re-dispatch) fails the test immediately
+    # instead of deadlocking it
+    monkeypatch.setenv("ESCALATOR_TPU_LOCK_WITNESS", "1")
+    witness_base = len(lockwitness.VIOLATIONS)
     rng = np.random.default_rng(17)
     pyrng = random.Random(17)
     eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
@@ -406,6 +413,8 @@ def test_engine_randomized_multi_tenant_soak(num_shards):
                     CHAOS.disarm("fleet_digest")
     assert eng.audit() == [], "maintained fleet aggregates diverged"
     assert eng.cache_hits > 0, "the soak never exercised the digest cache"
+    assert lockwitness.VIOLATIONS[witness_base:] == [], \
+        "the soak tripped the lock-order witness"
 
 
 def _copy_soa(soa):
@@ -1249,10 +1258,14 @@ def test_scheduler_pipelined_overlap_accounting():
         sched.shutdown()
 
 
-def test_scheduler_pipelined_shutdown_drains_inflight():
+def test_scheduler_pipelined_shutdown_drains_inflight(monkeypatch):
     """Satellite: shutdown with a batch mid-dispatch and another staged —
     both DRAIN (their futures resolve with results); queued-but-never-
-    prepped futures fail cleanly with RuntimeError."""
+    prepped futures fail cleanly with RuntimeError. Runs under the armed
+    lock witness: the shutdown/drain handoff is exactly where the PR-11
+    class of inversion would bite."""
+    monkeypatch.setenv("ESCALATOR_TPU_LOCK_WITNESS", "1")
+    witness_base = len(lockwitness.VIOLATIONS)
     eng = _FakeTwoStage(exec_sec=0.4)
     sched = FleetScheduler(eng, max_batch=1, flush_ms=1.0, queue_limit=64,
                            per_tenant_inflight=4)
@@ -1274,6 +1287,8 @@ def test_scheduler_pipelined_shutdown_drains_inflight():
         except RuntimeError:
             failed += 1
     assert failed == len(futs_late), "queued futures did not fail cleanly"
+    assert lockwitness.VIOLATIONS[witness_base:] == [], \
+        "pipelined shutdown tripped the lock-order witness"
 
 
 def test_scheduler_stats_snapshot_fields():

@@ -178,6 +178,89 @@ def test_mutation_host_callback_fires_R4():
     assert "R4" in _rules_of(report)
 
 
+def test_mutation_stray_host_scalar_fires_R7():
+    """Feed a python float back into a jitted callee at execute time — the
+    'stray float(x) on the dispatch path' bug class. Under the transfer
+    guard the implicit host->device transfer is an error R7 reports."""
+    def build():
+        body = jax.jit(lambda x, s: x * s)
+
+        def fn(x):
+            return body(x, 2.0)
+
+        def execute(placed):
+            (x,) = placed
+            return body(x, float(np.asarray(x)[0]))  # host scalar re-fed
+
+        return TracedEntry(fn=fn, args=(np.arange(8.0),), execute=execute)
+
+    entry = KernelEntry(
+        name="mutation.stray_host_scalar", module="test", kind="jit",
+        build=build,
+    )
+    report = analyze_entry(entry, with_retrace=False, with_execute=True)
+    r7 = [f for f in report.findings if f.rule == "R7"]
+    assert r7, report.findings
+    assert "host-to-device" in r7[0].detail or "host_to_device" in r7[0].detail
+
+    # the same entry with a declared escape hatch is clean
+    allowed = dataclasses.replace(entry, name="mutation.allowed_transfer",
+                                  transfer_allow=("host_to_device",))
+    report = analyze_entry(allowed, with_retrace=False, with_execute=True)
+    assert not [f for f in report.findings if f.rule == "R7"]
+
+
+def test_mutation_unknown_transfer_allow_direction_fires_R7():
+    entry = KernelEntry(
+        name="mutation.bad_direction", module="test", kind="jit",
+        build=lambda: TracedEntry(fn=lambda x: x * 2, args=(np.arange(4.0),)),
+        transfer_allow=("host_to_devize",),
+    )
+    report = analyze_entry(entry, with_retrace=False, with_execute=True)
+    r7 = [f for f in report.findings if f.rule == "R7"]
+    assert r7 and "host_to_devize" in r7[0].summary
+
+
+def test_mutation_callback_in_overlap_span_fires_R8():
+    """A host callback smuggled into a kernel that the host path overlaps
+    with prep: the lowered module grows a host-sync custom call, which
+    would serialize the span the overlap machinery assumes is fenceless."""
+    def build():
+        def fn(x):
+            jax.debug.callback(lambda v: None, x[0])
+            return x * 2
+
+        return TracedEntry(fn=fn, args=(np.arange(8.0),),
+                           jitted=jax.jit(fn))
+
+    entry = KernelEntry(
+        name="mutation.sync_in_span", module="test", kind="jit",
+        build=build, overlap_span="decide",
+    )
+    report = analyze_entry(entry, with_retrace=False)
+    r8 = [f for f in report.findings if f.rule == "R8"]
+    assert r8, report.findings
+    assert "decide" in r8[0].summary
+    # without the overlap_span declaration R8 does not apply (R4 still
+    # catches the callback itself)
+    plain = dataclasses.replace(entry, name="mutation.no_span",
+                                overlap_span=None)
+    report = analyze_entry(plain, with_retrace=False)
+    assert not [f for f in report.findings if f.rule == "R8"]
+
+
+@pytest.mark.slow
+def test_full_registry_transfer_hygiene_is_clean():
+    """R7 over the whole registry: every entry executes under the transfer
+    guard without an unwaived finding. Slow-marked — this actually compiles
+    and runs all 32 entries."""
+    report = run_analysis(with_retrace=False, with_execute=True)
+    unwaived = report.unwaived
+    assert not unwaived, "\n".join(
+        f"{f.rule} {f.entry}: {f.summary} ({f.detail})" for f in unwaived
+    )
+
+
 # ---------------------------------------------------------------------------
 # Walker + waiver mechanics
 # ---------------------------------------------------------------------------
