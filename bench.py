@@ -2860,13 +2860,8 @@ def run_smoke() -> dict:
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "REPLAY_SMOKE_LATEST.json"),
     )
-    try:
-        with open(replay_artifact, "w") as f:
-            json.dump(replay_report, f, indent=1)
-            f.write("\n")
-        out["replay_smoke_report"] = replay_artifact
-    except OSError:   # read-only checkout: the in-memory asserts still ran
-        out["replay_smoke_report"] = "(write failed)"
+    out["replay_smoke_report"] = write_smoke_artifact(
+        replay_artifact, replay_report)
     _leg("replay")
 
     # ---- streaming ingestion smoke (round 12): event-driven vs re-list ---
@@ -3023,13 +3018,8 @@ def run_smoke() -> dict:
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "HOST_PHASES_SMOKE_LATEST.json"),
     )
-    try:
-        with open(host_phase_path, "w") as f:
-            json.dump(host_phases, f, indent=1)
-            f.write("\n")
-        out["host_phases_report"] = host_phase_path
-    except OSError:   # read-only checkout: the in-memory asserts still ran
-        out["host_phases_report"] = "(write failed)"
+    out["host_phases_report"] = write_smoke_artifact(
+        host_phase_path, host_phases)
     _leg("streaming")
 
     # ---- flight recorder: populated, named phases, bounded overhead ------
@@ -3271,15 +3261,12 @@ def run_smoke() -> dict:
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "TRACE_SMOKE_LATEST.trace.json"),
     )
+    out["tail_smoke_report"] = write_smoke_artifact(tail_artifact, tail_report)
     try:
-        with open(tail_artifact, "w") as f:
-            json.dump(tail_report, f, indent=1)
-            f.write("\n")
-        out["tail_smoke_report"] = tail_artifact
         shutil.copyfile(trace_out_path, trace_artifact)
         out["trace_smoke_artifact"] = trace_artifact
     except OSError:   # read-only checkout: the in-memory asserts still ran
-        out["tail_smoke_report"] = "(write failed)"
+        out["trace_smoke_artifact"] = "(write failed)"
     shutil.rmtree(tail_dir, ignore_errors=True)
     _leg("tail_trace")
 
@@ -3548,13 +3535,8 @@ def run_smoke() -> dict:
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "FLEET_SMOKE_LATEST.json"),
     )
-    try:
-        with open(fleet_artifact, "w") as f:
-            json.dump(fleet_report, f, indent=1)
-            f.write("\n")
-        out["fleet_smoke_report"] = fleet_artifact
-    except OSError:   # read-only checkout: the in-memory asserts still ran
-        out["fleet_smoke_report"] = "(write failed)"
+    out["fleet_smoke_report"] = write_smoke_artifact(
+        fleet_artifact, fleet_report)
     _leg("fleet")
 
     # ---- request-journey smoke (round 17): a MULTI-CLASS run through the
@@ -3808,13 +3790,8 @@ def run_smoke() -> dict:
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "JOURNEY_SMOKE_LATEST.json"),
     )
-    try:
-        with open(journey_artifact, "w") as f:
-            json.dump(journey_report, f, indent=1)
-            f.write("\n")
-        out["journey_smoke_report"] = journey_artifact
-    except OSError:   # read-only checkout: the in-memory asserts still ran
-        out["journey_smoke_report"] = "(write failed)"
+    out["journey_smoke_report"] = write_smoke_artifact(
+        journey_artifact, journey_report)
     out["smoke_journey_mode"] = fleet_mode
     _leg("journey")
 
@@ -3829,9 +3806,196 @@ def run_smoke() -> dict:
                      "FLIGHT_SMOKE_LATEST.json"),
     )
     try:
-        out["flight_recorder_dump"] = RECORDER.dump(dump_path, reason="smoke")
+        dumped = RECORDER.dump(dump_path, reason="smoke")
+        # canonicalize the committed artifact (sorted keys, fixed float
+        # precision) without touching the live incident-dump format
+        with open(dumped) as f:
+            out["flight_recorder_dump"] = write_smoke_artifact(
+                dumped, json.load(f))
     except OSError:   # read-only checkout: the in-memory asserts still ran
         out["flight_recorder_dump"] = "(write failed)"
+
+    # ---- decision provenance smoke (round 19): explain-vs-columns bit
+    # parity on a LIVE fleet server, a forced up/down oscillation through
+    # the real decide path firing the flap watchdog (journal event +
+    # reason="flap" dump with the flapping group's explanations attached),
+    # a steady tenant firing NOTHING, and a debug-explain CLI round-trip
+    # over the real RPC. Written to PROVENANCE_SMOKE_LATEST.json for CI
+    # upload. Runs after the committed flight dump above on purpose: this
+    # leg's ~20 extra plugin ticks must not flush the streaming/incremental
+    # records out of the 256-deep ring the FLIGHT_SMOKE artifact carries.
+    prov_report: dict = {"smoke": True, "mode": fleet_mode}
+    if fleet_mode == "grpc":
+        import dataclasses as _pdc
+
+        from escalator_tpu.observability import journal as _pjournal
+        from escalator_tpu.observability import provenance as _prov
+
+        _prov.HISTORY.reset()
+        _prov.FLAPS.reset()
+        prov_dir = tempfile.mkdtemp(prefix="escalator-prov-smoke-")
+        prov_old_dump_dir = os.environ.get("ESCALATOR_TPU_DUMP_DIR")
+        os.environ["ESCALATOR_TPU_DUMP_DIR"] = prov_dir
+        prov_journal_seq = (_pjournal.JOURNAL.snapshot()[-1]["seq"]
+                           if _pjournal.JOURNAL.snapshot() else 0)
+        Gv, Pv, Nv = 4, 16, 8
+        psrv = make_server("127.0.0.1:0", max_workers=8, fleet=FleetConfig(
+            num_groups=Gv, pod_capacity=Pv, node_capacity=Nv, max_tenants=4,
+            max_batch=4, flush_ms=5.0, queue_limit=64,
+            per_tenant_inflight=1, num_shards=1))
+        psrv.start()
+        prov_addr = f"127.0.0.1:{psrv._escalator_bound_port}"
+        pclient = _FC(prov_addr, timeout_sec=300.0)
+        try:
+            base_c = representative_cluster(Gv, Pv, Nv, seed=940)
+
+            def _with_load(cpu_milli: int, mem_bytes: int):
+                """The same tenant topology under a different pod load:
+                heavy pushes every populated group over scale_up_thr (70%),
+                light drops max_percent under taint_lower (30%)."""
+                pods = _pdc.replace(
+                    base_c.pods,
+                    cpu_milli=np.full_like(
+                        np.asarray(base_c.pods.cpu_milli), cpu_milli),
+                    mem_bytes=np.full_like(
+                        np.asarray(base_c.pods.mem_bytes), mem_bytes))
+                return _pdc.replace(base_c, pods=pods)
+
+            heavy = _with_load(3800, 15 * 10**9)
+            light = _with_load(10, 10**6)
+
+            # a steady control tenant: the same light frame every tick —
+            # constant decisions must fire NOTHING (the watchdog's silence
+            # half of the acceptance criterion)
+            for i in range(6):
+                pclient.decide_arrays_fleet(light, int(now) + i, "steady")
+
+            # the forced oscillation: alternate heavy/light so nodes_delta
+            # flips sign every tick on the populated groups
+            flap_deltas = []
+            last_o = None
+            for i in range(12):
+                last_o, _p, _meta = pclient.decide_arrays_fleet(
+                    heavy if i % 2 == 0 else light, int(now) + 100 + i,
+                    "flappy")
+                flap_deltas.append(np.asarray(last_o.nodes_delta).copy())
+            deltas = np.stack(flap_deltas)                      # [T, G]
+            signs = np.sign(deltas)
+            alternating = [
+                g for g in range(Gv)
+                if ((signs[1:, g] != 0) & (signs[:-1, g] != 0)
+                    & (signs[1:, g] != signs[:-1, g])).sum() >= 3]
+            assert alternating, (
+                f"forced oscillation produced no sign-alternating group: "
+                f"{deltas.tolist()}")
+            prov_report["alternating_groups"] = alternating
+
+            # watchdog fired for the flapping tenant, stayed silent for the
+            # steady one; the dump worker finishes before we read the dir
+            _prov.FLAPS.drain()
+            assert _prov.FLAPS.flaps >= 1, "flap watchdog never fired"
+            flap_keys = {r["key"] for r in list(_prov.FLAPS.recent)}
+            assert flap_keys == {"flappy"}, (
+                f"flap watchdog misattributed: {flap_keys}")
+            flap_events = [
+                e for e in _pjournal.JOURNAL.snapshot(
+                    since_seq=prov_journal_seq, kinds=["group-flap"])
+                if e.get("key") == "flappy"]
+            assert flap_events, "no group-flap journal event"
+            assert any(set(e["groups"]) & set(alternating)
+                       for e in flap_events), (flap_events, alternating)
+            flap_dumps = sorted(
+                p for p in os.listdir(prov_dir) if "-flap-" in p)
+            assert flap_dumps, f"no reason=flap dump in {prov_dir}"
+            with open(os.path.join(prov_dir, flap_dumps[0])) as f:
+                flap_doc = json.load(f)
+            assert flap_doc["reason"] == "flap", flap_doc["reason"]
+            flap_info = flap_doc["flap"]
+            dumped_groups = {d["group"]
+                             for d in flap_info.get("explanations", [])}
+            assert dumped_groups & set(alternating), (
+                f"flap dump explanations name groups {dumped_groups}, "
+                f"expected one of {alternating}")
+            prov_report["flaps"] = {
+                "fired": int(_prov.FLAPS.flaps),
+                "dumps": int(_prov.FLAPS.dumps),
+                "journal_events": len(flap_events),
+                "dump_reason": flap_doc["reason"],
+                "dump_groups": sorted(dumped_groups),
+            }
+            out["smoke_provenance_flap"] = "ok"
+
+            # explain-vs-columns bit parity over the real Explain RPC: the
+            # served explanations must match the LAST decide's columns
+            # bit-for-bit and carry no cross-check mismatches
+            resp = pclient.explain("flappy")
+            docs = resp["explanations"]
+            assert len(docs) == Gv, (len(docs), Gv)
+            last = flap_deltas[-1]
+            mm_before = _prov.mismatch_total()
+            last_status = np.asarray(last_o.status)
+            last_cpu = np.asarray(last_o.cpu_percent)
+            last_mem = np.asarray(last_o.mem_percent)
+            for d in docs:
+                g = d["group"]
+                assert "mismatches" not in d, d["mismatches"]
+                assert d["status"] == int(last_status[g]), (
+                    g, d["status"], int(last_status[g]))
+                assert d["nodes_delta"] == int(last[g]), (
+                    g, d["nodes_delta"], int(last[g]))
+                assert d["threshold_branch"] in _prov.THRESHOLD_BRANCHES
+                # float terms are served bit-exact, not approximately
+                assert (np.float64(d["terms"]["cpu_percent"]).tobytes()
+                        == last_cpu[g].tobytes()), (g, "cpu_percent")
+                assert (np.float64(d["terms"]["mem_percent"]).tobytes()
+                        == last_mem[g].tobytes()), (g, "mem_percent")
+            assert _prov.mismatch_total() == mm_before == 0, (
+                "explain cross-check mismatches in the smoke")
+            assert len(resp["history"]) >= 8, len(resp["history"])
+            prov_report["explain"] = {
+                "groups": len(docs),
+                "mismatches": int(_prov.mismatch_total()),
+                "threshold_branches": sorted(
+                    {d["threshold_branch"] for d in docs}),
+                "history_depth": len(resp["history"]),
+            }
+            out["smoke_provenance_parity"] = "ok"
+
+            # health surfaces the provenance section
+            ph = pclient.health()
+            assert ph["provenance"]["flaps_total"] >= 1, ph["provenance"]
+            prov_report["health"] = ph["provenance"]
+
+            # debug-explain CLI round-trip over the real RPC: discovery
+            # then per-tenant (rc 0 = no mismatches anywhere)
+            from escalator_tpu.cli import main as _prov_cli
+            rc_disc = _prov_cli(["debug-explain",
+                                 "--plugin-address", prov_addr])
+            rc_tenant = _prov_cli(["debug-explain",
+                                   "--plugin-address", prov_addr,
+                                   "--tenant", "flappy"])
+            assert rc_disc == 0 and rc_tenant == 0, (rc_disc, rc_tenant)
+            prov_report["cli"] = {"discovery_rc": rc_disc,
+                                  "tenant_rc": rc_tenant}
+            out["smoke_provenance_cli"] = "ok"
+        finally:
+            pclient.close()
+            psrv.stop(grace=None)
+            if prov_old_dump_dir is None:
+                os.environ.pop("ESCALATOR_TPU_DUMP_DIR", None)
+            else:
+                os.environ["ESCALATOR_TPU_DUMP_DIR"] = prov_old_dump_dir
+            import shutil as _pshutil
+            _pshutil.rmtree(prov_dir, ignore_errors=True)
+    prov_artifact = os.environ.get(
+        "ESCALATOR_TPU_PROVENANCE_SMOKE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "PROVENANCE_SMOKE_LATEST.json"),
+    )
+    out["provenance_smoke_report"] = write_smoke_artifact(
+        prov_artifact, prov_report)
+    out["smoke_provenance_mode"] = fleet_mode
+    _leg("provenance")
 
     # ---- device resource observatory smoke (round 15): per-owner budgets,
     # forced-leak watchdog fire, compile-ring attribution, and a
@@ -4030,13 +4194,8 @@ def run_smoke() -> dict:
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "MEMORY_SMOKE_LATEST.json"),
     )
-    try:
-        with open(memory_artifact, "w") as f:
-            json.dump(_round_floats(memory_report), f, indent=1)
-            f.write("\n")
-        out["memory_smoke_report"] = memory_artifact
-    except OSError:   # read-only checkout: the in-memory asserts still ran
-        out["memory_smoke_report"] = "(write failed)"
+    out["memory_smoke_report"] = write_smoke_artifact(
+        memory_artifact, memory_report)
     return out
 
 
@@ -4068,6 +4227,35 @@ def _device_label(device, degraded: bool) -> str:
 def _round_floats(detail: dict) -> dict:
     return {k: (round(v, 3) if isinstance(v, float) else v)
             for k, v in detail.items()}
+
+
+def _canon_smoke(obj, ndigits: int = 4):
+    """Canonical smoke-artifact form (round 19 satellite): every float leaf
+    (durations, rates, percentiles) rounded to a fixed precision, recursively.
+    Together with sorted keys this makes regenerating an artifact with
+    unchanged behavior an empty diff instead of 49 lines of timing noise
+    (the PR-17 tip commit)."""
+    if isinstance(obj, dict):
+        return {k: _canon_smoke(v, ndigits) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_canon_smoke(v, ndigits) for v in obj]
+    if isinstance(obj, float):
+        return round(obj, ndigits)
+    return obj
+
+
+def write_smoke_artifact(path: str, report) -> str:
+    """The ONE ``*_SMOKE_LATEST.json`` writer: sorted keys + fixed float
+    precision (see :func:`_canon_smoke`). Returns the path written, or
+    ``"(write failed)"`` on a read-only checkout — the in-memory asserts
+    already ran, so a failed artifact write is reported, not fatal."""
+    try:
+        with open(path, "w") as f:
+            json.dump(_canon_smoke(report), f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+    except OSError:
+        return "(write failed)"
 
 
 def _atomic_json_write(path: str, rec: dict) -> None:

@@ -102,6 +102,7 @@ COVERED_MODULES: Tuple[str, ...] = (
     "escalator_tpu/observability/jaxmon.py",
     "escalator_tpu/observability/replay.py",
     "escalator_tpu/observability/resources.py",
+    "escalator_tpu/observability/provenance.py",
 )
 
 
@@ -231,6 +232,38 @@ CONTRACTS: List[LockContract] = [
         doc="profiler-capture state machine; stop runs on its own worker.",
     ),
     LockContract(
+        name="provenance.history", rank=82,
+        module="escalator_tpu/observability/provenance.py",
+        holder="DecisionHistory._lock", kind="lock",
+        doc="the per-key decision-history rings (LRU dict of deques); "
+            "push/history/keys do pure container work under it.",
+        guarded=("_rings", "_seq"),
+    ),
+    LockContract(
+        name="provenance.flaps", rank=84,
+        module="escalator_tpu/observability/provenance.py",
+        holder="FlapWatchdog._lock", kind="lock",
+        doc="flap debounce/rate-limit claims + worker handoff; the journal "
+            "event, metrics and the dump run OUTSIDE it (same shape as "
+            "tail.watchdog).",
+        guarded=("_last_dump_mono", "_last_flap", "_worker", "_totals",
+                 "flaps", "dumps"),
+    ),
+    LockContract(
+        name="provenance.mismatch", rank=86,
+        module="escalator_tpu/observability/provenance.py",
+        holder="_mismatch_lock", kind="lock",
+        doc="explain-mismatch totals + dump rate limit (module global); "
+            "list-cell mutations only, nothing lock-taking under it.",
+    ),
+    LockContract(
+        name="provenance.explainers", rank=88,
+        module="escalator_tpu/observability/provenance.py",
+        holder="_explainers_lock", kind="lock",
+        doc="the live-explainer weakref table; resolution copies under it "
+            "and calls the provider after release.",
+    ),
+    LockContract(
         name="chaos.rules", rank=90,
         module="escalator_tpu/chaos.py",
         holder="ChaosMonkey._lock", kind="lock",
@@ -272,6 +305,9 @@ THREADS: List[ThreadContract] = [
     ThreadContract("escalator-tail-dump",
                    "escalator_tpu/observability/tail.py",
                    "tail-breach dump serializer (daemon, off the tick)"),
+    ThreadContract("escalator-flap-dump",
+                   "escalator_tpu/observability/provenance.py",
+                   "group-flap dump serializer (daemon, off the tick)"),
     ThreadContract("escalator-memory-dump",
                    "escalator_tpu/observability/resources.py",
                    "memory-breach dump serializer (daemon, off the tick)"),

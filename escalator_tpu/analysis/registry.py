@@ -118,6 +118,27 @@ AGGREGATE_DTYPES: Dict[str, str] = {
     "dirty": "bool",
 }
 
+#: The explain-kernel contract (round 19): the 13 persistent decision
+#: columns RECONSTRUCTED by ``kernel.explain_decide`` must carry exactly
+#: the committed columns' dtypes — the provenance cross-check compares
+#: them bit-for-bit, so a silent widening/demotion here would fabricate
+#: mismatches (or worse, mask real ones). The two branch indices are the
+#: attribution surface: small int32 selectors into the named arm tuples.
+#: Derivation terms, gate booleans and config echoes ride along undeclared
+#: (R2's contract is a subset check by design).
+EXPLAIN_DTYPES: Dict[str, str] = {
+    name: DECISION_DTYPES[name]
+    for name in (
+        "status", "nodes_delta", "cpu_percent", "mem_percent",
+        "cpu_request_milli", "mem_request_bytes",
+        "cpu_capacity_milli", "mem_capacity_bytes",
+        "num_pods", "num_nodes", "num_untainted", "num_tainted",
+        "num_cordoned",
+    )
+}
+EXPLAIN_DTYPES["threshold_branch"] = "int32"
+EXPLAIN_DTYPES["status_branch"] = "int32"
+
 
 @dataclass
 class TracedEntry:
@@ -454,6 +475,117 @@ def _probe_fleet_step_drain_retraces() -> int:
             *_fleet_step_drain_args(seed=seed, row=row))
         jax.block_until_ready(out)
     return ds._fleet_step._cache_size() - before
+
+
+def _explain_decide_args(seed: int = 0):
+    """Representative explain-kernel operands: the [G] group config rows
+    plus randomized per-group aggregate columns at the EXACT dtypes the
+    incremental/fleet callers feed (int64 sums, int32 counts)."""
+    from escalator_tpu.ops import device_state as _ds  # noqa: F401
+    # ^ registers the bare GroupArrays pytree the explain kernel takes
+
+    rng = np.random.default_rng(seed + 900)
+    G = GROUPS
+    g = representative_cluster(seed=seed).groups
+    i64 = lambda hi: rng.integers(0, hi, G).astype(np.int64)  # noqa: E731
+    i32 = lambda hi: rng.integers(0, hi, G).astype(np.int32)  # noqa: E731
+    return (g, i64(10**6), i64(10**12), i64(10**7), i64(10**13),
+            i32(50), i32(20), i32(20), i32(5), i32(3))
+
+
+def _build_explain_decide() -> TracedEntry:
+    from escalator_tpu.ops import kernel
+
+    return TracedEntry(fn=kernel.explain_decide, args=_explain_decide_args(),
+                       jitted=kernel._explain_decide_raw)
+
+
+def _probe_explain_decide_retraces() -> int:
+    """Two explain calls at the same shapes, different group configs and
+    aggregate contents: exactly one compile — explain is content-blind."""
+    from escalator_tpu.ops import kernel
+
+    before = kernel._explain_decide_raw._cache_size()
+    for seed in (91, 92):
+        jax.block_until_ready(
+            kernel._explain_decide_raw(*_explain_decide_args(seed=seed)))
+    return kernel._explain_decide_raw._cache_size() - before
+
+
+def _explain_groups_args(seed: int = 0):
+    """A resident single-cluster explain fixture: group rows plus a
+    maintained :class:`GroupAggregates` at the incremental decider's
+    shapes ([G] columns, [N+1] per-node remainders with the scratch
+    lane)."""
+    from escalator_tpu.ops import kernel
+
+    rng = np.random.default_rng(seed + 910)
+    G, N = GROUPS, NODES
+    cluster = representative_cluster(seed=seed)
+    i64 = lambda hi, n=G: rng.integers(0, hi, n).astype(np.int64)  # noqa: E731
+    aggs = kernel.GroupAggregates(
+        cpu_req=i64(10**6), mem_req=i64(10**12), num_pods=i64(50),
+        cpu_cap=i64(10**7), mem_cap=i64(10**13), num_nodes=i64(20),
+        num_untainted=i64(20), num_tainted=i64(5), num_cordoned=i64(3),
+        node_pods_remaining=i64(8, N + 1),
+        dirty=np.zeros(G, bool),
+    )
+    return (cluster.groups, aggs)
+
+
+def _build_explain_groups() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    return TracedEntry(fn=ds._explain_terms, args=_explain_groups_args(),
+                       jitted=ds._explain_groups_core)
+
+
+def _probe_explain_groups_retraces() -> int:
+    from escalator_tpu.ops import device_state as ds
+
+    before = ds._explain_groups_core._cache_size()
+    for seed in (93, 94):
+        jax.block_until_ready(
+            ds._explain_groups_core(*_explain_groups_args(seed=seed)))
+    return ds._explain_groups_core._cache_size() - before
+
+
+def _explain_tenant_args(seed: int = 27, row: int = 0):
+    """One fleet tenant's explain gather operands: the shard-local
+    ``[1, C+1, …]`` group/aggregate/committed-column blocks after one real
+    fleet step (the same populated-arena recipe as the drain fixture),
+    plus the traced row index."""
+    from jax import tree_util
+
+    from escalator_tpu.ops import device_state as ds
+
+    state_out, _out = ds._fleet_step(*_fleet_step_args(seed=seed))
+    _pods, _nodes, groups, aggs, prev_cols = tree_util.tree_map(
+        np.asarray, state_out)
+    g_blk, a_blk, c_blk = tree_util.tree_map(
+        lambda a: a[None], (groups, aggs, prev_cols))
+    return (g_blk, a_blk, c_blk, np.int32(row))
+
+
+def _build_explain_tenant_local() -> TracedEntry:
+    from escalator_tpu.ops import device_state as ds
+
+    return TracedEntry(fn=ds._explain_tenant_core.__wrapped__,
+                       args=_explain_tenant_args(),
+                       jitted=ds._explain_tenant_core)
+
+
+def _probe_explain_tenant_retraces() -> int:
+    """Two tenants on the same arena shapes, DIFFERENT row indices: one
+    compile — ``row`` is traced content, so a single program serves every
+    tenant of a shard (the property fleet explain's latency rests on)."""
+    from escalator_tpu.ops import device_state as ds
+
+    before = ds._explain_tenant_core._cache_size()
+    for seed, row in ((95, 0), (96, 1)):
+        jax.block_until_ready(
+            ds._explain_tenant_core(*_explain_tenant_args(seed=seed, row=row)))
+    return ds._explain_tenant_core._cache_size() - before
 
 
 def _fleet_order_tail_args(seed: int = 27, rows=(0,)):
@@ -1367,6 +1499,43 @@ def default_registry() -> List[KernelEntry]:
             donate_expected=False,  # read-only: arenas stay resident
             retrace_budget=1,       # row membership is content, not shape
             retrace_probe=_probe_fleet_order_tail_sharded_retraces,
+        ),
+        e(
+            name="kernel.explain_decide",
+            module="escalator_tpu.ops.kernel",
+            kind="jit",
+            build=_build_explain_decide,
+            output_dtypes=EXPLAIN_DTYPES,
+            collective_budget=0,    # [G] math only: no pod/node sweeps
+            donate_expected=False,  # read-only: explaining a decision must
+                                    # never invalidate the state behind it
+            retrace_budget=1,       # group/aggregate CONTENT is never a key
+            retrace_probe=_probe_explain_decide_retraces,
+        ),
+        e(
+            name="device_state.explain_groups",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_explain_groups,
+            output_dtypes=EXPLAIN_DTYPES,
+            collective_budget=0,
+            donate_expected=False,  # read-only: aggregates stay resident
+            retrace_budget=1,
+            retrace_probe=_probe_explain_groups_retraces,
+        ),
+        e(
+            name="device_state.explain_tenant_local",
+            module="escalator_tpu.ops.device_state",
+            kind="jit",
+            build=_build_explain_tenant_local,
+            output_dtypes=EXPLAIN_DTYPES,
+            output_select=lambda out: out[0],  # the term dict; the gathered
+                                               # committed columns ride along
+            collective_budget=0,    # a [0, row] slice of the LOCAL block:
+                                    # no cross-device program by design
+            donate_expected=False,  # read-only: arenas stay resident
+            retrace_budget=1,       # row index is traced content, not shape
+            retrace_probe=_probe_explain_tenant_retraces,
         ),
         e(
             name="kernel.delta_decide",

@@ -237,8 +237,9 @@ def debug_journal_main(argv: List[str]) -> int:
                      help="fetch the live journal from a running compute"
                           " plugin instead of a file")
     p.add_argument("--kind", action="append", default=None,
-                   help="only events of this kind (repeatable, e.g."
-                        " --kind admission-reject --kind slo-breach)")
+                   help="only events of these kinds — a comma-separated"
+                        " list, repeatable (e.g. --kind"
+                        " admission-reject,slo-breach --kind group-flap)")
     p.add_argument("--since", type=int, default=0,
                    help="only events with seq > SINCE")
     p.add_argument("--tail", type=int, default=0,
@@ -278,7 +279,17 @@ def debug_journal_main(argv: List[str]) -> int:
     if args.since:
         events = [e for e in events if e.get("seq", 0) > args.since]
     if args.kind:
-        wanted = set(args.kind)
+        # each --kind is a comma-separated list; blanks (trailing commas,
+        # ",,") drop silently, a kind absent from the UNfiltered ring warns
+        # — a typo'd kind must not read as "nothing happened"
+        wanted = {k.strip() for spec in args.kind
+                  for k in spec.split(",") if k.strip()}
+        present = {e.get("kind") for e in all_events}
+        unknown = sorted(wanted - present)
+        if unknown:
+            known = ", ".join(sorted(k for k in present if k)) or "(none)"
+            print(f"warning: no events of kind(s) {', '.join(unknown)} in "
+                  f"this journal (kinds present: {known})", file=sys.stderr)
         events = [e for e in events if e.get("kind") in wanted]
     if args.tail > 0:
         events = events[-args.tail:]
@@ -302,6 +313,289 @@ def debug_journal_main(argv: List[str]) -> int:
         print(f"[{ev.get('seq', '?'):>5}] {ts} {ev.get('kind', '?'):<22}"
               f" {rest}".rstrip())
     return 0
+
+
+def _parse_groups(spec: "str | None") -> "List[int] | None":
+    """``--groups 0,3,7`` -> [0, 3, 7] (None passes through)."""
+    if spec is None:
+        return None
+    return [int(g) for g in spec.split(",") if g.strip()]
+
+
+def _render_explanations(docs: list) -> None:
+    """Text rendering of per-group explanation documents (the
+    debug-explain human surface; --json carries the full docs)."""
+    for d in docs:
+        mm = d.get("mismatches")
+        flag = f"  ** MISMATCH ({len(mm)} field(s)) **" if mm else ""
+        stale = " [stale: pending delta]" if d.get("stale") else ""
+        delta = int(d.get("nodes_delta", 0))
+        print(f"group {d['group']}: {d.get('status_name', d.get('status'))}"
+              f" delta={delta:+d} branch={d.get('threshold_branch')}"
+              f"/{d.get('status_branch')}{stale}{flag}")
+        t = d.get("terms") or {}
+        cfg = d.get("config") or {}
+        if t:
+            line = ", ".join(
+                f"{k}={t[k]}" for k in (
+                    "cpu_percent", "mem_percent", "max_percent",
+                    "percentage_needed", "num_nodes", "num_untainted",
+                    "num_tainted", "num_cordoned") if k in t)
+            print(f"    terms: {line}")
+        if cfg:
+            line = ", ".join(
+                f"{k.removeprefix('cfg_')}={cfg[k]}" for k in (
+                    "cfg_scale_up_threshold", "cfg_taint_lower",
+                    "cfg_taint_upper", "cfg_min_nodes", "cfg_max_nodes")
+                if k in cfg)
+            print(f"    config: {line}")
+        gates = [k for k, v in (d.get("gates") or {}).items() if v]
+        if gates:
+            print(f"    gates: {', '.join(sorted(gates))}")
+        if d.get("scale_down_candidates"):
+            print("    scale-down candidates (node slots, oldest-first): "
+                  f"{d['scale_down_candidates']}")
+        for m in mm or ():
+            print(f"    mismatch {m['field']}: explained={m['explained']}"
+                  f" committed={m['committed']}")
+
+
+def _load_explanation_docs(path: str, tenant: "str | None") -> list:
+    """Explanation documents from any carrier debug-explain produces or a
+    flight dump embeds: a bare doc list, a ``debug-explain --json`` /
+    Explain-RPC response (``explanations`` list), a replay report, a
+    dump's ``provenance.explanations`` map (keyed by tenant), or a
+    ``reason="flap"`` dump's ``flap.explanations`` (the offending groups,
+    as captured when the watchdog fired). Raises ValueError with a named
+    reason when the file carries none."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return doc
+    ex = doc.get("explanations")
+    if ex is None and isinstance(doc.get("provenance"), dict):
+        ex = doc["provenance"].get("explanations")
+    if ex is None and isinstance(doc.get("flap"), dict):
+        ex = doc["flap"].get("explanations")
+    if isinstance(ex, list):
+        return ex
+    if isinstance(ex, dict) and ex:
+        if tenant is not None:
+            if tenant not in ex:
+                raise ValueError(
+                    f"no explanations for tenant {tenant!r} in {path}"
+                    f" (has: {', '.join(sorted(ex))})")
+            return ex[tenant]
+        if len(ex) == 1:
+            return next(iter(ex.values()))
+        raise ValueError(
+            f"{path} carries explanations for several tenants"
+            f" ({', '.join(sorted(ex))}) — pass --tenant")
+    raise ValueError(f"{path} carries no explanation documents")
+
+
+def debug_explain_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-explain``: WHY did this group scale — the
+    decision provenance observatory's operator end (docs/observability.md).
+    Prints per-group explanation documents: every named term of the
+    decision calculus, the ONE controller.go:332-351 threshold arm that
+    fired, the status-cascade arm, gate booleans, config echoes, scale-down
+    victim candidates, and the bit-cross-check against the committed
+    decision columns (a mismatch is itself a finding).
+
+    Three sources:
+
+    - ``--plugin-address [--tenant T]``: live, over the ``Explain`` RPC —
+      re-derived from the server's resident arenas. Without ``--tenant``
+      the known history keys + provenance health print (discovery).
+    - ``--dump FILE``: the ``provenance`` section an incident/tail dump
+      embeds (explanations as captured at dump time).
+    - ``--replay --dump FILE --snapshot SNAP``: offline — re-execute the
+      dump's recorded tick ring bit-exactly from the snapshot
+      (debug-replay's machinery) and explain the FINAL state; the same
+      answer the live server would have given at that tick.
+
+    Exit status: 0 clean, 1 when any explanation carries a cross-check
+    mismatch or the replay diverged, 2 when the source cannot be
+    read/fetched."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-explain",
+        description="explain a tenant's scale decisions term by term",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--plugin-address",
+                     help="live source: a running compute plugin's Explain"
+                          " RPC")
+    src.add_argument("--dump",
+                     help="offline source: a flight dump's provenance"
+                          " section (with --replay: its recorded tick"
+                          " ring)")
+    p.add_argument("--replay", action="store_true",
+                   help="re-execute the dump's tick_inputs from --snapshot"
+                        " and explain the final replayed state")
+    p.add_argument("--snapshot",
+                   help="device-state snapshot (.snap) for --replay")
+    p.add_argument("--tenant",
+                   help="tenant id / history key (live: omit to list known"
+                        " keys)")
+    p.add_argument("--groups",
+                   help="comma-separated group indices (default: all)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full documents as JSON instead of text")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    groups = _parse_groups(args.groups)
+
+    if args.replay:
+        if not args.dump or not args.snapshot:
+            print("--replay needs both --dump and --snapshot",
+                  file=sys.stderr)
+            return 2
+        from escalator_tpu.observability import replay
+        from escalator_tpu.ops.snapshot import SnapshotCorruptError
+
+        try:
+            with open(args.dump) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read dump: {e}", file=sys.stderr)
+            return 2
+        entries = doc.get("tick_inputs")
+        if not entries:
+            print("dump carries no tick_inputs — record with "
+                  "ESCALATOR_TPU_RECORD_INPUTS=1 and re-dump",
+                  file=sys.stderr)
+            return 2
+        try:
+            report = replay.replay_ring(
+                entries, snapshot_path=args.snapshot,
+                explain=True, explain_groups=groups)
+        except (ValueError, OSError, SnapshotCorruptError) as e:
+            print(f"replay failed: {e}", file=sys.stderr)
+            return 2
+        docs = report["explanations"]
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(f"replayed {report['replayed']} tick(s) from tick "
+                  f"{report['base_tick']} "
+                  f"({len(report['divergent'])} divergent); explaining "
+                  f"tick {report['explain_tick']}:")
+            _render_explanations(docs)
+        bad = (not report["ok"]
+               or any(d.get("mismatches") for d in docs))
+        return 1 if bad else 0
+
+    if args.dump:
+        try:
+            docs = _load_explanation_docs(args.dump, args.tenant)
+        except (OSError, ValueError) as e:
+            print(f"cannot read explanations: {e}", file=sys.stderr)
+            return 2
+        if groups is not None:
+            docs = [d for d in docs if d.get("group") in set(groups)]
+        if args.json:
+            print(json.dumps({"explanations": docs}, indent=1))
+        else:
+            _render_explanations(docs)
+        return 1 if any(d.get("mismatches") for d in docs) else 0
+
+    from escalator_tpu.plugin.client import ComputeClient
+
+    client = ComputeClient(args.plugin_address, timeout_sec=args.timeout)
+    try:
+        doc = client.explain(args.tenant, groups=groups)
+    except Exception as e:  # noqa: BLE001 - any transport failure: exit 2
+        print(f"cannot fetch explanation from {args.plugin_address}: {e}",
+              file=sys.stderr)
+        return 2
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        if args.tenant is None:
+            return 0
+        docs = doc.get("explanations") or []
+        return 1 if any(d.get("mismatches") for d in docs) else 0
+    if args.tenant is None:
+        keys = doc.get("keys") or []
+        health = doc.get("health") or {}
+        print(f"decision history keys ({len(keys)}): "
+              f"{', '.join(keys) or '(none yet)'}")
+        mm_total = health.get("explain_mismatches_total", 0)
+        print(f"flaps={health.get('flaps_total', 0)} "
+              f"flap_dumps={health.get('flap_dumps', 0)} "
+              f"explain_mismatches={mm_total}")
+        for row in health.get("top_flapping") or []:
+            print(f"  flapping: {row['key']} group {row['group']}: "
+                  f"{row['flaps']} flap(s)")
+        return 0
+    docs = doc.get("explanations") or []
+    print(f"tenant {doc.get('key')}: {len(docs)} group(s)")
+    _render_explanations(docs)
+    hist = doc.get("history") or []
+    if hist:
+        recent = hist[-8:]
+        print(f"history ({len(hist)} tick(s), last {len(recent)}):")
+        for h in recent:
+            print(f"  tick {h['tick']}: status={h['status']}"
+                  f" delta={h['nodes_delta']}")
+    for fl in doc.get("flaps") or []:
+        print(f"  flap: group {fl.get('group')} klass={fl.get('klass')}"
+              f" at tick {fl.get('tick')}")
+    return 1 if any(d.get("mismatches") for d in docs) else 0
+
+
+def debug_decision_diff_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-decision-diff``: decision forensics between
+    TWO explanation snapshots of the same tenant — which groups' decisions
+    changed, and what moved them, attributed term by term ("max_percent
+    crossed taint_upper (82.1 -> 91.4, threshold 90.0)"). Each side is any
+    explanation carrier: ``debug-explain --json`` output, a flight dump
+    with a provenance section, or a ``--replay`` report. Exit status: like
+    diff(1) — 0 when no group's decision changed, 1 when changes were
+    found, 2 when a source cannot be read."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-decision-diff",
+        description="attribute decision changes between two explanation "
+                    "snapshots term by term",
+    )
+    p.add_argument("a", help="explanation carrier A (JSON file)")
+    p.add_argument("b", help="explanation carrier B (JSON file)")
+    p.add_argument("--tenant",
+                   help="tenant id when a carrier holds several tenants'"
+                        " explanations")
+    p.add_argument("--json", action="store_true",
+                   help="emit the diff document as JSON instead of text")
+    args = p.parse_args(argv)
+    from escalator_tpu.observability import provenance
+
+    try:
+        da = _load_explanation_docs(args.a, args.tenant)
+        db = _load_explanation_docs(args.b, args.tenant)
+    except (OSError, ValueError) as e:
+        print(f"cannot read explanations: {e}", file=sys.stderr)
+        return 2
+    res = provenance.diff_explanations(da, db)
+    if args.json:
+        print(json.dumps(res, indent=1))
+        return 1 if res["changed"] else 0
+    changed = res["changed"]
+    print(f"decision diff: {len(changed)} group(s) changed, "
+          f"{res['unchanged_groups']} unchanged"
+          + (f", only in A: {res['only_in_a']}" if res["only_in_a"] else "")
+          + (f", only in B: {res['only_in_b']}" if res["only_in_b"] else ""))
+    for ch in changed:
+        sa, sb = ch["status"]
+        na, nb = ch["nodes_delta"]
+        ba, bb = ch["threshold_branch"]
+        print(f"group {ch['group']}: {sa} -> {sb}, delta {na:+d} -> {nb:+d}"
+              f" (branch {ba} -> {bb})")
+        for note in ch["attribution"]:
+            print(f"    because: {note}")
+        for term, (va, vb) in sorted(ch["term_deltas"].items()):
+            print(f"    term {term}: {va} -> {vb}")
+    return 1 if changed else 0
 
 
 def debug_compiles_main(argv: List[str]) -> int:
@@ -642,6 +936,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return debug_replay_main(argv[1:])
     if argv and argv[0] == "debug-journal":
         return debug_journal_main(argv[1:])
+    if argv and argv[0] == "debug-explain":
+        return debug_explain_main(argv[1:])
+    if argv and argv[0] == "debug-decision-diff":
+        return debug_decision_diff_main(argv[1:])
     if argv and argv[0] == "debug-compiles":
         return debug_compiles_main(argv[1:])
     if argv and argv[0] == "debug-profile":

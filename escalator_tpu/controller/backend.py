@@ -141,6 +141,14 @@ class GoldenBackend(ComputeBackend):
         obs.add_phase("reap", t_reap)
         obs.add_phase("orders_assemble", t_orders)
         obs.annotate(digest=_decision_digest_objects(out))
+        # the provenance feed rides the same object walk (golden is the
+        # dependency-free fallback; flap detection still applies to it)
+        from escalator_tpu.observability import provenance
+
+        provenance.stage(
+            self.name,
+            np.array([int(r.decision.status) for r in out], np.int64),
+            np.array([r.decision.nodes_delta for r in out], np.int64))
         return out
 
 
@@ -155,6 +163,23 @@ def _decision_digest(out) -> str:
     from escalator_tpu.observability.replay import decision_digest
 
     return decision_digest(out)
+
+
+def _annotate_decision(key: str, out) -> None:
+    """The per-tick decision bookkeeping every array backend runs where it
+    used to annotate just the digest: ONE device->host copy per column
+    serves both the flight-record digest AND the provenance feed
+    (observability/provenance.py) — the decision history + flap watchdog
+    cost the tick nothing beyond the D2H the digest already paid. ``key``
+    is the backend's root name, which is also the history key debug-explain
+    and the flap journal events report."""
+    from escalator_tpu.observability import provenance
+    from escalator_tpu.observability.replay import decision_digest_arrays
+
+    status = np.asarray(out.status)
+    delta = np.asarray(out.nodes_delta)
+    obs.annotate(digest=decision_digest_arrays(status, delta))
+    provenance.stage(key, status, delta)
 
 
 def _decision_digest_objects(results: "List[GroupDecision]") -> str:
@@ -596,7 +621,7 @@ class JaxBackend(ComputeBackend):
                     dispatch_end=t2 if self._overlap and ordered else None)
             # digest reads force a device sync, so on an overlapped tick it
             # runs after unpack's first read (arrays are host-ready by then)
-            obs.annotate(digest=_decision_digest(out))
+            _annotate_decision(self.name, out)
             with obs.span("packing_post"):
                 self._packing.apply(
                     results, group_inputs, dry_mode_flags, taint_trackers)
@@ -889,7 +914,7 @@ class IncrementalJaxBackend(ComputeBackend):
                 out, group_inputs, ordered=ordered, node_masks=cluster.nodes,
                 dispatch_end=t2 if self._overlap and ordered else None,
                 pre_synced=self._inc.last_decide_synced)
-        obs.annotate(digest=_decision_digest(out))
+        _annotate_decision(self.name, out)
         with obs.span("packing_post"):
             self._packing.apply(
                 results, group_inputs, dry_mode_flags, taint_trackers)
@@ -980,7 +1005,8 @@ class ShardedJaxBackend(ComputeBackend):
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+        obs.annotate(ordered=bool(ordered))
+        _annotate_decision(self.name, out)
 
         # Reassemble per-shard outputs back to the caller's group order.
         with obs.span("unpack"):
@@ -1161,7 +1187,8 @@ class PodAxisJaxBackend(ComputeBackend):
             t2 = time.perf_counter()
             metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
             metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-            obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+            obs.annotate(ordered=bool(ordered))
+            _annotate_decision(self.name, out)
             with obs.span("unpack"):
                 results = _unpack(out, group_inputs, ordered=ordered,
                                   node_masks=cluster.nodes)

@@ -29,7 +29,7 @@ from escalator_tpu.controller.backend import (
     ComputeBackend,
     GroupDecision,
     PackingPostPass,
-    _decision_digest,
+    _annotate_decision,
     _round_up,
 )
 from escalator_tpu.core import semantics
@@ -642,7 +642,7 @@ class NativeJaxBackend(ComputeBackend):
                     untainted_mask=unpack_untainted,
                     dispatch_end=t2 if self._overlap and ordered else None,
                     pre_synced=self._inc.last_decide_synced)
-            obs.annotate(digest=_decision_digest(out))
+            _annotate_decision(self.name, out)
             with obs.span("packing_post"):
                 if packing_rows:
                     sel = set(PackingPostPass.select(results, group_inputs))
@@ -679,7 +679,8 @@ class NativeJaxBackend(ComputeBackend):
         t2 = time.perf_counter()
         metrics.solver_pack_latency.labels(self.name).observe(t1 - t0)
         metrics.solver_decide_latency.labels(self.name).observe(t2 - t1)
-        obs.annotate(ordered=bool(ordered), digest=_decision_digest(out))
+        obs.annotate(ordered=bool(ordered))
+        _annotate_decision(self.name, out)
         with obs.span("unpack"):
             results = self._unpack(out, group_inputs, unpack_group,
                                    unpack_cordoned, ordered=ordered,

@@ -530,6 +530,10 @@ class FleetEngine:
         #: micro-batch
         self.cache_hits = 0
         self._init_state()
+        # decision-provenance hook: tenant ids are history keys; the flap
+        # dump worker resolves them through this wildcard registration
+        # (WeakMethod inside — the engine's lifetime is not extended)
+        obs.provenance.register_explainer("*", self._explain_for_provenance)
 
     # -- arena construction / reshaping --------------------------------------
 
@@ -936,6 +940,12 @@ class FleetEngine:
         self.cache_hits += 1
         obs.journal.JOURNAL.event(
             "fleet-cache-hit", tenant=r.tenant_id, now=int(r.now_sec))
+        # the cached answer IS this tick's decision — feed the history/flap
+        # watchdog the same columns a dispatch would have staged, so the
+        # digest fast path cannot blind the oscillation detector
+        obs.provenance.stage(
+            r.tenant_id, np.array(t.cache_arrays.status),
+            np.array(t.cache_arrays.nodes_delta), tick=t.ticks)
         return FleetDecision(
             tenant_id=r.tenant_id, arrays=t.cache_arrays,
             ordered=t.cache_ordered, batch_size=0, shard=t.shard,
@@ -1316,6 +1326,12 @@ class FleetEngine:
                 sliced[f.name] = col[:N_c]
         out = _kernel.DecisionArrays(**sliced)
         self.decisions += 1
+        # provenance feed: the sliced columns are ALREADY host numpy (no
+        # extra device sync); copied so the history ring never pins the
+        # whole [S, T, …] batch output through a view
+        obs.provenance.stage(
+            e.request.tenant_id, np.array(sliced["status"]),
+            np.array(sliced["nodes_delta"]), tick=e.tenant.ticks)
         dec = FleetDecision(
             tenant_id=e.request.tenant_id, arrays=out, ordered=False,
             batch_size=batch_size, shard=e.shard,
@@ -1413,6 +1429,81 @@ class FleetEngine:
                 t.cache_arrays = copied
                 t.cache_ordered = dec.ordered
                 t.cache_epoch = pb.epoch
+
+    # -- decision provenance (round 19) --------------------------------------
+
+    def explain_tenant(self, tenant_id: str,
+                       groups: Optional[Sequence[int]] = None
+                       ) -> List[dict]:
+        """Re-derive one tenant's full decision calculus from the RESIDENT
+        arenas and bit-cross-check the reconstructed 13 columns against the
+        committed ones. The gather is ``device_state.explain_tenant_local``
+        over the tenant's shard-LOCAL block (``fleet_shard_local`` — the
+        ordered tail's zero-copy idiom), so explaining one tenant is O(row)
+        on its own device, never an O(arena) cross-device program.
+
+        The fused step writes a tenant's aggregates and its decision
+        columns in ONE device program, so under ``_device_lock`` the two
+        are always from the same committed tick — any mismatch is real
+        arena drift, journaled + counted + rate-limit-dumped by
+        ``provenance.report_mismatches``. READ-ONLY: the arenas stay
+        resident; nothing is donated.
+
+        Returns per-group explanation documents
+        (:func:`~escalator_tpu.observability.provenance.build_explanations`)
+        at the tenant's REQUEST group count, with scale-down victim windows
+        attached when the tenant's cached answer carries real orders.
+        Callable from any thread (the flap dump worker uses it via the
+        wildcard explainer registration)."""
+        from escalator_tpu.observability import provenance
+        from escalator_tpu.ops import device_state as ds
+        from escalator_tpu.ops import kernel as _kernel
+
+        with self._host:
+            t = self._tenants.get(tenant_id)
+            if t is None:
+                raise TenantError(f"unknown tenant {tenant_id!r}")
+            shard, row = t.shard, t.row
+            G_c = t.shapes[0]
+            cached = (t.cache_arrays
+                      if t.cache_ordered and t.cache_epoch == self._epoch
+                      else None)
+        candidates = None
+        if cached is not None:
+            candidates = provenance.candidate_windows(
+                cached.scale_down_order, cached.untainted_offsets)
+        with obs.span("fleet_explain", kind="device"), self._device_lock:
+            _pods, _nodes, groups_a, aggs, prev_cols = self._state
+            g_blk, a_blk, c_blk = ds.fleet_shard_local(
+                (groups_a, aggs, prev_cols), shard)
+            terms, committed = ds.explain_tenant_local(
+                g_blk, a_blk, c_blk, np.int32(row))
+            obs.fence((terms, committed))
+            host_terms = {k: np.asarray(v)[:G_c]
+                          for k, v in terms.items()}
+            committed_cols = {
+                name: np.asarray(col)[:G_c]
+                for name, col in zip(_kernel.GROUP_DECISION_FIELDS,
+                                     committed, strict=True)}
+        mismatches = provenance.cross_check(host_terms, committed_cols)
+        if mismatches:
+            provenance.report_mismatches(f"fleet/{tenant_id}", mismatches)
+        return provenance.build_explanations(
+            host_terms, committed_cols, groups=groups,
+            candidates=candidates)
+
+    def _explain_for_provenance(self, key: str, groups=None):
+        """The provenance registry's wildcard explainer (held weakly —
+        a dead engine unregisters itself): explanation docs for a live
+        tenant, None for keys this engine does not own. Never raises —
+        the flap dump worker calls through here."""
+        try:
+            return self.explain_tenant(key, groups=groups)
+        except TenantError:
+            return None
+        except Exception:  # noqa: BLE001 - dump-path helper must not break
+            log.debug("explain_tenant(%r) failed", key, exc_info=True)
+            return None
 
     # -- the sequential convenience + release --------------------------------
 

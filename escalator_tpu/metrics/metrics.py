@@ -317,6 +317,31 @@ fleet_tail_batch_size = Histogram(
     namespace="escalator_tpu", registry=registry,
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
 )
+fleet_group_flaps = Counter(
+    "fleet_group_flaps_total",
+    "scale-decision oscillations flagged by the provenance flap watchdog "
+    "(observability/provenance.py): a (tenant, group) whose nodes_delta "
+    "sign alternated (klass=delta_sign) or whose status toggled between "
+    "two codes (klass=status_churn) at least ESCALATOR_TPU_FLAP_MIN_"
+    "ALTERNATIONS times within the ESCALATOR_TPU_FLAP_WINDOW most recent "
+    "decisions — each increment also lands a group-flap journal event and "
+    "(rate-limited) a reason=\"flap\" flight dump naming the groups with "
+    "their explanations; a sustained oscillation re-counts once per full "
+    "window, not once per tick",
+    ["klass"], namespace="escalator_tpu", registry=registry,
+)
+provenance_explain_mismatches = Counter(
+    "provenance_explain_mismatches_total",
+    "explain-kernel cross-check failures: (group, column) cells where the "
+    "decision calculus re-derived from the resident aggregates was NOT "
+    "bit-equal to the committed decision columns (dirty groups excluded — "
+    "their committed columns are legitimately one decision behind). The "
+    "explain path shares the kernel's math core, so any increment means "
+    "the persistent aggregates drifted from the committed answer — a "
+    "stale-cache/missed-dirty bug class, never expected in production; "
+    "each burst also journals explain-mismatch and (rate-limited) dumps",
+    namespace="escalator_tpu", registry=registry,
+)
 fleet_class_p99_breach = Counter(
     "fleet_class_p99_breach_total",
     "per-priority-class SLO breach checks that found the class's RECENT "
@@ -483,6 +508,43 @@ class _DeviceResourceCollector:
 
 
 registry.register(_DeviceResourceCollector())
+
+
+# --- decision provenance (round 19: flap watchdog / explain observatory) -----
+class _ProvenanceCollector:
+    """Pull-time export of the flap watchdog's bounded hot list:
+
+    - ``escalator_tpu_provenance_top_flapping{key,group}`` — cumulative
+      flap incidents for the currently worst-oscillating (tenant, group)
+      pairs, top-5 only (the full per-group distribution would be an
+      unbounded label surface; the flight dumps carry the long tail).
+
+    Collected from in-memory counters at scrape time — zero cost on the
+    tick path, empty family on a flap-free process.
+    """
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        from escalator_tpu.observability import provenance
+
+        fam = GaugeMetricFamily(
+            "escalator_tpu_provenance_top_flapping",
+            "cumulative flap incidents for the top-5 oscillating "
+            "(history key, group) pairs (bounded label surface; dumps "
+            "carry the rest)",
+            labels=["key", "group"],
+        )
+        try:
+            for row in provenance.FLAPS.top_flapping():
+                fam.add_metric([str(row["key"]), str(row["group"])],
+                               float(row["flaps"]))
+        except Exception:  # noqa: BLE001 - a scrape must never crash
+            pass
+        yield fam
+
+
+registry.register(_ProvenanceCollector())
 
 
 def start(address: str = "0.0.0.0:8080", readiness=None) -> WSGIServer:

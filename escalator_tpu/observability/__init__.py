@@ -12,6 +12,7 @@ from escalator_tpu.observability import (
     histograms,
     jaxmon,
     journal,
+    provenance,
     resources,
     spans,
     tail,
@@ -37,6 +38,6 @@ flightrecorder.install()
 __all__ = [
     "RECORDER", "add_phase", "annotate", "current_path", "current_timeline",
     "dump_on_incident", "enabled", "fence", "flightrecorder", "graft",
-    "histograms", "jaxmon", "journal", "resources", "set_enabled", "span",
-    "spans", "tail",
+    "histograms", "jaxmon", "journal", "provenance", "resources",
+    "set_enabled", "span", "spans", "tail",
 ]

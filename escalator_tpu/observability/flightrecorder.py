@@ -167,6 +167,18 @@ class FlightRecorder:
                 doc["journal"] = journal.JOURNAL.as_doc()
         except Exception:  # noqa: BLE001 - a dump must never fail on extras
             pass
+        try:
+            # decision provenance (round 19): flap/mismatch state, recent
+            # decision history for the keys the incident names, and live
+            # explanations for breaching tenants — the "why did it scale"
+            # layer in the same artifact as the "how fast" timelines
+            from escalator_tpu.observability import provenance
+
+            sec = provenance.dump_section(extra)
+            if sec:
+                doc["provenance"] = sec
+        except Exception:  # noqa: BLE001 - a dump must never fail on extras
+            pass
         if extra:
             doc.update(extra)
         # deterministic replay (round 11): when tick-input recording is on,
@@ -257,6 +269,16 @@ def _on_root_complete(tl: spans.Timeline) -> None:
             metrics.tick_phase_latency.labels(backend, p["name"]).observe(
                 p["ms"] / 1e3)
     except Exception:  # noqa: BLE001 - metrics must never break the tick
+        pass
+    try:
+        # decision provenance (round 19): drain the decisions the decide
+        # paths staged on this timeline (already-host [G] columns, zero
+        # extra sync) into the history rings + flap watchdog; a flap
+        # schedules a worker-thread dump, never blocking the tick path
+        from escalator_tpu.observability import provenance
+
+        provenance.on_timeline(tl)
+    except Exception:  # noqa: BLE001 - observability must never break ticks
         pass
     # device resource observatory (round 15): sample the registered buffer
     # totals for the leak watchdog (a metadata walk) and run the

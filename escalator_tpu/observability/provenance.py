@@ -1,0 +1,976 @@
+"""Decision provenance: explainable scale decisions + the flap watchdog.
+
+The observability stack answers *how fast* (histograms, journeys) and *how
+healthy* (resources, journal); this module answers **why group G scaled by
+Δ** — the question the reference controller's verbose per-nodegroup logging
+exists for (``scaleNodeGroup`` → percent usage ``util.go:58-81`` → threshold
+switch ``controller.go:332-351``), and the question every tail/SLO-burn
+investigation otherwise dead-ends on. Three pieces:
+
+- **Explanations**: the explain kernel (``ops.kernel.explain_decide`` /
+  ``ops.device_state.explain_groups``) re-runs the decision calculus over
+  the resident state and emits every intermediate BY NAME — masked
+  request/capacity sums, cpu/mem percent, ``percentageNeeded``, the active
+  threshold-switch arm, the scale-delta derivation, the taint/cordon/drain
+  gates, scale-down candidate ranks. This module turns those device terms
+  into JSON-safe explanation documents (:func:`build_explanations`) and
+  bit-cross-checks the reconstructed columns against the COMMITTED decision
+  columns (:func:`cross_check`): the shared math core makes a mismatch
+  impossible unless the persistent aggregates drifted (stale cache, missed
+  dirty mark) — exactly the bug class the check exists to catch, so any
+  mismatch is itself a finding (``explain-mismatch`` journal event + flight
+  dump + counter).
+
+- **Decision history + flap watchdog**: a bounded per-(tenant, group) ring
+  of recent ``(tick, status, nodes_delta)`` records fed from the flight
+  recorder's root-complete hook (decide paths stage the already-host
+  columns via :func:`stage`; the hook drains the stash after every timed
+  phase closed, so the feed adds nothing to any phase duration). A
+  sign-alternation detector over the ring flags oscillating groups —
+  up/down/up within the window — with ``fleet_group_flaps_total{klass}``,
+  a ``group-flap`` journal event, and a rate-limited ``reason="flap"``
+  flight dump naming the offending groups with their explanations attached.
+
+- **Surfacing**: the plugin ``Explain`` RPC and ``escalator-tpu
+  debug-explain`` / ``debug-decision-diff`` (cli.py) read the same
+  documents; :func:`dump_section` embeds explanations for breaching
+  tenants into tail/SLO/flap flight dumps; :func:`health_section` feeds
+  the plugin health doc.
+
+Knobs (all env; strict-parsed per utils/envparse, warn-and-default,
+memoized on the raw strings):
+
+- ``ESCALATOR_TPU_FLAP_WINDOW``: ring depth the detector scans (default
+  8 decisions per group; ``off``/``0`` disables detection — history still
+  records).
+- ``ESCALATOR_TPU_FLAP_MIN_ALTERNATIONS``: delta-sign flips within the
+  window that make a flap (default 3: up/down/up/down).
+- ``ESCALATOR_TPU_FLAP_DUMP_INTERVAL_SEC``: rate limit between ``flap``
+  dumps per history key (default 300; ``off`` disables the limit; every
+  flap journals regardless).
+
+Import cost: stdlib only (numpy lazily inside the feed path) — this module
+loads with ``escalator_tpu.observability`` on processes that may never
+import jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from escalator_tpu.analysis import lockwitness
+
+__all__ = [
+    "COLUMN_FIELDS", "STATUS_BRANCHES", "TERM_GLOSSARY",
+    "THRESHOLD_BRANCHES", "DecisionHistory", "FlapWatchdog", "FLAPS",
+    "HISTORY", "build_explanations", "cross_check", "diff_explanations",
+    "dump_section", "explain_for", "health_section", "on_timeline",
+    "register_explainer", "report_mismatches", "reset", "stage",
+]
+
+_ENV_WINDOW = "ESCALATOR_TPU_FLAP_WINDOW"
+_ENV_MIN_ALT = "ESCALATOR_TPU_FLAP_MIN_ALTERNATIONS"
+_ENV_INTERVAL = "ESCALATOR_TPU_FLAP_DUMP_INTERVAL_SEC"
+
+DEFAULT_WINDOW = 8
+DEFAULT_MIN_ALTERNATIONS = 3
+DEFAULT_INTERVAL_SEC = 300.0
+#: history ring depth per key (>= the largest usable flap window)
+DEFAULT_HISTORY_DEPTH = int(os.environ.get(
+    "ESCALATOR_TPU_PROVENANCE_HISTORY", "32"))
+#: distinct history keys kept (LRU): tenants come and go; the observatory
+#: must stay bounded no matter how many ids a soak churns through
+_MAX_KEYS = 1024
+
+#: timeline-meta stash key for staged decisions (private: deliberately NOT
+#: in flightrecorder._META_KEYS, so the stash never bloats tick records)
+_STASH = "_provenance_decisions"
+
+#: the 13 persistent decision columns (ops.kernel.GROUP_DECISION_FIELDS —
+#: duplicated here so importing the glossary never imports jax; the sync is
+#: asserted in tests/test_provenance.py)
+COLUMN_FIELDS = (
+    "status", "nodes_delta", "cpu_percent", "mem_percent",
+    "cpu_request_milli", "mem_request_bytes",
+    "cpu_capacity_milli", "mem_capacity_bytes",
+    "num_pods", "num_nodes", "num_untainted", "num_tainted", "num_cordoned",
+)
+
+#: kernel.EXPLAIN_THRESHOLD_BRANCHES twin (sync asserted in tests)
+THRESHOLD_BRANCHES = ("scale_down_fast", "scale_down_slow", "scale_up",
+                      "hold")
+#: kernel.EXPLAIN_STATUS_BRANCHES twin (sync asserted in tests)
+STATUS_BRANCHES = ("invalid_or_empty", "below_min", "above_max",
+                   "forced_min", "div_zero", "locked", "neg_delta",
+                   "threshold_switch")
+
+#: every explain term, mapped back to the reference controller's source
+#: lines — the debug-explain glossary (docs/observability.md renders this)
+TERM_GLOSSARY: Dict[str, str] = {
+    "status": "committed DecisionStatus code (controller.go:192-397 cascade)",
+    "nodes_delta": "committed scaleNodeGroup verdict (controller.go:332-351)",
+    "cpu_percent": "reported cpu percent, 0 on pre-percent exits "
+                   "(util.go:58-81)",
+    "mem_percent": "reported mem percent via MilliValue = bytes*1000 "
+                   "(util.go:58-81)",
+    "cpu_request_milli": "masked Σ pod cpu requests (k8s/util.go:27-51; "
+                         "zeroed on pre-aggregation exits, "
+                         "controller.go:233-255)",
+    "mem_request_bytes": "masked Σ pod mem requests (k8s/util.go:27-51)",
+    "cpu_capacity_milli": "masked Σ node cpu capacity (k8s/util.go:27-51)",
+    "mem_capacity_bytes": "masked Σ node mem capacity (k8s/util.go:27-51)",
+    "num_pods": "pods counted by the filter pass (controller.go:210-230)",
+    "num_nodes": "registered nodes in the group (controller.go:210-230)",
+    "num_untainted": "schedulable nodes (controller.go:210-230)",
+    "num_tainted": "tainted nodes (controller.go:210-230)",
+    "num_cordoned": "cordoned nodes (controller.go:210-230)",
+    "cpu_percent_raw": "cpu percent before the reporting mask "
+                       "(util.go:58-81)",
+    "mem_percent_raw": "mem percent before the reporting mask "
+                       "(util.go:58-81)",
+    "max_percent": "max(cpu, mem) percent — the threshold switch's input "
+                   "(controller.go:332)",
+    "from_zero_cpu_needed": "scale-from-zero cpu node estimate from cached "
+                            "per-node capacity (util.go:39-46)",
+    "from_zero_mem_needed": "scale-from-zero mem node estimate "
+                            "(util.go:39-46)",
+    "percentage_needed_cpu": "ceil(nodes*(cpu% - thr)/thr) — Go's "
+                             "percentageNeeded op order (util.go:33-37)",
+    "percentage_needed_mem": "ceil(nodes*(mem% - thr)/thr) (util.go:33-37)",
+    "nodes_needed": "max of the cpu/mem estimates pre-truncation "
+                    "(util.go:13-46)",
+    "up_delta": "int(math.Max(...)) — the scale-up delta before the "
+                "threshold switch applies it (util.go:46)",
+    "switch_delta": "the threshold switch's verdict before the status "
+                    "cascade overrides (controller.go:332-351)",
+    "gate_all_zero": "no requests, capacity or untainted nodes: percents "
+                     "report 0 (util.go:60-63)",
+    "gate_from_zero": "zero capacity, zero untainted: MaxFloat64 percent "
+                      "forces scale-from-zero (util.go:64-71)",
+    "gate_div_zero": "zero capacity WITH untainted nodes: ERR_DIV_ZERO "
+                     "(util.go:72-75)",
+    "gate_no_cache": "no cached per-node capacity for scale-from-zero: "
+                     "delta falls back to 1 (util.go:41-43)",
+    "gate_bad_threshold": "non-positive scale_up_threshold: ERR_NEG_DELTA "
+                          "(node_group.go:96 rejects; guarded anyway)",
+    "gate_neg_delta": "the scale-up arm computed a negative delta "
+                      "(controller.go:345-347)",
+    "gate_down_fast": "max_percent < taint_lower (controller.go:334)",
+    "gate_down_slow": "taint_lower <= max_percent < taint_upper "
+                      "(controller.go:338)",
+    "gate_scale_up": "max_percent > scale_up_threshold "
+                     "(controller.go:343)",
+    "gate_empty": "zero nodes AND zero pods: NOOP_EMPTY "
+                  "(controller.go:216-221)",
+    "gate_below_min": "num_nodes < min_nodes (controller.go:233)",
+    "gate_above_max": "num_nodes > max_nodes (controller.go:244)",
+    "gate_forced_min": "untainted < min_nodes: forced scale-up "
+                       "(controller.go:258-266)",
+    "gate_invalid": "unregistered/invalid group row",
+    "gate_locked": "scale lock: delta passes through requested_nodes "
+                   "(controller.go:269-279)",
+    "gate_pct_computed": "percents were computed (no pre-percent exit "
+                         "fired)",
+    "gate_pre_agg_exit": "exit before aggregation: the masked sums report "
+                         "0 (controller.go:233-255)",
+    "threshold_branch": "which controller.go:332-351 arm fired (exactly "
+                        "one): " + "/".join(THRESHOLD_BRANCHES),
+    "status_branch": "first status-cascade exit arm "
+                     "(controller.go:192-397): "
+                     + "/".join(STATUS_BRANCHES),
+    "cfg_scale_up_threshold": "configured scale-up threshold percent",
+    "cfg_taint_lower": "configured taint_lower_percent",
+    "cfg_taint_upper": "configured taint_upper_percent",
+    "cfg_fast_rate": "configured fast scale-down node rate",
+    "cfg_slow_rate": "configured slow scale-down node rate",
+    "cfg_min_nodes": "configured min_nodes",
+    "cfg_max_nodes": "configured max_nodes",
+    "cfg_cached_cpu_milli": "cached per-node cpu for scale-from-zero",
+    "cfg_cached_mem_bytes": "cached per-node mem for scale-from-zero",
+}
+
+_CONFIG_KEYS = tuple(k for k in TERM_GLOSSARY if k.startswith("cfg_"))
+_GATE_KEYS = tuple(k for k in TERM_GLOSSARY if k.startswith("gate_"))
+
+
+def _status_name(code: int) -> str:
+    from escalator_tpu.core.semantics import DecisionStatus
+
+    try:
+        return DecisionStatus(int(code)).name
+    except ValueError:
+        return f"UNKNOWN_{int(code)}"
+
+
+def _scalar(x) -> Any:
+    """One array element as a JSON-exact python scalar (json round-trips
+    float64 via repr bit-exactly; ints/bools pass through)."""
+    import numpy as np
+
+    v = x.item() if isinstance(x, np.generic) or hasattr(x, "item") else x
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Explanations + the bit-cross-check
+# ---------------------------------------------------------------------------
+
+
+def cross_check(terms: Dict[str, Any], committed: Dict[str, Any],
+                skip=None) -> List[Dict[str, Any]]:
+    """Bit-compare the explain kernel's reconstructed decision columns
+    against the COMMITTED columns. ``skip`` is an optional bool [G] mask of
+    groups whose committed columns are legitimately behind (dirty groups: a
+    pending delta has not been decided yet, so the reconstruction is the
+    *next* decision, not a drifted one). Returns one finding per differing
+    (group, field): ``{"group", "field", "explained", "committed"}``.
+
+    Float columns compare on raw bits (a NaN or -0.0 drift must not hide
+    behind ``==`` semantics); integer columns on value."""
+    import numpy as np
+
+    findings: List[Dict[str, Any]] = []
+    for field in COLUMN_FIELDS:
+        if field not in committed or committed[field] is None:
+            continue
+        a = np.asarray(terms[field])
+        b = np.asarray(committed[field])
+        if a.shape != b.shape:
+            findings.append({"group": -1, "field": field,
+                             "explained": list(a.shape),
+                             "committed": list(b.shape)})
+            continue
+        if a.dtype.kind == "f":
+            diff = a.view(np.int64) != b.astype(a.dtype).view(np.int64)
+        else:
+            diff = a != b
+        if skip is not None:
+            diff = diff & ~np.asarray(skip)
+        for g in np.nonzero(diff)[0]:
+            findings.append({
+                "group": int(g), "field": field,
+                "explained": _scalar(a[g]), "committed": _scalar(b[g]),
+            })
+    return findings
+
+
+def build_explanations(terms: Dict[str, Any],
+                       committed: Optional[Dict[str, Any]] = None,
+                       dirty=None,
+                       groups: Optional[Sequence[int]] = None,
+                       candidates: Optional[Dict[int, List[int]]] = None,
+                       ) -> List[Dict[str, Any]]:
+    """Per-group explanation documents from the explain kernel's host term
+    dict. ``committed`` (column name -> [G] array) arms the bit-cross-check;
+    ``dirty`` marks groups whose committed columns are legitimately pending.
+    ``groups`` restricts the output set (default: every group); valid=False
+    rows are kept — an invalid group's NOOP_EMPTY is a decision too.
+    ``candidates`` optionally attaches scale-down victim node ids per group
+    (from order state / a cached ordered answer)."""
+    import numpy as np
+
+    G = int(np.asarray(terms["status"]).shape[0])
+    wanted = range(G) if groups is None else [g for g in groups
+                                             if 0 <= int(g) < G]
+    mismatches = (cross_check(terms, committed, skip=dirty)
+                  if committed is not None else [])
+    by_group: Dict[int, List[Dict[str, Any]]] = {}
+    for m in mismatches:
+        by_group.setdefault(m["group"], []).append(m)
+    dirty_arr = None if dirty is None else np.asarray(dirty)
+    docs = []
+    for g in wanted:
+        g = int(g)
+        tb = int(np.asarray(terms["threshold_branch"])[g])
+        sb = int(np.asarray(terms["status_branch"])[g])
+        doc: Dict[str, Any] = {
+            "group": g,
+            "status": _scalar(np.asarray(terms["status"])[g]),
+            "status_name": _status_name(
+                _scalar(np.asarray(terms["status"])[g])),
+            "nodes_delta": _scalar(np.asarray(terms["nodes_delta"])[g]),
+            "threshold_branch": THRESHOLD_BRANCHES[tb],
+            "status_branch": STATUS_BRANCHES[sb],
+            "stale": bool(dirty_arr[g]) if dirty_arr is not None else False,
+            "terms": {k: _scalar(np.asarray(terms[k])[g])
+                      for k in TERM_GLOSSARY
+                      if k in terms and not k.startswith(("gate_", "cfg_"))
+                      and k not in ("threshold_branch", "status_branch")},
+            "gates": {k: bool(np.asarray(terms[k])[g])
+                      for k in _GATE_KEYS if k in terms},
+            "config": {k: _scalar(np.asarray(terms[k])[g])
+                       for k in _CONFIG_KEYS if k in terms},
+        }
+        if by_group.get(g):
+            doc["mismatches"] = by_group[g]
+        if candidates and g in candidates:
+            doc["scale_down_candidates"] = [int(n) for n in candidates[g]]
+        docs.append(doc)
+    return docs
+
+
+def candidate_windows(scale_down_order, untainted_offsets,
+                      max_per_group: int = 8) -> Dict[int, List[int]]:
+    """Scale-down victim ranks from an ORDERED decision (host arrays):
+    group g's candidates are ``scale_down_order[untainted_offsets[g] :
+    untainted_offsets[g+1]]`` — the reference's taintOldestN consumption
+    order (scale_down.go:171) — truncated to ``max_per_group``."""
+    import numpy as np
+
+    order = np.asarray(scale_down_order)
+    offs = np.asarray(untainted_offsets)
+    out: Dict[int, List[int]] = {}
+    for g in range(offs.shape[0] - 1):
+        lo, hi = int(offs[g]), int(offs[g + 1])
+        if hi > lo:
+            out[g] = [int(n) for n in order[lo:min(hi, lo + max_per_group)]]
+    return out
+
+
+_mismatch_lock = lockwitness.make_lock("provenance.mismatch")
+_last_mismatch_dump_mono = [-float("inf")]
+_mismatch_total = [0]
+
+
+def report_mismatches(context: str, mismatches: List[Dict[str, Any]],
+                      explanations: Optional[List[Dict[str, Any]]] = None
+                      ) -> None:
+    """An explain/committed divergence IS a finding (the shared math core
+    makes it an aggregate-drift symptom): journal it, count it, and flight-
+    dump (rate-limited to one per flap interval — a systematically drifted
+    arena would otherwise dump per explain call). Never raises."""
+    if not mismatches:
+        return
+    try:
+        from escalator_tpu.metrics import metrics
+
+        metrics.provenance_explain_mismatches.inc(len(mismatches))
+    except Exception:  # noqa: BLE001 - observability must never break
+        pass
+    try:
+        from escalator_tpu.observability import journal
+
+        journal.JOURNAL.event(
+            "explain-mismatch", context=context, count=len(mismatches),
+            fields=sorted({m["field"] for m in mismatches}),
+            groups=sorted({m["group"] for m in mismatches})[:16])
+    except Exception:  # noqa: BLE001
+        pass
+    now = time.monotonic()
+    with _mismatch_lock:
+        _mismatch_total[0] += len(mismatches)
+        _, _, interval = FLAPS._config()
+        limited = (interval and
+                   now - _last_mismatch_dump_mono[0] < interval)
+        if not limited:
+            _last_mismatch_dump_mono[0] = now
+    if limited:
+        return
+    try:
+        from escalator_tpu.observability import flightrecorder
+
+        extra: Dict[str, Any] = {"explain_mismatch": {
+            "context": context, "mismatches": mismatches[:64]}}
+        if explanations:
+            extra["explain_mismatch"]["explanations"] = explanations[:16]
+        flightrecorder.dump_on_incident("explain-mismatch", extra=extra)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def mismatch_total() -> int:
+    with _mismatch_lock:
+        return _mismatch_total[0]
+
+
+# ---------------------------------------------------------------------------
+# Decision history + flap watchdog
+# ---------------------------------------------------------------------------
+
+
+class DecisionHistory:
+    """Bounded per-key ring of ``(tick, status [G], nodes_delta [G])``
+    records — key is a tenant id (fleet) or the backend's root name
+    (single cluster). LRU-bounded on keys; a shape change (arena/group
+    reconfigure) restarts the key's ring (stacking mixed widths would be
+    meaningless)."""
+
+    def __init__(self, depth: int = DEFAULT_HISTORY_DEPTH,
+                 max_keys: int = _MAX_KEYS):
+        self.depth = max(2, int(depth))
+        self.max_keys = int(max_keys)
+        self._lock = lockwitness.make_lock("provenance.history")
+        self._rings: "collections.OrderedDict[str, collections.deque]" = (
+            collections.OrderedDict())
+        self._seq: Dict[str, int] = {}
+
+    def push(self, key: str, status, delta,
+             tick: Optional[int] = None) -> Tuple[int, list]:
+        """Append one decision record; returns ``(tick, window)`` where
+        window is the ring contents (newest last) for the detector."""
+        import numpy as np
+
+        status = np.asarray(status)
+        delta = np.asarray(delta)
+        with self._lock:
+            ring = self._rings.get(key)
+            if ring is None:
+                if len(self._rings) >= self.max_keys:
+                    old, _ = self._rings.popitem(last=False)
+                    self._seq.pop(old, None)
+                ring = collections.deque(maxlen=self.depth)
+                self._rings[key] = ring
+            else:
+                self._rings.move_to_end(key)
+                if ring and ring[-1][1].shape != status.shape:
+                    ring.clear()   # reconfigured: old widths are apples
+            if tick is None:
+                tick = self._seq.get(key, 0) + 1
+            self._seq[key] = int(tick)
+            ring.append((int(tick), status, delta))
+            return int(tick), list(ring)
+
+    def history(self, key: str, group: Optional[int] = None
+                ) -> List[Dict[str, Any]]:
+        with self._lock:
+            ring = list(self._rings.get(key, ()))
+        out = []
+        for tick, status, delta in ring:
+            if group is None:
+                out.append({"tick": tick,
+                            "status": [int(s) for s in status],
+                            "nodes_delta": [int(d) for d in delta]})
+            elif 0 <= group < status.shape[0]:
+                out.append({"tick": tick, "status": int(status[group]),
+                            "nodes_delta": int(delta[group])})
+        return out
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._rings)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rings.clear()
+            self._seq.clear()
+
+
+class FlapWatchdog:
+    """Sign-alternation/oscillation detector over the decision history
+    (singleton :data:`FLAPS`). Two flap classes:
+
+    - ``delta_sign``: a group's nodes_delta sign alternated >= min_alt
+      times within the window (holds between moves still count — up, hold,
+      down, hold, up is the classic thrash);
+    - ``status_churn``: a group's status toggled between exactly two codes
+      >= min_alt times (e.g. OK <-> FORCED_MIN bouncing on a taint edge).
+
+    Every flap journals (``group-flap``) and counts
+    (``fleet_group_flaps_total{klass}``); the flight dump is rate-limited
+    per history key and carries the offending groups' explanations when an
+    explainer is registered. A group that keeps flapping re-fires only
+    after a full window of new decisions — a sustained oscillation is one
+    incident per window, not one per tick."""
+
+    def __init__(self) -> None:
+        self._lock = lockwitness.make_lock("provenance.flaps")
+        self._cfg_cache: Tuple[Tuple[Optional[str], ...],
+                               Tuple[int, int, float]] = (
+            ("\0",), (0, 0, 0.0))
+        self._last_dump_mono: Dict[str, float] = {}
+        #: (key, group) -> tick of the last fired flap (debounce)
+        self._last_flap: Dict[Tuple[str, int], int] = {}
+        self._worker: Optional[threading.Thread] = None
+        self.flaps = 0      # flap incidents observed (dumped or limited)
+        self.dumps = 0      # dumps handed to the worker
+        #: bounded recent-flap ring for health/metrics/top-K surfacing
+        self.recent: "collections.deque" = collections.deque(maxlen=64)
+        #: (key, group) -> total flap incidents (bounded with history keys)
+        self._totals: Dict[Tuple[str, int], int] = {}
+
+    # -- config ------------------------------------------------------------
+    def _config(self) -> Tuple[int, int, float]:
+        """(window, min_alternations, dump_interval_sec); window 0 means
+        detection off. Same memoize-on-raw-strings discipline as the tail
+        watchdog: steady ticks pay one dict lookup, typos warn once."""
+        raw = (os.environ.get(_ENV_WINDOW), os.environ.get(_ENV_MIN_ALT),
+               os.environ.get(_ENV_INTERVAL))
+        cached_raw, cached = self._cfg_cache
+        if raw == cached_raw:
+            return cached
+        import logging
+
+        from escalator_tpu.utils import envparse
+
+        warn = logging.getLogger("escalator_tpu.observability").warning
+        try:
+            window = envparse.parse_env_int(raw[0], _ENV_WINDOW,
+                                            allow_off=True, minimum=2)
+        except ValueError as e:
+            warn("%s; using default %d", e, DEFAULT_WINDOW)
+            window = None
+        try:
+            min_alt = envparse.parse_env_int(raw[1], _ENV_MIN_ALT)
+        except ValueError as e:
+            warn("%s; using default %d", e, DEFAULT_MIN_ALTERNATIONS)
+            min_alt = None
+        try:
+            interval = envparse.parse_env_float(raw[2], _ENV_INTERVAL,
+                                                allow_off=True,
+                                                allow_zero=True)
+        except ValueError as e:
+            warn("%s; using default %.0f", e, DEFAULT_INTERVAL_SEC)
+            interval = None
+        cfg = (DEFAULT_WINDOW if window is None else window,
+               DEFAULT_MIN_ALTERNATIONS if min_alt is None else min_alt,
+               DEFAULT_INTERVAL_SEC if interval is None else interval)
+        self._cfg_cache = (raw, cfg)
+        return cfg
+
+    # -- detection ---------------------------------------------------------
+    @staticmethod
+    def _alternations(window: list):
+        """Vectorized scan: per group, count delta-sign flips (vs the last
+        NONZERO sign — holds don't break an oscillation) and status
+        two-value toggles. O(W) numpy ops on [G] rows."""
+        import numpy as np
+
+        deltas = np.stack([d for _, _, d in window])      # [W, G]
+        statuses = np.stack([s for _, s, _ in window])    # [W, G]
+        signs = np.sign(deltas)
+        G = deltas.shape[1]
+        alt = np.zeros(G, np.int32)
+        last = np.zeros(G, np.int32)
+        for w in range(signs.shape[0]):
+            s = signs[w].astype(np.int32)
+            alt += ((s != 0) & (last != 0) & (s != last)).astype(np.int32)
+            last = np.where(s != 0, s, last)
+        changes = (statuses[1:] != statuses[:-1]).sum(axis=0).astype(
+            np.int32) if statuses.shape[0] > 1 else np.zeros(G, np.int32)
+        two_valued = np.array([
+            len(np.unique(statuses[:, g])) == 2 for g in range(G)
+        ]) if G else np.zeros(0, bool)
+        return alt, changes, two_valued
+
+    def on_decisions(self, key: str, tick: int, window: list) -> List[dict]:
+        """Run detection over one key's updated ring; returns the fired
+        flap findings (tests assert on them). Called from the root-complete
+        hook — after every timed phase closed — and prefiltered there so
+        steady workloads never reach the stack/scan."""
+        win, min_alt, interval = self._config()
+        if not win or len(window) < 3:
+            return []
+        window = window[-win:]
+        alt, changes, two_valued = self._alternations(window)
+        import numpy as np
+
+        sign_flaps = np.nonzero(alt >= min_alt)[0]
+        churn_flaps = np.nonzero((changes >= 2 * min_alt) & two_valued)[0]
+        findings = []
+        for klass, hits in (("delta_sign", sign_flaps),
+                            ("status_churn", churn_flaps)):
+            for g in hits:
+                g = int(g)
+                with self._lock:
+                    if tick - self._last_flap.get((key, g), -win) < win:
+                        continue   # same oscillation, already reported
+                    self._last_flap[(key, g)] = tick
+                    if len(self._last_flap) > 4 * _MAX_KEYS:
+                        self._last_flap.clear()
+                findings.append({
+                    "key": key, "group": g, "klass": klass, "tick": tick,
+                    "alternations": int(alt[g]),
+                    "status_changes": int(changes[g]),
+                    "history": [
+                        {"tick": t, "status": int(s[g]),
+                         "nodes_delta": int(d[g])} for t, s, d in window],
+                })
+        if findings:
+            self._fire(key, tick, findings)
+        return findings
+
+    def _fire(self, key: str, tick: int, findings: List[dict]) -> None:
+        win, min_alt, interval = self._config()
+        now = time.monotonic()
+        with self._lock:
+            self.flaps += len(findings)
+            for f in findings:
+                self._totals[(key, f["group"])] = self._totals.get(
+                    (key, f["group"]), 0) + 1
+                self.recent.append({k: f[k] for k in
+                                    ("key", "group", "klass", "tick")})
+            if len(self._totals) > 4 * _MAX_KEYS:
+                self._totals.clear()
+            rate_limited = (interval and now - self._last_dump_mono.get(
+                key, -float("inf")) < interval)
+            if not rate_limited:
+                self._last_dump_mono[key] = now   # claimed pre-handoff
+                self.dumps += 1
+        try:
+            from escalator_tpu.metrics import metrics
+
+            for f in findings:
+                metrics.fleet_group_flaps.labels(f["klass"]).inc()
+        except Exception:  # noqa: BLE001 - never break the tick
+            pass
+        try:
+            # every flap is a journal event — dumped or rate-limited — so
+            # "when did the thrash start" survives the dump rate limit
+            from escalator_tpu.observability import journal
+
+            journal.JOURNAL.event(
+                "group-flap", key=key, tick=tick,
+                groups=[f["group"] for f in findings],
+                klasses=sorted({f["klass"] for f in findings}),
+                window=win, min_alternations=min_alt,
+                dumped=not rate_limited)
+        except Exception:  # noqa: BLE001
+            pass
+        if rate_limited:
+            return
+        # the dump (JSON of a 256-deep ring + an explain gather) runs on a
+        # daemon worker — the breaching tick's successor must not pay it
+        worker = threading.Thread(
+            target=self._dump, args=(key, findings),
+            name="escalator-flap-dump", daemon=True)
+        with self._lock:
+            self._worker = worker
+        worker.start()
+
+    @staticmethod
+    def _dump(key: str, findings: List[dict]) -> None:
+        from escalator_tpu.observability import flightrecorder
+
+        flap_info: Dict[str, Any] = {
+            "key": key,
+            "groups": [f["group"] for f in findings],
+            "findings": findings,
+        }
+        try:
+            docs = explain_for(key, groups=[f["group"] for f in findings])
+            if docs is not None:
+                flap_info["explanations"] = docs
+        except Exception as e:  # noqa: BLE001 - the dump still lands
+            flap_info["explanations_error"] = str(e)
+        flightrecorder.dump_on_incident("flap", extra={"flap": flap_info})
+
+    # -- surfacing ---------------------------------------------------------
+    def top_flapping(self, k: int = 5) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = sorted(self._totals.items(), key=lambda kv: -kv[1])[:k]
+        return [{"key": key, "group": g, "flaps": n}
+                for (key, g), n in items]
+
+    def drain(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._last_dump_mono.clear()
+            self._last_flap.clear()
+            self._totals.clear()
+            self.recent.clear()
+            self.flaps = 0
+            self.dumps = 0
+
+
+HISTORY = DecisionHistory()
+FLAPS = FlapWatchdog()
+
+
+# ---------------------------------------------------------------------------
+# The decide-path feed (staged on the timeline, drained by the hook)
+# ---------------------------------------------------------------------------
+
+
+def stage(key: str, status, nodes_delta, tick: Optional[int] = None) -> None:
+    """Stage one decision's ``(status, nodes_delta)`` host columns for the
+    history/flap feed. Decide paths call this where the columns are ALREADY
+    host numpy (the digest annotation / fleet unpack) — no extra device
+    sync anywhere. The stash rides the current timeline's meta under a
+    private key (never recorded) and the flight recorder's root-complete
+    hook drains it after all timed phases closed; with no active timeline
+    (raw library use) the record feeds through immediately."""
+    from escalator_tpu.observability import spans
+
+    entry = (str(key), status, nodes_delta, tick)
+    tl = spans.current_timeline()
+    if tl is None:
+        _ingest([entry])
+        return
+    tl.meta.setdefault(_STASH, []).append(entry)
+
+
+def _ingest(entries) -> None:
+    import numpy as np
+
+    for key, status, delta, tick in entries:
+        status = np.asarray(status)
+        delta = np.asarray(delta)
+        tick, window = HISTORY.push(key, status, delta, tick=tick)
+        # push cleared the ring on a shape change, so a predecessor in the
+        # returned window is always shape-compatible
+        prev_status = window[-2][1] if len(window) >= 2 else None
+        # steady-state prefilter: a group can only START or CONTINUE an
+        # oscillation on a tick that moves (nonzero delta) or changes
+        # status — everything else skips the window scan entirely
+        if not delta.any() and (
+                prev_status is None
+                or not (prev_status != status).any()):
+            continue
+        FLAPS.on_decisions(key, tick, window)
+
+
+def on_timeline(tl) -> None:
+    """The flight recorder's provenance feed (called from
+    ``flightrecorder._on_root_complete``, isolated like every other
+    consumer): drain the timeline's staged decisions into the history +
+    flap watchdog. O(1) when nothing was staged."""
+    staged = tl.meta.pop(_STASH, None)
+    if staged:
+        _ingest(staged)
+
+
+# ---------------------------------------------------------------------------
+# Explainer registry (live explanation providers: the fleet engine, a
+# backend's decider) + dump/health surfacing
+# ---------------------------------------------------------------------------
+
+_explainers_lock = lockwitness.make_lock("provenance.explainers")
+_explainers: Dict[str, Any] = {}   # key -> weakref.WeakMethod | callable
+
+
+def register_explainer(key: str, fn: Callable) -> None:
+    """Register a live explanation provider: ``fn(tenant_or_key, groups)``
+    -> explanation doc list (or a dict with an "explanations" field). Bound
+    methods are held weakly — a dead engine unregisters itself."""
+    import weakref
+
+    try:
+        ref = weakref.WeakMethod(fn)   # type: ignore[arg-type]
+    except TypeError:
+        ref = fn                       # plain function: hold directly
+    with _explainers_lock:
+        _explainers[str(key)] = ref
+
+
+def unregister_explainer(key: str) -> None:
+    with _explainers_lock:
+        _explainers.pop(str(key), None)
+
+
+def _resolve_explainer(key: str):
+    import weakref
+
+    with _explainers_lock:
+        candidates = [(k, r) for k, r in _explainers.items()
+                      if k == key or k == "*"]
+        # fleet tenants register under the engine's "*" wildcard
+        dead = []
+        resolved = None
+        for k, ref in candidates:
+            fn = ref() if isinstance(ref, weakref.WeakMethod) else ref
+            if fn is None:
+                dead.append(k)
+            elif resolved is None or k == key:
+                resolved = fn
+        for k in dead:
+            _explainers.pop(k, None)
+    return resolved
+
+
+def explain_for(key: str, groups: Optional[Sequence[int]] = None):
+    """Live explanation documents for a history key (tenant id / root
+    name) via the registered provider; None when no provider covers it."""
+    fn = _resolve_explainer(str(key))
+    if fn is None:
+        return None
+    doc = fn(str(key), groups)
+    if isinstance(doc, dict):
+        return doc.get("explanations", doc)
+    return doc
+
+
+def _breaching_keys(extra: Optional[Dict[str, Any]]) -> List[str]:
+    """History keys named by an incident dump's extra sections: the tail
+    watchdog's breaching root (``fleet/<tenant>`` roots name the tenant),
+    an SLO escalation's tenant list, a flap's key."""
+    keys: List[str] = []
+    if not extra:
+        return keys
+    tail = extra.get("tail")
+    if isinstance(tail, dict):
+        root = str(tail.get("root") or "")
+        if root.startswith("fleet/") and not root.startswith("fleet/class/"):
+            keys.append(root.split("/", 1)[1])
+        elif root:
+            keys.append(root)
+    slo = extra.get("slo")
+    if isinstance(slo, dict):
+        for t in slo.get("tenants", ()):
+            keys.append(str(t))
+    flap = extra.get("flap")
+    if isinstance(flap, dict) and flap.get("key"):
+        keys.append(str(flap["key"]))
+    seen: Dict[str, None] = {}
+    return [seen.setdefault(k, k) or k for k in keys if k not in seen]
+
+
+def dump_section(extra: Optional[Dict[str, Any]] = None
+                 ) -> Optional[Dict[str, Any]]:
+    """The ``provenance`` section every flight dump carries: flap/mismatch
+    state, the top flapping groups, recent decision history for the keys
+    the incident names, and — when a live explainer covers a breaching
+    tenant — its current explanations. Bounded and never raises (the
+    caller isolates it anyway)."""
+    keys = _breaching_keys(extra)
+    sec: Dict[str, Any] = {
+        "flaps_total": FLAPS.flaps,
+        "flap_dumps": FLAPS.dumps,
+        "explain_mismatches_total": mismatch_total(),
+        "recent_flaps": list(FLAPS.recent)[-16:],
+        "top_flapping": FLAPS.top_flapping(),
+    }
+    histories = {}
+    explanations = {}
+    for key in keys[:8]:
+        h = HISTORY.history(key)
+        if h:
+            histories[key] = h[-DEFAULT_WINDOW:]
+        if "flap" in (extra or {}) and extra["flap"].get("key") == key:
+            continue   # the flap section already carries its explanations
+        try:
+            docs = explain_for(key)
+        except Exception:  # noqa: BLE001 - a dump must never fail on extras
+            docs = None
+        if docs:
+            explanations[key] = docs[:32]
+    if histories:
+        sec["history"] = histories
+    if explanations:
+        sec["explanations"] = explanations
+    if not (sec["flaps_total"] or sec["explain_mismatches_total"]
+            or histories or explanations):
+        return None
+    return sec
+
+
+def health_section() -> Dict[str, Any]:
+    """The plugin health doc's provenance row."""
+    return {
+        "history_keys": len(HISTORY.keys()),
+        "history_depth": HISTORY.depth,
+        "flaps_total": FLAPS.flaps,
+        "flap_dumps": FLAPS.dumps,
+        "explain_mismatches_total": mismatch_total(),
+        "top_flapping": FLAPS.top_flapping(),
+    }
+
+
+def reset() -> None:
+    """Test support: forget all history/flap/mismatch state."""
+    HISTORY.reset()
+    FLAPS.reset()
+    with _mismatch_lock:
+        _mismatch_total[0] = 0
+        _last_mismatch_dump_mono[0] = -float("inf")
+
+
+# ---------------------------------------------------------------------------
+# Decision-diff forensics (debug-decision-diff)
+# ---------------------------------------------------------------------------
+
+#: numeric terms attributed against config thresholds when a decision
+#: changed between two explanations: (term, config key, relation)
+_CROSSINGS = (
+    ("max_percent", "cfg_taint_lower", "<"),
+    ("max_percent", "cfg_taint_upper", "<"),
+    ("max_percent", "cfg_scale_up_threshold", ">"),
+    ("num_nodes", "cfg_min_nodes", "<"),
+    ("num_nodes", "cfg_max_nodes", ">"),
+    ("num_untainted", "cfg_min_nodes", "<"),
+)
+
+
+def _crossed(a_doc: Dict[str, Any], b_doc: Dict[str, Any]) -> List[str]:
+    """Human-readable per-term attributions: which monitored term crossed
+    which configured threshold between explanation A and explanation B."""
+    notes = []
+    for term, cfg, rel in _CROSSINGS:
+        av = a_doc["terms"].get(term)
+        bv = b_doc["terms"].get(term)
+        ac = a_doc["config"].get(cfg)
+        bc = b_doc["config"].get(cfg)
+        if av is None or bv is None or ac is None or bc is None:
+            continue
+        if ac != bc:
+            # two crossing rules may watch the same config key (min_nodes
+            # guards both num_nodes and num_untainted) — note it once
+            note = f"{cfg} changed {ac} -> {bc}"
+            if note not in notes:
+                notes.append(note)
+            continue
+        was = (av < ac) if rel == "<" else (av > ac)
+        now = (bv < bc) if rel == "<" else (bv > bc)
+        if was != now:
+            notes.append(
+                f"{term} crossed {cfg.removeprefix('cfg_')} "
+                f"({av} -> {bv}, threshold {ac})")
+    if a_doc["threshold_branch"] != b_doc["threshold_branch"]:
+        notes.append(
+            f"threshold branch {a_doc['threshold_branch']} -> "
+            f"{b_doc['threshold_branch']}")
+    if a_doc["status_branch"] != b_doc["status_branch"]:
+        notes.append(
+            f"status branch {a_doc['status_branch']} -> "
+            f"{b_doc['status_branch']}")
+    for gate in _GATE_KEYS:
+        ga, gb = a_doc["gates"].get(gate), b_doc["gates"].get(gate)
+        if ga is not None and gb is not None and ga != gb:
+            notes.append(f"{gate} {ga} -> {gb}")
+    return notes
+
+
+def diff_explanations(a: List[Dict[str, Any]], b: List[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
+    """Group-by-group decision diff between two explanation lists (two
+    dumps, two replay ticks): for every group whose committed decision
+    changed, the per-term attribution — which terms moved, which crossed a
+    configured threshold ("Δ changed because mem_percent crossed
+    taint_upper"). Groups only in one side are reported as added/removed."""
+    a_by = {d["group"]: d for d in a}
+    b_by = {d["group"]: d for d in b}
+    changed = []
+    unchanged = 0
+    for g in sorted(set(a_by) & set(b_by)):
+        da, db = a_by[g], b_by[g]
+        if (da["status"], da["nodes_delta"]) == (
+                db["status"], db["nodes_delta"]):
+            unchanged += 1
+            continue
+        term_deltas = {}
+        for k in sorted(set(da["terms"]) & set(db["terms"])):
+            if da["terms"][k] != db["terms"][k]:
+                term_deltas[k] = [da["terms"][k], db["terms"][k]]
+        changed.append({
+            "group": g,
+            "status": [da["status_name"], db["status_name"]],
+            "nodes_delta": [da["nodes_delta"], db["nodes_delta"]],
+            "threshold_branch": [da["threshold_branch"],
+                                 db["threshold_branch"]],
+            "attribution": _crossed(da, db),
+            "term_deltas": term_deltas,
+        })
+    return {
+        "changed": changed,
+        "unchanged_groups": unchanged,
+        "only_in_a": sorted(set(a_by) - set(b_by)),
+        "only_in_b": sorted(set(b_by) - set(a_by)),
+    }

@@ -45,14 +45,21 @@ from escalator_tpu.analysis import lockwitness
 DEFAULT_CAPACITY = int(os.environ.get("ESCALATOR_TPU_INPUT_LOG_SIZE", "256"))
 
 
+def decision_digest_arrays(status, nodes_delta) -> str:
+    """:func:`decision_digest` over already-host column arrays — the form
+    the backends' annotate-and-stage helper uses so the digest and the
+    provenance feed share ONE device->host copy per column."""
+    s = np.ascontiguousarray(np.asarray(status))
+    d = np.ascontiguousarray(np.asarray(nodes_delta))
+    return format(zlib.crc32(s.tobytes() + d.tobytes()), "08x")
+
+
 def decision_digest(out) -> str:
     """crc32 over the decision-defining columns (status + nodes_delta) — the
     SAME token ``controller.backend._decision_digest`` stamps into flight
     records (that function delegates here), so a replayed tick's digest is
     directly comparable to the recorded one."""
-    s = np.ascontiguousarray(np.asarray(out.status))
-    d = np.ascontiguousarray(np.asarray(out.nodes_delta))
-    return format(zlib.crc32(s.tobytes() + d.tobytes()), "08x")
+    return decision_digest_arrays(out.status, out.nodes_delta)
 
 
 def encode_array(arr) -> Dict[str, Any]:
@@ -156,7 +163,9 @@ def decode_batch(enc: Dict[str, Any]):
 
 def replay_ring(entries: List[Dict[str, Any]],
                 snapshot_path: Optional[str] = None,
-                leaves=None, meta=None) -> Dict[str, Any]:
+                leaves=None, meta=None,
+                explain: bool = False,
+                explain_groups=None) -> Dict[str, Any]:
     """Re-execute a recorded input ring from a device-state snapshot and
     compare each tick's decision digest (and lazy-orders outcome) against
     the recording. Returns a report dict::
@@ -171,7 +180,16 @@ def replay_ring(entries: List[Dict[str, Any]],
     or before the snapshot's tick are skipped (the ring may be longer than
     the checkpoint gap); a gap in the remaining tick sequence is a hard
     error — a replay over missing inputs would diverge for boring reasons
-    and mask real ones."""
+    and mask real ones.
+
+    ``explain=True`` (round 19, ``debug-explain --replay``) additionally
+    runs the explain kernel over the FINAL replayed state and attaches the
+    per-group explanation documents as ``report["explanations"]`` — the
+    same named terms, threshold-branch attribution and bit-cross-check
+    against the committed columns a live server would serve at that tick,
+    reproduced offline from a dump + snapshot alone (the determinism
+    argument above extends verbatim: the explain kernel is a pure function
+    of the replayed resident state)."""
     from escalator_tpu.ops import device_state as ds
     from escalator_tpu.ops import snapshot as snaplib
 
@@ -213,7 +231,7 @@ def replay_ring(entries: List[Dict[str, Any]],
         ticks.append(row)
         if not row["ok"]:
             divergent.append(row)
-    return {
+    report = {
         "ok": not divergent,
         "base_tick": base_tick,
         "replayed": len(ticks),
@@ -221,3 +239,7 @@ def replay_ring(entries: List[Dict[str, Any]],
         "divergent": divergent,
         "ticks": ticks,
     }
+    if explain:
+        report["explain_tick"] = base_tick + len(ticks)
+        report["explanations"] = inc.explain(groups=explain_groups)
+    return report

@@ -640,6 +640,63 @@ def _fleet_tenant_state(pods, nodes, groups, aggs, row):
     )
 
 
+def _explain_terms(groups, aggs):
+    """The explain kernel over maintained aggregates: feed
+    :func:`kernel.explain_decide` exactly what ``kernel.decide`` feeds
+    :func:`kernel.group_decision_math` — the ``aggregates_tuple`` unpack
+    and the int64→int32 count casts replicated verbatim, so the
+    reconstructed columns can only differ from the committed ones if the
+    AGGREGATES drifted (the cross-check's entire point)."""
+    pod_aggs, node_aggs = _kernel.aggregates_tuple(aggs)
+    cpu_req, mem_req, num_pods64, _node_pods_remaining = pod_aggs
+    cpu_cap, mem_cap, nn64, nu64, nt64, nc64 = node_aggs
+    return _kernel.explain_decide(
+        groups, cpu_req, mem_req, cpu_cap, mem_cap,
+        num_pods64.astype(jnp.int32), nn64.astype(jnp.int32),
+        nu64.astype(jnp.int32), nt64.astype(jnp.int32),
+        nc64.astype(jnp.int32))
+
+
+_explain_groups_core = jax.jit(_explain_terms)
+
+
+def explain_groups(cluster: ClusterArrays, aggs):
+    """Re-derive the full decision calculus for every group of a resident
+    single-cluster state as a named term dict (see
+    ``kernel.explain_decide``). READ-ONLY: no donation — explaining a
+    decision must never invalidate the state that produced it. Same
+    wedged-transport guard as the decide entries (debug-explain is a raw
+    library surface)."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _explain_groups_core(cluster.groups, aggs)
+
+
+@jax.jit
+def _explain_tenant_core(groups, aggs, prev_cols, row):
+    """One fleet tenant's explain gather over a shard's local arena block
+    ``[1, Cs+1, …]`` (from :func:`fleet_shard_local`): slice the tenant's
+    group rows, aggregates and committed decision columns at ``[0, row]``
+    and run the explain kernel on the slice — O(row) on the shard's own
+    device, no cross-device program. ``row`` is traced: one compile per
+    process serves every tenant (the retrace pin in the analysis registry
+    holds this). Returns ``(terms, committed_cols)``."""
+    g = lambda tree: tree_util.tree_map(  # noqa: E731
+        lambda a: a[0, row], tree)
+    terms = _explain_terms(g(groups), g(aggs))
+    return terms, tuple(c[0, row] for c in prev_cols)
+
+
+def explain_tenant_local(groups, aggs, prev_cols, row):
+    """Guarded wrapper over :func:`_explain_tenant_core` (the fleet
+    engine's per-tenant explain entry; READ-ONLY, arenas stay resident)."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _explain_tenant_core(groups, aggs, prev_cols, row)
+
+
 class DeviceClusterCache:
     """Keeps the packed cluster resident on one device across ticks.
 
@@ -1156,6 +1213,64 @@ class IncrementalDecider:
     def _set_prev(self, out) -> None:
         self._prev_cols = tuple(
             getattr(out, f) for f in _kernel.GROUP_DECISION_FIELDS)
+
+    # -- decision provenance (round 19) -------------------------------------
+
+    def _scale_down_candidates(self, max_per_group: int = 8):
+        """Per-group scale-down victim windows from the persistent order
+        state, host-side: the combined perm's untainted block rolled to the
+        front IS scale_down_order (kernel.decide's assembly), and the
+        maintained per-group untainted counts are exactly its window
+        offsets. O(N) host copies on a debug surface; None when no ordered
+        tick has run yet."""
+        if self._order_state is None:
+            return None
+        *_, perm = self._order_state
+        perm_h = np.asarray(perm)
+        scale_down = np.roll(perm_h,
+                             -int(np.asarray(self._aggs.num_tainted).sum()))
+        offsets = np.concatenate(
+            [[0], np.cumsum(np.asarray(self._aggs.num_untainted))])
+        from escalator_tpu.observability import provenance
+
+        return provenance.candidate_windows(scale_down, offsets,
+                                            max_per_group)
+
+    def explain(self, groups=None):
+        """Explain the committed decision: re-derive the full calculus from
+        the resident state (``explain_groups`` — READ-ONLY, nothing
+        donated), bit-cross-check the reconstruction against the committed
+        decision columns (dirty groups excluded: their columns are
+        legitimately one pending delta behind) and return per-group
+        explanation documents. Any mismatch is itself a finding — journal
+        event + counter + rate-limited flight dump — because the shared
+        math core leaves aggregate drift as the only possible cause.
+
+        Call between ticks (same thread discipline as :meth:`decide`: the
+        read must not race a donating dispatch)."""
+        from escalator_tpu import observability as obs
+        from escalator_tpu.observability import provenance
+
+        self._await_snapshot()
+        with obs.span("explain", kind="device"):
+            terms = explain_groups(self._cache.cluster, self._aggs)
+            terms = obs.fence(terms)
+        host_terms = {k: np.asarray(v) for k, v in terms.items()}
+        committed = None
+        if self._prev_cols is not None:
+            committed = {
+                f: np.asarray(c) for f, c in
+                zip(_kernel.GROUP_DECISION_FIELDS, self._prev_cols,
+                    strict=True)}
+        dirty = np.asarray(self._aggs.dirty)
+        if committed is not None:
+            mismatches = provenance.cross_check(host_terms, committed,
+                                                skip=dirty)
+            if mismatches:
+                provenance.report_mismatches("incremental", mismatches)
+        return provenance.build_explanations(
+            host_terms, committed, dirty=dirty, groups=groups,
+            candidates=self._scale_down_candidates())
 
     def decide(self, now_sec, tainted_any: bool, _record: bool = True,
                overlap_work=None):

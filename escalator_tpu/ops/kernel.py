@@ -400,22 +400,45 @@ def dirty_indices(dirty_mask, min_bucket: int = _MIN_DIRTY_BUCKET):
     return out
 
 
-def group_decision_math(g: GroupArrays, cpu_req, mem_req, cpu_cap, mem_cap,
-                        num_pods, num_nodes, num_untainted):
-    """The per-group decision core — percent usage (pkg/controller/util.go:
-    58-81), scale-up delta (util.go:13-46), threshold switch
-    (controller.go:332-351) and the status priority cascade — as ONE
-    shape-polymorphic elementwise function: :func:`decide` runs it on the
-    full ``[G]`` rows, :func:`delta_decide` on a compacted ``[D]`` dirty
-    batch. Single implementation so the two paths cannot drift; every op is
-    elementwise, so the same int64/float64 inputs produce bit-identical
-    outputs at either shape.
+#: the four arms of the reference's threshold switch
+#: (pkg/controller/controller.go:332-351), in the order the switch tests
+#: them — :func:`explain_decide`'s ``threshold_branch`` indexes this tuple.
+EXPLAIN_THRESHOLD_BRANCHES = (
+    "scale_down_fast",   # max_percent < taint_lower (controller.go:334)
+    "scale_down_slow",   # max_percent < taint_upper (controller.go:338)
+    "scale_up",          # max_percent > scale_up_threshold (controller.go:343)
+    "hold",              # inside the deadband: no arm fired
+)
 
-    ``cpu_req``/``mem_req``/``cpu_cap``/``mem_cap`` are the int64 aggregate
-    sums; counts are int32. Returns ``(status, nodes_delta, cpu_percent,
-    mem_percent, cpu_req_masked, mem_req_masked, cpu_cap_masked,
-    mem_cap_masked)`` — the masked sums apply the reference's
-    pre-aggregation-exit zeroing (controller.go:233-255)."""
+#: the status priority cascade's exit arms (exit order of
+#: controller.go:192-397) — :func:`explain_decide`'s ``status_branch``
+#: indexes this tuple; index 7 means no early exit fired (threshold switch
+#: decided, status OK or ERR_NEG_DELTA folded in by arm 6).
+EXPLAIN_STATUS_BRANCHES = (
+    "invalid_or_empty",  # unregistered group, or zero nodes AND zero pods
+    "below_min",         # num_nodes < min_nodes (controller.go:233)
+    "above_max",         # num_nodes > max_nodes (controller.go:244)
+    "forced_min",        # untainted < min_nodes: forced scale-up
+    "div_zero",          # capacity zero with untainted nodes present
+    "locked",            # group locked: delta passes through requested
+    "neg_delta",         # scale-up arm computed a negative delta
+    "threshold_switch",  # none fired: the threshold switch's verdict stands
+)
+
+
+def group_decision_terms(g: GroupArrays, cpu_req, mem_req, cpu_cap, mem_cap,
+                         num_pods, num_nodes, num_untainted):
+    """The per-group decision calculus with every intermediate NAMED — the
+    single implementation behind :func:`group_decision_math` (which extracts
+    the 8 committed outputs) and :func:`explain_decide` (which re-emits the
+    full term dict for the provenance layer). The body is the verbatim
+    decision core; returning references to the intermediates adds no ops, so
+    the traced program of every pre-existing caller is unchanged (the
+    standing jaxpr-byte-identity gate covers this).
+
+    Returns a dict of per-group arrays; keys are stable API for the explain
+    surface (observability/provenance.py glossaries map them back to the
+    reference's util.go/controller.go lines)."""
     # ---- percent usage (pkg/controller/util.go:58-81) ----
     # Memory percent uses MilliValue (= bytes*1000) in the reference; replicate the
     # exact int64->float64 conversion order for bit-parity.
@@ -553,8 +576,152 @@ def group_decision_math(g: GroupArrays, cpu_req, mem_req, cpu_cap, mem_cap,
     cpu_cap = jnp.where(pre_agg_exit, zero64, cpu_cap)
     mem_cap = jnp.where(pre_agg_exit, zero64, mem_cap)
 
-    return (status, nodes_delta, cpu_pct_out, mem_pct_out,
-            cpu_req, mem_req, cpu_cap, mem_cap)
+    return {
+        # the 8 committed outputs (the masked sums carry the column names)
+        "status": status,
+        "nodes_delta": nodes_delta,
+        "cpu_percent": cpu_pct_out,
+        "mem_percent": mem_pct_out,
+        "cpu_request_milli": cpu_req,
+        "mem_request_bytes": mem_req,
+        "cpu_capacity_milli": cpu_cap,
+        "mem_capacity_bytes": mem_cap,
+        # percent-usage terms (util.go:58-81)
+        "cpu_percent_raw": cpu_pct,
+        "mem_percent_raw": mem_pct,
+        "max_percent": max_pct,
+        # scale-up delta derivation (util.go:13-46)
+        "from_zero_cpu_needed": fz_cpu,
+        "from_zero_mem_needed": fz_mem,
+        "percentage_needed_cpu": nrm_cpu,
+        "percentage_needed_mem": nrm_mem,
+        "nodes_needed": needed,
+        "up_delta": up_delta,
+        "switch_delta": switch_delta,
+        # gates, in evaluation order
+        "gate_all_zero": all_zero,
+        "gate_from_zero": from_zero,
+        "gate_div_zero": div_zero,
+        "gate_no_cache": no_cache,
+        "gate_bad_threshold": bad_thr,
+        "gate_neg_delta": neg_delta,
+        "gate_down_fast": down_fast,
+        "gate_down_slow": down_slow,
+        "gate_scale_up": scale_up,
+        "gate_empty": empty,
+        "gate_below_min": below_min,
+        "gate_above_max": above_max,
+        "gate_forced_min": forced_min,
+        "gate_invalid": invalid,
+        "gate_locked": g.locked,
+        "gate_pct_computed": pct_computed,
+        "gate_pre_agg_exit": pre_agg_exit,
+    }
+
+
+def group_decision_math(g: GroupArrays, cpu_req, mem_req, cpu_cap, mem_cap,
+                        num_pods, num_nodes, num_untainted):
+    """The per-group decision core — percent usage (pkg/controller/util.go:
+    58-81), scale-up delta (util.go:13-46), threshold switch
+    (controller.go:332-351) and the status priority cascade — as ONE
+    shape-polymorphic elementwise function: :func:`decide` runs it on the
+    full ``[G]`` rows, :func:`delta_decide` on a compacted ``[D]`` dirty
+    batch. Single implementation (:func:`group_decision_terms`) so the two
+    paths cannot drift; every op is elementwise, so the same int64/float64
+    inputs produce bit-identical outputs at either shape.
+
+    ``cpu_req``/``mem_req``/``cpu_cap``/``mem_cap`` are the int64 aggregate
+    sums; counts are int32. Returns ``(status, nodes_delta, cpu_percent,
+    mem_percent, cpu_req_masked, mem_req_masked, cpu_cap_masked,
+    mem_cap_masked)`` — the masked sums apply the reference's
+    pre-aggregation-exit zeroing (controller.go:233-255)."""
+    t = group_decision_terms(g, cpu_req, mem_req, cpu_cap, mem_cap,
+                             num_pods, num_nodes, num_untainted)
+    return (t["status"], t["nodes_delta"], t["cpu_percent"], t["mem_percent"],
+            t["cpu_request_milli"], t["mem_request_bytes"],
+            t["cpu_capacity_milli"], t["mem_capacity_bytes"])
+
+
+def explain_decide(g: GroupArrays, cpu_req, mem_req, cpu_cap, mem_cap,
+                   num_pods, num_nodes, num_untainted,
+                   num_tainted, num_cordoned):
+    """The explain kernel: re-run the decision calculus and emit EVERY term
+    by name — the 13 persistent decision columns reconstructed (the
+    provenance layer bit-cross-checks these against the committed columns;
+    any mismatch is itself a finding), plus the derivation terms, gate
+    booleans, the active threshold branch and the active status-cascade arm.
+
+    The reconstruction shares :func:`group_decision_terms` with the live
+    paths, so a mismatch can only mean the AGGREGATES drifted (stale cache,
+    missed dirty mark) — exactly the class of bug the cross-check exists to
+    catch. The two branch codes are explain-only extras computed OUTSIDE the
+    shared core so the live programs gain no dead equations:
+
+    - ``threshold_branch`` indexes :data:`EXPLAIN_THRESHOLD_BRANCHES` — the
+      controller.go:332-351 arm that fired (exactly one, by construction:
+      the three gates are mutually exclusive and "hold" is their complement).
+    - ``status_branch`` indexes :data:`EXPLAIN_STATUS_BRANCHES` — the first
+      status-cascade arm that fired, 7 when none did.
+
+    Config echoes ride along so one gather explains a decision without a
+    second trip for the thresholds it was judged against."""
+    t = group_decision_terms(g, cpu_req, mem_req, cpu_cap, mem_cap,
+                             num_pods, num_nodes, num_untainted)
+    threshold_branch = jnp.where(
+        t["gate_down_fast"], jnp.int32(0),
+        jnp.where(t["gate_down_slow"], jnp.int32(1),
+                  jnp.where(t["gate_scale_up"], jnp.int32(2), jnp.int32(3))))
+    cascade = [
+        t["gate_invalid"] | t["gate_empty"],
+        t["gate_below_min"],
+        t["gate_above_max"],
+        t["gate_forced_min"],
+        t["gate_div_zero"],
+        t["gate_locked"],
+        t["gate_scale_up"] & t["gate_neg_delta"],
+    ]
+    status_branch = jnp.select(
+        cascade, [jnp.int32(i) for i in range(7)], jnp.int32(7))
+    return {
+        **t,
+        # counts echoed so the dict reconstructs all 13 decision columns
+        "num_pods": num_pods,
+        "num_nodes": num_nodes,
+        "num_untainted": num_untainted,
+        "num_tainted": num_tainted,
+        "num_cordoned": num_cordoned,
+        # explain-only branch codes
+        "threshold_branch": threshold_branch,
+        "status_branch": status_branch,
+        # config echoes (the thresholds the decision was judged against)
+        "cfg_scale_up_threshold": g.scale_up_thr,
+        "cfg_taint_lower": g.taint_lower,
+        "cfg_taint_upper": g.taint_upper,
+        "cfg_fast_rate": g.fast_rate,
+        "cfg_slow_rate": g.slow_rate,
+        "cfg_min_nodes": g.min_nodes,
+        "cfg_max_nodes": g.max_nodes,
+        "cfg_cached_cpu_milli": g.cached_cpu_milli,
+        "cfg_cached_mem_bytes": g.cached_mem_bytes,
+    }
+
+
+_explain_decide_raw = jax.jit(explain_decide)
+
+
+def explain_decide_jit(g: GroupArrays, cpu_req, mem_req, cpu_cap, mem_cap,
+                       num_pods, num_nodes, num_untainted,
+                       num_tainted, num_cordoned):
+    """Jitted :func:`explain_decide` with the same wedged-transport guard as
+    :func:`decide_jit` (debug-explain is a raw-library surface when replaying
+    offline). READ-ONLY by design: no donation — explaining a decision must
+    never invalidate the state that produced it."""
+    from escalator_tpu.jaxconfig import ensure_responsive_accelerator
+
+    ensure_responsive_accelerator()
+    return _explain_decide_raw(g, cpu_req, mem_req, cpu_cap, mem_cap,
+                               num_pods, num_nodes, num_untainted,
+                               num_tainted, num_cordoned)
 
 
 def _node_offsets(sel, ngroup, G):

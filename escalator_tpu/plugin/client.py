@@ -44,7 +44,7 @@ from escalator_tpu.controller.backend import (
     GoldenBackend,
     PackingPostPass,
     PaddedPacker,
-    _decision_digest,
+    _annotate_decision,
     _unpack,
 )
 from escalator_tpu.metrics import metrics
@@ -173,6 +173,11 @@ class ComputeClient:
             request_serializer=lambda x: x,
             response_deserializer=lambda x: x,
         )
+        self._explain = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Explain",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x,
+        )
 
     def health(self) -> dict:
         return msgpack.unpackb(self._health(b"", timeout=self.timeout_sec))
@@ -190,6 +195,25 @@ class ComputeClient:
         (UNIMPLEMENTED from a pre-round-17 server) on transport failure."""
         req = msgpack.packb({"since": int(since_seq)}) if since_seq else b""
         return msgpack.unpackb(self._journal(req, timeout=self.timeout_sec))
+
+    def explain(self, tenant: Optional[str] = None,
+                groups: Optional[list] = None) -> dict:
+        """The server's decision-provenance surface (the debug-explain
+        CLI's live source). Without a tenant: discovery —
+        ``{keys: [...], health: {...}}``. With one: ``{key, explanations:
+        [per-group docs], history: [...], flaps: [...]}`` re-derived live
+        from the server's resident arenas. Raises grpc.RpcError
+        (UNIMPLEMENTED from a pre-round-19 server, NOT_FOUND for a key no
+        explainer or history covers) on transport failure."""
+        req = b""
+        if tenant is not None or groups is not None:
+            body: dict = {}
+            if tenant is not None:
+                body["tenant"] = str(tenant)
+            if groups is not None:
+                body["groups"] = [int(g) for g in groups]
+            req = msgpack.packb(body)
+        return msgpack.unpackb(self._explain(req, timeout=self.timeout_sec))
 
     def profile(self, ticks: int = 4, timeout_sec: float = 60.0) -> dict:
         """Capture a jax profiler trace of the server's next ``ticks``
@@ -565,7 +589,7 @@ class GrpcBackend(ComputeBackend):
             self._breaker_open = False
             self._ticks_since_open = 0
             self._consecutive_failures = 0
-            obs.annotate(digest=_decision_digest(out))
+            _annotate_decision(self.name, out)
             if fleet_meta is not None:
                 obs.annotate(fleet_batch_size=fleet_meta.get("batch_size"),
                              fleet_ordered=fleet_meta.get("ordered"))

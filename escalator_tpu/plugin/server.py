@@ -39,6 +39,15 @@ Methods:
   artifact back (the ``escalator-tpu debug-profile`` CLI's wire target).
   Degrades to ``{ok: False, unsupported: reason}`` where the platform lacks
   the profiler.
+- ``Explain``: msgpack ``{tenant?: str, groups?: [int]}`` (or empty) ->
+  msgpack decision-provenance doc (observability/provenance.py). Empty
+  request = discovery: the known history keys + flap/mismatch health.
+  With a tenant: per-group explanation documents re-derived LIVE from the
+  resident fleet arenas (named terms, gate booleans, the one
+  controller.go:332-351 threshold arm that fired, config echoes,
+  bit-cross-check against the committed columns), the tenant's recent
+  decision history ring, and its flap record. The ``escalator-tpu
+  debug-explain`` CLI's wire target.
 """
 
 from __future__ import annotations
@@ -360,6 +369,12 @@ class _ComputeService:
             # nothing), same section every flight dump carries
             "memory": obs.resources.memory_section(),
         }
+        # decision provenance (round 19): flap/mismatch health from the
+        # same probe that exposes staleness — a flapping fleet is visible
+        # without a Prometheus scrape or a flight dump
+        from escalator_tpu.observability import provenance
+
+        doc["provenance"] = provenance.health_section()
         if self._fleet is not None:
             # the batcher's stale-but-alive surface (mirrors tick_p99_ms):
             # a wedged worker shows oldest_waiting growing while the queue
@@ -400,6 +415,53 @@ class _ComputeService:
                               "Journal request must be a msgpack map")
             since = int(req.get("since", 0) or 0)
         return msgpack.packb(obs.journal.JOURNAL.as_doc(since_seq=since))
+
+    def explain(self, request: bytes, context) -> bytes:
+        """Decision provenance over the wire (``debug-explain``'s live
+        source). Request: empty, or msgpack ``{tenant?: str, groups?:
+        [int]}``. Without a tenant the response is DISCOVERY — the known
+        history keys plus the provenance health row. With one, the
+        per-group explanation documents from the registered live explainer
+        (the fleet engine's wildcard registration / an embedded
+        controller's), the tenant's recent decision history, and its flap
+        record. NOT_FOUND when neither an explainer nor any history covers
+        the key — fleet tenants appear after their first decide."""
+        from escalator_tpu.observability import provenance
+
+        req: dict = {}
+        if request:
+            try:
+                req = msgpack.unpackb(request)
+            except Exception:  # noqa: BLE001 - malformed request: named error
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "Explain request must be a msgpack map")
+            if not isinstance(req, dict):
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "Explain request must be a msgpack map")
+        tenant = req.get("tenant")
+        if tenant is None:
+            return msgpack.packb({
+                "keys": provenance.HISTORY.keys(),
+                "health": provenance.health_section(),
+            })
+        key = str(tenant)
+        groups = req.get("groups")
+        if groups is not None:
+            groups = [int(g) for g in groups]
+        docs = provenance.explain_for(key, groups)
+        history = provenance.HISTORY.history(key)
+        if docs is None and not history:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"no live explainer or decision history covers {key!r} "
+                "(fleet tenants appear after their first decide)")
+        return msgpack.packb({
+            "key": key,
+            "explanations": docs,
+            "history": history,
+            "flaps": [r for r in list(provenance.FLAPS.recent)
+                      if r.get("key") == key][-16:],
+        })
 
     #: total profile artifact bytes one Profile RPC will ship back — a
     #: pathological capture must not balloon one response without bound
@@ -501,6 +563,11 @@ def make_server(
         ),
         "Profile": grpc.unary_unary_rpc_method_handler(
             service.profile,
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "Explain": grpc.unary_unary_rpc_method_handler(
+            service.explain,
             request_deserializer=_identity,
             response_serializer=_identity,
         ),

@@ -229,6 +229,10 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
                        str(tmp_path / "fleet-smoke.json"))
     monkeypatch.setenv("ESCALATOR_TPU_MEMORY_SMOKE",
                        str(tmp_path / "memory-smoke.json"))
+    monkeypatch.setenv("ESCALATOR_TPU_JOURNEY_SMOKE",
+                       str(tmp_path / "journey-smoke.json"))
+    monkeypatch.setenv("ESCALATOR_TPU_PROVENANCE_SMOKE",
+                       str(tmp_path / "provenance-smoke.json"))
     out = bench.run_smoke()
     assert out["smoke_cfg8_parity"] == "ok"
     assert out["smoke_cfg10_parity"] == "ok"
@@ -316,12 +320,34 @@ def test_smoke_mode_parity(bench, tmp_path, monkeypatch):
     assert memory_report["forced_leak"]["growth_bytes"] > 0
     assert any(f.endswith(".xplane.pb")
                for f in memory_report["profile_rpc"]["files"])
+    # round 19: the decision provenance leg — explain-vs-columns bit
+    # parity over the real Explain RPC, a forced oscillation firing the
+    # flap watchdog (journal + reason="flap" dump, steady tenant silent),
+    # and the debug-explain CLI round-trip (run_smoke asserts the details
+    # internally; here we lock the artifact surface CI uploads)
+    assert out["smoke_provenance_mode"] == "grpc"
+    assert out["smoke_provenance_flap"] == "ok"
+    assert out["smoke_provenance_parity"] == "ok"
+    assert out["smoke_provenance_cli"] == "ok"
+    prov_text = (tmp_path / "provenance-smoke.json").read_text()
+    prov_report = json.loads(prov_text)
+    assert prov_report["flaps"]["fired"] >= 1
+    assert prov_report["flaps"]["dump_reason"] == "flap"
+    assert prov_report["flaps"]["dump_groups"], prov_report["flaps"]
+    assert prov_report["explain"]["mismatches"] == 0
+    assert set(prov_report["explain"]["threshold_branches"]) <= {
+        "scale_down_fast", "scale_down_slow", "scale_up", "hold"}
+    assert prov_report["cli"] == {"discovery_rc": 0, "tenant_rc": 0}
+    # smoke artifacts are canonical: sorted keys + fixed float precision,
+    # so a canonical re-dump is byte-identical (round 19 satellite)
+    assert prov_text == json.dumps(
+        bench._canon_smoke(prov_report), indent=1, sort_keys=True) + "\n"
     # per-leg duration table (round 15 satellite): every major leg is
     # named in both the stdout dict and the persisted artifact
     legs = out["smoke_leg_seconds"]
     assert {"cfg8_order_tail", "cfg10_ffd", "cfg14_incremental", "replay",
             "streaming", "recorder_overhead", "tail_trace", "fleet",
-            "resources"} <= set(legs)
+            "resources", "provenance"} <= set(legs)
     assert all(sec >= 0 for sec in legs.values())
     assert memory_report["leg_seconds"] == legs
 

@@ -322,6 +322,9 @@ def test_engine_randomized_multi_tenant_soak(num_shards, monkeypatch):
     # instead of deadlocking it
     monkeypatch.setenv("ESCALATOR_TPU_LOCK_WITNESS", "1")
     witness_base = len(lockwitness.VIOLATIONS)
+    from escalator_tpu.observability import provenance
+
+    mismatch_base = provenance.mismatch_total()
     rng = np.random.default_rng(17)
     pyrng = random.Random(17)
     eng = FleetEngine(num_groups=G, pod_capacity=P, node_capacity=N,
@@ -401,13 +404,33 @@ def test_engine_randomized_multi_tenant_soak(num_shards, monkeypatch):
                             tid, None, now,
                             delta=_delta_from(world[tid], world[tid])))
                     expect.append(res)
-                for res2, res1 in zip(eng.step(reqs2), expect, strict=True):
+                results2 = eng.step(reqs2)
+                for res2, res1 in zip(results2, expect, strict=True):
                     for f in kernel.GROUP_DECISION_FIELDS:
                         np.testing.assert_array_equal(
                             np.asarray(getattr(res2.arrays, f)),
                             np.asarray(getattr(res1.arrays, f)),
                             err_msg=f"cached tick {tick} "
                                     f"{res1.tenant_id}:{f}")
+                # round 19: a digest-served answer must EXPLAIN exactly
+                # like a dispatched one — the re-derived calculus
+                # bit-cross-checks against the cached columns the tenant
+                # was actually served (ticks >= 7 bound the explain
+                # kernel's compile to the final grown arena shape)
+                if tick >= 7:
+                    for res2 in results2:
+                        if not res2.cached:
+                            continue
+                        docs = eng.explain_tenant(res2.tenant_id)
+                        st = np.asarray(res2.arrays.status)
+                        nd = np.asarray(res2.arrays.nodes_delta)
+                        for d in docs:
+                            assert "mismatches" not in d, \
+                                f"tick {tick} {res2.tenant_id}: {d}"
+                            g = d["group"]
+                            assert d["status"] == int(st[g])
+                            assert d["nodes_delta"] == int(nd[g])
+                    assert provenance.mismatch_total() == mismatch_base
             finally:
                 if tick == 4:
                     CHAOS.disarm("fleet_digest")

@@ -1,0 +1,932 @@
+"""Decision provenance observatory (round-19 tentpole).
+
+Four layers under test:
+
+- the explain CONTRACT (observability/provenance.py): the term glossary /
+  column / branch tables stay in sync with the kernel's explain entries,
+  the cross-check compares on raw float bits (NaN and -0.0 drifts must
+  not hide behind ``==``), and explanation documents name exactly the one
+  controller.go:332-351 threshold arm the fired gates imply;
+- the decision HISTORY + flap watchdog: bounded per-key rings, the
+  sign-alternation / status-churn detectors (holds don't break an
+  oscillation; steady workloads never reach the scan), per-window
+  re-fire debounce, rate-limited ``reason="flap"`` dumps with
+  explanations, and the env-knob parse discipline;
+- the traced explain PATH: ``IncrementalDecider.explain`` bit-cross-
+  checks the re-derived calculus against the committed columns across a
+  randomized 30-tick soak (pod churn + taint/cordon/drain flips), and
+  ``debug-explain --replay`` re-executes the recorded ring from a
+  snapshot to byte-identical explanations — plus the inertness law: a
+  provenance-armed process traces byte-identical jaxprs;
+- the fleet end: ``explain_tenant`` parity against the served columns,
+  the wildcard explainer registration, and the digest fast path staging
+  cached answers into the same history the dispatch path feeds.
+"""
+
+from __future__ import annotations
+
+import copy
+import gc
+import json
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from escalator_tpu.observability import provenance
+
+NOW = 1_700_000_000
+
+
+@pytest.fixture(autouse=True)
+def _provenance_hygiene():
+    """History/flap/mismatch state is process-global; every test starts
+    and ends clean (the dump worker drains before the reset so a late
+    flap dump never lands in the next test's tmpdir)."""
+    provenance.reset()
+    yield
+    provenance.FLAPS.drain()
+    provenance.reset()
+
+
+def _kernel_terms(seed: int = 0) -> dict:
+    """One real explain-kernel evaluation as a host term dict — the
+    fixture every contract test builds documents from."""
+    from escalator_tpu.analysis import registry
+    from escalator_tpu.ops import kernel
+
+    terms = kernel._explain_decide_raw(*registry._explain_decide_args(seed))
+    return {k: np.asarray(v) for k, v in terms.items()}
+
+
+def _committed_from(terms: dict) -> dict:
+    return {f: np.array(terms[f]) for f in provenance.COLUMN_FIELDS}
+
+
+# ---------------------------------------------------------------------------
+# contract sync: provenance's tables are twins of the kernel's
+# ---------------------------------------------------------------------------
+
+
+class TestContractSync:
+    def test_column_fields_and_branch_tables_match_kernel(self):
+        from escalator_tpu.ops import kernel
+
+        assert provenance.COLUMN_FIELDS == tuple(
+            kernel.GROUP_DECISION_FIELDS)
+        assert provenance.THRESHOLD_BRANCHES == tuple(
+            kernel.EXPLAIN_THRESHOLD_BRANCHES)
+        assert provenance.STATUS_BRANCHES == tuple(
+            kernel.EXPLAIN_STATUS_BRANCHES)
+
+    def test_glossary_names_every_explain_term(self):
+        terms = _kernel_terms()
+        missing = set(terms) - set(provenance.TERM_GLOSSARY)
+        assert not missing, f"explain terms without a glossary row: {missing}"
+        assert set(provenance.COLUMN_FIELDS) <= set(terms)
+
+    def test_registry_dtype_contract_matches_live_terms(self):
+        from escalator_tpu.analysis.registry import EXPLAIN_DTYPES
+
+        terms = _kernel_terms()
+        for name, dtype in EXPLAIN_DTYPES.items():
+            assert str(terms[name].dtype) == dtype, name
+
+
+# ---------------------------------------------------------------------------
+# cross_check: raw-bit float semantics
+# ---------------------------------------------------------------------------
+
+
+class TestCrossCheck:
+    def test_identical_columns_are_clean(self):
+        terms = _kernel_terms()
+        assert provenance.cross_check(terms, _committed_from(terms)) == []
+
+    def test_integer_drift_is_a_named_finding(self):
+        terms = _kernel_terms()
+        committed = _committed_from(terms)
+        committed["nodes_delta"][2] += 1
+        findings = provenance.cross_check(terms, committed)
+        assert len(findings) == 1
+        f = findings[0]
+        assert (f["group"], f["field"]) == (2, "nodes_delta")
+        assert f["explained"] == f["committed"] - 1
+
+    def test_float_columns_compare_on_raw_bits(self):
+        terms = dict(_kernel_terms())
+        committed = _committed_from(terms)
+        cpu = np.array(terms["cpu_percent"])
+        # same-bits NaN is NOT a drift; 0.0 vs -0.0 IS (== would pass both)
+        cpu[0] = np.float64("nan")
+        committed["cpu_percent"][0] = np.float64("nan")
+        cpu[1] = 0.0
+        committed["cpu_percent"][1] = -0.0
+        terms["cpu_percent"] = cpu
+        findings = provenance.cross_check(terms, committed)
+        assert [(f["group"], f["field"]) for f in findings] == [
+            (1, "cpu_percent")]
+
+    def test_dirty_groups_are_skipped(self):
+        terms = _kernel_terms()
+        committed = _committed_from(terms)
+        committed["status"][3] += 1
+        G = committed["status"].shape[0]
+        dirty = np.zeros(G, bool)
+        dirty[3] = True
+        assert provenance.cross_check(terms, committed, skip=dirty) == []
+        assert provenance.cross_check(terms, committed) != []
+
+    def test_shape_mismatch_is_one_finding_not_a_crash(self):
+        terms = _kernel_terms()
+        committed = _committed_from(terms)
+        committed["status"] = committed["status"][:-1]
+        findings = [f for f in provenance.cross_check(terms, committed)
+                    if f["field"] == "status"]
+        assert findings == [{
+            "group": -1, "field": "status",
+            "explained": [terms["status"].shape[0]],
+            "committed": [terms["status"].shape[0] - 1]}]
+
+
+# ---------------------------------------------------------------------------
+# explanation documents
+# ---------------------------------------------------------------------------
+
+
+class TestBuildExplanations:
+    def test_documents_name_exactly_the_fired_threshold_arm(self):
+        terms = _kernel_terms()
+        docs = provenance.build_explanations(
+            terms, committed=_committed_from(terms))
+        assert len(docs) == terms["status"].shape[0]
+        for d in docs:
+            assert "mismatches" not in d
+            assert d["threshold_branch"] in provenance.THRESHOLD_BRANCHES
+            assert d["status_branch"] in provenance.STATUS_BRANCHES
+            # the ONE arm the fired gates imply, in the kernel's priority
+            fired = [k for k in ("gate_down_fast", "gate_down_slow",
+                                 "gate_scale_up") if d["gates"][k]]
+            arm = {"gate_down_fast": "scale_down_fast",
+                   "gate_down_slow": "scale_down_slow",
+                   "gate_scale_up": "scale_up"}
+            assert d["threshold_branch"] == (
+                arm[fired[0]] if fired else "hold")
+            assert set(d["config"]) == set(provenance._CONFIG_KEYS)
+            assert not any(k.startswith(("gate_", "cfg_"))
+                           for k in d["terms"])
+
+    def test_groups_filter_and_candidate_attachment(self):
+        terms = _kernel_terms()
+        docs = provenance.build_explanations(
+            terms, groups=[3, 1, 99], candidates={3: [5, 6], 1: []})
+        assert [d["group"] for d in docs] == [3, 1]
+        assert docs[0]["scale_down_candidates"] == [5, 6]
+        assert docs[1]["scale_down_candidates"] == []
+        docs = provenance.build_explanations(terms, groups=[1])
+        assert "scale_down_candidates" not in docs[0]   # none attached
+
+    def test_dirty_marks_stale_and_suppresses_the_finding(self):
+        terms = _kernel_terms()
+        committed = _committed_from(terms)
+        committed["nodes_delta"][2] += 5
+        G = committed["status"].shape[0]
+        dirty = np.zeros(G, bool)
+        dirty[2] = True
+        docs = provenance.build_explanations(terms, committed, dirty=dirty)
+        assert docs[2]["stale"] is True
+        assert "mismatches" not in docs[2]
+        docs = provenance.build_explanations(terms, committed)
+        assert docs[2]["mismatches"][0]["field"] == "nodes_delta"
+
+
+def test_candidate_windows_slices_and_truncates():
+    order = np.arange(10)
+    offsets = np.array([0, 3, 3, 9])
+    wins = provenance.candidate_windows(order, offsets, max_per_group=4)
+    assert wins == {0: [0, 1, 2], 2: [3, 4, 5, 6]}   # empty g=1 absent
+
+
+# ---------------------------------------------------------------------------
+# decision-diff forensics
+# ---------------------------------------------------------------------------
+
+
+def _doc(group=0, status=0, delta=0, tb="hold", sb=None, terms=None,
+         config=None, gates=None):
+    return {"group": group, "status": status, "status_name": f"S{status}",
+            "nodes_delta": delta, "threshold_branch": tb,
+            "status_branch": sb or provenance.STATUS_BRANCHES[-1],
+            "stale": False, "terms": dict(terms or {}),
+            "config": dict(config or {}), "gates": dict(gates or {})}
+
+
+_CFG = {"cfg_scale_up_threshold": 70, "cfg_taint_lower": 40,
+        "cfg_taint_upper": 55, "cfg_min_nodes": 1, "cfg_max_nodes": 10}
+
+
+class TestDiffForensics:
+    def test_attribution_names_the_crossed_threshold(self):
+        a = _doc(terms={"max_percent": 60.0, "num_nodes": 3,
+                        "num_untainted": 3}, config=_CFG,
+                 gates={"gate_scale_up": False})
+        b = _doc(status=4, delta=2, tb="scale_up",
+                 terms={"max_percent": 80.0, "num_nodes": 3,
+                        "num_untainted": 3}, config=_CFG,
+                 gates={"gate_scale_up": True})
+        res = provenance.diff_explanations([a], [b])
+        assert res["unchanged_groups"] == 0
+        (ch,) = res["changed"]
+        assert ch["nodes_delta"] == [0, 2]
+        assert ch["term_deltas"]["max_percent"] == [60.0, 80.0]
+        notes = ch["attribution"]
+        assert ("max_percent crossed scale_up_threshold "
+                "(60.0 -> 80.0, threshold 70)") in notes
+        assert "threshold branch hold -> scale_up" in notes
+        assert "gate_scale_up False -> True" in notes
+
+    def test_config_change_is_noted_once(self):
+        # two crossing rules watch cfg_min_nodes (num_nodes AND
+        # num_untainted) — a changed knob must not print twice
+        terms = {"max_percent": 50.0, "num_nodes": 3, "num_untainted": 3}
+        a = _doc(terms=terms, config=_CFG)
+        b = _doc(status=2, terms=terms,
+                 config=dict(_CFG, cfg_min_nodes=5))
+        (ch,) = provenance.diff_explanations([a], [b])["changed"]
+        assert ch["attribution"].count("cfg_min_nodes changed 1 -> 5") == 1
+
+    def test_membership_and_unchanged_accounting(self):
+        shared = _doc(group=1, status=0, delta=0)
+        res = provenance.diff_explanations(
+            [_doc(group=0), shared], [copy.deepcopy(shared), _doc(group=2)])
+        assert res["changed"] == []
+        assert res["unchanged_groups"] == 1
+        assert res["only_in_a"] == [0] and res["only_in_b"] == [2]
+
+
+# ---------------------------------------------------------------------------
+# decision history ring
+# ---------------------------------------------------------------------------
+
+
+class TestDecisionHistory:
+    def test_push_window_and_group_view(self):
+        h = provenance.DecisionHistory(depth=3)
+        for t in range(5):
+            tick, window = h.push(
+                "k", np.array([0, 4]), np.array([t, -t]))
+        assert tick == 5 and len(window) == 3
+        full = h.history("k")
+        assert [r["tick"] for r in full] == [3, 4, 5]
+        assert full[-1]["nodes_delta"] == [4, -4]
+        g1 = h.history("k", group=1)
+        assert [r["status"] for r in g1] == [4, 4, 4]
+        assert h.history("k", group=7) == []   # out of range: empty view
+
+    def test_explicit_tick_then_sequence_resumes(self):
+        h = provenance.DecisionHistory(depth=4)
+        h.push("k", np.zeros(1), np.zeros(1), tick=41)
+        tick, _ = h.push("k", np.zeros(1), np.zeros(1))
+        assert tick == 42
+
+    def test_shape_change_restarts_the_ring(self):
+        h = provenance.DecisionHistory(depth=8)
+        h.push("k", np.zeros(4), np.zeros(4))
+        h.push("k", np.zeros(4), np.zeros(4))
+        _, window = h.push("k", np.zeros(6), np.zeros(6))
+        assert len(window) == 1   # mixed widths would stack meaninglessly
+
+    def test_key_lru_bound(self):
+        h = provenance.DecisionHistory(depth=2, max_keys=2)
+        h.push("a", np.zeros(1), np.zeros(1))
+        h.push("b", np.zeros(1), np.zeros(1))
+        h.push("a", np.zeros(1), np.zeros(1))   # refresh a
+        h.push("c", np.zeros(1), np.zeros(1))   # evicts b (LRU)
+        assert set(h.keys()) == {"a", "c"}
+
+
+# ---------------------------------------------------------------------------
+# flap watchdog
+# ---------------------------------------------------------------------------
+
+
+def _feed(key, deltas, statuses=None, G=2, start_tick=1):
+    """Drive the singleton via the real staging path (no active timeline:
+    records feed through immediately). Group 0 carries the pattern."""
+    for i, d in enumerate(deltas):
+        delta = np.zeros(G, np.int64)
+        delta[0] = d
+        status = np.zeros(G, np.int64)
+        if statuses is not None:
+            status[0] = statuses[i]
+        provenance.stage(key, status, delta, tick=start_tick + i)
+
+
+def _flap_events():
+    from escalator_tpu.observability import journal
+
+    return [e for e in journal.JOURNAL.snapshot()
+            if e.get("kind") == "group-flap"]
+
+
+def _counter(name, labels=None):
+    from escalator_tpu.metrics import metrics
+
+    return metrics.registry.get_sample_value(name, labels or {}) or 0.0
+
+
+class TestFlapWatchdog:
+    def test_steady_and_monotone_workloads_are_silent(self, monkeypatch):
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_WINDOW", "6")
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_MIN_ALTERNATIONS", "3")
+        base_events = len(_flap_events())
+        _feed("idle", [0] * 10)          # prefiltered: never reaches a scan
+        _feed("monotone", [1] * 10)      # moves, but never alternates
+        assert provenance.FLAPS.flaps == 0
+        assert len(_flap_events()) == base_events
+
+    def test_oscillation_fires_counts_journals_and_dumps(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_WINDOW", "6")
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_MIN_ALTERNATIONS", "3")
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_DUMP_INTERVAL_SEC", "3600")
+        provenance.register_explainer(
+            "osc", lambda key, groups: [{"group": int(g), "key": key}
+                                        for g in (groups or [0])])
+        try:
+            before = _counter("escalator_tpu_fleet_group_flaps_total",
+                              {"klass": "delta_sign"})
+            _feed("osc", [1, -1] * 4)
+            assert provenance.FLAPS.flaps >= 1
+            provenance.FLAPS.drain()
+            assert _counter("escalator_tpu_fleet_group_flaps_total",
+                            {"klass": "delta_sign"}) >= before + 1
+            ev = [e for e in _flap_events() if e.get("key") == "osc"]
+            assert ev and ev[0]["groups"] == [0] and ev[0]["dumped"] is True
+            assert provenance.FLAPS.top_flapping()[0]["key"] == "osc"
+            assert list(provenance.FLAPS.recent)[-1]["klass"] == "delta_sign"
+            dumps = sorted(tmp_path.glob(
+                "escalator-tpu-flight-flap-*.json"))
+            assert dumps, "no flap dump landed"
+            flap = json.loads(dumps[-1].read_text())["flap"]
+            assert flap["key"] == "osc" and flap["groups"] == [0]
+            assert flap["findings"][0]["klass"] == "delta_sign"
+            assert flap["findings"][0]["history"]   # the offending window
+            assert flap["explanations"] == [{"group": 0, "key": "osc"}]
+        finally:
+            provenance.unregister_explainer("osc")
+
+    def test_holds_do_not_break_an_oscillation(self, monkeypatch):
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_WINDOW", "8")
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_MIN_ALTERNATIONS", "3")
+        _feed("thrash", [1, 0, -1, 0, 1, 0, -1])   # the classic thrash
+        assert provenance.FLAPS.flaps >= 1
+        assert list(provenance.FLAPS.recent)[-1]["klass"] == "delta_sign"
+
+    def test_refire_debounce_and_dump_rate_limit(self, monkeypatch):
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_WINDOW", "4")
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_MIN_ALTERNATIONS", "2")
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_DUMP_INTERVAL_SEC", "3600")
+        base_events = len(_flap_events())
+        _feed("sustained", [1, -1] * 6)   # ticks 1..12
+        provenance.FLAPS.drain()
+        # one incident per full window (ticks 3, 7, 11), one dump per
+        # interval — the journal keeps the rate-limited re-fires
+        assert provenance.FLAPS.flaps == 3
+        assert provenance.FLAPS.dumps == 1
+        dumped = [e["dumped"] for e in _flap_events()[base_events:]]
+        assert dumped == [True, False, False]
+
+    def test_status_churn_between_two_codes(self, monkeypatch):
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_WINDOW", "8")
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_MIN_ALTERNATIONS", "2")
+        _feed("bounce", [0] * 8, statuses=[0, 4] * 4)
+        assert provenance.FLAPS.flaps >= 1
+        assert list(provenance.FLAPS.recent)[-1]["klass"] == "status_churn"
+
+    def test_window_off_disables_detection(self, monkeypatch):
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_WINDOW", "off")
+        _feed("osc-off", [1, -1] * 6)
+        assert provenance.FLAPS.flaps == 0
+
+    def test_bad_env_warns_once_and_defaults(self, monkeypatch, caplog):
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_WINDOW", "banana")
+        with caplog.at_level(logging.WARNING,
+                             logger="escalator_tpu.observability"):
+            _feed("osc-bad", [1, -1] * 4)   # default window 8 / min_alt 3
+        assert provenance.FLAPS.flaps >= 1
+        assert "using default" in caplog.text
+
+
+# ---------------------------------------------------------------------------
+# the staging feed (timeline stash -> root-complete drain)
+# ---------------------------------------------------------------------------
+
+
+class TestStagingFeed:
+    def test_stage_rides_the_timeline_until_root_completes(self):
+        from escalator_tpu.observability import spans
+
+        with spans.span("prov_root"):
+            provenance.stage("tl-key", np.zeros(2, np.int64),
+                             np.zeros(2, np.int64))
+            assert "tl-key" not in provenance.HISTORY.keys()
+        # the flight recorder's root-complete hook drained the stash
+        assert "tl-key" in provenance.HISTORY.keys()
+
+    def test_stage_without_timeline_feeds_immediately(self):
+        provenance.stage("raw-key", np.zeros(2, np.int64),
+                         np.zeros(2, np.int64), tick=9)
+        hist = provenance.HISTORY.history("raw-key")
+        assert [r["tick"] for r in hist] == [9]
+
+
+# ---------------------------------------------------------------------------
+# mismatch reporting
+# ---------------------------------------------------------------------------
+
+
+class TestMismatchReporting:
+    def test_counter_journal_and_rate_limited_dump(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_DUMP_INTERVAL_SEC", "3600")
+        before = _counter("escalator_tpu_provenance_explain_mismatches_total")
+        mm = [{"group": 0, "field": "status", "explained": 1,
+               "committed": 0}]
+        provenance.report_mismatches("unit", mm,
+                                     explanations=[{"group": 0}])
+        provenance.report_mismatches("unit", mm)   # inside the interval
+        assert provenance.mismatch_total() == 2
+        assert _counter(
+            "escalator_tpu_provenance_explain_mismatches_total"
+        ) == before + 2
+        from escalator_tpu.observability import journal
+
+        ev = [e for e in journal.JOURNAL.snapshot()
+              if e.get("kind") == "explain-mismatch"
+              and e.get("context") == "unit"]
+        assert len(ev) == 2 and ev[0]["fields"] == ["status"]
+        dumps = sorted(tmp_path.glob(
+            "escalator-tpu-flight-explain-mismatch-*.json"))
+        assert len(dumps) == 1   # the second burst was rate-limited
+        extra = json.loads(dumps[0].read_text())["explain_mismatch"]
+        assert extra["context"] == "unit" and extra["mismatches"] == mm
+        assert extra["explanations"] == [{"group": 0}]
+
+    def test_empty_report_is_a_noop(self):
+        provenance.report_mismatches("unit", [])
+        assert provenance.mismatch_total() == 0
+
+
+# ---------------------------------------------------------------------------
+# explainer registry + dump/health surfacing
+# ---------------------------------------------------------------------------
+
+
+class TestExplainerRegistry:
+    def test_exact_key_wins_over_wildcard_and_dicts_unwrap(self):
+        provenance.register_explainer(
+            "*", lambda key, groups: [{"group": 0, "via": "wildcard"}])
+        provenance.register_explainer(
+            "t1", lambda key, groups: {"explanations":
+                                       [{"group": 0, "via": "exact"}]})
+        try:
+            assert provenance.explain_for("t1")[0]["via"] == "exact"
+            assert provenance.explain_for("anything")[0]["via"] == "wildcard"
+        finally:
+            provenance.unregister_explainer("*")
+            provenance.unregister_explainer("t1")
+        assert provenance.explain_for("t1") is None
+
+    def test_bound_methods_are_held_weakly(self):
+        class Engine:
+            def explain(self, key, groups):
+                return [{"group": 0}]
+
+        eng = Engine()
+        provenance.register_explainer("weak", eng.explain)
+        assert provenance.explain_for("weak") == [{"group": 0}]
+        del eng
+        gc.collect()
+        assert provenance.explain_for("weak") is None   # self-unregistered
+
+
+class TestSurfacing:
+    def test_dump_section_is_none_when_clean(self):
+        assert provenance.dump_section() is None
+        assert provenance.dump_section({"tail": {"root": "tick"}}) is None
+
+    def test_dump_section_carries_history_and_explanations(self):
+        provenance.stage("t9", np.zeros(2, np.int64),
+                         np.zeros(2, np.int64), tick=1)
+        provenance.register_explainer(
+            "t9", lambda key, groups: [{"group": 0}])
+        try:
+            sec = provenance.dump_section({"tail": {"root": "fleet/t9"}})
+            assert sec["history"]["t9"][0]["tick"] == 1
+            assert sec["explanations"]["t9"] == [{"group": 0}]
+            # a flap incident's own key skips the duplicate explain gather
+            sec = provenance.dump_section({"flap": {"key": "t9"}})
+            assert "explanations" not in sec
+        finally:
+            provenance.unregister_explainer("t9")
+
+    def test_health_section_fields(self):
+        provenance.stage("hk", np.zeros(1, np.int64),
+                         np.zeros(1, np.int64), tick=1)
+        h = provenance.health_section()
+        assert h["history_keys"] == 1
+        assert h["history_depth"] == provenance.HISTORY.depth
+        for k in ("flaps_total", "flap_dumps",
+                  "explain_mismatches_total", "top_flapping"):
+            assert k in h
+
+
+# ---------------------------------------------------------------------------
+# inertness: provenance armed changes no traced program
+# ---------------------------------------------------------------------------
+
+
+def test_jaxprs_byte_identical_with_provenance_armed(monkeypatch):
+    """The observatory lives strictly host-side: tracing the pre-existing
+    decide entries with provenance fully armed (history staged, flap knobs
+    set, a live explainer registered) yields jaxprs byte-identical to a
+    disarmed process — the same inertness law the span layer obeys."""
+    import jax
+
+    from escalator_tpu.analysis.registry import default_registry
+
+    entries = {e.name: e for e in default_registry()}
+    for name in ("kernel.decide", "kernel.delta_decide"):
+        traced = entries[name].build()
+
+        def jaxpr_text():
+            return str(jax.make_jaxpr(traced.fn)(*traced.args))
+
+        provenance.reset()
+        plain = jaxpr_text()
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_WINDOW", "4")
+        monkeypatch.setenv("ESCALATOR_TPU_FLAP_MIN_ALTERNATIONS", "2")
+        provenance.register_explainer(
+            "armed", lambda key, groups: [{"group": 0}])
+        try:
+            _feed("armed", [1, -1] * 4)
+            assert provenance.FLAPS.flaps >= 1
+            armed = jaxpr_text()
+        finally:
+            provenance.unregister_explainer("armed")
+        assert armed == plain, f"{name}: jaxpr changed under provenance"
+
+
+# ---------------------------------------------------------------------------
+# hook overhead: the steady-tick feed is sub-quarter-millisecond
+# ---------------------------------------------------------------------------
+
+
+def test_history_feed_overhead_under_quarter_millisecond():
+    """The acceptance bound on the root-complete hook's provenance leg: a
+    steady tick (no delta, no status change — the prefiltered path every
+    production tick takes) stages + ingests in well under 0.25 ms."""
+    status = np.zeros(64, np.int64)
+    delta = np.zeros(64, np.int64)
+    for i in range(50):   # warm the ring + the config memo
+        provenance.stage("overhead", status, delta, tick=i + 1)
+    iters = 400
+    t0 = time.perf_counter()
+    for i in range(iters):
+        provenance.stage("overhead", status, delta, tick=100 + i)
+    per_tick = (time.perf_counter() - t0) / iters
+    assert per_tick < 0.25e-3, f"{per_tick * 1e3:.3f} ms per staged tick"
+
+
+# ---------------------------------------------------------------------------
+# the traced explain path: 30-tick randomized parity soak + replay
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _input_log_hygiene():
+    from escalator_tpu.observability import replay
+
+    replay.INPUT_LOG.set_enabled(False)
+    replay.INPUT_LOG.clear()
+    yield
+    replay.INPUT_LOG.set_enabled(False)
+    replay.INPUT_LOG.clear()
+
+
+def _soak_tick(host, cache, inc, rng, t):
+    """One randomized churn tick: pod resource churn plus taint/cordon
+    flips on live nodes (a tainted node with pods IS the drain
+    transition), then the incremental ordered decide."""
+    P = host.pods.valid.shape[0]
+    N = host.nodes.valid.shape[0]
+    pidx = np.unique(rng.integers(0, P, 5))
+    host.pods.cpu_milli[pidx] = rng.integers(100, 8000, len(pidx))
+    host.pods.mem_bytes[pidx] = rng.integers(1 << 20, 1 << 34, len(pidx))
+    nidx = np.unique(rng.integers(0, N, 3))
+    host.nodes.tainted[nidx] = ~host.nodes.tainted[nidx]
+    host.nodes.cordoned[nidx[:1]] = ~host.nodes.cordoned[nidx[:1]]
+    inc.apply_gathered(cache.gather_deltas(pidx.astype(np.int64),
+                                           nidx.astype(np.int64)))
+    return inc.decide(NOW + 60 * t, tainted_any=True)
+
+
+def _assert_explained_parity(docs, out, t):
+    """The acceptance contract, per tick: every clean group's document is
+    bit-equal to the committed columns, no cross-check finding survived,
+    and the named threshold branch is exactly the arm its gates fired."""
+    status = np.asarray(out.status)
+    delta = np.asarray(out.nodes_delta)
+    cpu = np.asarray(out.cpu_percent)
+    mem = np.asarray(out.mem_percent)
+    assert len(docs) == status.shape[0]
+    arm = {"gate_down_fast": "scale_down_fast",
+           "gate_down_slow": "scale_down_slow",
+           "gate_scale_up": "scale_up"}
+    for d in docs:
+        assert "mismatches" not in d, f"tick {t}: {d}"
+        fired = [k for k in ("gate_down_fast", "gate_down_slow",
+                             "gate_scale_up") if d["gates"][k]]
+        assert d["threshold_branch"] == (
+            arm[fired[0]] if fired else "hold"), f"tick {t}: {d}"
+        if d["stale"]:
+            continue   # a pending delta: columns legitimately behind
+        g = d["group"]
+        assert d["status"] == int(status[g]), f"tick {t} group {g}"
+        assert d["nodes_delta"] == int(delta[g]), f"tick {t} group {g}"
+        assert np.float64(d["terms"]["cpu_percent"]).tobytes() \
+            == cpu[g].tobytes(), f"tick {t} group {g}: cpu bits"
+        assert np.float64(d["terms"]["mem_percent"]).tobytes() \
+            == mem[g].tobytes(), f"tick {t} group {g}: mem bits"
+
+
+def test_thirty_tick_randomized_explain_parity_and_replay(
+        tmp_path, capsys, _input_log_hygiene):
+    """The tentpole soak: 30 randomized ticks (pod churn, taint/cordon
+    flips, drain transitions) with every tick's explanation bit-cross-
+    checked against the committed columns — then the SAME assertion
+    offline: ``debug-explain --replay`` re-executes the recorded ring
+    from a mid-run snapshot and must print byte-identical explanations."""
+    from escalator_tpu.analysis.registry import representative_cluster
+    from escalator_tpu.cli import main
+    from escalator_tpu.observability import RECORDER, replay
+    from escalator_tpu.ops import snapshot as snaplib
+    from escalator_tpu.ops.device_state import (
+        DeviceClusterCache,
+        IncrementalDecider,
+    )
+
+    host = representative_cluster(seed=1923)
+    cache = DeviceClusterCache(host)
+    inc = IncrementalDecider(cache, refresh_every=0, background=False)
+    rng = np.random.default_rng(1923)
+    replay.INPUT_LOG.set_enabled(True)
+    snap_path = None
+    live_docs = None
+    for t in range(30):
+        if t == 27:
+            leaves, meta = inc.snapshot_state()
+            snap_path = snaplib.write_snapshot(
+                str(tmp_path / "prov.snap"), leaves, meta)
+        out, ordered = _soak_tick(host, cache, inc, rng, t)
+        assert ordered
+        live_docs = inc.explain()
+        _assert_explained_parity(live_docs, out, t)
+        # an incremental ordered tick attaches real scale-down victim
+        # windows (tick 0 is the full-refresh decide: no persistent order
+        # state to read them from yet)
+        if t >= 1:
+            assert any("scale_down_candidates" in d for d in live_docs), t
+    assert provenance.mismatch_total() == 0
+    replay.INPUT_LOG.set_enabled(False)
+    entries = replay.INPUT_LOG.snapshot()
+    assert len(entries) == 30
+
+    # in-process replay: bit-identical explanations of the final state
+    report = replay.replay_ring(entries, snapshot_path=snap_path,
+                                explain=True)
+    assert report["ok"], report["divergent"]
+    assert report["replayed"] == 3 and report["explain_tick"] == 30
+    canon_live = json.dumps(json.loads(json.dumps(live_docs)),
+                            sort_keys=True)
+    assert json.dumps(json.loads(json.dumps(report["explanations"])),
+                      sort_keys=True) == canon_live
+
+    # the CLI end: debug-explain --replay prints the same documents and
+    # exits 0 (no divergence, no cross-check mismatch)
+    dump_path = str(tmp_path / "ring.json")
+    RECORDER.dump(dump_path, reason="test")
+    rc = main(["debug-explain", "--replay", "--dump", dump_path,
+               "--snapshot", snap_path, "--json"])
+    cli_out = capsys.readouterr().out
+    assert rc == 0
+    cli_report = json.loads(cli_out)
+    assert cli_report["ok"] and cli_report["replayed"] == 3
+    assert json.dumps(cli_report["explanations"],
+                      sort_keys=True) == canon_live
+    # --replay without a snapshot is a usage error, not a traceback
+    assert main(["debug-explain", "--replay", "--dump", dump_path]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# fleet end: explain_tenant parity, wildcard routing, cached provenance
+# ---------------------------------------------------------------------------
+
+
+class TestFleetExplain:
+    @pytest.fixture(scope="class")
+    def fleet(self):
+        from escalator_tpu.analysis.registry import representative_cluster
+        from escalator_tpu.fleet import DecideRequest, FleetEngine
+
+        eng = FleetEngine(num_groups=6, pod_capacity=24, node_capacity=12,
+                          max_tenants=2)
+        clusters = {f"pv{i}": representative_cluster(6, 24, 12,
+                                                     seed=640 + i)
+                    for i in range(2)}
+        results = {r.tenant_id: r for r in eng.step(
+            [DecideRequest(t, c, NOW) for t, c in clusters.items()])}
+        return eng, clusters, results
+
+    def test_explain_tenant_matches_served_columns(self, fleet):
+        eng, _clusters, results = fleet
+        for tid, res in results.items():
+            docs = eng.explain_tenant(tid)
+            _assert_explained_parity(docs, res.arrays, tid)
+        assert provenance.mismatch_total() == 0
+        # groups filter returns exactly the requested rows
+        docs = eng.explain_tenant("pv0", groups=[4, 2])
+        assert [d["group"] for d in docs] == [4, 2]
+
+    def test_unknown_tenant_raises_and_wildcard_shields(self, fleet):
+        from escalator_tpu.fleet import TenantError
+
+        eng, _c, _r = fleet
+        with pytest.raises(TenantError, match="ghost"):
+            eng.explain_tenant("ghost")
+        # the dump worker's path: the wildcard explainer never raises
+        assert eng._explain_for_provenance("ghost") is None
+        assert provenance.explain_for("ghost") is None
+
+    def test_wildcard_registration_routes_to_engine(self, fleet):
+        eng, _c, _r = fleet
+        via_registry = provenance.explain_for("pv1")
+        direct = eng.explain_tenant("pv1")
+        assert json.dumps(via_registry, sort_keys=True, default=str) \
+            == json.dumps(direct, sort_keys=True, default=str)
+
+    def test_cache_hit_stages_history_and_explains_consistently(
+            self, fleet):
+        from escalator_tpu.fleet import DecideRequest
+
+        eng, clusters, _r = fleet
+        # the same full frame at the same now: the digest fast path
+        # answers from the cached columns — and must feed the SAME
+        # history record a dispatch would have (satellite (c)'s unit end)
+        res2 = eng.step([DecideRequest("pv0", clusters["pv0"], NOW)])[0]
+        assert res2.cached and res2.batch_size == 0
+        hist = provenance.HISTORY.history("pv0")
+        assert hist, "cache hit staged no history record"
+        assert hist[-1]["status"] == [int(s) for s in
+                                      np.asarray(res2.arrays.status)]
+        assert hist[-1]["nodes_delta"] == [
+            int(d) for d in np.asarray(res2.arrays.nodes_delta)]
+        docs = eng.explain_tenant("pv0")
+        _assert_explained_parity(docs, res2.arrays, "cached pv0")
+        assert provenance.mismatch_total() == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI forensics: debug-explain --dump, debug-decision-diff, debug-journal
+# ---------------------------------------------------------------------------
+
+
+class TestCLIForensics:
+    def _clean_docs(self):
+        terms = _kernel_terms()
+        return provenance.build_explanations(
+            terms, committed=_committed_from(terms))
+
+    def test_debug_explain_dump_exit_semantics(self, tmp_path, capsys):
+        from escalator_tpu.cli import main
+
+        docs = self._clean_docs()
+        p = tmp_path / "docs.json"
+        p.write_text(json.dumps(docs))
+        assert main(["debug-explain", "--dump", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "group 0:" in out and "branch=" in out
+        # --groups filters; --json carries the full documents
+        assert main(["debug-explain", "--dump", str(p),
+                     "--groups", "1,3", "--json"]) == 0
+        shown = json.loads(capsys.readouterr().out)["explanations"]
+        assert [d["group"] for d in shown] == [1, 3]
+        # a surviving cross-check mismatch is exit 1 and rendered
+        docs[0]["mismatches"] = [{"group": 0, "field": "status",
+                                  "explained": 1, "committed": 0}]
+        p.write_text(json.dumps(docs))
+        assert main(["debug-explain", "--dump", str(p)]) == 1
+        assert "MISMATCH" in capsys.readouterr().out
+        # unreadable / carrier without docs -> exit 2
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert main(["debug-explain", "--dump", str(bad)]) == 2
+        capsys.readouterr()
+
+    def test_debug_explain_dump_multi_tenant_needs_tenant(
+            self, tmp_path, capsys):
+        from escalator_tpu.cli import main
+
+        docs = self._clean_docs()
+        p = tmp_path / "flight.json"
+        p.write_text(json.dumps({"provenance": {"explanations": {
+            "a": docs, "b": docs}}}))
+        assert main(["debug-explain", "--dump", str(p)]) == 2
+        assert "--tenant" in capsys.readouterr().err
+        assert main(["debug-explain", "--dump", str(p),
+                     "--tenant", "a"]) == 0
+        assert main(["debug-explain", "--dump", str(p),
+                     "--tenant", "zz"]) == 2
+        capsys.readouterr()
+
+    def test_flap_dump_is_a_first_class_carrier(self, tmp_path, capsys):
+        """The forensics flow the watchdog sets up — "a reason=flap dump
+        landed, explain/diff it" — must load the explanations the dump
+        carries under its top-level ``flap`` section."""
+        from escalator_tpu.cli import main
+
+        docs = self._clean_docs()
+        p = tmp_path / "escalator-tpu-flight-flap-0.json"
+        p.write_text(json.dumps({
+            "flight_recorder": True, "reason": "flap",
+            "flap": {"key": "t0", "groups": [0], "explanations": docs}}))
+        assert main(["debug-explain", "--dump", str(p)]) == 0
+        assert "group 0:" in capsys.readouterr().out
+        assert main(["debug-decision-diff", str(p), str(p)]) == 0
+        capsys.readouterr()
+
+    def test_debug_decision_diff_cli(self, tmp_path, capsys):
+        from escalator_tpu.cli import main
+
+        a = [_doc(terms={"max_percent": 60.0, "num_nodes": 3,
+                         "num_untainted": 3}, config=_CFG,
+                  gates={"gate_scale_up": False})]
+        b = [_doc(status=4, delta=2, tb="scale_up",
+                  terms={"max_percent": 80.0, "num_nodes": 3,
+                         "num_untainted": 3}, config=_CFG,
+                  gates={"gate_scale_up": True})]
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        pa.write_text(json.dumps(a))
+        pb.write_text(json.dumps(b))
+        # changed decision -> exit 1 (diff(1) semantics) + attribution
+        assert main(["debug-decision-diff", str(pa), str(pb)]) == 1
+        out = capsys.readouterr().out
+        assert "because: max_percent crossed scale_up_threshold" in out
+        assert "delta +0 -> +2" in out
+        # identical sides -> exit 0
+        assert main(["debug-decision-diff", str(pa), str(pa)]) == 0
+        capsys.readouterr()
+        # --json carries the structured diff document
+        assert main(["debug-decision-diff", str(pa), str(pb),
+                     "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["changed"][0]["term_deltas"]["max_percent"] == [60.0,
+                                                                   80.0]
+        # unreadable side -> exit 2
+        assert main(["debug-decision-diff", str(pa),
+                     str(tmp_path / "nope.json")]) == 2
+        capsys.readouterr()
+
+    def test_debug_journal_kind_comma_list_and_unknown_warning(
+            self, tmp_path, capsys):
+        from escalator_tpu.cli import main
+
+        events = [
+            {"seq": 1, "kind": "group-flap", "time_unix": 0, "key": "t0"},
+            {"seq": 2, "kind": "explain-mismatch", "time_unix": 0},
+            {"seq": 3, "kind": "slo-burn", "time_unix": 0},
+        ]
+        p = tmp_path / "flight.json"
+        p.write_text(json.dumps({"journal": {
+            "events": events, "total_recorded": 3, "capacity": 256}}))
+        # one --kind flag, comma-separated list (blanks drop silently)
+        rc = main(["debug-journal", "--dump", str(p),
+                   "--kind", "group-flap,explain-mismatch,", "--json"])
+        captured = capsys.readouterr()
+        assert rc == 0 and captured.err == ""
+        shown = json.loads(captured.out)["events"]
+        assert [e["kind"] for e in shown] == ["group-flap",
+                                              "explain-mismatch"]
+        # a typo'd kind warns with the kinds actually present
+        rc = main(["debug-journal", "--dump", str(p),
+                   "--kind", "group-flop,slo-burn", "--json"])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "no events of kind(s) group-flop" in captured.err
+        assert "kinds present:" in captured.err
+        assert "group-flap" in captured.err
+        assert [e["kind"] for e in json.loads(captured.out)["events"]] \
+            == ["slo-burn"]

@@ -263,6 +263,40 @@ def fleet_tail_cycle():
     return p50, p99, rate
 
 
+def decision_health_cycle(ticks_per_window=30, window=3):
+    """Synthetic round-19 decision-health panel: flap detections cluster on
+    the demand wave's FLANKS — where utilisation hovers around the 30/70
+    thresholds and nodes_delta alternates sign tick over tick — not at its
+    peak (a steady scale-up is not a flap), split by watchdog klass (sign
+    alternation vs status churn); explain mismatches are a hard zero by
+    construction (the explain kernel shares the decide math core, so any
+    non-zero cell is a finding, not noise); and the explain-hook overhead
+    p99 runs THROUGH THE REAL HISTOGRAM ENGINE — per-tick hook timings
+    (lognormal around the ~40 us stage cost, nudged by load) recorded into
+    a LogHistogram per scrape window, plotted as the rolling-window p99
+    the <1 % overhead gate bounds."""
+    rnd = random.Random(233)
+    flap_sign, flap_status, mism, hook_p99_us = [], [], [], []
+    hists = []
+    for i in range(T):
+        b = _burst(i)
+        # the wave's slope: largest on the flanks, ~0 at peak and trough
+        edge = abs(_burst(min(T - 1, i + 1)) - _burst(max(0, i - 1)))
+        flap_sign.append(max(0.0, rnd.gauss(26.0 * edge, 0.4)))
+        flap_status.append(max(0.0, rnd.gauss(9.0 * edge, 0.25)))
+        mism.append(0.0)
+        mu = math.log(4.2e-5 * (1.0 + 0.35 * b))
+        h = LogHistogram()
+        for _ in range(ticks_per_window):
+            h.record(rnd.lognormvariate(mu, 0.3))
+        hists.append(h)
+        merged = LogHistogram()
+        for hh in hists[-window:]:
+            merged.merge(hh)
+        hook_p99_us.append(merged.quantile(0.99) * 1e6)
+    return flap_sign, flap_status, mism, hook_p99_us
+
+
 def journey_cycle(ticks_per_window=30, window=3):
     """Synthetic per-stage request-journey p99s THROUGH THE REAL HISTOGRAM
     ENGINE (the round-17 panel): the critical class's five journey stages
@@ -417,6 +451,7 @@ def main():
     stage_p99, budget_burn = journey_cycle()
     cache_frac, cache_crit, cache_std = fleet_cache_cycle()
     tail_p50, tail_p99, tail_rate = fleet_tail_cycle()
+    flap_sign, flap_status, prov_mism, hook_p99_us = decision_health_cycle()
     panels, grid = [], [
         ("Node counts by state",
          [(s["nodes"], S1, "total"), (s["untainted"], S2, "untainted"),
@@ -490,6 +525,16 @@ def main():
          [(tail_p50, S1, "tail batch p50"),
           (tail_p99, S2, "tail batch p99"),
           (tail_rate, S3, "tail dispatches/s")], "", ()),
+        # round 19: the decision-health panel — flap-watchdog fire rate by
+        # klass (clustered on the wave's flanks, where deltas alternate),
+        # the always-zero explain-mismatch count, and the explain-hook
+        # overhead p99 through the real log-bucket engine
+        # (see decision_health_cycle)
+        ("Decision health: flaps / mismatches / explain overhead",
+         [(flap_sign, S1, "flaps/s (delta_sign)"),
+          (flap_status, S2, "flaps/s (status_churn)"),
+          (prov_mism, S3, "explain mismatches"),
+          (hook_p99_us, S4, "explain hook p99 (µs)")], "", (2,)),
     ]
     for i, (title, series, unit, labels) in enumerate(grid):
         x = PAD + (i % 2) * (PANEL_W + PAD)
