@@ -70,6 +70,13 @@ Configs:
               micro-batch size, per-tick 13-column bit-parity for EVERY
               tenant vs its standalone decide, and the one-dispatch-per-
               micro-batch proof from flight-recorder phase counts
+  cfg18       SCALE-OUT partition sweep (round-20 tentpole): N=1 vs N=2
+              fleet partition subprocesses behind the consistent-hash
+              router — aggregate decisions/sec at the host-bound
+              high-idle arm and the device-bound full-churn arm,
+              per-class p99 per partition, core-gated >=1.5x scaling bar
+              (also standalone via ``--cfg18``, which merges into the
+              existing BENCH_FULL_LATEST.json)
 
 Tail truth (round 13): every recorder-sourced per-phase column is a
 p50/p99/p999/min dict (``_recorder_phase_stats``), e2e churn rows carry
@@ -1800,6 +1807,278 @@ def _cfg17_fleet(rng, now, device, detail: dict, degraded: bool) -> None:
             idle_sweep["idle_90pct"]["decisions_per_sec"])
         detail["cfg17_fleet_idle90_cache_hit_rate"] = (
             idle_sweep["idle_90pct"]["cache_hit_rate"])
+
+
+def _cfg18_scaleout(now, detail: dict, degraded: bool) -> None:
+    """cfg18 (round-20 tentpole): horizontal scale-out — N=1 vs N=2 fleet
+    PARTITIONS, each a REAL subprocess (own interpreter, own JAX runtime,
+    own GIL) behind the consistent-hash PartitionRouter, on the cfg17
+    per-tenant workload shape (4 groups x 100 pods x 20 nodes, the 10%
+    critical / 60% standard / 30% batch class mix) at gRPC-subprocess
+    scale (C=64; cfg17's C=10k drain model stays the in-process number).
+    Two arms per partition count: the HOST-BOUND high-idle arm (90% of
+    tenants repeat an unchanged frame — the digest fast path, pure host
+    work, the arm the router exists for) and the full-churn arm (every
+    tenant ships a delta, every micro-batch dispatches — device-bound).
+    Per-class p99 is reported per partition against the cfg17 bars.
+
+    Honesty contract: partition scaling is CORE-GATED. Two processes on a
+    rig that exposes one usable core merely timeshare it — aggregate
+    decisions/sec cannot exceed 1x, and the measured ratio is committed
+    with that caveat instead of asserted (the cfg7/8 convention: the
+    scaling SHAPE is the evidence the rig can produce). On >=2 cores the
+    high-idle arm must scale >=1.5x. The full-churn arm is reported with
+    the device-contention caveat either way: both partitions dispatch onto
+    the same physical device pool, so device-bound work does not scale
+    with partition count on a shared-core rig."""
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from escalator_tpu.analysis.registry import representative_cluster
+    from escalator_tpu.fleet.router import PartitionRouter
+
+    Gt, Pt, Nt = 4, 100, 20
+    C = 64
+    idle_frac = 0.9
+    warm_ticks, timed_ticks = 2, 3
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+
+    def klass_of(t: int) -> str:
+        m = t % 10
+        return "critical" if m == 0 else ("batch" if m >= 7 else "standard")
+
+    launcher = (
+        "from escalator_tpu.plugin.server import FleetConfig, "
+        "make_server\n"
+        "srv = make_server('127.0.0.1:0', max_workers=16, "
+        "fleet=FleetConfig(num_groups=%d, pod_capacity=%d, "
+        "node_capacity=%d, max_tenants=%d, max_batch=16, flush_ms=5.0, "
+        "queue_limit=%d, per_tenant_inflight=1, num_shards=1))\n"
+        "srv.start()\n"
+        "print('SCALEOUT_PORT=%%d' %% srv._escalator_bound_port, "
+        "flush=True)\n"
+        "srv.wait_for_termination()\n" % (Gt, Pt, Nt, C + 4, 4 * C))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run_arm(nparts: int) -> dict:
+        tmp = tempfile.mkdtemp(prefix="escalator-cfg18-")
+        procs: dict = {}
+        errs: dict = {}
+        addrs: dict = {}
+        for i in range(nparts):
+            p = f"part{i}"
+            errs[p] = open(os.path.join(tmp, f"{p}.stderr.log"), "w")
+            procs[p] = subprocess.Popen(
+                [sys.executable, "-c", launcher],
+                stdout=subprocess.PIPE, stderr=errs[p], text=True, env=env)
+        router = None
+        pool = None
+        try:
+            boot_t0 = time.perf_counter()
+            for p, proc in procs.items():
+                port = None
+                deadline = time.monotonic() + 300
+                while time.monotonic() < deadline:
+                    line = proc.stdout.readline()
+                    if not line:
+                        break
+                    if line.startswith("SCALEOUT_PORT="):
+                        port = int(line.split("=", 1)[1])
+                        break
+                if port is None:
+                    errs[p].flush()
+                    with open(os.path.join(
+                            tmp, f"{p}.stderr.log")) as f:
+                        tail = f.read()[-2000:]
+                    raise AssertionError(
+                        f"cfg18 partition {p} failed to start:\n{tail}")
+                addrs[p] = f"127.0.0.1:{port}"
+            router = PartitionRouter(addrs, timeout_sec=300.0)
+            sessions: dict = {}
+            nows: dict = {}
+            for j in range(C):
+                tid = f"c18t{j}"
+                sess = router.stream_session(
+                    tid, pod_capacity=Pt, node_capacity=Nt,
+                    store_kind="numpy", klass=klass_of(j))
+                sess.set_groups(representative_cluster(
+                    Gt, Pt, Nt, seed=1800 + j).groups)
+                for k in range(24):
+                    sess.store.upsert_pod(f"{tid}-p{k}", k % Gt,
+                                          400 + 10 * k + 3 * j,
+                                          10 ** 9, k % 5)
+                for k in range(8):
+                    sess.store.upsert_node(f"{tid}-n{k}", k % Gt, 4000,
+                                           16 * 10 ** 9)
+                sessions[tid] = sess
+                nows[tid] = int(now)
+            tids = list(sessions)
+            homes = {tid: router.home(tid) for tid in tids}
+            pool = ThreadPoolExecutor(max_workers=16)
+            lat_lock = threading.Lock()
+
+            def tick(changed, lat_out=None):
+                """One drain: every tenant decides concurrently; tenants
+                in ``changed`` churn one pod row and advance their clock,
+                the rest repeat unchanged (the digest fast path).
+                Returns the drain wall."""
+                def one(tid):
+                    t_sub = time.perf_counter()
+                    router.decide_stream(sessions[tid], nows[tid])
+                    if lat_out is not None:
+                        with lat_lock:
+                            lat_out.setdefault(tid, []).append(
+                                time.perf_counter() - t_sub)
+                for tid in changed:
+                    nows[tid] += 60
+                    sessions[tid].store.upsert_pod(
+                        f"{tid}-p1", 1, 500 + nows[tid] % 997,
+                        10 ** 9, 1)
+                futs = [pool.submit(one, tid) for tid in tids]
+                t0 = time.perf_counter()
+                for f in futs:
+                    f.result(timeout=1200)
+                return time.perf_counter() - t0
+
+            # bootstrap: full frames register every tenant (the only full
+            # frames of the sweep) + one all-delta warm
+            tick(set())
+            boot_s = time.perf_counter() - boot_t0
+            tick(set(tids))
+            prng = np.random.default_rng(180 + nparts)
+            n_changed = C - int(round(idle_frac * C))
+            arm_row: dict = {
+                "partitions": nparts,
+                "addresses": sorted(addrs.values()),
+                "tenants": C,
+                "tenant_spread": {
+                    p: sum(1 for h in homes.values() if h == p)
+                    for p in addrs},
+                "bootstrap_s": round(boot_s, 1),
+            }
+            for arm_name, mix in (("high_idle", "idle"),
+                                  ("full_churn", "all")):
+                def changed_set():
+                    if mix == "all":
+                        return set(tids)
+                    return set(prng.choice(tids, size=n_changed,
+                                           replace=False))
+                # warm THIS mix (cfg17 lesson: the step program keys on
+                # batch widths — the timed window must not eat a compile)
+                for _ in range(warm_ticks):
+                    tick(changed_set())
+                lats: dict = {}
+                walls = [tick(changed_set(), lat_out=lats)
+                         for _ in range(timed_ticks)]
+                per_class: dict = {}
+                for p in addrs:
+                    for name in ("critical", "standard", "batch"):
+                        cls = [l * 1e3
+                               for j, tid in enumerate(tids)
+                               if homes[tid] == p and klass_of(j) == name
+                               for l in lats.get(tid, ())]
+                        if not cls:
+                            continue
+                        bar = _CFG17_CLASS_BARS[name]
+                        p99 = float(np.percentile(cls, 99))
+                        per_class[f"{p}/{name}"] = {
+                            "p50_ms": round(
+                                float(np.percentile(cls, 50)), 3),
+                            "p99_ms": round(p99, 3),
+                            "p99_bar_ms": bar,
+                            "within_bar": (True if bar is None
+                                           else bool(p99 <= bar)),
+                        }
+                arm_row[arm_name] = {
+                    "decisions_per_sec": round(
+                        C * timed_ticks / sum(walls), 1),
+                    "tick_wall_ms": round(
+                        float(np.median(walls)) * 1e3, 1),
+                    "idle_fraction": 0.0 if mix == "all" else idle_frac,
+                    "classes": per_class,
+                }
+            return arm_row
+        finally:
+            if pool is not None:
+                pool.shutdown()
+            if router is not None:
+                router.close()
+            for p, proc in procs.items():
+                proc.kill()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+                if proc.stdout is not None:
+                    proc.stdout.close()
+                errs[p].close()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    arms = {f"N{n}": run_arm(n) for n in (1, 2)}
+    idle_ratio = round(
+        arms["N2"]["high_idle"]["decisions_per_sec"]
+        / max(arms["N1"]["high_idle"]["decisions_per_sec"], 1e-9), 3)
+    churn_ratio = round(
+        arms["N2"]["full_churn"]["decisions_per_sec"]
+        / max(arms["N1"]["full_churn"]["decisions_per_sec"], 1e-9), 3)
+    row = {
+        "workload": ("cfg17 per-tenant shape + class mix at C=64 over "
+                     "real gRPC subprocess partitions"),
+        "usable_cores": cores,
+        "sweep": arms,
+        "idle_scaling_1_to_2": idle_ratio,
+        "churn_scaling_1_to_2": churn_ratio,
+        "churn_caveat": (
+            "full-churn is device-bound: both partitions dispatch onto "
+            "the same physical device pool, so decisions/sec does not "
+            "scale with partition count on a shared-core rig"),
+    }
+    if cores >= 2:
+        assert idle_ratio >= 1.5, (
+            f"cfg18: high-idle aggregate decisions/sec scaled only "
+            f"{idle_ratio}x from 1 to 2 partitions on a {cores}-core rig "
+            "(bar: >=1.5x)")
+        row["idle_scaling_bar"] = ">=1.5x (met)"
+    else:
+        row["idle_scaling_bar"] = (
+            f">=1.5x NOT ASSERTABLE: this rig exposes {cores} usable "
+            "core(s); two partition processes timeshare it, so aggregate "
+            "throughput is capped at ~1x regardless of the router. The "
+            "measured ratio is committed as the honest number; the bar "
+            "needs a >=2-core host")
+    detail["cfg18_scaleout"] = row
+    detail["cfg18_scaleout_idle_scaling_1_to_2"] = idle_ratio
+
+
+def run_cfg18() -> dict:
+    """Targeted cfg18 runner (``python bench.py --cfg18``): run ONLY the
+    partition-scaling sweep and MERGE its rows into the existing
+    BENCH_FULL_LATEST.json detail (the full bench stays the artifact of
+    record for every other section — this mode exists so the scale-out
+    numbers can be refreshed without re-pricing 17 sections)."""
+    detail: dict = {}
+    now = np.int64(1_700_000_000)
+    _cfg18_scaleout(now, detail, degraded=True)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_FULL_LATEST.json")
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, ValueError):  # no prior full artifact: minimal shell
+        record = {"full_artifact": "BENCH_FULL_LATEST.json", "detail": {}}
+    record.setdefault("detail", {}).update(_round_floats(detail))
+    try:
+        _atomic_json_write(path, record)
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    return _round_floats(detail)
 
 
 def _background_audit_row(store, cache, inc, now, P, G, cpu_m,
@@ -3997,6 +4276,255 @@ def run_smoke() -> dict:
     out["smoke_provenance_mode"] = fleet_mode
     _leg("provenance")
 
+    # ---- scale-out smoke (round 20): TWO fleet partitions as REAL
+    # subprocesses behind the PartitionRouter — route streamed decides
+    # across both, warm-migrate one tenant (journal sequence + digest
+    # continuity + measured gap), roll the tenant checkpoints, SIGKILL one
+    # partition and prove the breaker → fail_over → replay ladder re-homes
+    # its tenants onto the survivor with digest continuity and a measured
+    # failover wall time. Written to SCALEOUT_SMOKE_LATEST.json for CI
+    # upload; the numbers feed docs/scale-out.md's committed SLO table.
+    import shutil as _soshutil
+    import subprocess as _sosubprocess
+    import tempfile as _sotempfile
+
+    scaleout_report: dict = {"smoke": True, "mode": fleet_mode}
+    if fleet_mode == "grpc":
+        from dataclasses import fields as _sodcfields
+
+        from escalator_tpu import observability as _soobs
+        from escalator_tpu.core.arrays import ClusterArrays as _SOCA
+        from escalator_tpu.fleet.router import PartitionRouter as _SOPR
+
+        Gs, Ps, Ns = 6, 24, 12
+        nowi = int(now)
+        so_dir = _sotempfile.mkdtemp(prefix="escalator-scaleout-smoke-")
+        # each partition is a real process: its own interpreter, its own
+        # JAX runtime, its own GIL — the thing the router exists to escape
+        so_launcher = (
+            "from escalator_tpu.plugin.server import FleetConfig, "
+            "make_server\n"
+            "srv = make_server('127.0.0.1:0', max_workers=8, "
+            "fleet=FleetConfig(num_groups=%d, pod_capacity=%d, "
+            "node_capacity=%d, max_tenants=8, max_batch=8, flush_ms=5.0, "
+            "queue_limit=64, per_tenant_inflight=1, num_shards=1))\n"
+            "srv.start()\n"
+            "print('SCALEOUT_PORT=%%d' %% srv._escalator_bound_port, "
+            "flush=True)\n"
+            "srv.wait_for_termination()\n" % (Gs, Ps, Ns))
+        so_env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        so_env["JAX_PLATFORMS"] = "cpu"
+        so_procs: dict = {}
+        so_errs: dict = {}
+        for pname in ("sp0", "sp1"):
+            so_errs[pname] = open(
+                os.path.join(so_dir, f"{pname}.stderr.log"), "w")
+            so_procs[pname] = _sosubprocess.Popen(
+                [sys.executable, "-c", so_launcher],
+                stdout=_sosubprocess.PIPE, stderr=so_errs[pname],
+                text=True, env=so_env)
+
+        def _so_port(pname):
+            proc = so_procs[pname]
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                if line.startswith("SCALEOUT_PORT="):
+                    return int(line.split("=", 1)[1])
+            so_errs[pname].flush()
+            with open(os.path.join(so_dir,
+                                   f"{pname}.stderr.log")) as f:
+                tail = f.read()[-2000:]
+            raise AssertionError(
+                f"scale-out partition {pname} failed to start:\n{tail}")
+
+        srouter = None
+        try:
+            so_t0 = time.perf_counter()
+            addrs = {p: f"127.0.0.1:{_so_port(p)}" for p in so_procs}
+            scaleout_report["partition_start_s"] = round(
+                time.perf_counter() - so_t0, 3)
+            # breaker_threshold=1: the smoke wants ONE dead RPC to trip
+            # fail-over — production keeps the default 3
+            srouter = _SOPR(addrs, breaker_threshold=1,
+                            checkpoint_dir=os.path.join(so_dir, "ckpt"),
+                            timeout_sec=120.0)
+            # deterministic spread: walk tenant names until each partition
+            # owns two (consistent hashing is pure, so this terminates
+            # fast and the picked set is identical on every run)
+            by_home: dict = {"sp0": [], "sp1": []}
+            i = 0
+            while min(len(v) for v in by_home.values()) < 2:
+                t = f"so{i}"
+                i += 1
+                h = srouter.home(t)
+                if len(by_home[h]) < 2:
+                    by_home[h].append(t)
+            stenants = by_home["sp0"] + by_home["sp1"]
+            scaleout_report["partitions"] = {
+                p: {"address": addrs[p], "tenants": by_home[p]}
+                for p in addrs}
+
+            ssessions: dict = {}
+            sgroups_so: dict = {}
+            for j, tid in enumerate(stenants):
+                sess = srouter.stream_session(
+                    tid, pod_capacity=Ps, node_capacity=Ns,
+                    store_kind="numpy")
+                sgroups_so[tid] = representative_cluster(
+                    Gs, Ps, Ns, seed=1200 + j).groups
+                sess.set_groups(sgroups_so[tid])
+                for k in range(8):
+                    sess.store.upsert_pod(f"{tid}-p{k}", k % Gs,
+                                          400 + 20 * k + 7 * j,
+                                          10 ** 9, k % 5)
+                for k in range(5):
+                    sess.store.upsert_node(f"{tid}-n{k}", k % Gs, 4000,
+                                           16 * 10 ** 9,
+                                           tainted=(k == 4))
+                ssessions[tid] = sess
+
+            def _so_content(tid):
+                def copy(soa):
+                    return type(soa)(**{
+                        f.name: np.array(getattr(soa, f.name))
+                        for f in _sodcfields(soa)})
+                pods, nodes = ssessions[tid].store.as_pod_node_arrays()
+                return _SOCA(groups=copy(sgroups_so[tid]),
+                             pods=copy(pods), nodes=copy(nodes))
+
+            def _so_assert_parity(tid, o, at):
+                ref = _fk.decide_jit(jax.device_put(_so_content(tid)),
+                                     np.int64(at))
+                assert decision_digest(o) == decision_digest(ref), (
+                    f"scale-out smoke: digest diverged for {tid} @ {at}")
+
+            # (1) route: full frame then a churned delta through BOTH
+            # partitions, every answer digest-equal to a local reference
+            # decide on the session's store content
+            for tid in stenants:
+                o, _p, _m = srouter.decide_stream(ssessions[tid], nowi)
+                _so_assert_parity(tid, o, nowi)
+            for tid in stenants:
+                ssessions[tid].store.upsert_pod(
+                    f"{tid}-p1", 1, 3000, 4 * 10 ** 9, 1)
+                ssessions[tid].store.delete_pod(f"{tid}-p6")
+                o, _p, _m = srouter.decide_stream(
+                    ssessions[tid], nowi + 60)
+                _so_assert_parity(tid, o, nowi + 60)
+                assert ssessions[tid].full_frames == 1, (
+                    tid, ssessions[tid].full_frames)
+            assert {srouter.home(t) for t in stenants} == {"sp0", "sp1"}
+            scaleout_report["routed_decides"] = 2 * len(stenants)
+            out["smoke_scaleout_route_parity"] = "ok"
+
+            # (2) warm migration sp0 -> sp1: journal sequence, the session
+            # stays on the DELTA path (no resync full frame), and the next
+            # decide digest-matches the local reference
+            mig_tid = by_home["sp0"][0]
+            seq0 = _soobs.journal.JOURNAL.total_recorded
+            mig = srouter.migrate_tenant(mig_tid, "sp1")
+            mig_kinds = [
+                e["kind"]
+                for e in _soobs.journal.JOURNAL.snapshot(since_seq=seq0)
+                if e.get("tenant") == mig_tid]
+            assert mig_kinds == [
+                "migration-start", "migration-row-snapshot",
+                "migration-evict", "migration-adopt",
+                "migration-complete"], mig_kinds
+            assert srouter.home(mig_tid) == "sp1"
+            ssessions[mig_tid].store.upsert_pod(
+                f"{mig_tid}-p2", 2, 5000, 8 * 10 ** 9, 0)
+            o, _p, _m = srouter.decide_stream(
+                ssessions[mig_tid], nowi + 120)
+            _so_assert_parity(mig_tid, o, nowi + 120)
+            assert ssessions[mig_tid].full_frames == 1, (
+                "warm migration forced a resync full frame")
+            scaleout_report["migration"] = {
+                "tenant": mig_tid, "source": "sp0", "dest": "sp1",
+                "gap_ms": mig["gap_ms"], "journal_sequence": mig_kinds,
+                "post_migration_frames": "delta",
+            }
+            out["smoke_scaleout_migration_gap_ms"] = mig["gap_ms"]
+
+            # (3) roll the failover checkpoints off the LIVE homes
+            ckpt = srouter.checkpoint_tenants()
+            assert set(ckpt) == set(stenants) and all(
+                v == "ok" for v in ckpt.values()), ckpt
+            scaleout_report["checkpoint"] = ckpt
+
+            # (4) SIGKILL the partition now holding three tenants; the
+            # next routed decide eats the dead RPC, trips the breaker,
+            # fails every sp1 tenant over to sp0 (warm, from the rolling
+            # checkpoints) and replays — ONE slow decide, no error
+            so_procs["sp1"].kill()
+            so_procs["sp1"].wait(timeout=30)
+            seq1 = _soobs.journal.JOURNAL.total_recorded
+            fail_tid = by_home["sp1"][0]
+            ft0 = time.perf_counter()
+            o, _p, _m = srouter.decide_stream(
+                ssessions[fail_tid], nowi + 180, max_attempts=1)
+            failover_decide_ms = (time.perf_counter() - ft0) * 1e3
+            _so_assert_parity(fail_tid, o, nowi + 180)
+            fo_events = _soobs.journal.JOURNAL.snapshot(since_seq=seq1)
+            fo_kinds = [e["kind"] for e in fo_events]
+            assert "partition-breaker-open" in fo_kinds, fo_kinds
+            assert "partition-failover-start" in fo_kinds, fo_kinds
+            rehomes = [e for e in fo_events
+                       if e["kind"] == "failover-rehome"]
+            assert rehomes and all(
+                e["outcome"] == "warm" and e["partition"] == "sp0"
+                for e in rehomes), rehomes
+            complete = [e for e in fo_events
+                        if e["kind"] == "partition-failover-complete"]
+            assert len(complete) == 1, fo_kinds
+            # every survivor-side tenant keeps answering, digest-equal to
+            # its local reference (continuity through the kill)
+            for tid in stenants:
+                assert srouter.home(tid) == "sp0"
+                o, _p, _m = srouter.decide_stream(
+                    ssessions[tid], nowi + 240)
+                _so_assert_parity(tid, o, nowi + 240)
+            sh = srouter.health()
+            assert sh["down"] == ["sp1"], sh["down"]
+            assert sh["aggregate"]["partitions"] == 1, sh["aggregate"]
+            scaleout_report["failover"] = {
+                "killed": "sp1",
+                "tenants_rehomed": len(rehomes),
+                "rehome_outcomes": sorted(
+                    e["outcome"] for e in rehomes),
+                "wall_ms": complete[0]["wall_ms"],
+                "first_decide_ms": round(failover_decide_ms, 3),
+            }
+            out["smoke_scaleout_failover_wall_ms"] = complete[0]["wall_ms"]
+            out["smoke_scaleout_failover_decide_ms"] = round(
+                failover_decide_ms, 3)
+            out["smoke_scaleout_parity"] = "ok"
+        finally:
+            if srouter is not None:
+                srouter.close()
+            for pname, proc in so_procs.items():
+                proc.kill()
+                try:
+                    proc.wait(timeout=30)
+                except _sosubprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+                if proc.stdout is not None:
+                    proc.stdout.close()
+                so_errs[pname].close()
+            _soshutil.rmtree(so_dir, ignore_errors=True)
+    scaleout_artifact = os.environ.get(
+        "ESCALATOR_TPU_SCALEOUT_SMOKE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "SCALEOUT_SMOKE_LATEST.json"),
+    )
+    out["scaleout_smoke_report"] = write_smoke_artifact(
+        scaleout_artifact, scaleout_report)
+    out["smoke_scaleout_mode"] = fleet_mode
+    _leg("scaleout")
+
     # ---- device resource observatory smoke (round 15): per-owner budgets,
     # forced-leak watchdog fire, compile-ring attribution, and a
     # debug-profile round-trip through the REAL plugin RPC — written to
@@ -4432,6 +4960,16 @@ def main() -> None:
         detail["cfg17_error"] = str(e)
     _flush_partial(detail, device, degraded)
 
+    # 18. scale-out partition sweep (round-20 tentpole): N=1 vs N=2 fleet
+    # partition subprocesses behind the consistent-hash router — aggregate
+    # decisions/sec at the host-bound high-idle arm and the device-bound
+    # full-churn arm, per-class p99 per partition, core-gated scaling bar
+    try:
+        _cfg18_scaleout(now, detail, degraded)
+    except Exception as e:  # pragma: no cover
+        detail["cfg18_error"] = str(e)
+    _flush_partial(detail, device, degraded)
+
     # device memory: stats probe + computed envelope, after the biggest
     # clusters (cfg13's 1M-pod store) are resident so peak covers them
     _memory_envelope(device, detail)
@@ -4580,6 +5118,11 @@ if __name__ == "__main__":
                 " [passes]")
         passes = int(args[2]) if len(args) > 2 else 5
         print(json.dumps(run_recorded(args[0], args[1], passes=passes)))
+    elif "--cfg18" in sys.argv:
+        # targeted scale-out refresh: run ONLY the cfg18 partition sweep
+        # (CPU subprocesses either way) and merge into BENCH_FULL_LATEST
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        print(json.dumps(run_cfg18()))
     elif "--smoke" in sys.argv:
         # tier-1-safe: pin to CPU with 8 virtual devices BEFORE jax loads
         # (bench.py keeps jax imports inside functions for exactly this)

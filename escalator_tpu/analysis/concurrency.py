@@ -91,6 +91,7 @@ class ThreadContract:
 COVERED_MODULES: Tuple[str, ...] = (
     "escalator_tpu/fleet/scheduler.py",
     "escalator_tpu/fleet/service.py",
+    "escalator_tpu/fleet/router.py",
     "escalator_tpu/plugin/server.py",
     "escalator_tpu/plugin/client.py",
     "escalator_tpu/ops/snapshot.py",
@@ -124,6 +125,22 @@ CONTRACTS: List[LockContract] = [
             "_slo_burn_counts", "_slo_fast_streak", "_slo_escalated",
             "_cache_hit_ema",
         ),
+    ),
+    LockContract(
+        name="router.state", rank=12,
+        module="escalator_tpu/fleet/router.py",
+        holder="PartitionRouter._lock", kind="lock",
+        doc="the partition router's one lock: hash ring, override map, "
+            "session registry, traffic counters, per-partition breaker "
+            "state, journal cursors, migration holds. Pure container work "
+            "only — NO gRPC round-trip ever runs under it (rule T2): every "
+            "RPC helper snapshots what it needs, releases, calls, then "
+            "reacquires to commit. Sits between scheduler.cv and the "
+            "engine locks: a routed client may run in the same process as "
+            "a partition (embedded tests), and the router never calls "
+            "into scheduler/engine while holding it.",
+        guarded=("_ring", "_overrides", "_sessions", "_known", "_traffic",
+                 "_cursors", "_migrating", "_partitions"),
     ),
     LockContract(
         name="engine.exec", rank=20,
@@ -302,6 +319,10 @@ THREADS: List[ThreadContract] = [
     ThreadContract("escalator-slo-profile",
                    "escalator_tpu/fleet/scheduler.py",
                    "one-shot SLO-escalation profiler arm"),
+    ThreadContract("escalator-router-rebalance",
+                   "escalator_tpu/fleet/router.py",
+                   "SLO-burn rebalancer loop (daemon, migrates hot tenants "
+                   "off burning partitions)"),
     ThreadContract("escalator-tail-dump",
                    "escalator_tpu/observability/tail.py",
                    "tail-breach dump serializer (daemon, off the tick)"),
@@ -355,8 +376,11 @@ ASSUME_HELD: Dict[Tuple[str, str], Tuple[str, ...]] = {
 
 #: Attribute-chain tails that mark a call as a gRPC round-trip (rule T2:
 #: never inside a lock body — a stuck peer would turn a lock hold into a
-#: cluster-wide stall).
-GRPC_RECEIVERS: Tuple[str, ...] = ("_stub", "stub", "_channel")
+#: cluster-wide stall). ``client`` covers the router path (round 20):
+#: ``part.client.<rpc>`` / ``self.client.<rpc>`` are ComputeClient
+#: round-trips, so any such call under ``router.state`` — or any other
+#: contract lock — is a T2 finding.
+GRPC_RECEIVERS: Tuple[str, ...] = ("_stub", "stub", "_channel", "client")
 
 
 #: Cross-module singleton receivers the T1 call graph resolves: a call

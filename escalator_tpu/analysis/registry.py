@@ -1178,6 +1178,104 @@ def _probe_snapshot_restore_retraces() -> int:
     return snaplib._restore_adopt._cache_size() - before
 
 
+def _tenant_row_fixture(seed: int = 35, row: int = 0):
+    """Fleet arena operands for the tenant-row migration programs: the
+    shard-local ``(aggs, prev_cols)`` block a freeze gathers from, the full
+    ``(pods, nodes, groups, aggs, prev_cols)`` arena tree an adopt donates,
+    and one tenant's arena-shaped row values — built with the SAME service
+    helpers the engine's adopt path uses (``zero_state_sharded``,
+    ``_repad``), so the analyzed programs see production's exact shapes."""
+    from escalator_tpu.fleet import service as fsvc
+    from escalator_tpu.ops import kernel
+
+    C, G, P, N = 2, GROUPS, 24, 12
+    state = fsvc.zero_state_sharded(1, C, G, P, N)
+    cluster = representative_cluster(G, P, N, seed=seed)
+    aggs = kernel.compute_aggregates_jit(cluster)
+    out = kernel.decide_jit(cluster, NOW)
+
+    def pad(a, w):
+        a = np.asarray(a)
+        full = np.zeros(w, a.dtype)
+        full[:a.shape[0]] = a
+        return full
+
+    aggs_full = type(aggs)(**{
+        f.name: pad(getattr(aggs, f.name),
+                    N + 1 if f.name == "node_pods_remaining" else G)
+        for f in dataclasses.fields(aggs)})
+    cols = tuple(np.asarray(getattr(out, f))
+                 for f in kernel.GROUP_DECISION_FIELDS)
+    row_values = (fsvc._repad(cluster.pods, P + 1, fsvc._empty_pods),
+                  fsvc._repad(cluster.nodes, N + 1, fsvc._empty_nodes),
+                  cluster.groups, aggs_full, cols)
+
+    def set_row(arena, v):
+        blk = np.array(arena)
+        blk[0, row] = v
+        return blk
+
+    _, _, _, aggs_ar, cols_ar = state
+    aggs_blk = type(aggs_ar)(**{
+        f.name: set_row(getattr(aggs_ar, f.name), getattr(aggs_full, f.name))
+        for f in dataclasses.fields(aggs_ar)})
+    cols_blk = tuple(set_row(a, v) for a, v in zip(cols_ar, cols,
+                                                   strict=True))
+    return (aggs_blk, cols_blk), state, row_values
+
+
+def _build_tenant_row_freeze() -> TracedEntry:
+    from escalator_tpu.ops import snapshot as snaplib
+
+    shard_block, _state, _row_values = _tenant_row_fixture()
+    return TracedEntry(fn=snaplib._tenant_row_freeze_body,
+                       args=(shard_block, np.int32(0)),
+                       jitted=snaplib._tenant_row_freeze)
+
+
+def _probe_tenant_row_freeze_retraces() -> int:
+    """Two row freezes off the SAME arena buckets at different rows with
+    different tenant contents: the row INDEX is traced data, so migrating
+    any tenant off any slot must reuse one compiled gather."""
+    import jax
+
+    from escalator_tpu.ops import snapshot as snaplib
+
+    before = snaplib._tenant_row_freeze._cache_size()
+    for seed, row in ((37, 0), (38, 1)):
+        shard_block, _state, _row_values = _tenant_row_fixture(
+            seed=seed, row=row)
+        jax.block_until_ready(snaplib.tenant_row_freeze(shard_block, row))
+    return snaplib._tenant_row_freeze._cache_size() - before
+
+
+def _build_tenant_row_adopt() -> TracedEntry:
+    from escalator_tpu.ops import snapshot as snaplib
+
+    _blk, state, row_values = _tenant_row_fixture(seed=36)
+    return TracedEntry(
+        fn=snaplib._tenant_row_adopt_body,
+        args=(state, np.int32(0), np.int32(0), row_values),
+        jitted=snaplib._tenant_row_adopt)
+
+
+def _probe_tenant_row_adopt_retraces() -> int:
+    """Two adopts into the SAME arena buckets at different slots with
+    different row values (two migrations landing on different rows):
+    neither the slot index nor the row contents is a cache key — exactly
+    one compile."""
+    import jax
+
+    from escalator_tpu.ops import snapshot as snaplib
+
+    before = snaplib._tenant_row_adopt._cache_size()
+    for seed, row in ((39, 0), (40, 1)):
+        _blk, state, row_values = _tenant_row_fixture(seed=seed, row=row)
+        jax.block_until_ready(snaplib.tenant_row_adopt(
+            jax.device_put(state), 0, row, row_values))
+    return snaplib._tenant_row_adopt._cache_size() - before
+
+
 def _build_simulate_sweep() -> TracedEntry:
     from escalator_tpu.ops import simulate
 
@@ -1665,6 +1763,35 @@ def default_registry() -> List[KernelEntry]:
                                    # resident state: zero-copy adoption
             retrace_budget=1,      # restored VALUES are never a cache key
             retrace_probe=_probe_snapshot_restore_retraces,
+        ),
+        e(
+            name="snapshot.tenant_row_freeze",
+            module="escalator_tpu.ops.snapshot",
+            kind="jit",
+            build=_build_tenant_row_freeze,
+            output_dtypes=AGGREGATE_DTYPES,
+            output_select=lambda out: out[0],
+            collective_budget=0,
+            # donation deliberately ABSENT (donate_expected=False): the row
+            # gather copies ONE tenant out of the live arenas, which keep
+            # mutating under subsequent micro-batches while the row blob is
+            # serialized — the same liveness contract as snapshot.freeze
+            retrace_budget=1,      # the row INDEX is data, never a cache key
+            retrace_probe=_probe_tenant_row_freeze_retraces,
+        ),
+        e(
+            name="snapshot.tenant_row_adopt",
+            module="escalator_tpu.ops.snapshot",
+            kind="jit",
+            build=_build_tenant_row_adopt,
+            output_dtypes=AGGREGATE_DTYPES,
+            output_select=lambda out: out[3],
+            collective_budget=0,
+            donate_expected=True,  # the arena tree is donated: the adopt
+                                   # lowers to in-place dynamic-update-slices
+                                   # — one H2D row upload, zero arena copies
+            retrace_budget=1,      # slot index + row values: never cache keys
+            retrace_probe=_probe_tenant_row_adopt_retraces,
         ),
         e(
             name="simulate.sweep_deltas",

@@ -41,6 +41,11 @@ Sites in the production tree (grep ``CHAOS.`` to enumerate):
 - ``lease_renew``     — raise from the renew loop's CAS
   (k8s/election.LeaderElector): lease loss mid-tick; deposition after the
   renew deadline.
+- ``router_partition`` — raise the plugin_rpc-style synthetic RpcError on a
+  routed decide (fleet/router.PartitionRouter.decide_stream): a partition
+  "kill" that drives the breaker → checkpoint fail_over → replay ladder
+  without killing a process; ``partition=`` scopes the blast, ``code=``
+  picks the status.
 """
 
 from __future__ import annotations

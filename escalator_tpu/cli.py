@@ -732,6 +732,75 @@ def debug_profile_main(argv: List[str]) -> int:
     return 0 if files else 2
 
 
+def debug_partitions_main(argv: List[str]) -> int:
+    """``escalator-tpu debug-partitions``: the scale-out operator view — a
+    throwaway :class:`PartitionRouter` over the named partitions renders the
+    aggregated ``health()`` doc (per-partition fleet health, breaker state,
+    tenant placement, override pins) as a table or JSON. Read-only: the
+    router here never routes a decide, so breakers stay closed and nothing
+    is migrated. Exit status: 0 when every partition answered, 2 when any
+    is unreachable (its row says so)."""
+    p = argparse.ArgumentParser(
+        prog="escalator-tpu debug-partitions",
+        description="render aggregated health across fleet partitions",
+    )
+    p.add_argument("--partition", action="append", required=True,
+                   metavar="NAME=ADDR", dest="partitions",
+                   help="a partition as name=host:port (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full aggregated health doc as JSON")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    spec = {}
+    for item in args.partitions:
+        name, sep, addr = item.partition("=")
+        if not sep or not name or not addr:
+            print(f"bad --partition {item!r}: expected NAME=ADDR",
+                  file=sys.stderr)
+            return 2
+        spec[name] = addr
+    from escalator_tpu.fleet.router import PartitionRouter
+
+    router = PartitionRouter(spec, timeout_sec=args.timeout)
+    try:
+        doc = router.health()
+    finally:
+        router.close()
+    if args.json:
+        print(json.dumps(doc, indent=1, default=str))
+        return 0 if doc.get("ok") else 2
+    parts = doc.get("partitions", {})
+    rows = []
+    for name in sorted(parts):
+        pdoc = parts[name]
+        if not pdoc.get("ok", True):
+            rows.append((name, spec.get(name, "?"), "UNREACHABLE",
+                         "-", "-", str(pdoc.get("error", ""))[:48]))
+            continue
+        fleet = pdoc.get("fleet") or {}
+        classes = fleet.get("classes") or {}
+        burn = max((float(c.get("slo_burn", 0.0) or 0.0)
+                    for c in classes.values()), default=0.0)
+        rows.append((name, spec.get(name, "?"), "ok",
+                     str(fleet.get("tenants", pdoc.get("tenants", "?"))),
+                     str(fleet.get("queue_depth",
+                                   pdoc.get("queue_depth", "?"))),
+                     f"burn={burn:.2f}"))
+    widths = [max(len(r[i]) for r in rows + [
+        ("PARTITION", "ADDRESS", "STATE", "TENANTS", "QUEUE", "NOTES")])
+        for i in range(6)]
+    header = ("PARTITION", "ADDRESS", "STATE", "TENANTS", "QUEUE", "NOTES")
+    for row in [header] + rows:
+        print("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+    agg = doc.get("aggregate") or {}
+    print(f"\naggregate: {agg.get('partitions', len(parts))} partition(s), "
+          f"{agg.get('tenants', '?')} tenant(s), "
+          f"queue_depth={agg.get('queue_depth', '?')}; "
+          f"down={doc.get('down') or []}; "
+          f"overrides={len(doc.get('overrides') or {})}")
+    return 0 if doc.get("ok") else 2
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="escalator-tpu",
@@ -944,6 +1013,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return debug_compiles_main(argv[1:])
     if argv and argv[0] == "debug-profile":
         return debug_profile_main(argv[1:])
+    if argv and argv[0] == "debug-partitions":
+        return debug_partitions_main(argv[1:])
     args = build_parser().parse_args(argv)
     setup_logging(args.loglevel, args.logfmt)
 

@@ -254,6 +254,31 @@ class NativeJaxBackend(ComputeBackend):
         except Exception as e:
             self._note_corrupt_snapshot(path, e)
             return False
+        # leaf length = capacity + 1 (the scratch lane rides the snapshot)
+        cap_p = int(
+            np.asarray(leaves.get("cluster.pods.valid", ())).shape[0]) - 1
+        cap_n = int(
+            np.asarray(leaves.get("cluster.nodes.valid", ())).shape[0]) - 1
+        if (0 <= cap_p < self.store.pod_capacity
+                or 0 <= cap_n < self.store.node_capacity):
+            # round 20: a checkpoint SMALLER than the configured store is a
+            # slot remap, not a stale restore — the occupied slots keep
+            # their indices and every new lane is a hole, so the
+            # ingestion-ordered replay below reproduces the snapshot's
+            # layout inside the larger store (the tenant-row adopt's
+            # identity-remap contract; docs/ha.md). Shrinking still
+            # cold-starts: pad_cluster_leaves refuses it by construction.
+            target_p = max(cap_p, self.store.pod_capacity)
+            target_n = max(cap_n, self.store.node_capacity)
+            leaves = snaplib.pad_cluster_leaves(
+                leaves, target_p + 1, target_n + 1)
+            meta = dict(meta, pod_capacity=target_p, node_capacity=target_n)
+            pod_keys += [""] * max(0, target_p - len(pod_keys))
+            node_keys += [""] * max(0, target_n - len(node_keys))
+            log.info(
+                "snapshot %s capacities (%dP/%dN) padded up to the "
+                "configured store (%dP/%dN): warm restore via slot remap",
+                path, cap_p, cap_n, target_p, target_n)
         try:
             cache, inc = restore_decider(
                 leaves, meta, impl="xla", refresh_every=self._refresh_every,
@@ -263,6 +288,8 @@ class NativeJaxBackend(ComputeBackend):
             return False
         if (cache.pod_capacity < self.store.pod_capacity
                 or cache.node_capacity < self.store.node_capacity):
+            # unreachable after the pad above unless the snapshot carried
+            # no cluster leaves at all — keep the named stale rejection
             metrics.snapshot_restores.labels("stale").inc()
             log.warning(
                 "snapshot %s capacities (%dP/%dN) are smaller than the "

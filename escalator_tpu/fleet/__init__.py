@@ -40,6 +40,17 @@ from escalator_tpu.fleet.service import (
 __all__ = [
     "AdmissionError", "DEFAULT_CLASSES", "DecideRequest", "DeltaFrame",
     "EvictAck", "EvictRequest", "FleetDecision", "FleetEngine",
-    "FleetScheduler", "PriorityClass", "StaleBatchError", "TenantError",
-    "validate_tenant_id",
+    "FleetScheduler", "PartitionRouter", "PriorityClass", "Rebalancer",
+    "RouterError", "StaleBatchError", "TenantError", "validate_tenant_id",
 ]
+
+
+def __getattr__(name):
+    # the router pulls in the gRPC client stack; lazy so embedders of the
+    # bare engine/scheduler (and the analysis CLI's pin-before-import
+    # dance) never pay for grpc at fleet import time
+    if name in ("PartitionRouter", "Rebalancer", "RouterError"):
+        from escalator_tpu.fleet import router as _router
+
+        return getattr(_router, name)
+    raise AttributeError(name)

@@ -371,6 +371,45 @@ class FleetScheduler:
                 klass = self.default_class
         return self._admit(EvictRequest(tenant_id), klass)
 
+    def snapshot_tenant(self, tenant_id: str, timeout_sec: float = 30.0):
+        """Quiesce ONE tenant, then freeze its arena row (round 20 — the
+        migration source path): wait until the tenant has zero queued and
+        zero in-flight requests, then take
+        :meth:`FleetEngine.snapshot_tenant_row` at a batch boundary.
+        Returns ``(leaves, meta)`` in the tenant-row snapshot format.
+
+        The quiesce covers requests ALREADY admitted — the caller (the
+        partition router) owns keeping new ones out by holding the
+        tenant's stream for the duration of the migration; this method is
+        not a barrier against a second independent client. Other tenants'
+        traffic keeps flowing throughout — nothing here pauses the
+        scheduler."""
+        validate_tenant_id(tenant_id)
+        if not self.engine.has_tenant(tenant_id):
+            raise TenantError(f"unknown tenant {tenant_id!r}")
+        deadline = time.monotonic() + timeout_sec
+        while True:
+            with self._cv:
+                if (tenant_id not in self._inflight
+                        and tenant_id not in self._queued_classes):
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"tenant {tenant_id!r} did not quiesce within "
+                        f"{timeout_sec}s "
+                        f"(inflight={self._inflight.get(tenant_id, 0)})")
+                self._cv.wait(timeout=min(0.05, remaining))
+        return self.engine.snapshot_tenant_row(
+            tenant_id, timeout_sec=max(deadline - time.monotonic(), 1.0))
+
+    def adopt_tenant(self, leaves, meta) -> tuple:
+        """Adopt a tenant-row snapshot on THIS partition (round 20 — the
+        migration target path): delegates to
+        :meth:`FleetEngine.adopt_tenant_row`, which serializes itself
+        against staged batches. Returns ``(shard, row)``."""
+        return self.engine.adopt_tenant_row(leaves, meta)
+
     def _admit(self, request, klass: str) -> Future:
         fut: Future = Future()
         cls = self.classes[klass]

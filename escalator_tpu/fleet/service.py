@@ -1505,6 +1505,236 @@ class FleetEngine:
             log.debug("explain_tenant(%r) failed", key, exc_info=True)
             return None
 
+    # -- tenant-row snapshot / adopt (round 20: warm migration) ---------------
+
+    def snapshot_tenant_row(self, tenant_id: str, timeout_sec: float = 30.0):
+        """Freeze ONE tenant's persistent state into snapshot leaves:
+        ``(leaves, meta)`` in the ``ops.snapshot`` tenant-row format (host
+        cluster twins at the tenant's request shapes, the aggregates row,
+        the 13 decision columns, the dirty mask, and the digest-fast-path
+        cache when it is live). The freeze point is a batch boundary — the
+        same drain-then-lock loop as :meth:`compact`, so the host twins and
+        the device row are from the SAME committed tick — and the device
+        gather is the explain path's ``fleet_shard_local`` + row-gather
+        idiom (``snapshot.tenant_row_freeze``): O(row), shard-local, no
+        donation, arenas stay live. Migration = this, then
+        ``evict_tenant`` on the source, then :meth:`adopt_tenant_row` on
+        the target; the first post-migration request folds everything that
+        changed in between into one delta batch, exactly like the PR-6
+        killed-leader warm start."""
+        deadline = time.monotonic() + timeout_sec
+        while True:
+            with self._host:
+                self._await_staged_drain()
+            with self._exec_lock, self._host:
+                st = self._staged
+                if (st is None or st.executed or st.released
+                        or st.epoch != self._epoch):
+                    return self._snapshot_row_locked(tenant_id)
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "tenant-row snapshot timed out: a staged batch kept "
+                    "re-appearing — quiesce the tenant (the scheduler's "
+                    "snapshot path does) and retry")
+
+    def _snapshot_row_locked(self, tenant_id: str):
+        """Caller holds ``_exec_lock`` + ``_host`` with no live staged
+        batch."""
+        from jax import tree_util
+
+        from escalator_tpu.ops import device_state as ds
+        from escalator_tpu.ops import snapshot as snaplib
+
+        t = self._tenants.get(tenant_id)
+        if t is None:
+            raise TenantError(f"unknown tenant {tenant_id!r}")
+        G_c, P_c, N_c = t.shapes
+        with obs.span("fleet_row_freeze", kind="device"), self._device_lock:
+            _pods, _nodes, _groups, aggs, prev_cols = self._state
+            a_blk, c_blk = ds.fleet_shard_local((aggs, prev_cols), t.shard)
+            frozen = snaplib.tenant_row_freeze((a_blk, c_blk), t.row)
+            aggs_row, col_rows = tree_util.tree_map(np.asarray, frozen)
+        trim = lambda soa, k: type(soa)(  # noqa: E731
+            **{f.name: np.array(getattr(soa, f.name)[:k])
+               for f in fields(type(soa))})
+        cluster = ClusterArrays(
+            groups=trim(t.groups, G_c), pods=trim(t.pods, P_c),
+            nodes=trim(t.nodes, N_c))
+        aggs_trim = type(aggs_row)(**{
+            f.name: np.array(getattr(aggs_row, f.name)[
+                :N_c if f.name == "node_pods_remaining" else G_c])
+            for f in fields(type(aggs_row))})
+        cols_trim = tuple(np.array(c[:G_c]) for c in col_rows)
+        cache_live = (t.cache_arrays is not None
+                      and t.cache_epoch == self._epoch)
+        leaves = snaplib.tenant_row_to_leaves(
+            cluster, aggs_trim, cols_trim, np.array(t.dirty[:G_c]),
+            cache_arrays=t.cache_arrays if cache_live else None)
+        meta = {
+            "kind": snaplib.TENANT_ROW_KIND,
+            "tenant": tenant_id,
+            "shapes": [G_c, P_c, N_c],
+            "ticks": int(t.ticks),
+            "cache": {
+                "live": bool(cache_live),
+                "digest": (t.cache_digest.hex()
+                           if cache_live and t.cache_digest else None),
+                "now": int(t.cache_now) if cache_live else 0,
+                "ordered": bool(t.cache_ordered) if cache_live else False,
+            },
+        }
+        obs.journal.JOURNAL.event(
+            "fleet-tenant-row-snapshot", tenant=tenant_id, shard=t.shard,
+            row=t.row, ticks=int(t.ticks))
+        return leaves, meta
+
+    def adopt_tenant_row(self, leaves, meta,
+                         timeout_sec: float = 30.0) -> Tuple[int, int]:
+        """Adopt a tenant-row snapshot as a RESIDENT tenant: register a
+        fresh slot, seed the host twins/dirty mask/digest cache from the
+        leaves, and scatter the row into the arenas with the donated
+        ``snapshot.tenant_row_adopt`` program (in-place dynamic-update-
+        slice — one H2D upload, zero arena copies). Returns ``(shard,
+        row)``. Rejections keep the existing restore-outcome taxonomy:
+        structurally invalid rows raise :class:`SnapshotCorruptError`
+        (``snapshot_restores_total{outcome="corrupt"}``), rows the arena
+        cannot hold (bucket caps) or a resident same-id tenant raise
+        :class:`TenantError` (``outcome="stale"``) — the caller falls back
+        to the cold path (a full first frame), never to a wrong adopt."""
+        from escalator_tpu.ops import snapshot as snaplib
+
+        try:
+            if meta.get("kind") != snaplib.TENANT_ROW_KIND:
+                raise snaplib.SnapshotCorruptError(
+                    f"not a tenant-row snapshot (kind="
+                    f"{meta.get('kind')!r})")
+            try:
+                tenant_id = validate_tenant_id(meta.get("tenant"))
+            except TenantError as e:
+                raise snaplib.SnapshotCorruptError(
+                    f"tenant-row meta carries an invalid tenant id: {e}"
+                ) from None
+            cluster, aggs_row, col_rows, dirty, cache = \
+                snaplib.leaves_to_tenant_row(leaves)
+            shapes = tuple(int(v) for v in meta.get("shapes", ()))
+            got = (int(cluster.groups.valid.shape[0]),
+                   int(cluster.pods.valid.shape[0]),
+                   int(cluster.nodes.valid.shape[0]))
+            if len(shapes) != 3 or shapes != got:
+                raise snaplib.SnapshotCorruptError(
+                    f"tenant-row meta shapes {shapes} disagree with leaf "
+                    f"shapes {got}")
+            if (dirty.shape[0] != shapes[0]
+                    or aggs_row.cpu_req.shape[0] != shapes[0]
+                    or aggs_row.node_pods_remaining.shape[0] != shapes[2]):
+                raise snaplib.SnapshotCorruptError(
+                    "tenant-row aggregate/dirty rows disagree with the "
+                    "declared shapes")
+        except snaplib.SnapshotCorruptError:
+            metrics.snapshot_restores.labels("corrupt").inc()
+            raise
+        deadline = time.monotonic() + timeout_sec
+        while True:
+            with self._host:
+                self._await_staged_drain()
+            with self._exec_lock, self._host:
+                st = self._staged
+                if (st is None or st.executed or st.released
+                        or st.epoch != self._epoch):
+                    try:
+                        return self._adopt_row_locked(
+                            tenant_id, cluster, aggs_row, col_rows, dirty,
+                            cache, meta)
+                    except TenantError:
+                        metrics.snapshot_restores.labels("stale").inc()
+                        raise
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "tenant-row adopt timed out: a staged batch kept "
+                    "re-appearing — pause the scheduler and retry")
+
+    def _adopt_row_locked(self, tenant_id, cluster, aggs_row, col_rows,
+                          dirty, cache, meta) -> Tuple[int, int]:
+        """Caller holds ``_exec_lock`` + ``_host`` with no live staged
+        batch."""
+        from escalator_tpu.ops import kernel as _kernel
+        from escalator_tpu.ops import snapshot as snaplib
+
+        if tenant_id in self._tenants:
+            raise TenantError(
+                f"tenant {tenant_id!r} is already resident — evict before "
+                f"adopting a migrated row")
+        G_c, P_c, N_c = (int(cluster.groups.valid.shape[0]),
+                         int(cluster.pods.valid.shape[0]),
+                         int(cluster.nodes.valid.shape[0]))
+        self._ensure_buckets(cluster)   # may grow; raises TenantError at caps
+        t = self._register(tenant_id)
+        t.pods = _repad_copy(cluster.pods, self._P, _empty_pods)
+        t.nodes = _repad_copy(cluster.nodes, self._N, _empty_nodes)
+        t.groups = _repad_copy(cluster.groups, self._G, _empty_groups)
+        t.shapes = (G_c, P_c, N_c)
+        t.ticks = int(meta.get("ticks", 0))
+        # pad lanes past the snapshot's group count: a dispatched row holds
+        # the kernel's invalid-lane fixpoint there (status=NOOP_EMPTY,
+        # every other column 0, dirty clear) — reproduce it, or the
+        # full-width ``dirty.any()`` proxy in the digest fast path would
+        # miss forever on a migrated tenant whose arena is wider than its
+        # request. A never-dispatched row (ticks=0) keeps register()'s
+        # all-dirty bootstrap instead: its arena really is all zeros.
+        dispatched = t.ticks > 0
+        if dispatched:
+            t.dirty[:] = False
+        t.dirty[:G_c] = dirty
+        # row values at ARENA shapes: twins lead, the scratch lane / pad
+        # tail carries the positions' pad values (the same invariant
+        # _assemble maintains), aggregates and columns zero-fill past the
+        # snapshot's request shapes
+        pods_row = _repad(t.pods, self._P + 1, _empty_pods)
+        nodes_row = _repad(t.nodes, self._N + 1, _empty_nodes)
+        groups_row = t.groups
+        aggs_full = _kernel.GroupAggregates(**{
+            f.name: self._zero_row_like(getattr(aggs_row, f.name),
+                                        self._N + 1
+                                        if f.name == "node_pods_remaining"
+                                        else self._G)
+            for f in fields(_kernel.GroupAggregates)})
+        cols_full = []
+        for name, col in zip(_kernel.GROUP_DECISION_FIELDS, col_rows,
+                             strict=True):
+            full = np.zeros(self._G, _COL_DTYPES[name])
+            if dispatched and name == "status":
+                from escalator_tpu.core.semantics import DecisionStatus
+
+                full[G_c:] = int(DecisionStatus.NOOP_EMPTY)
+            full[:G_c] = col
+            cols_full.append(full)
+        row_values = (pods_row, nodes_row, groups_row, aggs_full,
+                      tuple(cols_full))
+        with obs.span("fleet_row_adopt", kind="device"), self._device_lock:
+            self._state = snaplib.tenant_row_adopt(
+                self._state, t.shard, t.row, row_values)
+        if cache is not None and meta.get("cache", {}).get("live"):
+            cmeta = meta["cache"]
+            t.cache_arrays = cache
+            t.cache_digest = (bytes.fromhex(cmeta["digest"])
+                              if cmeta.get("digest") else None)
+            t.cache_now = int(cmeta.get("now", 0))
+            t.cache_ordered = bool(cmeta.get("ordered", False))
+            t.cache_epoch = self._epoch
+        metrics.snapshot_restores.labels("warm").inc()
+        obs.journal.JOURNAL.event(
+            "fleet-tenant-row-adopt", tenant=tenant_id, shard=t.shard,
+            row=t.row, ticks=int(t.ticks))
+        log.info("adopted tenant-row snapshot for %r at shard=%d row=%d",
+                 tenant_id, t.shard, t.row)
+        return t.shard, t.row
+
+    @staticmethod
+    def _zero_row_like(src: np.ndarray, width: int) -> np.ndarray:
+        full = np.zeros(width, src.dtype)
+        full[:src.shape[0]] = src
+        return full
+
     # -- the sequential convenience + release --------------------------------
 
     def step(self, requests: Sequence[Union[DecideRequest, EvictRequest]]

@@ -342,6 +342,30 @@ provenance_explain_mismatches = Counter(
     "each burst also journals explain-mismatch and (rate-limited) dumps",
     namespace="escalator_tpu", registry=registry,
 )
+# --- partition router (round 20: horizontal scale-out) ----------------------
+router_migrations = Counter(
+    "router_migrations_total",
+    "warm tenant migrations driven by the partition router, by outcome "
+    "(ok = snapshot->evict->adopt completed and the override pinned; "
+    "error = the sequence aborted — the tenant stays where the last "
+    "completed step left it, journal has the detail)",
+    ["outcome"], namespace="escalator_tpu", registry=registry,
+)
+router_breaker_trips = Counter(
+    "router_breaker_trips_total",
+    "per-partition circuit-breaker openings in the router (consecutive "
+    "forwarding failures reached the threshold): the partition leaves the "
+    "ring and its tenants fail over to the survivors",
+    ["partition"], namespace="escalator_tpu", registry=registry,
+)
+router_failover_rehomes = Counter(
+    "router_failover_rehomes_total",
+    "tenants re-homed by a partition failover, by outcome (warm = rolling "
+    "checkpoint adopted on the survivor, digest continuity holds from the "
+    "checkpointed columns; cold = no usable checkpoint — full-frame "
+    "resync, first decision recomputes from the client twin)",
+    ["outcome"], namespace="escalator_tpu", registry=registry,
+)
 fleet_class_p99_breach = Counter(
     "fleet_class_p99_breach_total",
     "per-priority-class SLO breach checks that found the class's RECENT "

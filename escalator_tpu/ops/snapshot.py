@@ -136,6 +136,53 @@ def restore_adopt(state_tree, device=None):
     return _restore_adopt(staged)
 
 
+def _tenant_row_freeze_body(shard_block, row):
+    """Gather ONE tenant's row out of a fleet shard block (a pytree of
+    ``[1, Cs+1, …]`` device arrays — the ``(aggs, prev_cols)`` slice from
+    ``device_state.fleet_shard_local``). ``row`` is a traced int32 — the
+    row INDEX is data, never a jit cache key, so migrating any tenant off
+    any slot reuses one compiled program (jaxlint entry
+    ``snapshot.tenant_row_freeze`` pins the retrace count). The gather
+    outputs are fresh buffers by construction (no donation is declared and
+    a dynamic-index gather cannot alias its operand), so the arena stays
+    live and keeps mutating under subsequent micro-batches while the row
+    copy is serialized — the same liveness contract as :func:`_freeze_state`."""
+    return tree_util.tree_map(lambda a: a[0, row], shard_block)
+
+
+_tenant_row_freeze = jax.jit(_tenant_row_freeze_body)
+
+
+def _tenant_row_adopt_body(state_tree, shard, row, row_values):
+    """Scatter one tenant's row values into the resident fleet arenas at
+    ``[shard, row]``. The arena tree is DONATED: XLA aliases every output
+    to its input and lowers the whole adopt to in-place dynamic-update-
+    slices (jaxlint entry ``snapshot.tenant_row_adopt`` verifies the
+    aliasing survives lowering), so adopting a migrated tenant costs one
+    H2D upload of the row values — never an arena copy. ``shard``/``row``
+    are traced int32s for the same no-retrace reason as the freeze side."""
+    return tree_util.tree_map(
+        lambda a, v: a.at[shard, row].set(v), state_tree, row_values)
+
+
+_tenant_row_adopt = jax.jit(_tenant_row_adopt_body, donate_argnums=(0,))
+
+
+def tenant_row_freeze(shard_block, row: int):
+    """Public row-freeze entry: dispatches :func:`_tenant_row_freeze`
+    (async; the caller's D2H read fences)."""
+    return _tenant_row_freeze(shard_block, np.int32(row))
+
+
+def tenant_row_adopt(state_tree, shard: int, row: int, row_values):
+    """Public row-adopt entry: stages ``row_values`` on device and scatters
+    them into the donated arena tree at ``[shard, row]``; returns the new
+    resident tree (the input references are dead — donation)."""
+    return _tenant_row_adopt(
+        state_tree, np.int32(shard), np.int32(row),
+        jax.device_put(row_values))
+
+
 # ---------------------------------------------------------------------------
 # Serialization: one self-describing binary file
 # ---------------------------------------------------------------------------
@@ -158,6 +205,20 @@ def write_snapshot(path: str, leaves: Mapping[str, np.ndarray],
     Integer/bool round-trips are exact by construction; there are no float
     leaves anywhere in the persisted state except the two [G] percent
     columns, whose float64 bytes round-trip bit-exactly too."""
+    header_raw, payload_parts = _serialize_parts(leaves, meta)
+
+    def emit(f):
+        f.write(SNAPSHOT_MAGIC)
+        f.write(len(header_raw).to_bytes(8, "big"))
+        f.write(header_raw)
+        for raw in payload_parts:
+            f.write(raw)
+
+    return atomic_write(path, emit)
+
+
+def _serialize_parts(leaves: Mapping[str, np.ndarray],
+                     meta: Optional[Dict[str, Any]]):
     meta = dict(meta or {})
     header: Dict[str, Any] = {
         "version": SNAPSHOT_VERSION,
@@ -181,16 +242,28 @@ def write_snapshot(path: str, leaves: Mapping[str, np.ndarray],
         payload_parts.append(raw)
         offset += len(raw)
     header["payload_bytes"] = offset
-    header_raw = json.dumps(header).encode()
+    return json.dumps(header).encode(), payload_parts
 
-    def emit(f):
-        f.write(SNAPSHOT_MAGIC)
-        f.write(len(header_raw).to_bytes(8, "big"))
-        f.write(header_raw)
-        for raw in payload_parts:
-            f.write(raw)
 
-    return atomic_write(path, emit)
+def snapshot_to_bytes(leaves: Mapping[str, np.ndarray],
+                      meta: Optional[Dict[str, Any]] = None) -> bytes:
+    """The file format as an in-memory blob — the wire form a tenant-row
+    migration ships over the plugin RPC. Byte-identical to what
+    :func:`write_snapshot` puts on disk (same magic, header, crcs), so one
+    validator (:func:`snapshot_from_bytes` / :func:`read_snapshot`) covers
+    both transports."""
+    header_raw, payload_parts = _serialize_parts(leaves, meta)
+    return b"".join([SNAPSHOT_MAGIC, len(header_raw).to_bytes(8, "big"),
+                     header_raw, *payload_parts])
+
+
+def snapshot_from_bytes(
+        blob: bytes, label: str = "<bytes>",
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Validate + decode an in-memory snapshot blob; raises
+    :class:`SnapshotCorruptError` on any integrity violation, exactly like
+    :func:`read_snapshot` (they share the parser)."""
+    return _parse_snapshot(blob, label)
 
 
 def read_snapshot(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
@@ -202,6 +275,12 @@ def read_snapshot(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     the normal first boot)."""
     with open(path, "rb") as f:
         blob = f.read()
+    return _parse_snapshot(blob, path)
+
+
+def _parse_snapshot(
+        blob: bytes, path: str,
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     if not blob.startswith(SNAPSHOT_MAGIC):
         raise SnapshotCorruptError(f"{path}: bad magic")
     off = len(SNAPSHOT_MAGIC)
@@ -315,6 +394,116 @@ def leaves_to_state(leaves: Mapping[str, np.ndarray]):
         order_state = tuple(
             need(f"order.{name}") for name in ORDER_STATE_FIELDS)
     return cluster, aggs, prev_cols, order_state
+
+
+# ---------------------------------------------------------------------------
+# Tenant-row format: one fleet tenant's arena row as a snapshot
+# ---------------------------------------------------------------------------
+
+#: ``meta["kind"]`` stamped on tenant-row snapshots. Adopters REQUIRE it: a
+#: whole-decider snapshot fed to the row-adopt path (or vice versa) must be
+#: a named rejection, not a shape error three layers down.
+TENANT_ROW_KIND = "fleet-tenant-row"
+
+
+def tenant_row_to_leaves(cluster, aggs_row, col_rows, dirty,
+                         cache_arrays=None) -> Dict[str, np.ndarray]:
+    """Flatten ONE fleet tenant's persistent state into named leaves:
+    the host cluster twins (``cluster.<section>.<field>`` at the tenant's
+    bucket shapes), the tenant's aggregates row (``aggs.<field>``, [G]),
+    the 13 persistent decision columns (``col.<name>``, [G]), the pending
+    dirty-group mask (``dirty``), and — when the tenant's digest fast path
+    holds a cached answer — the cached decision arrays (``cache.<field>``).
+    Scalar cache fields (digest/now/ordered/epoch validity) ride in the
+    snapshot META, not as leaves: they are identity, not column data."""
+    leaves = state_to_leaves(cluster, aggs_row, col_rows, None)
+    leaves["dirty"] = np.asarray(dirty, bool)
+    if cache_arrays is not None:
+        for f in fields(type(cache_arrays)):
+            leaves[f"cache.{f.name}"] = np.asarray(
+                getattr(cache_arrays, f.name))
+    return leaves
+
+
+def leaves_to_tenant_row(leaves: Mapping[str, np.ndarray]):
+    """Inverse of :func:`tenant_row_to_leaves`: ``(cluster, aggs_row,
+    col_rows, dirty, cache_arrays_or_None)``. Missing required leaves raise
+    :class:`SnapshotCorruptError` by name (same contract as
+    :func:`leaves_to_state`)."""
+    from escalator_tpu.ops import kernel as _kernel
+
+    cluster, aggs_row, col_rows, _ = leaves_to_state(leaves)
+    try:
+        dirty = np.asarray(leaves["dirty"], bool)
+    except KeyError:
+        raise SnapshotCorruptError(
+            "tenant-row snapshot is missing required leaf 'dirty'") from None
+    cache_arrays = None
+    if any(k.startswith("cache.") for k in leaves):
+        try:
+            cache_arrays = _kernel.DecisionArrays(**{
+                f.name: np.asarray(leaves[f"cache.{f.name}"])
+                for f in fields(_kernel.DecisionArrays)})
+        except KeyError as e:
+            raise SnapshotCorruptError(
+                f"tenant-row snapshot has a partial decision cache "
+                f"(missing {e.args[0]!r})") from None
+    return cluster, aggs_row, col_rows, dirty, cache_arrays
+
+
+def pad_cluster_leaves(leaves: Mapping[str, np.ndarray], pod_capacity: int,
+                       node_capacity: int) -> Dict[str, np.ndarray]:
+    """Slot-remap adopt for a capacity-grown restore target: extend the
+    per-pod / per-node cluster leaves (and the lane-indexed order state) to
+    the configured capacities. Slots keep their indices — the remap is the
+    identity on every occupied slot, and every NEW slot is a hole (pad
+    values, ``valid=False``), so an ingestion-ordered slot replay
+    (``NativeStateStore`` warm restore) reproduces the snapshot's layout
+    inside the larger store instead of falling back to a cold start.
+    Order-state key columns pad with zeros and ``perm`` extends with the
+    new lane indices: the padded lanes' stored keys may disagree with
+    their recomputed keys, which the first ordered update detects and
+    repairs (or full-sorts past) — self-healing, never silently wrong.
+    Shrinking is NOT a remap this function performs: a smaller target
+    cannot hold the occupied slots, and callers treat that as stale."""
+    from escalator_tpu.ops.device_state import _NODE_PAD, _POD_PAD
+
+    out = dict(leaves)
+
+    def _grow(key: str, cap: int, pad_overrides: Mapping[str, int]) -> None:
+        arr = np.asarray(out[key])
+        old = arr.shape[0]
+        if old == cap:
+            return
+        if old > cap:
+            raise ValueError(
+                f"{key}: snapshot capacity {old} exceeds target {cap} "
+                f"(shrinking is a stale restore, not a remap)")
+        field_name = key.rsplit(".", 1)[-1]
+        pad = pad_overrides.get(field_name, 0)
+        grown = np.full((cap,) + arr.shape[1:], pad, arr.dtype)
+        grown[:old] = arr
+        out[key] = grown
+
+    for key in list(out):
+        if key.startswith("cluster.pods."):
+            _grow(key, pod_capacity, _POD_PAD)
+        elif key.startswith("cluster.nodes."):
+            _grow(key, node_capacity, _NODE_PAD)
+    if "aggs.node_pods_remaining" in out:
+        # the one node-axis aggregate column: holes carry no pods, zero pad
+        _grow("aggs.node_pods_remaining", node_capacity, {})
+    if "order.perm" in out:
+        perm = np.asarray(out["order.perm"])
+        old = perm.shape[0]
+        if old < node_capacity:
+            out["order.perm"] = np.concatenate(
+                [perm, np.arange(old, node_capacity, dtype=perm.dtype)])
+            for name in ("major", "k1", "k2"):
+                col = np.asarray(out[f"order.{name}"])
+                out[f"order.{name}"] = np.concatenate(
+                    [col, np.zeros(node_capacity - old, col.dtype)])
+    return out
 
 
 # ---------------------------------------------------------------------------

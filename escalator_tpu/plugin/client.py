@@ -178,6 +178,16 @@ class ComputeClient:
             request_serializer=lambda x: x,
             response_deserializer=lambda x: x,
         )
+        self._tenant_snapshot = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/TenantSnapshot",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x,
+        )
+        self._tenant_adopt = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/TenantAdopt",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x,
+        )
 
     def health(self) -> dict:
         return msgpack.unpackb(self._health(b"", timeout=self.timeout_sec))
@@ -326,6 +336,37 @@ class ComputeClient:
         resp = self._decide_with_retry(frame, max_attempts=max_attempts)
         return codec.decode_decision_full(resp)
 
+    def snapshot_tenant(self, tenant_id: str,
+                        timeout_sec: Optional[float] = None) -> bytes:
+        """Freeze ``tenant_id``'s arena row on a fleet-mode server into
+        portable snapshot bytes (round 20 warm migration: the blob feeds
+        :meth:`adopt_tenant` on the target partition, or a checkpoint
+        file — same container format). The server quiesces the tenant and
+        freezes at a batch boundary; ``timeout_sec`` bounds that quiesce
+        (the RPC deadline adds the client's own timeout on top). Raises
+        grpc.RpcError: NOT_FOUND for an unknown tenant,
+        FAILED_PRECONDITION from a non-fleet server, UNIMPLEMENTED from a
+        pre-round-20 one."""
+        t = float(timeout_sec if timeout_sec is not None
+                  else self.timeout_sec)
+        req = codec.encode_migration("snapshot", tenant_id, timeout_sec=t)
+        resp = self._tenant_snapshot(req, timeout=t + self.timeout_sec)
+        _doc, blob = codec.decode_migration(resp)
+        return bytes(blob)
+
+    def adopt_tenant(self, blob: bytes) -> dict:
+        """Adopt a tenant-row snapshot blob (from :meth:`snapshot_tenant`
+        or a checkpoint file) as a resident tenant on this server. Returns
+        the ack doc ``{op: "ack", tenant, shard, row}``. Raises
+        grpc.RpcError: INVALID_ARGUMENT for a corrupt blob,
+        FAILED_PRECONDITION when the arena cannot hold it (bucket caps,
+        already-resident id) — fall back to a cold full frame, never to a
+        wrong adopt."""
+        req = codec.encode_migration("adopt", blob=blob)
+        resp = self._tenant_adopt(req, timeout=self.timeout_sec)
+        doc, _blob = codec.decode_migration(resp)
+        return doc
+
     def evict_tenant(self, tenant_id: str) -> dict:
         """Deregister ``tenant_id`` on a fleet-mode server. Returns the
         ack sidecar; raises grpc.RpcError (INVALID_ARGUMENT) when the
@@ -462,6 +503,23 @@ class FleetStreamSession:
         self._synced_generation = None
         self._groups_dirty = True
         return ack
+
+    def rebind(self, client: ComputeClient, resync: bool = False) -> None:
+        """Point the session at a DIFFERENT partition's client (round 20).
+
+        After a warm migration the target's twin is the source's frozen
+        row — the snapshot of the tenant's last committed tick — so the
+        delta path simply continues: the first post-rebind decide folds
+        everything dirtied since into one delta batch, exactly the PR-6
+        killed-leader warm start. ``resync=True`` is for FAILOVER, where
+        the new home adopted from a rolling checkpoint that may predate
+        the last served tick: it forces a full frame, rebasing the server
+        twin from the client's live store instead of trusting a possibly
+        stale one."""
+        self.client = client
+        if resync:
+            self._synced_generation = None
+            self._groups_dirty = True
 
 
 class GrpcBackend(ComputeBackend):

@@ -59,6 +59,17 @@ _FLEET_KEY = "__fleet__"
 #: (test-locked), so non-streaming tenants are unaffected.
 _DELTA_KEY = "__delta__"
 
+#: Tenant-migration sidecar (round 20). Migration RPCs (``TenantSnapshot``
+#: / ``TenantAdopt``) speak the SAME columnar frame as every other plugin
+#: message: ``__migrate__`` is a msgpack dict (``{"op": str, "tenant":
+#: str, …}`` — extra keys like shard/row placements ride along) and the
+#: tenant-row snapshot blob (the ``ops.snapshot`` byte format, crc-checked
+#: by its own reader) rides as the ``snap`` uint8 pseudo-array. Mixed
+#: versions stay loud: a pre-round-20 server has no migration handlers at
+#: all (UNIMPLEMENTED from the gRPC layer), and a torn sidecar raises the
+#: named error below — never a silent misroute into the decide path.
+_MIGRATE_KEY = "__migrate__"
+
 #: Fields added to the wire format after v1 frames shipped, with the default a
 #: decoder must assume when a peer's frame predates them. Keyed by frame array
 #: name; the value is (dtype, fill) — the array is materialised against the
@@ -337,6 +348,41 @@ def decode_decision_traced(data: bytes):
     peer sent none / predates tracing)."""
     out, phases, _fleet = decode_decision_full(data)
     return out, phases
+
+
+def encode_migration(op: str, tenant: Optional[str] = None,
+                     blob: bytes = b"", **extra: Any) -> bytes:
+    """Encode one migration message (request or response — both are the
+    same frame shape; see ``_MIGRATE_KEY``). ``blob`` is an opaque
+    tenant-row snapshot in the ``ops.snapshot`` byte format; validation
+    belongs to that format's reader, not the codec."""
+    doc: Dict[str, Any] = {"op": str(op), **extra}
+    if tenant is not None:
+        doc["tenant"] = str(tenant)
+    named: List[Tuple[str, np.ndarray]] = [
+        (_MIGRATE_KEY, _msgpack_array(doc)),
+        ("snap", np.frombuffer(blob, np.uint8)),
+    ]
+    return _encode_arrays(named)
+
+
+def decode_migration(data: bytes) -> Tuple[Dict[str, Any], bytes]:
+    """Decode a migration frame to ``(doc, blob)`` where ``doc`` is the
+    ``__migrate__`` msgpack dict. A missing or torn sidecar is a hard
+    named error: a frame on the migration RPCs that does not declare its
+    op must never be guessed at."""
+    arrays = _decode_arrays(data)
+    raw = arrays.get(_MIGRATE_KEY)
+    if raw is None:
+        raise ValueError(
+            "frame carries no __migrate__ sidecar (not a migration message)")
+    try:
+        doc = msgpack.unpackb(raw.tobytes())
+        assert isinstance(doc, dict) and "op" in doc
+    except Exception as e:  # noqa: BLE001 - torn migration header is fatal
+        raise ValueError("frame carries a torn __migrate__ sidecar") from e
+    snap = arrays.get("snap")
+    return doc, (b"" if snap is None else snap.tobytes())
 
 
 def decode_decision_full(data: bytes):

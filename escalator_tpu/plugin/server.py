@@ -48,6 +48,14 @@ Methods:
   bit-cross-check against the committed columns), the tenant's recent
   decision history ring, and its flap record. The ``escalator-tpu
   debug-explain`` CLI's wire target.
+- ``TenantSnapshot`` / ``TenantAdopt`` (fleet mode only): warm tenant
+  migration (round 20). Both speak ``__migrate__`` frames (codec.py):
+  TenantSnapshot ``{op: "snapshot", tenant}`` freezes the tenant's arena
+  row at a batch boundary into portable snapshot bytes; TenantAdopt
+  ``{op: "adopt"}`` + blob scatters it into this partition's arenas as a
+  resident tenant. The partition router (fleet/router.py) drives the
+  migration sequence — snapshot on the source, evict, adopt on the
+  target — through these.
 """
 
 from __future__ import annotations
@@ -463,6 +471,81 @@ class _ComputeService:
                       if r.get("key") == key][-16:],
         })
 
+    def tenant_snapshot(self, request: bytes, context) -> bytes:
+        """Freeze one tenant's arena row for migration (round 20).
+        Request: a ``__migrate__`` frame ``{op: "snapshot", tenant}``.
+        Response: ``{op: "row", tenant}`` carrying the tenant-row snapshot
+        blob (the ``ops.snapshot`` container bytes — same format a
+        checkpoint file holds, so the router can also park it on disk).
+        The scheduler quiesces the tenant first (zero queued + inflight)
+        and the engine freezes at a batch boundary, so the row is one
+        committed tick; the caller owns keeping NEW requests for this
+        tenant out while the migration is in flight (the router holds the
+        tenant's stream)."""
+        from escalator_tpu.fleet import TenantError
+        from escalator_tpu.ops import snapshot as snaplib
+
+        if self._fleet is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "TenantSnapshot requires a fleet-mode server")
+        try:
+            doc, _blob = codec.decode_migration(request)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        if doc.get("op") != "snapshot" or not doc.get("tenant"):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "TenantSnapshot request must be {op: 'snapshot', "
+                "tenant: <id>}")
+        tenant = str(doc["tenant"])
+        timeout = float(doc.get("timeout_sec", 30.0) or 30.0)
+        try:
+            leaves, meta = self._fleet.snapshot_tenant(
+                tenant, timeout_sec=timeout)
+        except TenantError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except (TimeoutError, RuntimeError) as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        return codec.encode_migration(
+            "row", tenant, snaplib.snapshot_to_bytes(leaves, meta))
+
+    def tenant_adopt(self, request: bytes, context) -> bytes:
+        """Adopt a migrated tenant row (round 20). Request: a
+        ``__migrate__`` frame ``{op: "adopt", tenant}`` whose blob is the
+        TenantSnapshot response's snapshot bytes. Response: ``{op: "ack",
+        tenant, shard, row}``. Rejections keep the restore taxonomy:
+        corrupt rows are INVALID_ARGUMENT, rows this arena cannot hold
+        (bucket caps, already-resident id) are FAILED_PRECONDITION — the
+        router falls back to the cold path (full first frame), never to a
+        wrong adopt."""
+        from escalator_tpu.fleet import TenantError
+        from escalator_tpu.ops import snapshot as snaplib
+
+        if self._fleet is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "TenantAdopt requires a fleet-mode server")
+        try:
+            doc, blob = codec.decode_migration(request)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        if doc.get("op") != "adopt" or not blob:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "TenantAdopt request must be {op: 'adopt'} with a "
+                "snapshot blob")
+        try:
+            leaves, meta = snaplib.snapshot_from_bytes(
+                blob, label="<tenant-adopt>")
+            shard, row = self._fleet.adopt_tenant(leaves, meta)
+        except snaplib.SnapshotCorruptError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except TenantError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return codec.encode_migration(
+            "ack", meta.get("tenant"), shard=int(shard), row=int(row))
+
     #: total profile artifact bytes one Profile RPC will ship back — a
     #: pathological capture must not balloon one response without bound
     _PROFILE_MAX_BYTES = 64 << 20
@@ -568,6 +651,16 @@ def make_server(
         ),
         "Explain": grpc.unary_unary_rpc_method_handler(
             service.explain,
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "TenantSnapshot": grpc.unary_unary_rpc_method_handler(
+            service.tenant_snapshot,
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "TenantAdopt": grpc.unary_unary_rpc_method_handler(
+            service.tenant_adopt,
             request_deserializer=_identity,
             response_serializer=_identity,
         ),

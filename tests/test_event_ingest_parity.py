@@ -450,13 +450,15 @@ def _stream_opts():
     ]
 
 
-def _attach_stream(client, snapdir):
+def _attach_stream(client, snapdir, pod_capacity=256, node_capacity=64):
     from escalator_tpu.controller.backend import IncrementalJaxBackend
 
     backend = IncrementalJaxBackend(
         refresh_every=0, snapshot_dir=snapdir, snapshot_every=1)
-    backend.attach_event_source(client, _stream_opts(), pod_capacity=256,
-                                node_capacity=64, store_kind="numpy")
+    backend.attach_event_source(client, _stream_opts(),
+                                pod_capacity=pod_capacity,
+                                node_capacity=node_capacity,
+                                store_kind="numpy")
     return backend
 
 
@@ -514,6 +516,50 @@ def test_streaming_warm_restore_parity(tmp_path):
             assert (gd_got.decision.num_pods
                     == gd_want.decision.num_pods), t
     assert stream._cache is adopted, "first warm tick rebuilt instead of adopting"
+
+
+def test_streaming_warm_restore_smaller_checkpoint_pads_up(tmp_path):
+    """Round-20 closure of the round-18 caveat: a checkpoint SMALLER than
+    the configured store is a slot remap, not a cold start — the cluster
+    leaves pad up to the configured capacities (every new lane a hole, the
+    occupied slots keep their indices), the key tables extend with empty
+    entries, and the restart warm-adopts with full decision parity."""
+    from escalator_tpu.controller.backend import IncrementalJaxBackend
+
+    snapdir = str(tmp_path / "snaps")
+    client = make_world()
+    configs = make_configs(2)
+    states = [sem.GroupState() for _ in range(2)]
+    gi = [([], [], configs[g], states[g]) for g in range(2)]
+    now = 1_700_000_000
+
+    first = _attach_stream(client, snapdir, pod_capacity=64,
+                           node_capacity=16)
+    for t in range(2):
+        first.decide(gi, now + t)
+    first._stream._writer.drain()
+    assert first._stream._writer.checkpoints >= 1
+
+    # restart with a LARGER configured store: pre-round-20 this was the
+    # "capacities smaller than the configured store" stale cold start
+    second = _attach_stream(client, snapdir, pod_capacity=256,
+                            node_capacity=64)
+    stream = second._stream
+    assert stream._cache is not None, "pad-up restore cold-started"
+    assert stream._cache.pod_capacity == 256
+    assert stream._cache.node_capacity == 64
+
+    client.add_pod(pod("beta-growth", "beta", cpu=1200))
+    repack = IncrementalJaxBackend(refresh_every=0)
+    got = second.decide(gi, now + 60)
+    want = repack.decide(
+        relist_group_inputs(client, make_filters(), configs,
+                            [sem.GroupState() for _ in range(2)]),
+        now + 60)
+    for gd_got, gd_want in zip(got, want, strict=True):
+        assert gd_got.decision.status == gd_want.decision.status
+        assert gd_got.decision.nodes_delta == gd_want.decision.nodes_delta
+        assert gd_got.decision.num_pods == gd_want.decision.num_pods
 
 
 def test_streaming_warm_restore_sidecar_missing_cold_starts(tmp_path):

@@ -1,0 +1,325 @@
+"""Scale-out layer tests (round 20): tenant-row snapshot round-trip,
+consistent-hash router stability, warm migration through the router under
+the runtime lock witness, and the repo-hygiene guard for flight dumps.
+
+The gRPC wire path for the same machinery (TenantSnapshot/TenantAdopt RPCs,
+subprocess partitions, kill-based failover) is exercised by the scale-out
+smoke leg in bench.py — these tests stay in-process so tier-1 keeps its
+budget; the router here talks to FleetEngines through the SAME client
+surface the real ComputeClient exposes.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from escalator_tpu import observability as obs
+from escalator_tpu.analysis.registry import representative_cluster
+from escalator_tpu.fleet import (
+    DecideRequest,
+    EvictAck,
+    EvictRequest,
+    FleetEngine,
+    TenantError,
+)
+from escalator_tpu.fleet.router import (
+    PartitionRouter,
+    RouterError,
+    hash_ring_points,
+)
+from escalator_tpu.metrics import metrics
+from escalator_tpu.ops import kernel
+from escalator_tpu.ops import snapshot as snaplib
+
+NOW = 1_700_000_000
+G, P, N = 6, 24, 12
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cluster(seed: int):
+    return representative_cluster(G, P, N, seed=seed)
+
+
+def make_engine(**kw):
+    kw.setdefault("num_groups", G)
+    kw.setdefault("pod_capacity", P)
+    kw.setdefault("node_capacity", N)
+    kw.setdefault("max_tenants", 4)
+    return FleetEngine(**kw)
+
+
+def assert_column_parity(arrays, cluster, now, msg=""):
+    import jax
+
+    ref = kernel.decide_jit(jax.device_put(cluster), np.int64(now))
+    for f in kernel.GROUP_DECISION_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(arrays, f)), np.asarray(getattr(ref, f)),
+            err_msg=f"{msg}:{f}")
+
+
+# ---------------------------------------------------------------------------
+# repo hygiene: flight dumps never land in the tree
+# ---------------------------------------------------------------------------
+
+
+def test_no_flight_dumps_tracked_outside_traces():
+    """Incident flight dumps are working artifacts: .gitignore keeps them
+    out, and this guard fails LOUDLY if one is ever force-added anywhere
+    but the curated tpu_traces/ corpus."""
+    try:
+        out = subprocess.run(
+            ["git", "ls-files"], cwd=REPO_ROOT, capture_output=True,
+            text=True, timeout=30, check=True).stdout
+    except (OSError, subprocess.SubprocessError):
+        pytest.skip("git unavailable (sdist / exported tree)")
+    strays = [
+        path for path in out.splitlines()
+        if os.path.basename(path).startswith("escalator-tpu-flight-")
+        and path.endswith(".json")
+        and not path.startswith("tpu_traces/")
+    ]
+    assert not strays, (
+        f"flight dumps tracked outside tpu_traces/: {strays} — "
+        f"git rm them (incident dumps are diagnostics, not sources)")
+
+
+# ---------------------------------------------------------------------------
+# tenant-row snapshot: freeze -> serialize -> adopt round trip
+# ---------------------------------------------------------------------------
+
+
+def _dispatched_engine(tenant="t0", seed=7, ticks=2):
+    """An engine with one dispatched tenant (ticks>0, digest cache live:
+    the last tick repeats the previous cluster at a later now)."""
+    eng = make_engine()
+    cluster = tiny_cluster(seed)
+    for k in range(ticks):
+        eng.step([DecideRequest(tenant, tiny_cluster(seed), NOW + 60 * k)])
+    return eng, cluster
+
+
+def test_tenant_row_roundtrip_bit_parity():
+    eng_a, cluster = _dispatched_engine()
+    leaves, meta = eng_a.snapshot_tenant_row("t0")
+    assert meta["kind"] == snaplib.TENANT_ROW_KIND
+    assert meta["tenant"] == "t0" and meta["ticks"] == 2
+
+    # serialize -> parse: every leaf bit-identical (order state and the
+    # digest/decision cache ride as cache.* leaves when live)
+    blob = snaplib.snapshot_to_bytes(leaves, meta)
+    leaves2, meta2 = snaplib.snapshot_from_bytes(blob, label="<test>")
+    assert set(leaves2) == set(leaves)
+    for key in leaves:
+        np.testing.assert_array_equal(
+            np.asarray(leaves2[key]), np.asarray(leaves[key]),
+            err_msg=f"leaf {key}")
+    assert meta2["cache"] == meta["cache"]
+
+    # adopt on a second engine; re-freezing must reproduce the same row
+    # (freeze -> adopt -> freeze is a fixpoint, digest cache included)
+    eng_b = make_engine()
+    shard, row = eng_b.adopt_tenant_row(leaves2, meta2)
+    assert shard >= 0 and row >= 0
+    leaves3, meta3 = eng_b.snapshot_tenant_row("t0")
+    assert set(leaves3) == set(leaves)
+    for key in leaves:
+        np.testing.assert_array_equal(
+            np.asarray(leaves3[key]), np.asarray(leaves[key]),
+            err_msg=f"post-adopt leaf {key}")
+    assert meta3["cache"] == meta["cache"]
+    assert meta3["ticks"] == meta["ticks"]
+
+    # post-adopt decides stay bit-identical to the standalone decide
+    later = NOW + 600
+    [fd] = eng_b.step([DecideRequest("t0", tiny_cluster(7), later)])
+    assert_column_parity(fd.arrays, cluster, later, msg="post-adopt")
+
+
+def test_tenant_row_corrupt_rejected():
+    eng_a, _ = _dispatched_engine(seed=9)
+    leaves, meta = eng_a.snapshot_tenant_row("t0")
+    blob = bytearray(snaplib.snapshot_to_bytes(leaves, meta))
+
+    # torn payload: the container checksum rejects before any adopt
+    blob[-3] ^= 0xFF
+    with pytest.raises(snaplib.SnapshotCorruptError):
+        snaplib.snapshot_from_bytes(bytes(blob), label="<torn>")
+
+    # wrong kind: a whole-decider snapshot fed to the row-adopt path is a
+    # NAMED rejection with the corrupt outcome metric, not a shape error
+    eng_b = make_engine()
+    bad_meta = dict(meta, kind="escalator-decider-state")
+    before = metrics.snapshot_restores.labels("corrupt")._value.get()
+    with pytest.raises(snaplib.SnapshotCorruptError):
+        eng_b.adopt_tenant_row(leaves, bad_meta)
+    assert metrics.snapshot_restores.labels(
+        "corrupt")._value.get() == before + 1
+
+
+def test_tenant_row_stale_resident_rejected():
+    eng_a, _ = _dispatched_engine(seed=11)
+    leaves, meta = eng_a.snapshot_tenant_row("t0")
+    # the SOURCE engine still holds t0: adopting the row back without an
+    # evict is the split-brain shape -> stale rejection, cold path
+    before = metrics.snapshot_restores.labels("stale")._value.get()
+    with pytest.raises(TenantError):
+        eng_a.adopt_tenant_row(leaves, meta)
+    assert metrics.snapshot_restores.labels(
+        "stale")._value.get() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# router: consistent-hash stability
+# ---------------------------------------------------------------------------
+
+
+class _NullClient:
+    def __init__(self, address=""):
+        self.address = address
+
+    def close(self):
+        pass
+
+
+def test_ring_points_deterministic():
+    assert hash_ring_points("p0") == hash_ring_points("p0")
+    assert hash_ring_points("p0") != hash_ring_points("p1")
+    assert len(set(hash_ring_points("p0", 64))) == 64
+
+
+def test_router_hash_stability_under_membership_change():
+    router = PartitionRouter({"p0": "a:1", "p1": "a:2", "p2": "a:3"},
+                             client_factory=_NullClient)
+    tenants = [f"tenant-{i}" for i in range(256)]
+    before = {t: router.home(t) for t in tenants}
+    assert len(set(before.values())) == 3   # 256 keys spread over 3 parts
+
+    # add: ONLY keys landing on the new arcs move, and they move to p3
+    router.add_partition("p3", "a:4", client=_NullClient())
+    after = {t: router.home(t) for t in tenants}
+    moved = {t for t in tenants if after[t] != before[t]}
+    assert moved, "a joining partition must take some arcs"
+    assert len(moved) < len(tenants), "a join must not reshuffle the world"
+    assert all(after[t] == "p3" for t in moved)
+
+    # remove: the mapping returns to exactly the pre-join assignment
+    router.remove_partition("p3")
+    assert {t: router.home(t) for t in tenants} == before
+    router.close()
+
+
+def test_router_override_pins_home():
+    router = PartitionRouter({"p0": "a:1", "p1": "a:2"},
+                             overrides={"pinned": "p1"},
+                             client_factory=_NullClient)
+    assert router.home("pinned") == "p1"
+    # a dead override target falls back to the ring, never errors
+    router.remove_partition("p1")
+    assert router.home("pinned") == "p0"
+    router.close()
+
+
+def test_router_no_live_partitions_is_an_error():
+    router = PartitionRouter(client_factory=_NullClient)
+    with pytest.raises(RouterError):
+        router.home("anyone")
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# warm migration through the router, under the runtime lock witness
+# ---------------------------------------------------------------------------
+
+
+class _EngineClient:
+    """In-process partition: a FleetEngine behind the exact client surface
+    migrate_tenant/fail_over drive (snapshot_tenant/evict_tenant/
+    adopt_tenant returning the wire-shaped docs)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def snapshot_tenant(self, tenant_id, timeout_sec=None):
+        leaves, meta = self.engine.snapshot_tenant_row(tenant_id)
+        return snaplib.snapshot_to_bytes(leaves, meta)
+
+    def adopt_tenant(self, blob):
+        leaves, meta = snaplib.snapshot_from_bytes(blob, label="<adopt>")
+        shard, row = self.engine.adopt_tenant_row(leaves, meta)
+        return {"ok": True, "tenant": meta.get("tenant"),
+                "shard": shard, "row": row}
+
+    def evict_tenant(self, tenant_id):
+        [ack] = self.engine.step([EvictRequest(tenant_id)])
+        assert isinstance(ack, EvictAck)
+        return {"ok": True}
+
+    def close(self):
+        pass
+
+
+MIGRATION_SEQUENCE = ["migration-start", "migration-row-snapshot",
+                      "migration-evict", "migration-adopt",
+                      "migration-complete"]
+
+
+def test_warm_migration_journal_sequence_and_parity(monkeypatch):
+    # the runtime witness turns every contract-lock acquisition into a
+    # rank check: a regression in the router/engine lock order fails HERE,
+    # not in a production deadlock
+    monkeypatch.setenv("ESCALATOR_TPU_LOCK_WITNESS", "1")
+    engines = {"p0": make_engine(), "p1": make_engine()}
+    router = PartitionRouter(client_factory=_NullClient)
+    for name, eng in engines.items():
+        router.add_partition(name, f"inproc:{name}",
+                             client=_EngineClient(eng))
+    tenant = "mig-tenant"
+    src = router.home(tenant)
+    dest = "p1" if src == "p0" else "p0"
+    cluster = tiny_cluster(13)
+    for k in range(2):
+        engines[src].step([DecideRequest(tenant, tiny_cluster(13),
+                                         NOW + 60 * k)])
+
+    seq0 = obs.journal.JOURNAL.total_recorded
+    report = router.migrate_tenant(tenant, dest)
+    assert report["source"] == src and report["dest"] == dest
+    assert report["gap_ms"] > 0
+
+    # journal sequence is doc-locked (docs/scale-out.md)
+    events = [e for e in obs.journal.JOURNAL.snapshot(since_seq=seq0)
+              if e.get("tenant") == tenant]
+    kinds = [e["kind"] for e in events]
+    mig = [k for k in kinds if k in MIGRATION_SEQUENCE]
+    assert mig == MIGRATION_SEQUENCE, kinds
+
+    # the tenant now routes to dest (override pin) and decides WARM with
+    # bit-parity — zero digest divergence vs the standalone control
+    assert router.home(tenant) == dest
+    later = NOW + 600
+    [fd] = engines[dest].step([DecideRequest(tenant, tiny_cluster(13),
+                                             later)])
+    assert_column_parity(fd.arrays, cluster, later, msg="post-migration")
+    # and the source really evicted: adopting back would not be "stale"
+    with pytest.raises(TenantError):
+        engines[src].snapshot_tenant_row(tenant)
+    router.close()
+
+
+def test_migration_rejects_bad_targets():
+    engines = {"p0": make_engine(), "p1": make_engine()}
+    router = PartitionRouter(client_factory=_NullClient)
+    for name, eng in engines.items():
+        router.add_partition(name, f"inproc:{name}",
+                             client=_EngineClient(eng))
+    tenant = "t-reject"
+    home = router.home(tenant)
+    with pytest.raises(RouterError):
+        router.migrate_tenant(tenant, home)        # src == dest
+    with pytest.raises(RouterError):
+        router.migrate_tenant(tenant, "ghost")     # unknown partition
+    router.close()
